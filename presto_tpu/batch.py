@@ -1,0 +1,338 @@
+"""Columnar batch data model (reference: presto-common Page.java:33,
+block/Block.java:24, PageBuilder.java:29).
+
+A `Batch` is the unit of data flow between operators, like Presto's `Page`,
+but designed for XLA's static-shape world:
+
+- Every column is a fixed-`capacity` device array plus a validity (non-null)
+  mask. Capacities are power-of-two buckets so the set of compiled kernel
+  shapes stays small (SURVEY.md §7 step 1).
+- Row liveness is a separate `row_valid` mask: a filter just ANDs into it
+  (selection-vector execution, no compaction, no dynamic shape). Presto's
+  positionCount becomes "number of True lanes in row_valid".
+- VARCHAR columns hold int32 dictionary codes; the dictionary itself (a
+  tuple of python strings, sorted ascending so code order == collation
+  order) lives host-side in the column's static metadata. This replaces
+  Presto's DictionaryBlock (block/DictionaryBlock.java:37) and makes
+  string predicates compile to tiny device lookup tables.
+
+Batch/Column are registered pytrees so whole batches flow through jit /
+shard_map directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.types import Type, VARCHAR, BOOLEAN, DOUBLE, BIGINT
+
+MIN_CAPACITY = 16
+#: Default target rows per batch fed to kernels (like Presto's ~1MB pages).
+DEFAULT_BATCH_ROWS = 64 * 1024
+
+
+def bucket_capacity(n: int) -> int:
+    """Round up to a power of two (>= MIN_CAPACITY) to bound recompiles."""
+    cap = MIN_CAPACITY
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """One column: data + validity mask, plus static type/dictionary metadata.
+
+    `dictionary` is only set for string types: a tuple of distinct values,
+    sorted ascending, such that `data` holds indices into it. A code of -1
+    never appears for valid rows.
+    """
+
+    data: jnp.ndarray
+    mask: jnp.ndarray  # bool, True = value present (not NULL)
+    type: Type
+    dictionary: Optional[Tuple[str, ...]] = None
+
+    def tree_flatten(self):
+        return (self.data, self.mask), (self.type, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, mask = children
+        typ, dictionary = aux
+        return cls(data, mask, typ, dictionary)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def astuple(self):
+        return (self.data, self.mask)
+
+    @classmethod
+    def from_numpy(cls, values: np.ndarray, mask: Optional[np.ndarray],
+                   typ: Type, capacity: int,
+                   dictionary: Optional[Tuple[str, ...]] = None) -> "Column":
+        n = len(values)
+        assert n <= capacity
+        data = np.zeros(capacity, dtype=typ.np_dtype)
+        data[:n] = values
+        m = np.zeros(capacity, dtype=bool)
+        m[:n] = True if mask is None else mask
+        return cls(jnp.asarray(data), jnp.asarray(m), typ, dictionary)
+
+    @classmethod
+    def from_pylist(cls, values: Sequence[Any], typ: Type,
+                    capacity: Optional[int] = None) -> "Column":
+        """Build from python values; None means NULL. Strings are
+        dictionary-encoded here (sorted so codes preserve collation)."""
+        n = len(values)
+        capacity = capacity or bucket_capacity(n)
+        mask = np.array([v is not None for v in values], dtype=bool)
+        if typ.is_string:
+            present = sorted({v for v in values if v is not None})
+            dictionary = tuple(present)
+            index = {v: i for i, v in enumerate(present)}
+            data = np.array([index[v] if v is not None else 0 for v in values],
+                            dtype=np.int32)
+            return cls.from_numpy(data, mask, typ, capacity, dictionary)
+        if typ.is_decimal:
+            data = np.array(
+                [_to_unscaled(v, typ.scale) if v is not None else 0
+                 for v in values], dtype=np.int64)
+            return cls.from_numpy(data, mask, typ, capacity)
+        data = np.array([v if v is not None else 0 for v in values],
+                        dtype=typ.np_dtype)
+        return cls.from_numpy(data, mask, typ, capacity)
+
+    def to_pylist(self, row_valid: Optional[np.ndarray] = None) -> List[Any]:
+        data = np.asarray(self.data)
+        mask = np.asarray(self.mask)
+        n = self.capacity
+        rows = range(n) if row_valid is None else np.nonzero(row_valid)[0]
+        out: List[Any] = []
+        for i in rows:
+            if not mask[i]:
+                out.append(None)
+            elif self.dictionary is not None:
+                out.append(self.dictionary[int(data[i])])
+            elif self.type.is_decimal:
+                out.append(int(data[i]) / (10 ** self.type.scale))
+            elif self.type.name == "boolean":
+                out.append(bool(data[i]))
+            elif self.type.is_floating:
+                out.append(float(data[i]))
+            else:
+                out.append(int(data[i]))
+        return out
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Batch:
+    """An ordered set of named columns sharing `row_valid` (cf. Page.java:33).
+
+    Invariants: all columns and row_valid share the same capacity; column
+    order is meaningful (operators address columns by name, output order is
+    the dict insertion order).
+    """
+
+    columns: Dict[str, Column]
+    row_valid: jnp.ndarray  # bool[capacity]
+
+    def tree_flatten(self):
+        names = tuple(self.columns.keys())
+        children = tuple(self.columns[n] for n in names) + (self.row_valid,)
+        return children, names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        cols = dict(zip(names, children[:-1]))
+        return cls(cols, children[-1])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.row_valid.shape[0])
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def num_valid(self) -> int:
+        """Host-syncing count of live rows (Presto's positionCount)."""
+        return int(jnp.sum(self.row_valid))
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_pydict(cls, data: Dict[str, Tuple[Sequence[Any], Type]],
+                    capacity: Optional[int] = None) -> "Batch":
+        lengths = {len(v) for v, _ in data.values()}
+        assert len(lengths) == 1, "all columns must have equal length"
+        n = lengths.pop()
+        capacity = capacity or bucket_capacity(n)
+        cols = {name: Column.from_pylist(vals, typ, capacity)
+                for name, (vals, typ) in data.items()}
+        rv = np.zeros(capacity, dtype=bool)
+        rv[:n] = True
+        return cls(cols, jnp.asarray(rv))
+
+    @classmethod
+    def from_numpy(cls, arrays: Dict[str, np.ndarray],
+                   types: Dict[str, Type],
+                   masks: Optional[Dict[str, np.ndarray]] = None,
+                   dictionaries: Optional[Dict[str, Tuple[str, ...]]] = None,
+                   capacity: Optional[int] = None) -> "Batch":
+        n = len(next(iter(arrays.values())))
+        capacity = capacity or bucket_capacity(n)
+        cols = {}
+        for name, arr in arrays.items():
+            mask = masks.get(name) if masks else None
+            dic = dictionaries.get(name) if dictionaries else None
+            cols[name] = Column.from_numpy(arr, mask, types[name], capacity, dic)
+        rv = np.zeros(capacity, dtype=bool)
+        rv[:n] = True
+        return cls(cols, jnp.asarray(rv))
+
+    # -- host-side materialization ----------------------------------------
+
+    def to_pydict(self) -> Dict[str, List[Any]]:
+        rv = np.asarray(self.row_valid)
+        return {name: col.to_pylist(rv) for name, col in self.columns.items()}
+
+    def to_pylist(self) -> List[Tuple[Any, ...]]:
+        d = self.to_pydict()
+        if not d:
+            return [()] * int(np.sum(np.asarray(self.row_valid)))
+        return list(zip(*d.values()))
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame(self.to_pydict())
+
+    # -- transformations ---------------------------------------------------
+
+    def with_columns(self, columns: Dict[str, Column]) -> "Batch":
+        return Batch(columns, self.row_valid)
+
+    def select(self, names: Sequence[str]) -> "Batch":
+        return Batch({n: self.columns[n] for n in names}, self.row_valid)
+
+    def rename(self, mapping: Dict[str, str]) -> "Batch":
+        return Batch({mapping.get(n, n): c for n, c in self.columns.items()},
+                     self.row_valid)
+
+    def filter(self, keep: jnp.ndarray) -> "Batch":
+        """Selection-vector filter: just narrows row_valid. O(n) mask AND."""
+        return Batch(self.columns, self.row_valid & keep)
+
+    def compact(self, capacity: Optional[int] = None) -> "Batch":
+        """Pack live rows to the front; optionally resize to `capacity`.
+
+        Used at rebatch points (before joins/output) where padding waste
+        matters; the hot filter path never compacts. Shrinking syncs to
+        the host to check the live rows fit.
+        """
+        out = _compact(self)
+        if capacity is None or capacity == self.capacity:
+            return out
+        if capacity < self.capacity:
+            n = out.num_valid()
+            assert n <= capacity, f"compact overflow: {n} > {capacity}"
+            cols = {name: Column(c.data[:capacity], c.mask[:capacity],
+                                 c.type, c.dictionary)
+                    for name, c in out.columns.items()}
+            return Batch(cols, out.row_valid[:capacity])
+        pad = capacity - self.capacity
+        cols = {name: Column(jnp.pad(c.data, (0, pad)),
+                             jnp.pad(c.mask, (0, pad)), c.type, c.dictionary)
+                for name, c in out.columns.items()}
+        return Batch(cols, jnp.pad(out.row_valid, (0, pad)))
+
+    @staticmethod
+    def concat(batches: Sequence["Batch"], capacity: int) -> "Batch":
+        """Concatenate live rows of compatible batches into one batch."""
+        assert batches
+        compacted = [b.compact(b.capacity) for b in batches]
+        counts = [b.num_valid() for b in compacted]
+        total = sum(counts)
+        assert total <= capacity, f"concat overflow: {total} > {capacity}"
+        names = compacted[0].names
+        cols: Dict[str, Column] = {}
+        for name in names:
+            parts_d, parts_m = [], []
+            typ = compacted[0].columns[name].type
+            dic = compacted[0].columns[name].dictionary
+            for b, cnt in zip(compacted, counts):
+                c = b.columns[name]
+                if c.dictionary != dic:
+                    raise ValueError(
+                        f"concat with mismatched dictionaries on {name!r}; "
+                        "unify dictionaries first")
+                parts_d.append(np.asarray(c.data)[:cnt])
+                parts_m.append(np.asarray(c.mask)[:cnt])
+            data = np.zeros(capacity, dtype=typ.np_dtype)
+            mask = np.zeros(capacity, dtype=bool)
+            if total:
+                data[:total] = np.concatenate(parts_d)
+                mask[:total] = np.concatenate(parts_m)
+            cols[name] = Column(jnp.asarray(data), jnp.asarray(mask), typ, dic)
+        rv = np.zeros(capacity, dtype=bool)
+        rv[:total] = True
+        return Batch(cols, jnp.asarray(rv))
+
+
+@jax.jit
+def _compact(batch: Batch) -> Batch:
+    order = jnp.argsort(~batch.row_valid, stable=True)
+    cols = {
+        n: Column(c.data[order], c.mask[order] & batch.row_valid[order],
+                  c.type, c.dictionary)
+        for n, c in batch.columns.items()
+    }
+    return Batch(cols, batch.row_valid[order])
+
+
+def unify_dictionaries(cols: Sequence[Column]) -> List[Column]:
+    """Re-encode string columns onto a shared sorted dictionary so their
+    codes are directly comparable (needed before joins/set-ops on VARCHAR).
+    Host-side; O(total dictionary size)."""
+    for c in cols:
+        if c.dictionary is None:
+            raise ValueError(
+                "unify_dictionaries: string column without a dictionary; "
+                "from_numpy callers must supply one for varchar columns")
+    merged = sorted(set().union(*[set(c.dictionary) for c in cols]))
+    dic = tuple(merged)
+    index = {v: i for i, v in enumerate(merged)}
+    out = []
+    for c in cols:
+        if c.dictionary == dic:
+            out.append(Column(c.data, c.mask, c.type, dic))
+            continue
+        remap = np.array([index[v] for v in c.dictionary] or [0],
+                         dtype=np.int32)
+        out.append(Column(jnp.asarray(remap)[c.data], c.mask, c.type, dic))
+    return out
+
+
+def _to_unscaled(v, scale: int) -> int:
+    """Exact decimal encoding: ints and Decimals never pass through float."""
+    import decimal as _dec
+    if isinstance(v, bool):
+        raise TypeError("boolean is not a decimal value")
+    if isinstance(v, int):
+        return v * (10 ** scale)
+    if isinstance(v, _dec.Decimal):
+        return int((v * (10 ** scale)).to_integral_value(
+            rounding=_dec.ROUND_HALF_UP))
+    return int(round(float(v) * (10 ** scale)))

@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+This is the direct analog of the reference's in-JVM DistributedQueryRunner
+(presto-tests DistributedQueryRunner.java:85): real multi-device semantics,
+one host, no hardware requirement (SURVEY.md §4 adoption note (c)).
+Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+# Hard-force the CPU backend: the host environment preloads the axon TPU
+# plugin (JAX_PLATFORMS=axon, PYTHONPATH=/root/.axon_site) whose discovery
+# can hang on a flaky tunnel even when cpu is selected. Tests must never
+# depend on the tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PYTHONPATH"] = ""
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
