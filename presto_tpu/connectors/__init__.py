@@ -1,0 +1,9 @@
+"""Connector SPI + built-in connectors (reference: presto-spi
+spi/connector/ interfaces; SURVEY.md LX). Connectors are plain Python
+classes registered with the catalog manager; the tpch connector is the
+deterministic-data workhorse the test pyramid keys off (SURVEY.md §4)."""
+
+from presto_tpu.connectors.spi import (
+    Connector, ConnectorMetadata, ConnectorSplitManager, Split,
+    ConnectorPageSource, TableHandle,
+)

@@ -1,0 +1,80 @@
+"""Connector SPI (reference: presto-spi spi/connector/ —
+ConnectorMetadata.java:65, ConnectorSplitManager.java:23,
+ConnectorPageSourceProvider.java:25, Plugin.java:32).
+
+A Connector provides: metadata (tables/schemas), splits (units of
+parallel scan), and page sources (split -> stream of Batches). The
+scheduler assigns splits to workers/devices; page sources generate or
+read data directly into device arrays.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from presto_tpu.batch import Batch
+from presto_tpu.schema import RelationSchema
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHandle:
+    catalog: str
+    schema: str
+    table: str
+
+    def __str__(self):
+        return f"{self.catalog}.{self.schema}.{self.table}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """A unit of scan parallelism (reference: spi ConnectorSplit).
+    `info` is connector-private (e.g. a row range)."""
+    table: TableHandle
+    info: Any
+    # hint for placement on a mesh axis (connector bucketing, P10)
+    partition: Optional[int] = None
+
+
+class ConnectorMetadata(abc.ABC):
+    @abc.abstractmethod
+    def list_schemas(self) -> List[str]: ...
+
+    @abc.abstractmethod
+    def list_tables(self, schema: str) -> List[str]: ...
+
+    @abc.abstractmethod
+    def get_table_schema(self, handle: TableHandle) -> RelationSchema: ...
+
+
+class ConnectorSplitManager(abc.ABC):
+    @abc.abstractmethod
+    def get_splits(self, handle: TableHandle,
+                   target_splits: int) -> List[Split]: ...
+
+
+class ConnectorPageSource(abc.ABC):
+    """Produces batches for one split (reference:
+    spi ConnectorPageSource.java:22)."""
+
+    @abc.abstractmethod
+    def batches(self, split: Split, columns: Sequence[str],
+                batch_rows: int) -> Iterator[Batch]: ...
+
+
+class Connector(abc.ABC):
+    name: str
+
+    @property
+    @abc.abstractmethod
+    def metadata(self) -> ConnectorMetadata: ...
+
+    @property
+    @abc.abstractmethod
+    def split_manager(self) -> ConnectorSplitManager: ...
+
+    @property
+    @abc.abstractmethod
+    def page_source(self) -> ConnectorPageSource: ...

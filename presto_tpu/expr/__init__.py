@@ -1,0 +1,13 @@
+"""Expression layer: RowExpression IR compiled to jax-traceable functions.
+
+This replaces the reference's runtime bytecode generation
+(presto-bytecode + presto-main sql/gen/ExpressionCompiler.java:56,
+PageFunctionCompiler.java:118): instead of emitting JVM classes, an
+expression tree is compiled into a pure function over (data, mask)
+column pairs, which XLA then fuses into the surrounding kernel.
+"""
+
+from presto_tpu.expr.ir import (
+    RowExpression, Literal, InputRef, Call, SpecialForm, lit, ref, call,
+)
+from presto_tpu.expr.compile import compile_expression, fold_constants

@@ -1,0 +1,848 @@
+"""Compile RowExpressions into jax-traceable functions.
+
+This is the XLA replacement for the reference's expression codegen
+(sql/gen/PageFunctionCompiler.java:118): a fully-typed RowExpression tree
+becomes a closure `env -> (data, mask)` over `{name: (data, mask)}`
+column environments. XLA fuses the whole tree (plus the surrounding
+filter/project kernel) into one program — there is no interpreter at
+batch time.
+
+Null semantics: every value is a (data, mask) pair, mask True = present.
+Functions default to "null if any input null" (the reference's
+RETURN_NULL_ON_NULL calling convention); AND/OR implement Kleene
+three-valued logic; IF/CASE treat NULL conditions as false.
+
+Strings: VARCHAR data is dictionary codes. String predicates (LIKE, IN,
+comparisons against literals) are evaluated host-side over the (tiny,
+static) dictionary at *compile* time, becoming boolean/int lookup tables
+the device just gathers from. String-producing functions (substr, upper,
+...) map the dictionary host-side and re-encode codes through a remap
+table, preserving the sorted-unique dictionary invariant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.expr import dates as D
+from presto_tpu.expr.ir import (
+    Call, InputRef, Literal, RowExpression, SpecialForm,
+)
+from presto_tpu.schema import ColumnSchema
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, INTERVAL_DAY, INTERVAL_YEAR,
+    REAL, Type, UNKNOWN, VARCHAR, decimal_type,
+)
+
+CVal = Tuple[jnp.ndarray, jnp.ndarray]  # (data, mask)
+Env = Dict[str, CVal]
+
+
+@dataclasses.dataclass
+class CompiledExpr:
+    """fn(env) -> (data, mask); `dictionary` set when type is a string."""
+    fn: Callable[[Env], CVal]
+    type: Type
+    dictionary: Optional[Tuple[str, ...]] = None
+
+
+class ExpressionCompileError(Exception):
+    pass
+
+
+def compile_expression(expr: RowExpression,
+                       schema: Dict[str, ColumnSchema]) -> CompiledExpr:
+    return _Compiler(schema).compile(expr)
+
+
+# ---------------------------------------------------------------------------
+
+_TRUE = (jnp.asarray(True), jnp.asarray(True))
+
+
+def _scalar(value, typ: Type) -> CVal:
+    if value is None:
+        return (jnp.zeros((), typ.np_dtype), jnp.asarray(False))
+    return (jnp.asarray(value, typ.np_dtype), jnp.asarray(True))
+
+
+def _like_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    out = []
+    i = 0
+    esc = escape
+    while i < len(pattern):
+        ch = pattern[i]
+        if esc and ch == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+class _Compiler:
+    def __init__(self, schema: Dict[str, ColumnSchema]):
+        self.schema = schema
+
+    def compile(self, expr: RowExpression) -> CompiledExpr:
+        if isinstance(expr, Literal):
+            return self._literal(expr)
+        if isinstance(expr, InputRef):
+            return self._input(expr)
+        if isinstance(expr, SpecialForm):
+            return self._special(expr)
+        if isinstance(expr, Call):
+            return self._call(expr)
+        raise ExpressionCompileError(f"unknown expression node: {expr!r}")
+
+    # -- leaves ------------------------------------------------------------
+
+    def _literal(self, e: Literal) -> CompiledExpr:
+        if e.type.is_string:
+            # A bare string literal only materializes through a parent that
+            # consumes it (comparison/LIKE/IN); encode as 1-value dictionary.
+            if e.value is None:
+                return CompiledExpr(lambda env: _scalar(None, e.type),
+                                    e.type, ())
+            return CompiledExpr(lambda env: _scalar(0, e.type),
+                                e.type, (e.value,))
+        val = e.value
+        return CompiledExpr(lambda env: _scalar(val, e.type), e.type)
+
+    def _input(self, e: InputRef) -> CompiledExpr:
+        cs = self.schema.get(e.name)
+        if cs is None:
+            raise ExpressionCompileError(f"unknown input column {e.name!r}")
+        name = e.name
+        return CompiledExpr(lambda env: env[name], cs.type, cs.dictionary)
+
+    # -- special forms -----------------------------------------------------
+
+    def _special(self, e: SpecialForm) -> CompiledExpr:
+        form = e.form
+        if form == "and":
+            parts = [self.compile(a) for a in e.args]
+
+            def f_and(env):
+                d, m = _TRUE
+                for p in parts:
+                    pd, pm = p.fn(env)
+                    # Kleene: false wins over null
+                    new_d = d & pd
+                    new_m = (m & pm) | (m & ~d) | (pm & ~pd)
+                    d, m = new_d, new_m
+                return d, m
+            return CompiledExpr(f_and, BOOLEAN)
+        if form == "or":
+            parts = [self.compile(a) for a in e.args]
+
+            def f_or(env):
+                d = jnp.asarray(False)
+                m = jnp.asarray(True)
+                for p in parts:
+                    pd, pm = p.fn(env)
+                    new_d = d | pd
+                    new_m = (m & pm) | (m & d) | (pm & pd)
+                    d, m = new_d, new_m
+                return d, m
+            return CompiledExpr(f_or, BOOLEAN)
+        if form == "not":
+            a = self.compile(e.args[0])
+
+            def f_not(env):
+                d, m = a.fn(env)
+                return ~d, m
+            return CompiledExpr(f_not, BOOLEAN)
+        if form == "is_null":
+            a = self.compile(e.args[0])
+            return CompiledExpr(
+                lambda env: (~a.fn(env)[1], jnp.asarray(True)), BOOLEAN)
+        if form == "is_not_null":
+            a = self.compile(e.args[0])
+            return CompiledExpr(
+                lambda env: (a.fn(env)[1], jnp.asarray(True)), BOOLEAN)
+        if form == "if":
+            cond = self.compile(e.args[0])
+            then = self.compile(e.args[1])
+            els = self.compile(e.args[2])
+            dic = _merge_result_dicts(e.type, then, els)
+            if dic is not None:
+                then = _remap_to(then, dic)
+                els = _remap_to(els, dic)
+
+            def f_if(env):
+                cd, cm = cond.fn(env)
+                take_then = cd & cm  # NULL condition -> false branch
+                td, tm = then.fn(env)
+                ed, em = els.fn(env)
+                td, ed = _common_broadcast(td, ed)
+                tm, em = _common_broadcast(tm, em)
+                return (jnp.where(take_then, td, ed),
+                        jnp.where(take_then, tm, em))
+            return CompiledExpr(f_if, e.type, dic)
+        if form == "coalesce":
+            parts = [self.compile(a) for a in e.args]
+            dic = _merge_result_dicts(e.type, *parts)
+            if dic is not None:
+                parts = [_remap_to(p, dic) for p in parts]
+
+            def f_coalesce(env):
+                d, m = parts[0].fn(env)
+                for p in parts[1:]:
+                    pd, pm = p.fn(env)
+                    d, pd = _common_broadcast(d, pd)
+                    m, pm = _common_broadcast(m, pm)
+                    d = jnp.where(m, d, pd)
+                    m = m | pm
+                return d, m
+            return CompiledExpr(f_coalesce, e.type, dic)
+        if form == "between":
+            lo = Call("greater_than_or_equal", (e.args[0], e.args[1]), BOOLEAN)
+            hi = Call("less_than_or_equal", (e.args[0], e.args[2]), BOOLEAN)
+            return self._special(SpecialForm("and", (lo, hi), BOOLEAN))
+        if form == "in":
+            return self._in(e)
+        if form == "cast":
+            return self._cast(e)
+        raise ExpressionCompileError(f"unsupported special form {form!r}")
+
+    def _in(self, e: SpecialForm) -> CompiledExpr:
+        value = self.compile(e.args[0])
+        items = e.args[1:]
+        if value.type.is_string:
+            if not all(isinstance(i, Literal) for i in items):
+                raise ExpressionCompileError(
+                    "IN over varchar requires literal list")
+            dic = value.dictionary or ()
+            wanted = {i.value for i in items}
+            table = np.array([v in wanted for v in dic] or [False], bool)
+            tbl = jnp.asarray(table)
+            fn = value.fn
+            return CompiledExpr(lambda env: _apply_lookup(fn, tbl, env),
+                                BOOLEAN)
+        parts = [self.compile(i) for i in items]
+
+        def f_in(env):
+            vd, vm = value.fn(env)
+            hit = jnp.zeros_like(vd, dtype=bool)
+            any_null = jnp.zeros_like(vd, dtype=bool)
+            for p in parts:
+                pd, pm = p.fn(env)
+                hit = hit | ((vd == pd) & pm)
+                any_null = any_null | ~pm
+            # x IN (...) is NULL if no hit and some item was NULL
+            return hit, vm & (hit | ~any_null)
+        return CompiledExpr(f_in, BOOLEAN)
+
+    def _cast(self, e: SpecialForm) -> CompiledExpr:
+        src = self.compile(e.args[0])
+        to = e.type
+        frm = src.type
+        if frm == to:
+            return src
+        if to.is_string and frm.is_string:
+            return CompiledExpr(src.fn, to, src.dictionary)
+        if frm.is_string:
+            # cast(varchar as T): parse the dictionary host-side.
+            dic = src.dictionary or ()
+            if to == DATE:
+                vals = np.array([D.parse_date_literal(v) for v in dic]
+                                or [0], np.int32)
+            elif to.is_decimal:
+                from presto_tpu.batch import _to_unscaled
+                vals = np.array([_to_unscaled(float(v), to.scale)
+                                 for v in dic] or [0], np.int64)
+            elif to.is_numeric:
+                vals = np.array([float(v) for v in dic] or [0],
+                                to.np_dtype)
+            else:
+                raise ExpressionCompileError(f"cast varchar -> {to}")
+            tbl = jnp.asarray(vals)
+            fn = src.fn
+            return CompiledExpr(
+                lambda env: _apply_lookup(fn, tbl, env), to)
+        if to.is_string:
+            raise ExpressionCompileError(
+                f"cast {frm} -> varchar not yet supported")
+
+        def f_cast(env):
+            d, m = src.fn(env)
+            return _cast_data(d, frm, to), m
+        return CompiledExpr(f_cast, to)
+
+    # -- calls -------------------------------------------------------------
+
+    def _call(self, e: Call) -> CompiledExpr:
+        name = e.name
+        args = [self.compile(a) for a in e.args]
+
+        if name in _COMPARISONS:
+            return self._comparison(name, e, args)
+        if name == "like":
+            return self._like(e, args)
+        if name in _STRING_TO_STRING or name in _STRING_TO_INT:
+            return self._string_fn(name, e, args)
+        if name in ("add", "subtract", "multiply", "divide", "modulus"):
+            return self._arith(name, e, args)
+        if name == "negate":
+            a = args[0]
+
+            def f_neg(env):
+                d, m = a.fn(env)
+                return -d, m
+            return CompiledExpr(f_neg, e.type)
+        if name in _MATH_FNS:
+            impl = _MATH_FNS[name]
+            typed = _numeric_prep(args)
+
+            def f_math(env, impl=impl, typed=typed):
+                vals = [t(env) for t in typed]
+                m = vals[0][1]
+                for _, pm in vals[1:]:
+                    m = m & pm
+                return impl(*[v for v, _ in vals]), m
+            return CompiledExpr(f_math, e.type)
+        if name in _DATE_EXTRACT:
+            impl = _DATE_EXTRACT[name]
+            a = args[0]
+
+            def f_date(env, impl=impl, a=a):
+                d, m = a.fn(env)
+                return impl(d).astype(jnp.int64), m
+            return CompiledExpr(f_date, BIGINT)
+        if name == "nullif":
+            a, b = args
+
+            def f_nullif(env):
+                ad, am = a.fn(env)
+                bd, bm = b.fn(env)
+                eq = (ad == bd) & am & bm
+                return ad, am & ~eq
+            return CompiledExpr(f_nullif, e.type, a.dictionary)
+        if name in ("greatest", "least"):
+            cmpf = jnp.maximum if name == "greatest" else jnp.minimum
+
+            def f_gl(env):
+                vals = [a.fn(env) for a in args]
+                d = vals[0][0]
+                m = vals[0][1]
+                for vd, vm in vals[1:]:
+                    d = cmpf(d, vd)
+                    m = m & vm
+                return d, m
+            return CompiledExpr(f_gl, e.type)
+        if name == "hash_code":
+            parts = args
+
+            def f_hash(env):
+                h = None
+                for p in parts:
+                    d, m = p.fn(env)
+                    h_i = _hash64(d, m)
+                    h = h_i if h is None else _combine_hash(h, h_i)
+                return h, jnp.asarray(True)
+            return CompiledExpr(f_hash, BIGINT)
+        raise ExpressionCompileError(f"unknown scalar function {name!r}")
+
+    def _comparison(self, name: str, e: Call, args) -> CompiledExpr:
+        a, b = args
+        if a.type.is_string or b.type.is_string:
+            return self._string_comparison(name, a, b)
+        op = _COMPARISONS[name]
+        fa, fb = _coerce_pair(a, b)
+
+        def f_cmp(env):
+            ad, am = fa(env)
+            bd, bm = fb(env)
+            return op(ad, bd), am & bm
+        return CompiledExpr(f_cmp, BOOLEAN)
+
+    def _string_comparison(self, name: str, a: CompiledExpr,
+                           b: CompiledExpr) -> CompiledExpr:
+        # literal vs column: compare codes against the literal's rank in
+        # the (sorted) dictionary — no device strings ever.
+        op = _COMPARISONS[name]
+        a_lit = a.dictionary is not None and len(a.dictionary) == 1
+        b_lit = b.dictionary is not None and len(b.dictionary) == 1
+        if a_lit and b_lit:
+            # constant fold: both sides are single-value dictionaries
+            va, vb = a.dictionary[0], b.dictionary[0]
+            result = {"equal": va == vb, "not_equal": va != vb,
+                      "less_than": va < vb, "less_than_or_equal": va <= vb,
+                      "greater_than": va > vb,
+                      "greater_than_or_equal": va >= vb}[name]
+            fa, fb = a.fn, b.fn
+
+            def f_const(env):
+                _, am = fa(env)
+                _, bm = fb(env)
+                return jnp.asarray(result), am & bm
+            return CompiledExpr(f_const, BOOLEAN)
+        if b.dictionary is not None and len(b.dictionary) == 1 \
+                and a.dictionary is not None and len(a.dictionary) != 1:
+            lit_val = b.dictionary[0]
+            dic = a.dictionary
+            import bisect
+            pos = bisect.bisect_left(dic, lit_val)
+            present = pos < len(dic) and dic[pos] == lit_val
+            fn = a.fn
+            if name in ("equal", "not_equal"):
+                if not present:
+                    const = name == "not_equal"
+                    return CompiledExpr(
+                        lambda env: (jnp.full_like(fn(env)[0], const,
+                                                   dtype=bool), fn(env)[1]),
+                        BOOLEAN)
+                code = pos
+
+                def f_eq(env):
+                    d, m = fn(env)
+                    r = d == code
+                    return (r if name == "equal" else ~r), m
+                return CompiledExpr(f_eq, BOOLEAN)
+            # range comparisons: codes order == collation order
+            boundary = pos if present else pos  # insertion point
+
+            def f_range(env):
+                d, m = fn(env)
+                if present:
+                    return op(d, boundary), m
+                # literal not in dict: d < boundary <=> value < literal
+                if name in ("less_than", "less_than_or_equal"):
+                    return d < boundary, m
+                return d >= boundary, m
+            return CompiledExpr(f_range, BOOLEAN)
+        if a.dictionary is not None and len(a.dictionary) == 1:
+            flipped = {"less_than": "greater_than",
+                       "greater_than": "less_than",
+                       "less_than_or_equal": "greater_than_or_equal",
+                       "greater_than_or_equal": "less_than_or_equal",
+                       "equal": "equal", "not_equal": "not_equal"}[name]
+            return self._string_comparison(flipped, b, a)
+        if a.dictionary is not None and a.dictionary == b.dictionary:
+            fa, fb = a.fn, b.fn
+
+            def f_cc(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                return op(ad, bd), am & bm
+            return CompiledExpr(f_cc, BOOLEAN)
+        raise ExpressionCompileError(
+            "varchar comparison requires a shared dictionary "
+            "(planner must unify dictionaries first)")
+
+    def _like(self, e: Call, args) -> CompiledExpr:
+        col = args[0]
+        pat = e.args[1]
+        esc = None
+        if len(e.args) > 2:
+            if not isinstance(e.args[2], Literal):
+                raise ExpressionCompileError("LIKE escape must be literal")
+            esc = e.args[2].value
+        if not isinstance(pat, Literal):
+            raise ExpressionCompileError("LIKE pattern must be literal")
+        rx = re.compile(_like_to_regex(pat.value, esc))
+        dic = col.dictionary or ()
+        table = np.array([rx.match(v) is not None for v in dic] or [False],
+                         bool)
+        tbl = jnp.asarray(table)
+        fn = col.fn
+        return CompiledExpr(lambda env: _apply_lookup(fn, tbl, env), BOOLEAN)
+
+    def _string_fn(self, name: str, e: Call, args) -> CompiledExpr:
+        col = args[0]
+        dic = col.dictionary or ()
+        lit_args = []
+        for a in e.args[1:]:
+            if not isinstance(a, Literal):
+                raise ExpressionCompileError(
+                    f"{name}: non-leading arguments must be literals")
+            lit_args.append(a.value)
+        if name in _STRING_TO_INT:
+            impl = _STRING_TO_INT[name]
+            vals = np.array([impl(v, *lit_args) for v in dic] or [0],
+                            np.int64)
+            tbl = jnp.asarray(vals)
+            fn = col.fn
+            return CompiledExpr(
+                lambda env: _apply_lookup(fn, tbl, env), BIGINT)
+        impl = _STRING_TO_STRING[name]
+        mapped = [impl(v, *lit_args) for v in dic]
+        new_dic = tuple(sorted(set(mapped)))
+        index = {v: i for i, v in enumerate(new_dic)}
+        remap = np.array([index[v] for v in mapped] or [0], np.int32)
+        tbl = jnp.asarray(remap)
+        fn = col.fn
+        return CompiledExpr(lambda env: _apply_lookup(fn, tbl, env),
+                            VARCHAR, new_dic)
+
+    def _arith(self, name: str, e: Call, args) -> CompiledExpr:
+        a, b = args
+        out = e.type
+        if out.is_decimal or a.type.is_decimal or b.type.is_decimal:
+            return self._decimal_arith(name, e, a, b)
+        fa, fb = _coerce_pair(a, b)
+        if name == "divide" and out.is_integer:
+            def f_idiv(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                safe = jnp.where(bd == 0, 1, bd)
+                q = jnp.sign(ad) * jnp.sign(bd) * (abs(ad) // abs(safe))
+                return q.astype(out.np_dtype), am & bm & (bd != 0)
+            return CompiledExpr(f_idiv, out)
+        if name == "modulus" and out.is_integer:
+            def f_imod(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                safe = jnp.where(bd == 0, 1, bd)
+                r = jnp.sign(ad) * (abs(ad) % abs(safe))
+                return r.astype(out.np_dtype), am & bm & (bd != 0)
+            return CompiledExpr(f_imod, out)
+        op = {"add": jnp.add, "subtract": jnp.subtract,
+              "multiply": jnp.multiply, "divide": jnp.divide,
+              "modulus": jnp.mod}[name]
+        # date +/- interval day stays a date
+        if a.type == DATE and b.type == INTERVAL_DAY:
+            fa2, fb2 = a.fn, b.fn
+            sign = 1 if name == "add" else -1
+
+            def f_dint(env):
+                ad, am = fa2(env)
+                bd, bm = fb2(env)
+                return (ad.astype(jnp.int64)
+                        + sign * (bd // 86_400_000)).astype(np.int32), am & bm
+            return CompiledExpr(f_dint, DATE)
+        if a.type == DATE and b.type == INTERVAL_YEAR:
+            fa2, fb2 = a.fn, b.fn
+            sign = 1 if name == "add" else -1
+
+            def f_dy(env):
+                ad, am = fa2(env)
+                bd, bm = fb2(env)
+                y, m_, d_ = D.civil_from_days(ad)
+                months = y * 12 + (m_ - 1) + sign * bd
+                ny = jnp.floor_divide(months, 12)
+                nm = months - ny * 12 + 1
+                # clamp day to the target month's last day (Presto rule)
+                next_m = jnp.where(nm == 12, 1, nm + 1)
+                next_y = jnp.where(nm == 12, ny + 1, ny)
+                days_in_month = (D.days_from_civil(next_y, next_m, 1)
+                                 - D.days_from_civil(ny, nm, 1))
+                return D.days_from_civil(
+                    ny, nm, jnp.minimum(d_, days_in_month)) \
+                    .astype(np.int32), am & bm
+            return CompiledExpr(f_dy, DATE)
+
+        def f_arith(env):
+            ad, am = fa(env)
+            bd, bm = fb(env)
+            m = am & bm
+            if name in ("divide", "modulus"):
+                bd_safe = jnp.where(bd == 0, 1, bd) \
+                    if out.is_integer else bd
+                r = op(ad, bd_safe)
+                return r.astype(out.np_dtype), m
+            return op(ad, bd).astype(out.np_dtype), m
+        return CompiledExpr(f_arith, out)
+
+    def _decimal_arith(self, name, e, a, b) -> CompiledExpr:
+        out = e.type
+        if not out.is_decimal:
+            # decimal op double -> double
+            fa, fb = _coerce_pair(a, b)
+            op = {"add": jnp.add, "subtract": jnp.subtract,
+                  "multiply": jnp.multiply, "divide": jnp.divide,
+                  "modulus": jnp.mod}[name]
+
+            def f_dd(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                return op(ad, bd).astype(out.np_dtype), am & bm
+            return CompiledExpr(f_dd, out)
+        sa = a.type.scale if a.type.is_decimal else 0
+        sb = b.type.scale if b.type.is_decimal else 0
+        so = out.scale
+        fa, fb = a.fn, b.fn
+
+        def to_unscaled(d, typ, target_scale):
+            if typ.is_decimal:
+                shift = target_scale - typ.scale
+            else:
+                shift = target_scale
+            d = d.astype(jnp.int64)
+            if shift > 0:
+                return d * (10 ** shift)
+            return d
+
+        if name in ("add", "subtract"):
+            s = max(sa, sb)
+            op = jnp.add if name == "add" else jnp.subtract
+
+            def f_as(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                r = op(to_unscaled(ad, a.type, s), to_unscaled(bd, b.type, s))
+                return _rescale(r, s, so), am & bm
+            return CompiledExpr(f_as, out)
+        if name == "multiply":
+            s = sa + sb
+
+            def f_mul(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                r = ad.astype(jnp.int64) * bd.astype(jnp.int64)
+                return _rescale(r, s, so), am & bm
+            return CompiledExpr(f_mul, out)
+        if name == "divide":
+            # result = a / b at scale so, HALF_UP
+            shift = so + sb - sa
+
+            def f_div(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                num = ad.astype(jnp.int64) * (10 ** max(shift, 0))
+                den = bd.astype(jnp.int64) * (10 ** max(-shift, 0))
+                ok = den != 0
+                den_s = jnp.where(ok, den, 1)
+                q = _div_half_up(num, den_s)
+                return q, am & bm & ok
+            return CompiledExpr(f_div, out)
+        if name == "modulus":
+            s = max(sa, sb)
+
+            def f_mod(env):
+                ad, am = fa(env)
+                bd, bm = fb(env)
+                an = to_unscaled(ad, a.type, s)
+                bn = to_unscaled(bd, b.type, s)
+                ok = bn != 0
+                bs = jnp.where(ok, bn, 1)
+                r = jnp.sign(an) * (abs(an) % abs(bs))
+                return _rescale(r, s, so), am & bm & ok
+            return CompiledExpr(f_mod, out)
+        raise ExpressionCompileError(f"decimal op {name}")
+
+
+# -- helpers ----------------------------------------------------------------
+
+def _common_broadcast(a, b):
+    """Broadcast two arrays (either may be scalar) to a common shape."""
+    shape = jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b))
+    return jnp.broadcast_to(a, shape), jnp.broadcast_to(b, shape)
+
+
+def _apply_lookup(fn, tbl, env) -> CVal:
+    d, m = fn(env)
+    idx = jnp.clip(d, 0, tbl.shape[0] - 1)
+    return tbl[idx], m
+
+
+def _rescale(unscaled, from_scale: int, to_scale: int):
+    if to_scale == from_scale:
+        return unscaled
+    if to_scale > from_scale:
+        return unscaled * (10 ** (to_scale - from_scale))
+    return _div_half_up(unscaled, 10 ** (from_scale - to_scale))
+
+
+def _div_half_up(num, den):
+    """Integer division rounding half away from zero (SQL DECIMAL)."""
+    num = num.astype(jnp.int64)
+    den = jnp.asarray(den, jnp.int64)
+    sign = jnp.sign(num) * jnp.sign(den)
+    q = (2 * abs(num) + abs(den)) // (2 * abs(den))
+    return sign * q
+
+
+def _cast_data(d, frm: Type, to: Type):
+    if frm.is_decimal and to.is_decimal:
+        return _rescale(d, frm.scale, to.scale)
+    if frm.is_decimal and (to.is_floating):
+        return (d.astype(to.np_dtype)) / (10 ** frm.scale)
+    if frm.is_decimal and to.is_integer:
+        return _div_half_up(d, 10 ** frm.scale).astype(to.np_dtype)
+    if to.is_decimal:
+        if frm.is_integer or frm.name == "boolean":
+            return d.astype(jnp.int64) * (10 ** to.scale)
+        # float -> decimal: round half up
+        scaled = d.astype(jnp.float64) * (10 ** to.scale)
+        return jnp.round(scaled).astype(jnp.int64)
+    if to.is_integer and frm.is_floating:
+        return jnp.round(d).astype(to.np_dtype)
+    return d.astype(to.np_dtype)
+
+
+def _coerce_pair(a: CompiledExpr, b: CompiledExpr):
+    """Coerce both sides to a common numeric representation lazily."""
+    ta, tb = a.type, b.type
+
+    def conv(x: CompiledExpr, tx: Type, other: Type):
+        if tx.is_decimal and other.is_floating:
+            scale = tx.scale
+
+            def f(env):
+                d, m = x.fn(env)
+                return d.astype(jnp.float64) / (10 ** scale), m
+            return f
+        return x.fn
+    return conv(a, ta, tb), conv(b, tb, ta)
+
+
+def _numeric_prep(args):
+    out = []
+    for a in args:
+        if a.type.is_decimal:
+            scale = a.type.scale
+
+            def f(env, a=a, scale=scale):
+                d, m = a.fn(env)
+                return d.astype(jnp.float64) / (10 ** scale), m
+            out.append(f)
+        else:
+            out.append(a.fn)
+    return out
+
+
+def _merge_result_dicts(typ: Type, *parts) -> Optional[Tuple[str, ...]]:
+    if not typ.is_string:
+        return None
+    merged = sorted(set().union(*[set(p.dictionary or ()) for p in parts]))
+    return tuple(merged)
+
+
+def _remap_to(p: CompiledExpr, dic: Tuple[str, ...]) -> CompiledExpr:
+    if p.dictionary == dic:
+        return p
+    index = {v: i for i, v in enumerate(dic)}
+    remap = np.array([index[v] for v in (p.dictionary or ())] or [0],
+                     np.int32)
+    tbl = jnp.asarray(remap)
+    fn = p.fn
+    return CompiledExpr(lambda env: _apply_lookup(fn, tbl, env),
+                        p.type, dic)
+
+
+# 64-bit splitmix-style hash for shuffle partitioning / group-by.
+def _hash64(d, m):
+    x = d.astype(jnp.int64)
+    if d.dtype == jnp.float64 or d.dtype == jnp.float32:
+        x = jax.lax.bitcast_convert_type(d.astype(jnp.float64), jnp.int64)
+    x = jnp.where(m, x, jnp.int64(-0x61c8864680b583eb))
+    x = (x ^ (x >> 30)) * jnp.int64(-0x40a7b892e31b1a47)
+    x = (x ^ (x >> 27)) * jnp.int64(-0x6b2fb644ecceee15)
+    return x ^ (x >> 31)
+
+
+def _combine_hash(a, b):
+    return a * jnp.int64(31) + b
+
+
+_COMPARISONS = {
+    "equal": lambda a, b: a == b,
+    "not_equal": lambda a, b: a != b,
+    "less_than": lambda a, b: a < b,
+    "less_than_or_equal": lambda a, b: a <= b,
+    "greater_than": lambda a, b: a > b,
+    "greater_than_or_equal": lambda a, b: a >= b,
+}
+
+_MATH_FNS = {
+    "abs": jnp.abs,
+    "ceiling": jnp.ceil,
+    "floor": jnp.floor,
+    "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt,
+    "exp": jnp.exp,
+    "ln": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "power": jnp.power,
+    "sign": jnp.sign,
+    "round": lambda x, d=None: jnp.round(x) if d is None
+    else jnp.round(x * 10.0 ** d) / 10.0 ** d,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "atan2": jnp.arctan2,
+    "mod": jnp.mod,
+}
+
+_DATE_EXTRACT = {
+    "year": D.extract_year,
+    "month": D.extract_month,
+    "day": D.extract_day,
+    "quarter": D.extract_quarter,
+    "day_of_week": D.extract_dow,
+    "day_of_year": D.extract_doy,
+}
+
+def _substr(v: str, start, length=None) -> str:
+    """Presto substr: 1-based; negative start counts from the end
+    (substr('hello', -2) = 'lo'); start 0 yields ''."""
+    start = int(start)
+    if start == 0:
+        return ""
+    idx = start - 1 if start > 0 else len(v) + start
+    if idx < 0:
+        return ""
+    if length is None:
+        return v[idx:]
+    return v[idx:idx + int(length)]
+
+
+_STRING_TO_STRING = {
+    "substr": _substr,
+    "upper": lambda v: v.upper(),
+    "lower": lambda v: v.lower(),
+    "trim": lambda v: v.strip(),
+    "ltrim": lambda v: v.lstrip(),
+    "rtrim": lambda v: v.rstrip(),
+    "reverse": lambda v: v[::-1],
+    "concat_lit": lambda v, suffix: v + suffix,
+}
+
+_STRING_TO_INT = {
+    "length": lambda v: len(v),
+    "strpos": lambda v, sub: v.find(sub) + 1,
+}
+
+
+def fold_constants(expr: RowExpression) -> RowExpression:
+    """Evaluate literal-only subtrees host-side (reference analog:
+    sql/planner ConstantExpressionVerifier + interpreter folding).
+    E.g. `date '1998-12-01' - interval '90' day` becomes a DATE literal."""
+    if isinstance(expr, (Literal, InputRef)):
+        return expr
+    kids = tuple(fold_constants(c) for c in expr.children())
+    if isinstance(expr, Call):
+        expr = Call(expr.name, kids, expr.type)
+    elif isinstance(expr, SpecialForm):
+        expr = SpecialForm(expr.form, kids, expr.type)
+    if all(isinstance(k, Literal) for k in kids) and kids:
+        if any(k.value is None for k in kids):
+            return expr  # null-folding: keep simple, evaluate at runtime
+        if expr.type.is_string:
+            return expr
+        try:
+            compiled = compile_expression(expr, {})
+            d, m = compiled.fn({})
+            if not bool(np.asarray(m)):
+                return Literal(None, expr.type)
+            val = np.asarray(d)
+            pyval = val.item()
+            return Literal(pyval, expr.type)
+        except ExpressionCompileError:
+            return expr
+    return expr

@@ -1,0 +1,79 @@
+"""Civil-date arithmetic on device arrays.
+
+DATE is int32 days since 1970-01-01. These are branch-free integer
+algorithms (Howard Hinnant's civil_from_days) so XLA vectorizes them on
+the VPU; no host round-trips. (Reference surface: presto-main
+operator/scalar/DateTimeFunctions.java — year/month/day/quarter/extract.)
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import jax.numpy as jnp
+
+EPOCH = datetime.date(1970, 1, 1).toordinal()
+
+
+def date_to_days(d: datetime.date) -> int:
+    return d.toordinal() - EPOCH
+
+
+def parse_date_literal(text: str) -> int:
+    return date_to_days(datetime.date.fromisoformat(text.strip()))
+
+
+def civil_from_days(z):
+    """days since epoch -> (year, month, day), vectorized."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(jnp.where(z >= 0, z, z - 146096), 146097)
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)          # [0, 365]
+    mp = (5 * doy + 2) // 153                                # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                        # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)                   # [1, 12]
+    year = jnp.where(m <= 2, y + 1, y)
+    return year, m, d
+
+
+def extract_year(days):
+    return civil_from_days(days)[0]
+
+
+def extract_month(days):
+    return civil_from_days(days)[1]
+
+
+def extract_day(days):
+    return civil_from_days(days)[2]
+
+
+def extract_quarter(days):
+    return (civil_from_days(days)[1] - 1) // 3 + 1
+
+
+def extract_dow(days):
+    """ISO day of week 1=Monday..7=Sunday (Presto day_of_week)."""
+    return (days.astype(jnp.int64) + 3) % 7 + 1
+
+
+def extract_doy(days):
+    y, _, _ = civil_from_days(days)
+    jan1 = days_from_civil(y, 1, 1)
+    return days.astype(jnp.int64) - jan1 + 1
+
+
+def days_from_civil(y, m, d):
+    """(year, month, day) -> days since epoch, vectorized inverse."""
+    y = jnp.asarray(y, jnp.int64)
+    m = jnp.asarray(m, jnp.int64)
+    d = jnp.asarray(d, jnp.int64)
+    y = y - (m <= 2)
+    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468

@@ -1,0 +1,96 @@
+"""RowExpression IR (reference: presto-spi
+`com.facebook.presto.spi.relation.RowExpression` and friends:
+CallExpression, ConstantExpression, InputReferenceExpression,
+SpecialFormExpression — SURVEY.md L2).
+
+Expressions are produced by the analyzer fully typed; the compiler
+(expr/compile.py) never infers types.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+from presto_tpu.types import Type, BOOLEAN
+
+
+class RowExpression:
+    type: Type
+
+    def children(self) -> Tuple["RowExpression", ...]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(RowExpression):
+    """A constant. For string types, `value` is the python string; for
+    decimals, the *unscaled* int; for dates, days since epoch."""
+    value: Any  # None means typed NULL
+    type: Type
+
+
+@dataclasses.dataclass(frozen=True)
+class InputRef(RowExpression):
+    """Reference to a named input column of the operator's schema."""
+    name: str
+    type: Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(RowExpression):
+    """A resolved scalar function call: `name` is the registry key."""
+    name: str
+    args: Tuple[RowExpression, ...]
+    type: Type
+
+    def children(self):
+        return self.args
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecialForm(RowExpression):
+    """Non-function forms with their own evaluation/null rules
+    (reference: spi SpecialFormExpression.Form): AND OR NOT IF COALESCE
+    IN BETWEEN IS_NULL CAST SWITCH (searched case as nested IFs)."""
+    form: str
+    args: Tuple[RowExpression, ...]
+    type: Type
+
+    def children(self):
+        return self.args
+
+
+def lit(value: Any, typ: Type) -> Literal:
+    return Literal(value, typ)
+
+
+def ref(name: str, typ: Type) -> InputRef:
+    return InputRef(name, typ)
+
+
+def call(name: str, typ: Type, *args: RowExpression) -> Call:
+    return Call(name, tuple(args), typ)
+
+
+def and_(*args: RowExpression) -> SpecialForm:
+    return SpecialForm("and", tuple(args), BOOLEAN)
+
+
+def or_(*args: RowExpression) -> SpecialForm:
+    return SpecialForm("or", tuple(args), BOOLEAN)
+
+
+def not_(arg: RowExpression) -> SpecialForm:
+    return SpecialForm("not", (arg,), BOOLEAN)
+
+
+def walk(expr: RowExpression):
+    yield expr
+    for c in expr.children():
+        yield from walk(c)
+
+
+def referenced_inputs(expr: RowExpression):
+    """Names of input columns an expression reads (for column pruning)."""
+    return {e.name for e in walk(expr) if isinstance(e, InputRef)}

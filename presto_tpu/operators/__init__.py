@@ -1,0 +1,12 @@
+"""Host-side operator pipeline (reference: presto-main operator/ —
+Operator.java:20 contract, Driver.java:68 loop).
+
+Operators keep the reference's pull/push protocol
+(needs_input/add_input/get_output/finish) because it is what makes
+backpressure and pipelining composable; the *work* inside each operator
+is a jitted XLA kernel over Batch pytrees."""
+
+from presto_tpu.operators.base import (
+    Operator, OperatorFactory, OperatorContext, DriverContext,
+)
+from presto_tpu.operators.driver import Driver

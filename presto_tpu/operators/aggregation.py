@@ -1,0 +1,170 @@
+"""Aggregation operator (reference: HashAggregationOperator.java:47 with
+InMemoryHashAggregationBuilder; AggregationOperator for global aggs;
+steps PARTIAL/FINAL/SINGLE as in AggregationNode.Step).
+
+The device kernel is ops/hashagg.agg_step — a functional fold. This
+operator owns the fold state, grows `max_groups` on overflow (the
+rehash analog: the pre-step state is kept until the post-step overflow
+flag is checked, so no data is lost), and finalizes on finish().
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column, bucket_capacity
+from presto_tpu.expr.compile import CompiledExpr
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+from presto_tpu.ops import hashagg
+from presto_tpu.types import Type
+
+
+@dataclasses.dataclass
+class AggSpec:
+    """One aggregate in the operator's output."""
+    out_name: str
+    function: hashagg.AggFunction
+    input: Optional[CompiledExpr]       # None for count(*)
+    mask: Optional[CompiledExpr] = None  # FILTER (WHERE ...) — later
+
+
+# One compiled fold step per (shapes, agg specs). AggFunction instances
+# are frozen dataclasses -> hashable static args; the per-factory cache
+# key is their identity, which is stable across batches.
+_jit_step = jax.jit(hashagg.agg_step, static_argnums=(5, 6))
+
+
+class AggregationOperator(Operator):
+    def __init__(self, ctx: OperatorContext, key_names: Sequence[str],
+                 key_exprs: Sequence[CompiledExpr],
+                 specs: Sequence[AggSpec], mode: str,
+                 max_groups: int):
+        super().__init__(ctx)
+        self.key_names = list(key_names)
+        self.key_exprs = list(key_exprs)
+        self.specs = list(specs)
+        self.mode = mode  # "single" | "partial" | "final"
+        self.max_groups = max_groups
+        self._state = hashagg.init_state(
+            [k.type for k in key_exprs],
+            [s.function for s in self.specs], max_groups)
+        self._finishing = False
+        self._emitted = False
+
+    # -- input evaluation --------------------------------------------------
+
+    def _eval_inputs(self, batch: Batch):
+        env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
+        cap = batch.capacity
+        key_cols = []
+        for ke in self.key_exprs:
+            d, m = ke.fn(env)
+            key_cols.append((jnp.broadcast_to(d, (cap,)),
+                             jnp.broadcast_to(m, (cap,))))
+        agg_inputs, agg_weights, merge = [], [], []
+        for s in self.specs:
+            if self.mode == "final":
+                # inputs are partial-state columns out__s{i}
+                parts = []
+                w = batch.row_valid
+                for i in range(len(s.function.state_dtypes)):
+                    c = batch.columns[f"{s.out_name}__s{i}"]
+                    parts.append(c.data)
+                agg_inputs.append(tuple(parts))
+                agg_weights.append(batch.row_valid)
+                merge.append(True)
+            elif s.input is None:
+                agg_inputs.append(None)
+                agg_weights.append(batch.row_valid)
+                merge.append(False)
+            else:
+                d, m = s.input.fn(env)
+                agg_inputs.append(jnp.broadcast_to(d, (cap,)))
+                agg_weights.append(batch.row_valid
+                                   & jnp.broadcast_to(m, (cap,)))
+                merge.append(False)
+        return key_cols, agg_inputs, agg_weights, merge
+
+    # -- operator protocol -------------------------------------------------
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        key_cols, agg_inputs, agg_weights, merge = self._eval_inputs(batch)
+        aggs = tuple(s.function for s in self.specs)
+        while True:
+            new_state = _jit_step(
+                self._state, batch.row_valid, key_cols, agg_inputs,
+                agg_weights, aggs, tuple(merge))
+            if not bool(np.asarray(new_state.overflow)):
+                self._state = new_state
+                return
+            # grow and retry: merge old state into a double-size state,
+            # then redo this batch (reference: GroupByHash rehash :87)
+            self._grow()
+
+    def _grow(self) -> None:
+        self.max_groups *= 2
+        old = self._state
+        aggs = tuple(s.function for s in self.specs)
+        bigger = hashagg.init_state([k.type for k in self.key_exprs],
+                                    aggs, self.max_groups)
+        self._state = _jit_step(
+            bigger, old.valid, list(old.keys),
+            [tuple(st) for st in old.states],
+            [old.valid for _ in aggs], aggs, (True,) * len(aggs))
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        key_types = [k.type for k in self.key_exprs]
+        key_dicts = [k.dictionary for k in self.key_exprs]
+        aggs = [s.function for s in self.specs]
+        names = [s.out_name for s in self.specs]
+        if self.mode == "partial":
+            out = hashagg.intermediate_batch(
+                self._state, self.key_names, key_types, key_dicts,
+                names, aggs)
+        else:
+            out = hashagg.finalize(
+                self._state, self.key_names, key_types, key_dicts,
+                names, aggs)
+        # (global aggregation over zero rows already yields one live row:
+        #  the kernel's global path pins group 0, so count(*) = 0 works)
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class AggregationOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, key_names: Sequence[str],
+                 key_exprs: Sequence[CompiledExpr],
+                 specs: Sequence[AggSpec], mode: str = "single",
+                 max_groups: int = 4096):
+        super().__init__(operator_id, f"aggregation({mode})")
+        self.key_names = key_names
+        self.key_exprs = key_exprs
+        self.specs = specs
+        self.mode = mode
+        self.max_groups = max_groups
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return AggregationOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.key_names, self.key_exprs, self.specs, self.mode,
+            self.max_groups)

@@ -1,0 +1,103 @@
+"""Operator protocol (reference: operator/Operator.java:20 —
+needsInput/addInput/getOutput/finish/isBlocked — and OperatorContext /
+DriverContext stats plumbing, operator/OperatorContext.java)."""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from presto_tpu.batch import Batch
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    """Per-operator counters surfaced through EXPLAIN ANALYZE / REST
+    (reference: operator/OperatorStats.java)."""
+    input_batches: int = 0
+    input_rows: int = 0
+    output_batches: int = 0
+    output_rows: int = 0
+    busy_seconds: float = 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class DriverContext:
+    """Execution context shared by the operators of one driver."""
+    session: Any = None
+    memory: Any = None  # MemoryContext, wired in execution/memory.py
+
+
+class OperatorContext:
+    def __init__(self, operator_id: int, name: str,
+                 driver_context: DriverContext):
+        self.operator_id = operator_id
+        self.name = name
+        self.driver_context = driver_context
+        self.stats = OperatorStats()
+
+
+class Operator(abc.ABC):
+    """One stage of a pipeline. Contract (Operator.java:20):
+
+    - `needs_input()` true iff `add_input` may be called
+    - `add_input(batch)` accepts one batch (only when needs_input)
+    - `get_output()` returns a batch or None (no output ready)
+    - `finish()` signals no more input will arrive
+    - `is_finished()` true when no more output will be produced
+    - `is_blocked()` returns False or a reason string (driver yields)
+    """
+
+    def __init__(self, ctx: OperatorContext):
+        self.ctx = ctx
+
+    @abc.abstractmethod
+    def needs_input(self) -> bool: ...
+
+    @abc.abstractmethod
+    def add_input(self, batch: Batch) -> None: ...
+
+    @abc.abstractmethod
+    def get_output(self) -> Optional[Batch]: ...
+
+    @abc.abstractmethod
+    def finish(self) -> None: ...
+
+    @abc.abstractmethod
+    def is_finished(self) -> bool: ...
+
+    def is_blocked(self):
+        return False
+
+    def close(self) -> None:
+        pass
+
+    # -- stats helpers ------------------------------------------------------
+
+    def _count_in(self, batch: Batch) -> None:
+        self.ctx.stats.input_batches += 1
+
+    def _count_out(self, batch: Optional[Batch]) -> Optional[Batch]:
+        if batch is not None:
+            self.ctx.stats.output_batches += 1
+        return batch
+
+
+class OperatorFactory(abc.ABC):
+    """Creates one Operator per driver (reference: OperatorFactory in
+    operator/ — factories are what LocalExecutionPlanner emits)."""
+
+    def __init__(self, operator_id: int, name: str):
+        self.operator_id = operator_id
+        self.name = name
+
+    @abc.abstractmethod
+    def create(self, driver_context: DriverContext) -> Operator: ...
+
+    def no_more_operators(self) -> None:
+        """Called when every driver's operator has been created."""

@@ -1,0 +1,256 @@
+"""Core operators: Values, TableScan, FilterAndProject, Limit, Output.
+
+Reference surface: ValuesOperator, TableScanOperator.java:43,
+ScanFilterAndProjectOperator.java:58 / FilterAndProjectOperator.java:32,
+LimitOperator, and the PageConsumerOperator test sink
+(testing/PageConsumerOperator.java).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.expr.compile import CompiledExpr
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+from presto_tpu.ops import sort as sort_ops
+
+
+class SourceOperator(Operator):
+    """Base for operators that originate data (no input)."""
+
+    def needs_input(self) -> bool:
+        return False
+
+    def add_input(self, batch: Batch) -> None:
+        raise RuntimeError(f"{self.ctx.name} takes no input")
+
+
+class ValuesOperator(SourceOperator):
+    def __init__(self, ctx: OperatorContext, batches: List[Batch]):
+        super().__init__(ctx)
+        self._batches = list(batches)
+        self._finished = False
+
+    def get_output(self) -> Optional[Batch]:
+        if self._batches:
+            b = self._batches.pop(0)
+            self.ctx.stats.output_batches += 1
+            return b
+        self._finished = True
+        return None
+
+    def finish(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        return self._finished and not self._batches
+
+
+class ValuesOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, batches: List[Batch]):
+        super().__init__(operator_id, "values")
+        self.batches = batches
+        self._created = False
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        # each driver gets the batches once (single-driver pipelines)
+        assert not self._created, "values pipeline must be single-driver"
+        self._created = True
+        return ValuesOperator(
+            OperatorContext(self.operator_id, "values", driver_context),
+            self.batches)
+
+
+class TableScanOperator(SourceOperator):
+    """Pulls batches from a connector page source (reference:
+    TableScanOperator.java:43; splits arrive via the factory)."""
+
+    def __init__(self, ctx: OperatorContext,
+                 batch_iter: Iterator[Batch]):
+        super().__init__(ctx)
+        self._iter = batch_iter
+        self._finished = False
+
+    def get_output(self) -> Optional[Batch]:
+        if self._finished:
+            return None
+        try:
+            b = next(self._iter)
+        except StopIteration:
+            self._finished = True
+            return None
+        self.ctx.stats.output_batches += 1
+        # (live-row counts would force a device sync per batch; row stats
+        #  are filled in lazily by EXPLAIN ANALYZE, not on the hot path)
+        return b
+
+    def finish(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+
+class TableScanOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, name: str,
+                 batch_iter_factory: Callable[[], Iterator[Batch]]):
+        super().__init__(operator_id, name)
+        self._factory = batch_iter_factory
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return TableScanOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self._factory())
+
+
+def make_filter_project_kernel(
+        filter_expr: Optional[CompiledExpr],
+        projections: Sequence[Tuple[str, CompiledExpr]]):
+    """Build the jitted batch->batch kernel. XLA fuses the whole
+    expression forest with the mask updates (the PageProcessor analog,
+    operator/project/PageProcessor.java:57)."""
+
+    @jax.jit
+    def kernel(batch: Batch) -> Batch:
+        env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
+        cap = batch.capacity
+        rv = batch.row_valid
+        if filter_expr is not None:
+            d, m = filter_expr.fn(env)
+            rv = rv & jnp.broadcast_to(d & m, (cap,))
+        cols: Dict[str, Column] = {}
+        for name, ce in projections:
+            d, m = ce.fn(env)
+            d = jnp.broadcast_to(jnp.asarray(d, ce.type.np_dtype), (cap,))
+            m = jnp.broadcast_to(m, (cap,))
+            cols[name] = Column(d, m, ce.type, ce.dictionary)
+        return Batch(cols, rv)
+
+    return kernel
+
+
+class FilterProjectOperator(Operator):
+    def __init__(self, ctx: OperatorContext, kernel):
+        super().__init__(ctx)
+        self._kernel = kernel
+        self._pending: Optional[Batch] = None
+        self._finishing = False
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self._pending = self._kernel(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class FilterProjectOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int,
+                 filter_expr: Optional[CompiledExpr],
+                 projections: Sequence[Tuple[str, CompiledExpr]]):
+        super().__init__(operator_id, "filter_project")
+        self._kernel = make_filter_project_kernel(filter_expr, projections)
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return FilterProjectOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self._kernel)
+
+
+class LimitOperator(Operator):
+    """LIMIT n (reference: LimitOperator). Tracks emitted rows as a
+    device scalar to avoid per-batch recompiles."""
+
+    def __init__(self, ctx: OperatorContext, n: int):
+        super().__init__(ctx)
+        self._n = n
+        self._emitted = jnp.asarray(0, jnp.int64)
+        self._pending: Optional[Batch] = None
+        self._finishing = False
+        self._done = False
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing \
+            and not self._done
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        out = sort_ops.limit_batch(batch, self._n, self._emitted)
+        self._emitted = self._emitted + jnp.sum(out.row_valid)
+        self._pending = out
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        if out is not None and int(self._emitted) >= self._n:
+            self._done = True  # early termination: stop pulling input
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return (self._finishing or self._done) and self._pending is None
+
+
+class LimitOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, n: int):
+        super().__init__(operator_id, "limit")
+        self.n = n
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return LimitOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.n)
+
+
+class OutputCollectorOperator(Operator):
+    """Terminal sink gathering result batches (reference analog:
+    testing/PageConsumerOperator.java + MaterializedResult)."""
+
+    def __init__(self, ctx: OperatorContext, sink: List[Batch]):
+        super().__init__(ctx)
+        self.sink = sink
+        self._finishing = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self.sink.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class OutputCollectorOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, sink: List[Batch]):
+        super().__init__(operator_id, "output")
+        self.sink = sink
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return OutputCollectorOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.sink)

@@ -1,0 +1,91 @@
+"""The Driver loop (reference: operator/Driver.java:68; hot loop
+processInternal:371 — for each adjacent (current, next) pair, move one
+batch current.getOutput() -> next.addInput()).
+
+The host loop only moves device-array handles between operators; jax
+dispatch is async, so the device pipeline stays busy while the host walks
+the operator chain (SURVEY.md hard part #5)."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from presto_tpu.operators.base import Operator
+
+
+class Driver:
+    def __init__(self, operators: List[Operator]):
+        assert operators, "driver needs at least one operator"
+        self.operators = operators
+        self._closed = False
+
+    def is_finished(self) -> bool:
+        return self._closed or self.operators[-1].is_finished()
+
+    def process(self, max_iterations: int = 1) -> bool:
+        """Run up to `max_iterations` passes over the operator chain
+        (the analog of Driver.processFor's time quantum). Returns True if
+        any progress (batch moved / state advanced) was made."""
+        progress = False
+        for _ in range(max_iterations):
+            moved = self._process_once()
+            progress = progress or moved
+            if self.is_finished():
+                break
+        return progress
+
+    def _process_once(self) -> bool:
+        ops = self.operators
+        moved = False
+        # walk adjacent pairs, moving at most one batch per pair
+        # (Driver.processInternal:371)
+        for i in range(len(ops) - 1):
+            current, nxt = ops[i], ops[i + 1]
+            if current.is_blocked() or nxt.is_blocked():
+                continue
+            if nxt.needs_input() and not current.is_finished():
+                t0 = time.perf_counter()
+                batch = current.get_output()
+                current.ctx.stats.busy_seconds += time.perf_counter() - t0
+                if batch is not None:
+                    t0 = time.perf_counter()
+                    nxt.add_input(batch)
+                    nxt.ctx.stats.busy_seconds += time.perf_counter() - t0
+                    moved = True
+            # unwind finished prefix (Driver.java:438-447)
+            if current.is_finished():
+                nxt.finish()
+        # drain the tail operator if it is a sink that self-drives
+        tail = self.operators[-1]
+        if not tail.is_finished() and not tail.is_blocked():
+            out = tail.get_output()
+            if out is not None:
+                moved = True
+        return moved
+
+    def run_to_completion(self, max_steps: int = 1_000_000) -> None:
+        steps = 0
+        while not self.is_finished():
+            progress = self.process()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("driver did not converge (livelock?)")
+            if not progress and not self.is_finished():
+                blocked = [op.ctx.name for op in self.operators
+                           if op.is_blocked()]
+                if blocked:
+                    # single-driver completion can't unblock cross-driver
+                    # dependencies (e.g. a join bridge) — that's the task
+                    # executor's job (round-robin over drivers)
+                    raise RuntimeError(
+                        f"driver deadlock: operators blocked {blocked}")
+                # nothing blocked but no progress: let state machines
+                # advance (e.g. finish propagation), bounded by max_steps
+        self.close()
+
+    def close(self) -> None:
+        if not self._closed:
+            for op in self.operators:
+                op.close()
+            self._closed = True

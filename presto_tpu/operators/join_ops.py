@@ -1,0 +1,214 @@
+"""Join operators (reference: HashBuilderOperator.java:51 /
+LookupJoinOperator.java:53 / HashSemiJoinOperator + SetBuilderOperator,
+bridged exactly like the reference's LookupSourceFactory).
+
+The build pipeline fills a JoinBridge; probe pipelines block on it
+(Operator.is_blocked — the driver yields, the task executor keeps
+running the build driver), then stream probe batches through the
+searchsorted probe kernel."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu.batch import Batch, bucket_capacity
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+from presto_tpu.ops import join as join_ops
+
+
+class JoinBridge:
+    """Shared build-side handoff (reference: LookupSourceFactory)."""
+
+    def __init__(self):
+        self.table: Optional[join_ops.BuildTable] = None
+
+    @property
+    def ready(self) -> bool:
+        return self.table is not None
+
+
+class HashBuildOperator(Operator):
+    """Sink of the build pipeline: accumulates batches, indexes on
+    finish (reference: HashBuilderOperator.java:51)."""
+
+    def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
+                 key_names: Tuple[str, ...]):
+        super().__init__(ctx)
+        self.bridge = bridge
+        self.key_names = key_names
+        self._batches: List[Batch] = []
+        self._finished = False
+
+    def needs_input(self) -> bool:
+        return not self._finished
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self._batches.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        total = sum(b.num_valid() for b in self._batches)
+        cap = bucket_capacity(max(total, 1))
+        if self._batches:
+            merged = Batch.concat(self._batches, cap)
+        else:
+            raise RuntimeError("empty build side needs schema plumbing")
+        self.bridge.table = join_ops.build(merged, self.key_names)
+        self._batches = []
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+
+class LookupJoinOperator(Operator):
+    """Probe side (reference: LookupJoinOperator.java:53, processProbe:392).
+
+    Per probe batch: candidate runs via two searchsorted calls, a host
+    sync for the total match count (picks the output capacity bucket),
+    then one expand kernel."""
+
+    def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
+                 key_names: Tuple[str, ...], join_type: str,
+                 probe_output: Sequence[str], build_output: Sequence[str],
+                 build_rename: Optional[dict] = None):
+        super().__init__(ctx)
+        self.bridge = bridge
+        self.key_names = key_names
+        self.join_type = join_type
+        self.probe_output = list(probe_output)
+        self.build_output = list(build_output)
+        self.build_rename = build_rename or {}
+        self._pending: Optional[Batch] = None
+        self._finishing = False
+
+    def is_blocked(self):
+        return False if self.bridge.ready else "waiting for join build"
+
+    def needs_input(self) -> bool:
+        return self.bridge.ready and self._pending is None \
+            and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        table = self.bridge.table
+        lo, hi, counts, pkv = join_ops.probe_counts(
+            table, batch, self.key_names)
+        emit = np.asarray(counts)
+        if self.join_type == "left":
+            rv = np.asarray(batch.row_valid)
+            emit = np.where(rv & (emit == 0), 1, emit * rv)
+        total = int(emit.sum())
+        cap = bucket_capacity(max(total, 1))
+        out = join_ops.expand(
+            table, batch, self.key_names, lo, hi, counts, pkv, cap,
+            self.join_type, probe_output=self.probe_output,
+            build_output=self.build_output)
+        if self.build_rename:
+            out = out.rename(self.build_rename)
+        self._pending = out
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class SemiJoinOperator(Operator):
+    """WHERE x IN (subquery) / EXISTS — filters probe rows by membership
+    (reference: HashSemiJoinOperator; `negate` gives NOT IN/NOT EXISTS
+    anti-join semantics for non-null keys)."""
+
+    def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
+                 key_names: Tuple[str, ...], negate: bool):
+        super().__init__(ctx)
+        self.bridge = bridge
+        self.key_names = key_names
+        self.negate = negate
+        self._pending: Optional[Batch] = None
+        self._finishing = False
+
+    def is_blocked(self):
+        return False if self.bridge.ready else "waiting for semi build"
+
+    def needs_input(self) -> bool:
+        return self.bridge.ready and self._pending is None \
+            and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        found, valid = join_ops.semi_mark(self.bridge.table, batch,
+                                          self.key_names)
+        keep = (~found & valid) if self.negate else found
+        self._pending = batch.filter(keep)
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class HashBuildOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, bridge: JoinBridge,
+                 key_names: Sequence[str]):
+        super().__init__(operator_id, "hash_build")
+        self.bridge = bridge
+        self.key_names = tuple(key_names)
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return HashBuildOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.bridge, self.key_names)
+
+
+class LookupJoinOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, bridge: JoinBridge,
+                 key_names: Sequence[str], join_type: str,
+                 probe_output: Sequence[str], build_output: Sequence[str],
+                 build_rename: Optional[dict] = None):
+        super().__init__(operator_id, f"lookup_join({join_type})")
+        self.bridge = bridge
+        self.key_names = tuple(key_names)
+        self.join_type = join_type
+        self.probe_output = probe_output
+        self.build_output = build_output
+        self.build_rename = build_rename
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return LookupJoinOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.bridge, self.key_names, self.join_type,
+            self.probe_output, self.build_output, self.build_rename)
+
+
+class SemiJoinOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, bridge: JoinBridge,
+                 key_names: Sequence[str], negate: bool = False):
+        super().__init__(operator_id, "semi_join")
+        self.bridge = bridge
+        self.key_names = tuple(key_names)
+        self.negate = negate
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return SemiJoinOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.bridge, self.key_names, self.negate)

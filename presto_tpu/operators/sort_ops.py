@@ -1,0 +1,174 @@
+"""Ordering operators (reference: OrderByOperator.java:44,
+TopNOperator.java:35, DistinctLimitOperator / MarkDistinctOperator)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from presto_tpu.batch import Batch, bucket_capacity
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+from presto_tpu.ops import sort as sort_kernels
+
+
+class OrderByOperator(Operator):
+    """Full sort: accumulate, one device lex-sort on finish."""
+
+    def __init__(self, ctx: OperatorContext, key_names: Tuple[str, ...],
+                 descending: Tuple[bool, ...],
+                 nulls_first: Tuple[bool, ...]):
+        super().__init__(ctx)
+        self.key_names = key_names
+        self.descending = descending
+        self.nulls_first = nulls_first
+        self._batches: List[Batch] = []
+        self._finishing = False
+        self._emitted = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self._batches.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        if not self._batches:
+            return None
+        total = sum(b.num_valid() for b in self._batches)
+        merged = Batch.concat(self._batches, bucket_capacity(max(total, 1)))
+        self._batches = []
+        out = sort_kernels.sort_batch(merged, self.key_names,
+                                      self.descending, self.nulls_first)
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class TopNOperator(Operator):
+    """Bounded running top-N fold (constant memory)."""
+
+    def __init__(self, ctx: OperatorContext, n: int,
+                 key_names: Tuple[str, ...], descending: Tuple[bool, ...],
+                 nulls_first: Tuple[bool, ...],
+                 schema_cols: Sequence[tuple]):
+        super().__init__(ctx)
+        self.n = n
+        self.key_names = key_names
+        self.descending = descending
+        self.nulls_first = nulls_first
+        cap = bucket_capacity(max(n, 1))
+        self._state = sort_kernels.distinct_state(schema_cols, cap)
+        self._finishing = False
+        self._emitted = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self._state = sort_kernels.topn_step(
+            self._state, batch, self.n, self.key_names, self.descending,
+            self.nulls_first)
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        # state rows are already sorted by topn_step's internal sort
+        return self._count_out(self._state)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class DistinctOperator(Operator):
+    """SELECT DISTINCT dedup fold; grows capacity when nearly full."""
+
+    def __init__(self, ctx: OperatorContext, schema_cols: Sequence[tuple],
+                 capacity: int = 4096):
+        super().__init__(ctx)
+        self._schema_cols = list(schema_cols)
+        self._state = sort_kernels.distinct_state(schema_cols, capacity)
+        self._finishing = False
+        self._emitted = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        # grow until the merged distinct set fits with headroom: if the
+        # state fills to capacity we cannot tell kept from dropped rows,
+        # so re-merge at a larger capacity before accepting the batch
+        while True:
+            new_state = sort_kernels.distinct_step(self._state, batch)
+            if new_state.num_valid() < new_state.capacity:
+                self._state = new_state
+                return
+            bigger = sort_kernels.distinct_state(
+                self._schema_cols, self._state.capacity * 2)
+            self._state = sort_kernels.distinct_step(bigger, self._state)
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        return self._count_out(self._state)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class OrderByOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, key_names: Sequence[str],
+                 descending: Sequence[bool], nulls_first: Sequence[bool]):
+        super().__init__(operator_id, "order_by")
+        self.args = (tuple(key_names), tuple(descending),
+                     tuple(nulls_first))
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return OrderByOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            *self.args)
+
+
+class TopNOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, n: int, key_names: Sequence[str],
+                 descending: Sequence[bool], nulls_first: Sequence[bool],
+                 schema_cols: Sequence[tuple]):
+        super().__init__(operator_id, "topn")
+        self.args = (n, tuple(key_names), tuple(descending),
+                     tuple(nulls_first), schema_cols)
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return TopNOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            *self.args)
+
+
+class DistinctOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, schema_cols: Sequence[tuple],
+                 capacity: int = 4096):
+        super().__init__(operator_id, "distinct")
+        self.schema_cols = schema_cols
+        self.capacity = capacity
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return DistinctOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.schema_cols, self.capacity)

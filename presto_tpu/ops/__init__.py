@@ -1,0 +1,4 @@
+"""Device kernels for the relational operators (reference surface:
+presto-main operator/ — SURVEY.md §2.2). Each kernel is a pure jittable
+function over Batch pytrees; XLA fuses the compiled expression trees from
+expr/compile.py into these."""

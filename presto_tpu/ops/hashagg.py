@@ -1,0 +1,301 @@
+"""Grouped aggregation kernel (reference: HashAggregationOperator.java:47
++ InMemoryHashAggregationBuilder + MultiChannelGroupByHash.java:54).
+
+TPU-native design: instead of an open-addressing hash table (random
+scatter is hostile to the VPU), grouping is *sort-based*: rows are
+lex-sorted by key, group boundaries detected by adjacent comparison, and
+states reduced with `jax.ops.segment_*` over sorted segment ids — all
+static shapes, all fusible.
+
+Cross-batch accumulation keeps a running state batch of at most
+`max_groups` rows (keys + partial states). Each step re-groups
+[state ++ new-batch] in one jitted call, so the accumulator is a
+functional fold: state' = agg_step(state, batch). The same kernel
+implements partial and final aggregation (final consumes partial states
+as its input contributions), which is what makes the
+partial -> shuffle -> final plan shape work unchanged.
+
+Overflow: if distinct groups exceed max_groups the step reports it in
+`overflow` (checked host-side at operator level; the operator re-runs
+with a bigger bucket — the analog of MultiChannelGroupByHash rehash :87).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.ops import common
+from presto_tpu.types import BIGINT, DOUBLE, Type
+
+CVal = Tuple[jnp.ndarray, jnp.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class AggFunction:
+    """One aggregate: state layout + per-row contribution + merge + final.
+
+    state arrays are parallel to group slots. `init(value, weight)` maps a
+    row's input (already masked) to state contributions; contributions and
+    existing states merge with segment reductions described by `reduce`
+    (one of sum/min/max per state array).
+    """
+
+    name: str
+    state_dtypes: Tuple[np.dtype, ...]
+    reduces: Tuple[str, ...]  # per state array: "sum" | "min" | "max"
+    # (value_data, contribute_weight_bool) -> tuple of state arrays
+    init: Callable[[Optional[jnp.ndarray], jnp.ndarray], Tuple[jnp.ndarray, ...]]
+    # tuple of state arrays -> (data, mask)
+    final: Callable[[Tuple[jnp.ndarray, ...]], CVal]
+    output_type: Type = BIGINT
+    # partial-output: state arrays exposed as columns for shuffle
+    intermediate_types: Tuple[Type, ...] = ()
+
+
+def _ident_for(reduce: str, dtype) -> jnp.ndarray:
+    if reduce == "sum":
+        return jnp.zeros((), dtype)
+    info = jnp.iinfo(dtype) if jnp.issubdtype(dtype, jnp.integer) \
+        else jnp.finfo(dtype)
+    return jnp.asarray(info.max if reduce == "min" else info.min, dtype)
+
+
+def make_sum(input_type: Type, output_type: Type) -> AggFunction:
+    dt = output_type.np_dtype
+
+    def init(value, w):
+        v = jnp.where(w, value, 0).astype(dt)
+        return (v, w.astype(np.int64))
+
+    def final(state):
+        total, cnt = state
+        return total, cnt > 0  # SUM of empty/all-null group is NULL
+    return AggFunction("sum", (dt, np.dtype(np.int64)), ("sum", "sum"),
+                       init, final, output_type,
+                       (output_type, BIGINT))
+
+
+def make_count(input_type: Optional[Type]) -> AggFunction:
+    def init(value, w):
+        return (w.astype(np.int64),)
+
+    def final(state):
+        return state[0], jnp.ones_like(state[0], bool)
+    return AggFunction("count", (np.dtype(np.int64),), ("sum",),
+                       init, final, BIGINT, (BIGINT,))
+
+
+def make_avg(input_type: Type) -> AggFunction:
+    # avg computes in float64 (Presto: avg(decimal) keeps decimal — we
+    # finalize back to the decimal scale in the operator's projection).
+    def init(value, w):
+        v = jnp.where(w, value, 0).astype(np.float64)
+        return (v, w.astype(np.int64))
+
+    def final(state):
+        total, cnt = state
+        return total / jnp.maximum(cnt, 1), cnt > 0
+    return AggFunction("avg", (np.dtype(np.float64), np.dtype(np.int64)),
+                       ("sum", "sum"), init, final, DOUBLE,
+                       (DOUBLE, BIGINT))
+
+
+def make_min(input_type: Type) -> AggFunction:
+    dt = input_type.np_dtype
+    ident = _ident_for("min", dt)
+
+    def init(value, w):
+        return (jnp.where(w, value, ident).astype(dt), w.astype(np.int64))
+
+    def final(state):
+        return state[0], state[1] > 0
+    return AggFunction("min", (dt, np.dtype(np.int64)), ("min", "sum"),
+                       init, final, input_type, (input_type, BIGINT))
+
+
+def make_max(input_type: Type) -> AggFunction:
+    dt = input_type.np_dtype
+    ident = _ident_for("max", dt)
+
+    def init(value, w):
+        return (jnp.where(w, value, ident).astype(dt), w.astype(np.int64))
+
+    def final(state):
+        return state[0], state[1] > 0
+    return AggFunction("max", (dt, np.dtype(np.int64)), ("max", "sum"),
+                       init, final, input_type, (input_type, BIGINT))
+
+
+AGG_FACTORIES = {
+    "sum": make_sum,
+    "count": make_count,
+    "avg": make_avg,
+    "min": make_min,
+    "max": make_max,
+}
+
+
+@dataclasses.dataclass
+class GroupByState:
+    """Running accumulator: key columns + per-agg state arrays, with
+    `valid[g]` marking live group slots. A pytree (flows through jit)."""
+    keys: List[CVal]
+    states: List[Tuple[jnp.ndarray, ...]]
+    valid: jnp.ndarray
+    overflow: jnp.ndarray  # bool scalar
+
+
+jax.tree_util.register_pytree_node(
+    GroupByState,
+    lambda s: ((s.keys, s.states, s.valid, s.overflow), None),
+    lambda _, c: GroupByState(*c),
+)
+
+
+def init_state(key_types: Sequence[Type], aggs: Sequence[AggFunction],
+               max_groups: int) -> GroupByState:
+    keys = [(jnp.zeros(max_groups, t.np_dtype), jnp.zeros(max_groups, bool))
+            for t in key_types]
+    states = []
+    for a in aggs:
+        states.append(tuple(
+            jnp.full(max_groups, _ident_for(r, dt), dt)
+            for dt, r in zip(a.state_dtypes, a.reduces)))
+    return GroupByState(keys, states, jnp.zeros(max_groups, bool),
+                        jnp.asarray(False))
+
+
+def agg_step(state: GroupByState,
+             row_valid: jnp.ndarray,
+             key_cols: Sequence[CVal],
+             agg_inputs: Sequence[Optional[jnp.ndarray]],
+             agg_weights: Sequence[jnp.ndarray],
+             aggs: Sequence[AggFunction],
+             merge: Sequence[bool] | None = None) -> GroupByState:
+    """One functional fold step: regroup [state ++ batch rows].
+
+    `row_valid` is the incoming batch's selection vector (live rows form
+    groups even when every agg input is NULL). `agg_inputs[i]` is the
+    evaluated input column (or None for count(*)), `agg_weights[i]` is the
+    per-row contribute mask (row_valid & not-null). When `merge[i]` is
+    True the i-th "input" is a tuple of partial state arrays to merge
+    instead of raw values (final aggregation after a shuffle)."""
+    max_groups = state.valid.shape[0]
+    merge = merge or [False] * len(aggs)
+
+    # 1. contributions for the incoming rows
+    contribs: List[Tuple[jnp.ndarray, ...]] = []
+    for agg, inp, w, is_merge in zip(aggs, agg_inputs, agg_weights, merge):
+        if is_merge:
+            # inp is a tuple of partial state arrays; weight gates validity
+            parts = tuple(
+                jnp.where(w, p, _ident_for(r, dt)).astype(dt)
+                for p, dt, r in zip(inp, agg.state_dtypes, agg.reduces))
+            contribs.append(parts)
+        else:
+            contribs.append(agg.init(inp, w))
+
+    # 2. concat state rows + input rows
+    all_keys = [
+        (jnp.concatenate([sk[0], kc[0].astype(sk[0].dtype)]),
+         jnp.concatenate([sk[1], kc[1]]))
+        for sk, kc in zip(state.keys, key_cols)
+    ]
+    all_valid = jnp.concatenate([state.valid, row_valid])
+    all_states = []
+    for st, cb, agg in zip(state.states, contribs, aggs):
+        all_states.append(tuple(
+            jnp.concatenate([s, c.astype(s.dtype)])
+            for s, c in zip(st, cb)))
+
+    # 3. sort by keys (invalid rows last), detect boundaries, segment ids
+    perm = common.lex_order(all_keys, valid=all_valid)
+    sorted_keys = common.take(all_keys, perm)
+    sorted_valid = all_valid[perm]
+    if all_keys:
+        bnd = common.boundaries(sorted_keys, sorted_valid)
+    else:
+        # global aggregation: a single group holds every valid row
+        bnd = jnp.zeros_like(sorted_valid).at[0].set(True)
+    gid = jnp.cumsum(bnd) - 1
+    num_groups = jnp.sum(bnd)
+    # invalid rows -> overflow segment max_groups (sliced away)
+    gid = jnp.where(sorted_valid, jnp.minimum(gid, max_groups), max_groups)
+
+    # 4. segment-reduce each state array
+    new_states = []
+    for st, agg in zip(all_states, aggs):
+        reduced = []
+        for arr, r in zip(st, agg.reduces):
+            sarr = arr[perm]
+            if r == "sum":
+                red = jax.ops.segment_sum(sarr, gid,
+                                          num_segments=max_groups + 1,
+                                          indices_are_sorted=True)
+            elif r == "min":
+                red = jax.ops.segment_min(sarr, gid,
+                                          num_segments=max_groups + 1,
+                                          indices_are_sorted=True)
+            else:
+                red = jax.ops.segment_max(sarr, gid,
+                                          num_segments=max_groups + 1,
+                                          indices_are_sorted=True)
+            reduced.append(red[:max_groups])
+        new_states.append(tuple(reduced))
+
+    # 5. representative key row per group (first row of each segment)
+    row_idx = jnp.arange(sorted_valid.shape[0])
+    first_row = jax.ops.segment_min(
+        jnp.where(bnd, row_idx, sorted_valid.shape[0]), gid,
+        num_segments=max_groups + 1, indices_are_sorted=True)[:max_groups]
+    first_row = jnp.minimum(first_row, sorted_valid.shape[0] - 1)
+    new_keys = [(d[first_row], m[first_row] & True) for d, m in sorted_keys]
+    slot = jnp.arange(max_groups)
+    new_valid = slot < num_groups
+    new_keys = [(d, m & new_valid) for d, m in new_keys]
+
+    return GroupByState(new_keys, new_states, new_valid,
+                        state.overflow | (num_groups > max_groups))
+
+
+def finalize(state: GroupByState, key_names: Sequence[str],
+             key_types: Sequence[Type],
+             key_dicts: Sequence[Optional[tuple]],
+             out_names: Sequence[str],
+             aggs: Sequence[AggFunction]) -> Batch:
+    """Produce the output batch of one group per row."""
+    cols: Dict[str, Column] = {}
+    for name, typ, dic, (d, m) in zip(key_names, key_types, key_dicts,
+                                      state.keys):
+        cols[name] = Column(d.astype(typ.np_dtype), m, typ, dic)
+    for name, agg, st in zip(out_names, aggs, state.states):
+        d, m = agg.final(st)
+        cols[name] = Column(d.astype(agg.output_type.np_dtype),
+                            m & state.valid, agg.output_type, None)
+    return Batch(cols, state.valid)
+
+
+def intermediate_batch(state: GroupByState, key_names: Sequence[str],
+                       key_types: Sequence[Type],
+                       key_dicts: Sequence[Optional[tuple]],
+                       out_names: Sequence[str],
+                       aggs: Sequence[AggFunction]) -> Batch:
+    """Expose partial states as columns (<out>__s0, <out>__s1, ...) for
+    the shuffle between partial and final aggregation (reference analog:
+    the INTERMEDIATE step of AccumulatorCompiler accumulators)."""
+    cols: Dict[str, Column] = {}
+    for name, typ, dic, (d, m) in zip(key_names, key_types, key_dicts,
+                                      state.keys):
+        cols[name] = Column(d.astype(typ.np_dtype), m, typ, dic)
+    for name, agg, st in zip(out_names, aggs, state.states):
+        for i, (arr, it) in enumerate(zip(st, agg.intermediate_types)):
+            cols[f"{name}__s{i}"] = Column(arr.astype(it.np_dtype),
+                                           state.valid, it, None)
+    return Batch(cols, state.valid)

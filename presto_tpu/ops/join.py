@@ -1,0 +1,204 @@
+"""Equi-join kernels (reference: HashBuilderOperator.java:51,
+LookupJoinOperator.java:53 probing a generated PagesHashStrategy over
+PagesIndex.java:75).
+
+TPU-native design: no pointer-chasing hash table. The build side is
+*sorted by key hash* once; each probe row finds its candidate run with
+two `searchsorted` calls (binary search vectorizes cleanly on TPU and
+XLA lowers it to a while-free form). Row expansion (a probe row matching
+k build rows) is resolved by a prefix-sum + searchsorted "expand" pattern
+with a host-chosen output capacity, then candidates are verified against
+the actual key columns so hash collisions only cost masked-out lanes.
+
+Join types: inner, left, semi (IN/EXISTS), anti (NOT IN/NOT EXISTS);
+right/full are planned as flipped/united variants by the planner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.ops import common
+
+CVal = Tuple[jnp.ndarray, jnp.ndarray]
+
+
+@dataclasses.dataclass
+class BuildTable:
+    """Sorted-by-hash build side, ready for probing. A pytree."""
+    sorted_hash: jnp.ndarray          # [n] int64, invalid rows at +inf end
+    sorted_keys: List[CVal]           # key columns in hash order
+    sorted_row: jnp.ndarray           # [n] original row index
+    valid_count: jnp.ndarray          # scalar: live build rows
+    batch: Batch                      # original (compacted) build rows
+
+
+jax.tree_util.register_pytree_node(
+    BuildTable,
+    lambda t: ((t.sorted_hash, t.sorted_keys, t.sorted_row, t.valid_count,
+                t.batch), None),
+    lambda _, c: BuildTable(*c),
+)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def build(batch: Batch, key_names: Tuple[str, ...]) -> BuildTable:
+    """Index the build side: hash keys, sort rows by hash.
+
+    Rows with any NULL key never match an equi-join; they are pushed to
+    the end by giving them the maximum hash and marking them invalid.
+    """
+    keys = [batch.columns[k].astuple() for k in key_names]
+    valid = batch.row_valid
+    for _, m in keys:
+        valid = valid & m
+    h = common.row_hash(keys)
+    h = jnp.where(valid, h, jnp.iinfo(jnp.int64).max)
+    order = jnp.argsort(h, stable=True)
+    # (identical keys need not be adjacent within a hash run: expand()
+    #  scans the whole run and verifies actual keys per candidate)
+    sorted_keys = common.take(keys, order)
+    return BuildTable(
+        sorted_hash=h[order],
+        sorted_keys=sorted_keys,
+        sorted_row=order,
+        valid_count=jnp.sum(valid),
+        batch=batch,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def probe_counts(table: BuildTable, probe: Batch,
+                 key_names: Tuple[str, ...]):
+    """Per-probe-row candidate run [lo, hi) in the sorted build, plus the
+    verified match count (collision-free)."""
+    keys = [probe.columns[k].astuple() for k in key_names]
+    valid = probe.row_valid
+    for _, m in keys:
+        valid = valid & m
+    h = common.row_hash(keys)
+    lo = jnp.searchsorted(table.sorted_hash, h, side="left")
+    hi = jnp.searchsorted(table.sorted_hash, h, side="right")
+    lo = jnp.where(valid, lo, 0)
+    hi = jnp.where(valid, hi, 0)
+    # candidate counts include collisions; exact verification happens in
+    # expand(), but totals for capacity use hi-lo (an upper bound).
+    counts = hi - lo
+    return lo, hi, counts, valid
+
+
+def expand(table: BuildTable, probe: Batch, key_names: Tuple[str, ...],
+           lo, hi, counts, probe_key_valid,
+           out_capacity: int, join_type: str = "inner",
+           probe_prefix: str = "", build_prefix: str = "",
+           build_output: Optional[Sequence[str]] = None,
+           probe_output: Optional[Sequence[str]] = None) -> Batch:
+    """Materialize join output rows with a static `out_capacity`.
+
+    Output slot j belongs to probe row p(j) = searchsorted(cum, j) where
+    cum is the exclusive prefix sum of per-probe output counts; its build
+    candidate is build_slot = lo[p] + (j - cum[p]). Collision candidates
+    are masked out by comparing actual keys.
+    """
+    return _expand(table, probe, tuple(key_names), lo, hi, counts,
+                   probe_key_valid, out_capacity, join_type,
+                   tuple(probe_output if probe_output is not None
+                         else probe.names),
+                   tuple(build_output if build_output is not None
+                         else table.batch.names),
+                   probe_prefix, build_prefix)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 7, 8, 9, 10, 11, 12))
+def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
+            probe_key_valid, out_capacity: int, join_type: str,
+            probe_output, build_output, probe_prefix, build_prefix) -> Batch:
+    left_join = join_type == "left"
+    # per-probe emitted rows: matches, or 1 unmatched row for LEFT
+    emit = counts
+    if left_join:
+        emit = jnp.where(probe.row_valid & (counts == 0), 1, counts)
+        emit = jnp.where(probe.row_valid, emit, 0)
+    cum = jnp.cumsum(emit) - emit  # exclusive prefix
+    total = cum[-1] + emit[-1] if emit.shape[0] else jnp.asarray(0)
+
+    slots = jnp.arange(out_capacity)
+    # which probe row does output slot j come from?
+    pid = jnp.searchsorted(cum, slots, side="right") - 1
+    pid = jnp.clip(pid, 0, emit.shape[0] - 1)
+    k = slots - cum[pid]                      # k-th emission of that row
+    slot_live = slots < total
+    is_match = slot_live & (k < counts[pid])
+    bslot = jnp.clip(lo[pid] + k, 0, table.sorted_hash.shape[0] - 1)
+    brow = table.sorted_row[bslot]
+
+    # verify actual keys (hash collisions -> mask out)
+    verified = is_match
+    for kn in key_names:
+        pd, pm = probe.columns[kn].astuple()
+        bd, bm = table.batch.columns[kn].astuple()
+        same = (pd[pid] == bd[brow]) & pm[pid] & bm[brow]
+        verified = verified & same
+
+    if left_join:
+        # a probe row with zero *verified* matches must still emit one
+        # NULL-build row — including when all its hash-run candidates
+        # failed key verification (collision). Reuse its k==0 slot.
+        any_verified = jax.ops.segment_max(
+            verified.astype(jnp.int32), pid,
+            num_segments=emit.shape[0], indices_are_sorted=True) > 0
+        unmatched = slot_live & (k == 0) & ~any_verified[pid] \
+            & probe.row_valid[pid]
+        live = verified | unmatched
+    else:
+        live = verified
+
+    cols: Dict[str, Column] = {}
+    for name in probe_output:
+        c = probe.columns[name]
+        cols[probe_prefix + name] = Column(
+            c.data[pid], c.mask[pid] & live, c.type, c.dictionary)
+    for name in build_output:
+        c = table.batch.columns[name]
+        bmask = c.mask[brow] & verified  # NULL build side on unmatched
+        cols[build_prefix + name] = Column(c.data[brow], bmask, c.type,
+                                           c.dictionary)
+    return Batch(cols, live)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def semi_mark(table: BuildTable, probe: Batch, key_names: Tuple[str, ...]):
+    """For each probe row: does any build row share its key? Verified
+    exactly by scanning the (short) candidate run with a bounded loop of
+    gathers — runs are capped via MAX_RUN; longer runs fall back to
+    hash-equality (duplicates in build make long runs of identical keys,
+    for which hash equality IS key equality modulo collisions)."""
+    keys = [probe.columns[k].astuple() for k in key_names]
+    valid = probe.row_valid
+    for _, m in keys:
+        valid = valid & m
+    h = common.row_hash(keys)
+    lo = jnp.searchsorted(table.sorted_hash, h, side="left")
+    hi = jnp.searchsorted(table.sorted_hash, h, side="right")
+    MAX_RUN = 4
+    found = jnp.zeros_like(valid)
+    for i in range(MAX_RUN):
+        slot = jnp.clip(lo + i, 0, table.sorted_hash.shape[0] - 1)
+        in_run = (lo + i) < hi
+        brow = table.sorted_row[slot]
+        same = in_run
+        for (pd, pm), kn in zip(keys, key_names):
+            bd, bm = table.batch.columns[kn].astuple()
+            same = same & (pd == bd[brow]) & pm & bm[brow]
+        found = found | same
+    # long runs: treat hash-run membership as a match (collision risk
+    # bounded by 64-bit hash; exact for duplicate-heavy build keys)
+    found = found | ((hi - lo) > MAX_RUN)
+    return found & valid, valid

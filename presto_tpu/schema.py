@@ -1,0 +1,43 @@
+"""Relation schemas: the static (compile-time) metadata about columns.
+
+Dictionaries for VARCHAR columns are part of the static schema: connectors
+declare them at plan time (tpch data is generated from known value sets),
+and projections propagate/derive them, so every compiled kernel knows the
+code<->string mapping without touching device data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from presto_tpu.types import Type
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    type: Type
+    dictionary: Optional[Tuple[str, ...]] = None  # sorted, for string types
+
+
+@dataclasses.dataclass(frozen=True)
+class RelationSchema:
+    columns: Tuple[ColumnSchema, ...]
+
+    @property
+    def names(self):
+        return [c.name for c in self.columns]
+
+    def column(self, name: str) -> ColumnSchema:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    @staticmethod
+    def of(*cols: ColumnSchema) -> "RelationSchema":
+        return RelationSchema(tuple(cols))

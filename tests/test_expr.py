@@ -1,0 +1,220 @@
+"""Expression compiler tests (reference analog: presto-main
+sql/gen tests + operator/scalar function tests)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.batch import Batch
+from presto_tpu.expr.ir import (
+    Call, InputRef, Literal, SpecialForm, and_, lit, or_, ref,
+)
+from presto_tpu.expr.compile import (
+    compile_expression, fold_constants, ExpressionCompileError,
+)
+from presto_tpu.expr.dates import parse_date_literal
+from presto_tpu.schema import ColumnSchema
+from presto_tpu.types import (
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTERVAL_DAY, VARCHAR, decimal_type,
+)
+
+
+def env_of(batch: Batch):
+    return {n: (c.data, c.mask) for n, c in batch.columns.items()}
+
+
+def schema_of(batch: Batch):
+    return {n: ColumnSchema(n, c.type, c.dictionary)
+            for n, c in batch.columns.items()}
+
+
+def run(expr, batch):
+    c = compile_expression(expr, schema_of(batch))
+    d, m = c.fn(env_of(batch))
+    d = np.broadcast_to(np.asarray(d), (batch.capacity,))
+    m = np.broadcast_to(np.asarray(m), (batch.capacity,))
+    rv = np.asarray(batch.row_valid)
+    out = []
+    for i in np.nonzero(rv)[0]:
+        if not m[i]:
+            out.append(None)
+        elif c.dictionary is not None:
+            out.append(c.dictionary[int(d[i])])
+        else:
+            out.append(d[i].item())
+    return out, c
+
+
+BATCH = Batch.from_pydict({
+    "a": ([1, 2, None, 4], BIGINT),
+    "b": ([10.0, None, 30.0, 40.0], DOUBLE),
+    "flag": ([True, False, True, None], BOOLEAN),
+    "s": (["apple", "banana", None, "cherry"], VARCHAR),
+    "d": ([parse_date_literal("1995-01-15"), parse_date_literal("1996-06-30"),
+           parse_date_literal("1998-12-01"), None], DATE),
+})
+
+
+def test_arith_nulls():
+    e = Call("add", (ref("a", BIGINT), lit(10, BIGINT)), BIGINT)
+    vals, _ = run(e, BATCH)
+    assert vals == [11, 12, None, 14]
+
+
+def test_mixed_int_double():
+    e = Call("multiply", (ref("a", BIGINT), ref("b", DOUBLE)), DOUBLE)
+    vals, _ = run(e, BATCH)
+    assert vals == [10.0, None, None, 160.0]
+
+
+def test_three_valued_and_or():
+    # flag AND (a > 1): [T&F=F, F&T=F, T&NULL=NULL, NULL&T=NULL]
+    gt = Call("greater_than", (ref("a", BIGINT), lit(1, BIGINT)), BOOLEAN)
+    vals, _ = run(and_(ref("flag", BOOLEAN), gt), BATCH)
+    assert vals == [False, False, None, None]
+    vals, _ = run(or_(ref("flag", BOOLEAN), gt), BATCH)
+    assert vals == [True, True, True, True]
+
+
+def test_or_null_propagation():
+    b = Batch.from_pydict({"x": ([False, None], BOOLEAN),
+                           "y": ([None, None], BOOLEAN)})
+    vals, _ = run(or_(ref("x", BOOLEAN), ref("y", BOOLEAN)), b)
+    assert vals == [None, None]
+
+
+def test_is_null_coalesce_if():
+    e = SpecialForm("is_null", (ref("a", BIGINT),), BOOLEAN)
+    assert run(e, BATCH)[0] == [False, False, True, False]
+    e = SpecialForm("coalesce", (ref("a", BIGINT), lit(-1, BIGINT)), BIGINT)
+    assert run(e, BATCH)[0] == [1, 2, -1, 4]
+    cond = Call("greater_than", (ref("a", BIGINT), lit(1, BIGINT)), BOOLEAN)
+    e = SpecialForm("if", (cond, lit(100, BIGINT), lit(0, BIGINT)), BIGINT)
+    # null cond -> else branch
+    assert run(e, BATCH)[0] == [0, 100, 0, 100]
+
+
+def test_string_predicates_via_dictionary():
+    e = Call("equal", (ref("s", VARCHAR), lit("banana", VARCHAR)), BOOLEAN)
+    assert run(e, BATCH)[0] == [False, True, None, False]
+    e = Call("less_than", (ref("s", VARCHAR), lit("b", VARCHAR)), BOOLEAN)
+    assert run(e, BATCH)[0] == [True, False, None, False]
+    e = Call("like", (ref("s", VARCHAR), lit("%an%", VARCHAR)), BOOLEAN)
+    assert run(e, BATCH)[0] == [False, True, None, False]
+    e = SpecialForm("in", (ref("s", VARCHAR), lit("apple", VARCHAR),
+                           lit("cherry", VARCHAR)), BOOLEAN)
+    assert run(e, BATCH)[0] == [True, False, None, True]
+
+
+def test_string_functions_produce_new_dictionary():
+    e = Call("substr", (ref("s", VARCHAR), lit(1, BIGINT), lit(2, BIGINT)),
+             VARCHAR)
+    vals, c = run(e, BATCH)
+    assert vals == ["ap", "ba", None, "ch"]
+    assert c.dictionary == ("ap", "ba", "ch")
+    e = Call("upper", (ref("s", VARCHAR),), VARCHAR)
+    assert run(e, BATCH)[0] == ["APPLE", "BANANA", None, "CHERRY"]
+    e = Call("length", (ref("s", VARCHAR),), BIGINT)
+    assert run(e, BATCH)[0] == [5, 6, None, 6]
+
+
+def test_date_extract_and_interval():
+    e = Call("year", (ref("d", DATE),), BIGINT)
+    assert run(e, BATCH)[0] == [1995, 1996, 1998, None]
+    e = Call("month", (ref("d", DATE),), BIGINT)
+    assert run(e, BATCH)[0] == [1, 6, 12, None]
+    # date '1998-12-01' - interval '90' day = 1998-09-02
+    e = Call("subtract", (lit(parse_date_literal("1998-12-01"), DATE),
+                          lit(90 * 86_400_000, INTERVAL_DAY)), DATE)
+    folded = fold_constants(e)
+    assert isinstance(folded, Literal)
+    assert folded.value == parse_date_literal("1998-09-02")
+
+
+def test_decimal_arithmetic():
+    t2 = decimal_type(15, 2)
+    b = Batch.from_pydict({"p": ([10.25, 20.50, 3.33], t2),
+                           "q": ([2, 3, 4], BIGINT)})
+    # p * 2 (decimal * bigint -> decimal scale 2)
+    e = Call("multiply", (ref("p", t2), lit(2, BIGINT)), t2)
+    assert run(e, b)[0] == [2050, 4100, 666]  # unscaled
+    # 1 - discount style: scale-preserving subtract
+    e = Call("subtract", (lit(100, t2), ref("p", t2)), t2)
+    assert run(e, b)[0] == [-925, -1950, -233]
+    # decimal / decimal, HALF_UP
+    t1 = decimal_type(10, 1)
+    e = Call("divide", (ref("p", t2), lit(200, t2)), t1)
+    # 10.25/2.00 = 5.125 -> 5.1 ; 20.50/2.00 = 10.25 -> 10.3 (half up)
+    assert run(e, b)[0] == [51, 103, 17]
+
+
+def test_integer_division_truncates():
+    b = Batch.from_pydict({"x": ([7, -7, 9], BIGINT)})
+    e = Call("divide", (ref("x", BIGINT), lit(2, BIGINT)), BIGINT)
+    assert run(e, b)[0] == [3, -3, 4]
+    e = Call("modulus", (ref("x", BIGINT), lit(2, BIGINT)), BIGINT)
+    assert run(e, b)[0] == [1, -1, 1]
+
+
+def test_division_by_zero_is_null():
+    b = Batch.from_pydict({"x": ([6, 8], BIGINT)})
+    e = Call("divide", (ref("x", BIGINT), lit(0, BIGINT)), BIGINT)
+    assert run(e, b)[0] == [None, None]
+
+
+def test_cast():
+    e = SpecialForm("cast", (ref("a", BIGINT),), DOUBLE)
+    assert run(e, BATCH)[0] == [1.0, 2.0, None, 4.0]
+    t = decimal_type(10, 2)
+    e = SpecialForm("cast", (ref("a", BIGINT),), t)
+    assert run(e, BATCH)[0] == [100, 200, None, 400]
+
+
+def test_between_desugar():
+    e = SpecialForm("between", (ref("a", BIGINT), lit(2, BIGINT),
+                                lit(4, BIGINT)), BOOLEAN)
+    assert run(e, BATCH)[0] == [False, True, None, True]
+
+
+def test_in_int():
+    e = SpecialForm("in", (ref("a", BIGINT), lit(1, BIGINT),
+                           lit(4, BIGINT)), BOOLEAN)
+    assert run(e, BATCH)[0] == [True, False, None, True]
+
+
+def test_fold_constants():
+    e = Call("add", (lit(2, BIGINT), Call("multiply", (lit(3, BIGINT),
+             lit(4, BIGINT)), BIGINT)), BIGINT)
+    f = fold_constants(e)
+    assert isinstance(f, Literal) and f.value == 14
+
+
+def test_unknown_column_raises():
+    with pytest.raises(ExpressionCompileError):
+        compile_expression(ref("nope", BIGINT), {})
+
+
+def test_string_literal_vs_literal_comparison():
+    # regression: both sides single-entry dictionaries must not recurse
+    e = Call("equal", (lit("a", VARCHAR), lit("b", VARCHAR)), BOOLEAN)
+    assert run(e, BATCH)[0] == [False, False, False, False]
+    e = Call("less_than", (lit("a", VARCHAR), lit("b", VARCHAR)), BOOLEAN)
+    assert run(e, BATCH)[0] == [True, True, True, True]
+
+
+def test_interval_year_month_end_clamp():
+    from presto_tpu.types import INTERVAL_YEAR
+    # 2020-03-31 + 1 month = 2020-04-30 (clamp to last day of April)
+    e = Call("add", (lit(parse_date_literal("2020-03-31"), DATE),
+                     lit(1, INTERVAL_YEAR)), DATE)
+    f = fold_constants(e)
+    assert isinstance(f, Literal)
+    assert f.value == parse_date_literal("2020-04-30")
+    # 2020-01-31 + 1 month = 2020-02-29 (leap year)
+    e = Call("add", (lit(parse_date_literal("2020-01-31"), DATE),
+                     lit(1, INTERVAL_YEAR)), DATE)
+    assert fold_constants(e).value == parse_date_literal("2020-02-29")
+
+
+def test_substr_negative_start():
+    e = Call("substr", (ref("s", VARCHAR), lit(-2, BIGINT)), VARCHAR)
+    assert run(e, BATCH)[0] == ["le", "na", None, "ry"]
