@@ -325,6 +325,20 @@ def unify_dictionaries(cols: Sequence[Column]) -> List[Column]:
     return out
 
 
+def remap_column(col: Column, target: Tuple[str, ...]) -> Column:
+    """Re-encode a string column onto `target` (a superset dictionary,
+    sorted). Used to align join-key codes across tables."""
+    if col.dictionary == target:
+        return col
+    if col.dictionary is None:
+        raise ValueError("remap_column: column has no dictionary")
+    index = {v: i for i, v in enumerate(target)}
+    remap = np.array([index[v] for v in col.dictionary] or [0],
+                     dtype=np.int32)
+    return Column(jnp.asarray(remap)[col.data], col.mask, col.type,
+                  target)
+
+
 def _to_unscaled(v, scale: int) -> int:
     """Exact decimal encoding: ints and Decimals never pass through float."""
     import decimal as _dec

@@ -13,7 +13,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from presto_tpu.batch import Batch, bucket_capacity
+from presto_tpu.batch import Batch, bucket_capacity, remap_column
 from presto_tpu.operators.base import (
     DriverContext, Operator, OperatorContext, OperatorFactory,
 )
@@ -33,13 +33,20 @@ class JoinBridge:
 
 class HashBuildOperator(Operator):
     """Sink of the build pipeline: accumulates batches, indexes on
-    finish (reference: HashBuilderOperator.java:51)."""
+    finish (reference: HashBuilderOperator.java:51).
+
+    `key_dicts` (parallel to key_names; None for non-string keys) is the
+    planner-computed *unified* dictionary for each string key: both join
+    sides re-encode their codes onto it so code equality == string
+    equality across tables."""
 
     def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
-                 key_names: Tuple[str, ...]):
+                 key_names: Tuple[str, ...],
+                 key_dicts: Optional[List[Optional[tuple]]] = None):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
+        self.key_dicts = key_dicts
         self._batches: List[Batch] = []
         self._finished = False
 
@@ -48,7 +55,8 @@ class HashBuildOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
-        self._batches.append(batch)
+        self._batches.append(_remap_keys(batch, self.key_names,
+                                         self.key_dicts))
 
     def get_output(self) -> Optional[Batch]:
         return None
@@ -80,10 +88,14 @@ class LookupJoinOperator(Operator):
     def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
                  key_names: Tuple[str, ...], join_type: str,
                  probe_output: Sequence[str], build_output: Sequence[str],
-                 build_rename: Optional[dict] = None):
+                 build_rename: Optional[dict] = None,
+                 build_keys: Optional[Tuple[str, ...]] = None,
+                 key_dicts: Optional[List[Optional[tuple]]] = None):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
+        self.build_keys = build_keys  # None -> kernel defaults
+        self.key_dicts = key_dicts
         self.join_type = join_type
         self.probe_output = list(probe_output)
         self.build_output = list(build_output)
@@ -100,6 +112,7 @@ class LookupJoinOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        batch = _remap_keys(batch, self.key_names, self.key_dicts)
         table = self.bridge.table
         lo, hi, counts, pkv = join_ops.probe_counts(
             table, batch, self.key_names)
@@ -112,7 +125,7 @@ class LookupJoinOperator(Operator):
         out = join_ops.expand(
             table, batch, self.key_names, lo, hi, counts, pkv, cap,
             self.join_type, probe_output=self.probe_output,
-            build_output=self.build_output)
+            build_output=self.build_output, build_keys=self.build_keys)
         if self.build_rename:
             out = out.rename(self.build_rename)
         self._pending = out
@@ -134,10 +147,14 @@ class SemiJoinOperator(Operator):
     anti-join semantics for non-null keys)."""
 
     def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
-                 key_names: Tuple[str, ...], negate: bool):
+                 key_names: Tuple[str, ...], negate: bool,
+                 build_keys: Optional[Tuple[str, ...]] = None,
+                 key_dicts: Optional[List[Optional[tuple]]] = None):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
+        self.build_keys = build_keys
+        self.key_dicts = key_dicts
         self.negate = negate
         self._pending: Optional[Batch] = None
         self._finishing = False
@@ -151,8 +168,9 @@ class SemiJoinOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
-        found, valid = join_ops.semi_mark(self.bridge.table, batch,
-                                          self.key_names)
+        probe = _remap_keys(batch, self.key_names, self.key_dicts)
+        found, valid = join_ops.semi_mark(self.bridge.table, probe,
+                                          self.key_names, self.build_keys)
         keep = (~found & valid) if self.negate else found
         self._pending = batch.filter(keep)
 
@@ -167,27 +185,44 @@ class SemiJoinOperator(Operator):
         return self._finishing and self._pending is None
 
 
+def _remap_keys(batch: Batch, key_names, key_dicts) -> Batch:
+    """Align string key columns to the planner's unified dictionaries."""
+    if not key_dicts:
+        return batch
+    cols = dict(batch.columns)
+    for name, dic in zip(key_names, key_dicts):
+        if dic is not None and cols[name].dictionary != dic:
+            cols[name] = remap_column(cols[name], dic)
+    return Batch(cols, batch.row_valid)
+
+
 class HashBuildOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, bridge: JoinBridge,
-                 key_names: Sequence[str]):
+                 key_names: Sequence[str],
+                 key_dicts: Optional[List[Optional[tuple]]] = None):
         super().__init__(operator_id, "hash_build")
         self.bridge = bridge
         self.key_names = tuple(key_names)
+        self.key_dicts = key_dicts
 
     def create(self, driver_context: DriverContext) -> Operator:
         return HashBuildOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self.bridge, self.key_names)
+            self.bridge, self.key_names, self.key_dicts)
 
 
 class LookupJoinOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, bridge: JoinBridge,
                  key_names: Sequence[str], join_type: str,
                  probe_output: Sequence[str], build_output: Sequence[str],
-                 build_rename: Optional[dict] = None):
+                 build_rename: Optional[dict] = None,
+                 build_keys: Optional[Sequence[str]] = None,
+                 key_dicts: Optional[List[Optional[tuple]]] = None):
         super().__init__(operator_id, f"lookup_join({join_type})")
         self.bridge = bridge
         self.key_names = tuple(key_names)
+        self.build_keys = tuple(build_keys) if build_keys else None
+        self.key_dicts = key_dicts
         self.join_type = join_type
         self.probe_output = probe_output
         self.build_output = build_output
@@ -197,18 +232,24 @@ class LookupJoinOperatorFactory(OperatorFactory):
         return LookupJoinOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.bridge, self.key_names, self.join_type,
-            self.probe_output, self.build_output, self.build_rename)
+            self.probe_output, self.build_output, self.build_rename,
+            self.build_keys, self.key_dicts)
 
 
 class SemiJoinOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, bridge: JoinBridge,
-                 key_names: Sequence[str], negate: bool = False):
+                 key_names: Sequence[str], negate: bool = False,
+                 build_keys: Optional[Sequence[str]] = None,
+                 key_dicts: Optional[List[Optional[tuple]]] = None):
         super().__init__(operator_id, "semi_join")
         self.bridge = bridge
         self.key_names = tuple(key_names)
+        self.build_keys = tuple(build_keys) if build_keys else None
+        self.key_dicts = key_dicts
         self.negate = negate
 
     def create(self, driver_context: DriverContext) -> Operator:
         return SemiJoinOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self.bridge, self.key_names, self.negate)
+            self.bridge, self.key_names, self.negate, self.build_keys,
+            self.key_dicts)

@@ -1,0 +1,286 @@
+"""Cross join, EnforceSingleRow, and local union plumbing (reference:
+NestedLoopBuildOperator/NestedLoopJoinOperator, EnforceSingleRowOperator,
+and operator/exchange/LocalExchange.java:64 for the union queue)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column, bucket_capacity
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+
+
+class NestedLoopBridge:
+    """Materialized build side for cross joins."""
+
+    def __init__(self):
+        self.batch: Optional[Batch] = None
+
+    @property
+    def ready(self) -> bool:
+        return self.batch is not None
+
+
+class NestedLoopBuildOperator(Operator):
+    def __init__(self, ctx: OperatorContext, bridge: NestedLoopBridge):
+        super().__init__(ctx)
+        self.bridge = bridge
+        self._batches: List[Batch] = []
+        self._finished = False
+
+    def needs_input(self) -> bool:
+        return not self._finished
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self._batches.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        if not self._batches:
+            raise RuntimeError("empty cross-join build needs schema "
+                               "plumbing (planner bug)")
+        total = sum(b.num_valid() for b in self._batches)
+        self.bridge.batch = Batch.concat(
+            self._batches, bucket_capacity(max(total, 1)))
+        self._batches = []
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+
+class NestedLoopJoinOperator(Operator):
+    """Cross product; build sides here are small by construction
+    (scalar subqueries, EXISTS counts, tiny dimension tables)."""
+
+    def __init__(self, ctx: OperatorContext, bridge: NestedLoopBridge):
+        super().__init__(ctx)
+        self.bridge = bridge
+        self._pending: Optional[Batch] = None
+        self._finishing = False
+
+    def is_blocked(self):
+        return False if self.bridge.ready else "waiting for nl build"
+
+    def needs_input(self) -> bool:
+        return self.bridge.ready and self._pending is None \
+            and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        build = self.bridge.batch
+        nb = build.num_valid()
+        np_rows = batch.num_valid()
+        out_cap = bucket_capacity(max(nb * np_rows, 1))
+        if out_cap > 1 << 24:
+            raise RuntimeError(
+                f"cross join would materialize {nb * np_rows} rows; "
+                "add a join condition")
+        self._pending = _cross_product(
+            batch.compact(), build.compact(), out_cap)
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _cross_product(probe: Batch, build: Batch, out_cap: int) -> Batch:
+    nb_valid = jnp.sum(build.row_valid)
+    np_valid = jnp.sum(probe.row_valid)
+    slots = jnp.arange(out_cap)
+    pid = slots // jnp.maximum(nb_valid, 1)
+    bid = slots % jnp.maximum(nb_valid, 1)
+    live = slots < (nb_valid * np_valid)
+    pid = jnp.clip(pid, 0, probe.capacity - 1)
+    bid = jnp.clip(bid, 0, build.capacity - 1)
+    cols: Dict[str, Column] = {}
+    for name, c in probe.columns.items():
+        cols[name] = Column(c.data[pid], c.mask[pid] & live, c.type,
+                            c.dictionary)
+    for name, c in build.columns.items():
+        cols[name] = Column(c.data[bid], c.mask[bid] & live, c.type,
+                            c.dictionary)
+    return Batch(cols, live)
+
+
+class EnforceSingleRowOperator(Operator):
+    """Scalar subquery contract (reference: EnforceSingleRowOperator):
+    error on >1 row; a 0-row input yields one all-NULL row."""
+
+    def __init__(self, ctx: OperatorContext):
+        super().__init__(ctx)
+        self._batches: List[Batch] = []
+        self._finishing = False
+        self._emitted = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self._batches.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        total = sum(b.num_valid() for b in self._batches)
+        if total > 1:
+            raise RuntimeError(
+                "Scalar sub-query has returned multiple rows")
+        if total == 1:
+            merged = Batch.concat(self._batches, 16)
+            self._batches = []
+            return self._count_out(merged)
+        # no rows: one row of NULLs
+        proto = self._batches[0]
+        cols = {}
+        for name, c in proto.columns.items():
+            cols[name] = Column(jnp.zeros(16, c.data.dtype),
+                                jnp.zeros(16, bool), c.type, c.dictionary)
+        rv = jnp.zeros(16, bool).at[0].set(True)
+        self._batches = []
+        return self._count_out(Batch(cols, rv))
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class LocalQueue:
+    """In-process exchange between pipelines (LocalExchange.java:64)."""
+
+    def __init__(self, producers: int):
+        self.items: List[Batch] = []
+        self.open_producers = producers
+
+    def push(self, batch: Batch) -> None:
+        self.items.append(batch)
+
+    def producer_done(self) -> None:
+        self.open_producers -= 1
+
+    @property
+    def finished(self) -> bool:
+        return self.open_producers <= 0 and not self.items
+
+
+class LocalQueueSinkOperator(Operator):
+    """Tail of a producer pipeline; renames symbols to the consumer's."""
+
+    def __init__(self, ctx: OperatorContext, queue: LocalQueue,
+                 rename: Dict[str, str]):
+        super().__init__(ctx)
+        self.queue = queue
+        self.rename = rename
+        self._finished = False
+
+    def needs_input(self) -> bool:
+        return not self._finished
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self.queue.push(batch.rename(self.rename) if self.rename
+                        else batch)
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self.queue.producer_done()
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+    def close(self) -> None:
+        self.finish()
+
+
+class LocalQueueSourceOperator(Operator):
+    def __init__(self, ctx: OperatorContext, queue: LocalQueue):
+        super().__init__(ctx)
+        self.queue = queue
+
+    def needs_input(self) -> bool:
+        return False
+
+    def add_input(self, batch: Batch) -> None:
+        raise RuntimeError("source takes no input")
+
+    def is_blocked(self):
+        if self.queue.items or self.queue.finished:
+            return False
+        return "waiting for local exchange"
+
+    def get_output(self) -> Optional[Batch]:
+        if self.queue.items:
+            return self._count_out(self.queue.items.pop(0))
+        return None
+
+    def finish(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        return self.queue.finished
+
+
+class _SimpleFactory(OperatorFactory):
+    def __init__(self, operator_id: int, name: str, fn):
+        super().__init__(operator_id, name)
+        self._fn = fn
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return self._fn(OperatorContext(self.operator_id, self.name,
+                                        driver_context))
+
+
+def nested_loop_build_factory(op_id: int, bridge: NestedLoopBridge):
+    return _SimpleFactory(op_id, "nl_build",
+                          lambda ctx: NestedLoopBuildOperator(ctx, bridge))
+
+
+def nested_loop_join_factory(op_id: int, bridge: NestedLoopBridge):
+    return _SimpleFactory(op_id, "nl_join",
+                          lambda ctx: NestedLoopJoinOperator(ctx, bridge))
+
+
+def enforce_single_row_factory(op_id: int):
+    return _SimpleFactory(op_id, "enforce_single_row",
+                          EnforceSingleRowOperator)
+
+
+def queue_sink_factory(op_id: int, queue: LocalQueue,
+                       rename: Dict[str, str]):
+    return _SimpleFactory(op_id, "local_sink",
+                          lambda ctx: LocalQueueSinkOperator(ctx, queue,
+                                                             rename))
+
+
+def queue_source_factory(op_id: int, queue: LocalQueue):
+    return _SimpleFactory(op_id, "local_source",
+                          lambda ctx: LocalQueueSourceOperator(ctx, queue))

@@ -34,7 +34,6 @@ CVal = Tuple[jnp.ndarray, jnp.ndarray]
 class BuildTable:
     """Sorted-by-hash build side, ready for probing. A pytree."""
     sorted_hash: jnp.ndarray          # [n] int64, invalid rows at +inf end
-    sorted_keys: List[CVal]           # key columns in hash order
     sorted_row: jnp.ndarray           # [n] original row index
     valid_count: jnp.ndarray          # scalar: live build rows
     batch: Batch                      # original (compacted) build rows
@@ -42,7 +41,7 @@ class BuildTable:
 
 jax.tree_util.register_pytree_node(
     BuildTable,
-    lambda t: ((t.sorted_hash, t.sorted_keys, t.sorted_row, t.valid_count,
+    lambda t: ((t.sorted_hash, t.sorted_row, t.valid_count,
                 t.batch), None),
     lambda _, c: BuildTable(*c),
 )
@@ -63,11 +62,10 @@ def build(batch: Batch, key_names: Tuple[str, ...]) -> BuildTable:
     h = jnp.where(valid, h, jnp.iinfo(jnp.int64).max)
     order = jnp.argsort(h, stable=True)
     # (identical keys need not be adjacent within a hash run: expand()
-    #  scans the whole run and verifies actual keys per candidate)
-    sorted_keys = common.take(keys, order)
+    #  scans the whole run and verifies actual keys per candidate,
+    #  gathering them from batch via sorted_row)
     return BuildTable(
         sorted_hash=h[order],
-        sorted_keys=sorted_keys,
         sorted_row=order,
         valid_count=jnp.sum(valid),
         batch=batch,
@@ -76,10 +74,12 @@ def build(batch: Batch, key_names: Tuple[str, ...]) -> BuildTable:
 
 @functools.partial(jax.jit, static_argnums=(2,))
 def probe_counts(table: BuildTable, probe: Batch,
-                 key_names: Tuple[str, ...]):
+                 probe_keys: Tuple[str, ...]):
     """Per-probe-row candidate run [lo, hi) in the sorted build, plus the
-    verified match count (collision-free)."""
-    keys = [probe.columns[k].astuple() for k in key_names]
+    verified match count (collision-free). `probe_keys` name the probe
+    batch's key columns (build key names may differ — symbols are
+    per-side in the planner)."""
+    keys = [probe.columns[k].astuple() for k in probe_keys]
     valid = probe.row_valid
     for _, m in keys:
         valid = valid & m
@@ -94,12 +94,13 @@ def probe_counts(table: BuildTable, probe: Batch,
     return lo, hi, counts, valid
 
 
-def expand(table: BuildTable, probe: Batch, key_names: Tuple[str, ...],
+def expand(table: BuildTable, probe: Batch, key_names,
            lo, hi, counts, probe_key_valid,
            out_capacity: int, join_type: str = "inner",
            probe_prefix: str = "", build_prefix: str = "",
            build_output: Optional[Sequence[str]] = None,
-           probe_output: Optional[Sequence[str]] = None) -> Batch:
+           probe_output: Optional[Sequence[str]] = None,
+           build_keys: Optional[Sequence[str]] = None) -> Batch:
     """Materialize join output rows with a static `out_capacity`.
 
     Output slot j belongs to probe row p(j) = searchsorted(cum, j) where
@@ -107,19 +108,25 @@ def expand(table: BuildTable, probe: Batch, key_names: Tuple[str, ...],
     candidate is build_slot = lo[p] + (j - cum[p]). Collision candidates
     are masked out by comparing actual keys.
     """
+    if build_keys is not None:
+        assert len(build_keys) == len(key_names), \
+            "probe/build key lists must have equal length"
     return _expand(table, probe, tuple(key_names), lo, hi, counts,
                    probe_key_valid, out_capacity, join_type,
                    tuple(probe_output if probe_output is not None
                          else probe.names),
                    tuple(build_output if build_output is not None
                          else table.batch.names),
-                   probe_prefix, build_prefix)
+                   probe_prefix, build_prefix,
+                   tuple(build_keys) if build_keys is not None
+                   else tuple(key_names))
 
 
-@functools.partial(jax.jit, static_argnums=(2, 7, 8, 9, 10, 11, 12))
+@functools.partial(jax.jit, static_argnums=(2, 7, 8, 9, 10, 11, 12, 13))
 def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
             probe_key_valid, out_capacity: int, join_type: str,
-            probe_output, build_output, probe_prefix, build_prefix) -> Batch:
+            probe_output, build_output, probe_prefix, build_prefix,
+            build_keys) -> Batch:
     left_join = join_type == "left"
     # per-probe emitted rows: matches, or 1 unmatched row for LEFT
     emit = counts
@@ -141,9 +148,9 @@ def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
 
     # verify actual keys (hash collisions -> mask out)
     verified = is_match
-    for kn in key_names:
+    for kn, bn in zip(key_names, build_keys):
         pd, pm = probe.columns[kn].astuple()
-        bd, bm = table.batch.columns[kn].astuple()
+        bd, bm = table.batch.columns[bn].astuple()
         same = (pd[pid] == bd[brow]) & pm[pid] & bm[brow]
         verified = verified & same
 
@@ -173,13 +180,17 @@ def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
     return Batch(cols, live)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def semi_mark(table: BuildTable, probe: Batch, key_names: Tuple[str, ...]):
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def semi_mark(table: BuildTable, probe: Batch, key_names: Tuple[str, ...],
+              build_keys: Optional[Tuple[str, ...]] = None):
     """For each probe row: does any build row share its key? Verified
     exactly by scanning the (short) candidate run with a bounded loop of
     gathers — runs are capped via MAX_RUN; longer runs fall back to
     hash-equality (duplicates in build make long runs of identical keys,
     for which hash equality IS key equality modulo collisions)."""
+    build_keys = build_keys or key_names
+    assert len(build_keys) == len(key_names), \
+        "probe/build key lists must have equal length"
     keys = [probe.columns[k].astuple() for k in key_names]
     valid = probe.row_valid
     for _, m in keys:
@@ -194,8 +205,8 @@ def semi_mark(table: BuildTable, probe: Batch, key_names: Tuple[str, ...]):
         in_run = (lo + i) < hi
         brow = table.sorted_row[slot]
         same = in_run
-        for (pd, pm), kn in zip(keys, key_names):
-            bd, bm = table.batch.columns[kn].astuple()
+        for (pd, pm), bn in zip(keys, build_keys):
+            bd, bm = table.batch.columns[bn].astuple()
             same = same & (pd == bd[brow]) & pm & bm[brow]
         found = found | same
     # long runs: treat hash-run membership as a match (collision risk

@@ -1,0 +1,444 @@
+"""Local execution planner: PlanNode tree -> operator pipelines
+(reference: sql/planner/LocalExecutionPlanner.java:549 — the Visitor at
+:804 producing PhysicalOperation chains / DriverFactories).
+
+A pipeline is an ordered list of OperatorFactories with one source at
+the head; joins/semijoins/unions spawn dependent pipelines that feed
+bridges/queues, exactly like the reference's build/probe DriverFactory
+split."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.batch import Batch, DEFAULT_BATCH_ROWS
+from presto_tpu.expr.compile import CompiledExpr, compile_expression
+from presto_tpu.expr.ir import InputRef, RowExpression, walk, InputRef
+from presto_tpu.operators import misc_ops
+from presto_tpu.operators.aggregation import (
+    AggSpec, AggregationOperatorFactory,
+)
+from presto_tpu.operators.core import (
+    FilterProjectOperatorFactory, OutputCollectorOperatorFactory,
+    TableScanOperatorFactory, ValuesOperatorFactory,
+)
+from presto_tpu.operators.join_ops import (
+    HashBuildOperatorFactory, JoinBridge, LookupJoinOperatorFactory,
+    SemiJoinOperatorFactory,
+)
+from presto_tpu.operators.sort_ops import (
+    DistinctOperatorFactory, OrderByOperatorFactory, TopNOperatorFactory,
+)
+from presto_tpu.ops import hashagg
+from presto_tpu.planner import nodes as N
+from presto_tpu.schema import ColumnSchema
+from presto_tpu.types import DOUBLE, Type
+from presto_tpu.expr.ir import SpecialForm
+
+
+@dataclasses.dataclass
+class LocalExecutionPlan:
+    pipelines: List[List]              # of OperatorFactory
+    result_sink: List[Batch]
+    result_names: List[str]
+    result_fields: Tuple[N.Field, ...]
+
+
+class LocalPlanningError(Exception):
+    pass
+
+
+def _schema_of(node: N.PlanNode) -> Dict[str, ColumnSchema]:
+    return {f.symbol: ColumnSchema(f.symbol, f.type, f.dictionary)
+            for f in node.output}
+
+
+class LocalExecutionPlanner:
+    def __init__(self, catalog_manager, session):
+        self.catalogs = catalog_manager
+        self.session = session
+        self._pipelines: List[List] = []
+        self._op_id = 0
+
+    def _next_id(self) -> int:
+        self._op_id += 1
+        return self._op_id
+
+    def plan(self, root: N.OutputNode) -> LocalExecutionPlan:
+        prune_unused_columns(root)
+        sink: List[Batch] = []
+        pipeline: List = []
+        self._visit(root.source, pipeline)
+        # final projection to output order
+        src_schema = _schema_of(root.source)
+        projections = []
+        for sym in root.source_symbols:
+            cs = src_schema[sym]
+            projections.append(
+                (sym, compile_expression(InputRef(sym, cs.type),
+                                         src_schema)))
+        pipeline.append(FilterProjectOperatorFactory(
+            self._next_id(), None, projections))
+        pipeline.append(OutputCollectorOperatorFactory(
+            self._next_id(), sink))
+        self._pipelines.append(pipeline)
+        return LocalExecutionPlan(self._pipelines, sink, root.names,
+                                  root.output)
+
+    # ------------------------------------------------------------------
+
+    def _visit(self, node: N.PlanNode, pipe: List) -> None:
+        m = getattr(self, f"_visit_{type(node).__name__}", None)
+        if m is None:
+            raise LocalPlanningError(
+                f"no local planning for {type(node).__name__}")
+        m(node, pipe)
+
+    def _visit_TableScanNode(self, node: N.TableScanNode, pipe: List):
+        conn = self.catalogs.connector(node.handle.catalog)
+        symbols = list(node.assignments.keys())
+        columns = [node.assignments[s] for s in symbols]
+        rename = dict(zip(columns, symbols))
+        batch_rows = int(self.session.properties.get(
+            "batch_rows", DEFAULT_BATCH_ROWS))
+        target_splits = int(self.session.properties.get(
+            "target_splits", 4))
+        handle = node.handle
+
+        def batch_iter():
+            splits = conn.split_manager.get_splits(handle, target_splits)
+            for s in splits:
+                for b in conn.page_source.batches(s, columns, batch_rows):
+                    yield b.rename(rename)
+        pipe.append(TableScanOperatorFactory(
+            self._next_id(), f"scan:{handle.table}", batch_iter))
+
+    def _visit_ValuesNode(self, node: N.ValuesNode, pipe: List):
+        data = {}
+        for i, f in enumerate(node.output):
+            vals = [row[i] for row in node.rows]
+            if f.type.is_string:
+                # rows already hold dictionary codes
+                import numpy as np
+                from presto_tpu.batch import Column, bucket_capacity
+                cap = bucket_capacity(max(len(vals), 1))
+                arr = np.array([v if v is not None else 0
+                                for v in vals], f.type.np_dtype)
+                mask = np.array([v is not None for v in vals], bool)
+                data[f.symbol] = (arr, mask, f.dictionary)
+            else:
+                data[f.symbol] = (vals, None, None)
+        import numpy as np
+        from presto_tpu.batch import Column, bucket_capacity
+        import jax.numpy as jnp
+        cap = bucket_capacity(max(len(node.rows), 1))
+        cols = {}
+        for f in node.output:
+            vals, mask, dic = data[f.symbol]
+            if mask is None:
+                col = Column.from_pylist(list(vals), f.type, cap)
+            else:
+                col = Column.from_numpy(vals, mask, f.type, cap, dic)
+            cols[f.symbol] = col
+        rv = np.zeros(cap, bool)
+        rv[:len(node.rows)] = True
+        batch = Batch(cols, jnp.asarray(rv))
+        pipe.append(ValuesOperatorFactory(self._next_id(), [batch]))
+
+    def _visit_FilterNode(self, node: N.FilterNode, pipe: List):
+        self._visit(node.source, pipe)
+        schema = _schema_of(node.source)
+        pred = compile_expression(node.predicate, schema)
+        projections = [
+            (f.symbol, compile_expression(InputRef(f.symbol, f.type),
+                                          schema))
+            for f in node.output]
+        pipe.append(FilterProjectOperatorFactory(
+            self._next_id(), pred, projections))
+
+    def _visit_ProjectNode(self, node: N.ProjectNode, pipe: List):
+        self._visit(node.source, pipe)
+        schema = _schema_of(node.source)
+        projections = [(sym, compile_expression(e, schema))
+                       for sym, e in node.assignments]
+        pipe.append(FilterProjectOperatorFactory(
+            self._next_id(), None, projections))
+
+    def _visit_AggregationNode(self, node: N.AggregationNode, pipe: List):
+        self._visit(node.source, pipe)
+        schema = _schema_of(node.source)
+        key_names = [s for s, _ in node.keys]
+        key_exprs = [compile_expression(e, schema) for _, e in node.keys]
+        specs = []
+        for a in node.aggregates:
+            arg_ce = None
+            if a.argument is not None:
+                arg = a.argument
+                if a.function == "avg" and arg.type.is_decimal:
+                    arg = SpecialForm("cast", (arg,), DOUBLE)
+                arg_ce = compile_expression(arg, schema)
+            fn = self._make_agg(a, arg_ce)
+            specs.append(AggSpec(a.out_symbol, fn, arg_ce))
+        max_groups = int(self.session.properties.get("max_groups", 4096))
+        pipe.append(AggregationOperatorFactory(
+            self._next_id(), key_names, key_exprs, specs, node.step,
+            max_groups))
+
+    @staticmethod
+    def _make_agg(a: N.AggCall, arg_ce: Optional[CompiledExpr]):
+        if a.function == "count":
+            return hashagg.make_count(arg_ce.type if arg_ce else None)
+        if a.function == "sum":
+            return hashagg.make_sum(arg_ce.type, a.output_type)
+        if a.function == "avg":
+            return hashagg.make_avg(arg_ce.type)
+        if a.function == "min":
+            return hashagg.make_min(arg_ce.type)
+        if a.function == "max":
+            return hashagg.make_max(arg_ce.type)
+        raise LocalPlanningError(f"unknown aggregate {a.function}")
+
+    def _visit_JoinNode(self, node: N.JoinNode, pipe: List):
+        if node.join_type == "cross":
+            bridge = misc_ops.NestedLoopBridge()
+            build_pipe: List = []
+            self._visit(node.right, build_pipe)
+            build_pipe.append(misc_ops.nested_loop_build_factory(
+                self._next_id(), bridge))
+            self._pipelines.append(build_pipe)
+            self._visit(node.left, pipe)
+            pipe.append(misc_ops.nested_loop_join_factory(
+                self._next_id(), bridge))
+        elif node.join_type in ("inner", "left", "right"):
+            probe, build = node.left, node.right
+            criteria = node.criteria
+            jt = node.join_type
+            if jt == "right":
+                probe, build = build, probe
+                criteria = [(r, l) for l, r in criteria]
+                jt = "left"
+            bridge = JoinBridge()
+            key_dicts = _unified_key_dicts(probe, build, criteria)
+            build_pipe = []
+            self._visit(build, build_pipe)
+            build_pipe.append(HashBuildOperatorFactory(
+                self._next_id(), bridge, [r for _, r in criteria],
+                key_dicts))
+            self._pipelines.append(build_pipe)
+            self._visit(probe, pipe)
+            pipe.append(LookupJoinOperatorFactory(
+                self._next_id(), bridge,
+                [l for l, _ in criteria], jt,
+                probe_output=[f.symbol for f in probe.output],
+                build_output=[f.symbol for f in build.output],
+                build_keys=[r for _, r in criteria],
+                key_dicts=key_dicts))
+        else:
+            raise LocalPlanningError(
+                f"{node.join_type} join not supported yet")
+        if node.filter is not None:
+            schema = _schema_of(node)
+            pred = compile_expression(node.filter, schema)
+            projections = [
+                (f.symbol, compile_expression(
+                    InputRef(f.symbol, f.type), schema))
+                for f in node.output]
+            pipe.append(FilterProjectOperatorFactory(
+                self._next_id(), pred, projections))
+
+    def _visit_SemiJoinNode(self, node: N.SemiJoinNode, pipe: List):
+        bridge = JoinBridge()
+        key_dicts = _unified_key_dicts(
+            node.source, node.filtering_source,
+            [(node.source_key, node.filtering_key)])
+        build_pipe: List = []
+        self._visit(node.filtering_source, build_pipe)
+        build_pipe.append(HashBuildOperatorFactory(
+            self._next_id(), bridge, [node.filtering_key], key_dicts))
+        self._pipelines.append(build_pipe)
+        self._visit(node.source, pipe)
+        pipe.append(SemiJoinOperatorFactory(
+            self._next_id(), bridge, [node.source_key], node.negate,
+            build_keys=[node.filtering_key], key_dicts=key_dicts))
+
+    def _visit_SortNode(self, node: N.SortNode, pipe: List):
+        self._visit(node.source, pipe)
+        pipe.append(OrderByOperatorFactory(
+            self._next_id(), node.keys, node.descending,
+            node.nulls_first))
+
+    def _visit_TopNNode(self, node: N.TopNNode, pipe: List):
+        self._visit(node.source, pipe)
+        schema_cols = [(f.symbol, f.type, f.dictionary)
+                       for f in node.output]
+        pipe.append(TopNOperatorFactory(
+            self._next_id(), node.n, node.keys, node.descending,
+            node.nulls_first, schema_cols))
+
+    def _visit_LimitNode(self, node: N.LimitNode, pipe: List):
+        from presto_tpu.operators.core import LimitOperatorFactory
+        self._visit(node.source, pipe)
+        pipe.append(LimitOperatorFactory(self._next_id(), node.n))
+
+    def _visit_DistinctNode(self, node: N.DistinctNode, pipe: List):
+        self._visit(node.source, pipe)
+        schema_cols = [(f.symbol, f.type, f.dictionary)
+                       for f in node.output]
+        pipe.append(DistinctOperatorFactory(self._next_id(),
+                                            schema_cols))
+
+    def _visit_EnforceSingleRowNode(self, node, pipe: List):
+        self._visit(node.source, pipe)
+        pipe.append(misc_ops.enforce_single_row_factory(self._next_id()))
+
+    def _visit_UnionNode(self, node: N.UnionNode, pipe: List):
+        queue = misc_ops.LocalQueue(len(node.inputs))
+        for inp, symmap in zip(node.inputs, node.symbol_maps):
+            p: List = []
+            self._visit(inp, p)
+            rename = {src: out for out, src in symmap.items()}
+            p.append(misc_ops.queue_sink_factory(self._next_id(), queue,
+                                                 rename))
+            self._pipelines.append(p)
+        pipe.append(misc_ops.queue_source_factory(self._next_id(),
+                                                  queue))
+
+    def _visit_ExchangeNode(self, node: N.ExchangeNode, pipe: List):
+        # single-process mode: exchanges are free (pjit reshard analog)
+        self._visit(node.source, pipe)
+
+    def _visit_OutputNode(self, node: N.OutputNode, pipe: List):
+        self._visit(node.source, pipe)
+
+
+# ---------------------------------------------------------------------------
+
+def _unified_key_dicts(probe: N.PlanNode, build: N.PlanNode,
+                       criteria) -> Optional[List[Optional[tuple]]]:
+    """For string join keys, the union dictionary both sides re-encode
+    onto so code equality is string equality (batch.remap_column)."""
+    out: List[Optional[tuple]] = []
+    any_string = False
+    for l, r in criteria:
+        lf = probe.field(l)
+        rf = build.field(r)
+        if lf.type.is_string or rf.type.is_string:
+            any_string = True
+            merged = tuple(sorted(set(lf.dictionary or ())
+                                  | set(rf.dictionary or ())))
+            out.append(merged)
+        else:
+            out.append(None)
+    return out if any_string else None
+
+
+def prune_unused_columns(root: N.PlanNode) -> None:
+    """Demand-driven column pruning, top-down (reference:
+    PruneUnreferencedOutputs): each node narrows its output to what its
+    consumer demands and propagates its own input needs to its sources.
+    Mutates the plan in place; symbols are globally unique."""
+    if isinstance(root, N.OutputNode):
+        _prune(root.source, set(root.source_symbols))
+        return
+    _prune(root, {f.symbol for f in root.output})
+
+
+def _prune(node: N.PlanNode, demand: set) -> None:
+    def narrowed(extra: set = frozenset()):
+        want = demand | extra
+        return tuple(f for f in node.output if f.symbol in want)
+
+    if isinstance(node, N.TableScanNode):
+        keep = {s: c for s, c in node.assignments.items() if s in demand}
+        if not keep:  # keep one column so the scan still yields rows
+            first = next(iter(node.assignments.items()))
+            keep = {first[0]: first[1]}
+        node.assignments = keep
+        node.output = tuple(f for f in node.output if f.symbol in keep)
+        return
+    if isinstance(node, N.ValuesNode):
+        return
+    if isinstance(node, N.FilterNode):
+        node.output = narrowed()
+        child = set(demand)
+        _refs(node.predicate, child)
+        _prune(node.source, child)
+        return
+    if isinstance(node, N.ProjectNode):
+        node.assignments = [(s, e) for s, e in node.assignments
+                            if s in demand]
+        node.output = narrowed()
+        child: set = set()
+        for _, e in node.assignments:
+            _refs(e, child)
+        _prune(node.source, child)
+        return
+    if isinstance(node, N.AggregationNode):
+        node.aggregates = [a for a in node.aggregates
+                           if a.out_symbol in demand]
+        keep = {s for s, _ in node.keys} | \
+            {a.out_symbol for a in node.aggregates}
+        node.output = tuple(f for f in node.output if f.symbol in keep)
+        child: set = set()
+        for _, e in node.keys:
+            _refs(e, child)
+        for a in node.aggregates:
+            if a.argument is not None:
+                _refs(a.argument, child)
+        _prune(node.source, child)
+        return
+    if isinstance(node, N.JoinNode):
+        extra: set = set()
+        for l, r in node.criteria:
+            extra.add(l)
+            extra.add(r)
+        if node.filter is not None:
+            _refs(node.filter, extra)
+        node.output = narrowed(extra)
+        left_syms = {f.symbol for f in node.left.output}
+        right_syms = {f.symbol for f in node.right.output}
+        want = demand | extra
+        _prune(node.left, want & left_syms)
+        _prune(node.right, want & right_syms)
+        return
+    if isinstance(node, N.SemiJoinNode):
+        node.output = narrowed({node.source_key})
+        _prune(node.source, demand | {node.source_key})
+        _prune(node.filtering_source, {node.filtering_key})
+        return
+    if isinstance(node, (N.SortNode, N.TopNNode)):
+        node.output = narrowed(set(node.keys))
+        _prune(node.source, demand | set(node.keys))
+        return
+    if isinstance(node, N.DistinctNode):
+        # DISTINCT is defined over exactly its output columns
+        child = {f.symbol for f in node.output}
+        _prune(node.source, child)
+        return
+    if isinstance(node, (N.LimitNode, N.EnforceSingleRowNode,
+                         N.ExchangeNode)):
+        node.output = narrowed()
+        _prune(node.source, set(demand))
+        return
+    if isinstance(node, N.UnionNode):
+        node.output = narrowed()
+        keep_syms = {f.symbol for f in node.output}
+        new_maps = []
+        for inp, m in zip(node.inputs, node.symbol_maps):
+            m2 = {out: src for out, src in m.items() if out in keep_syms}
+            new_maps.append(m2)
+            _prune(inp, set(m2.values()))
+        node.symbol_maps = new_maps
+        return
+    if isinstance(node, N.OutputNode):
+        _prune(node.source, set(node.source_symbols))
+        return
+    raise LocalPlanningError(
+        f"prune: unhandled node {type(node).__name__}")
+
+
+def _refs(e: RowExpression, out: set) -> None:
+    for x in walk(e):
+        if isinstance(x, InputRef):
+            out.add(x.name)

@@ -1,0 +1,247 @@
+"""Logical plan nodes (reference: presto-spi spi/plan/PlanNode +
+presto-main sql/planner/plan/ — TableScanNode, FilterNode, ProjectNode,
+AggregationNode, JoinNode, SemiJoinNode, SortNode, TopNNode, LimitNode,
+ValuesNode, OutputNode, ExchangeNode).
+
+Every node carries its output schema as a tuple of Fields (symbol name,
+type, optional string dictionary). Symbols are globally unique per query
+(Presto's Symbol allocation), so joins never collide."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.expr.ir import RowExpression
+from presto_tpu.types import Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    symbol: str
+    type: Type
+    dictionary: Optional[Tuple[str, ...]] = None
+
+
+class PlanNode:
+    output: Tuple[Field, ...]
+
+    def sources(self) -> Tuple["PlanNode", ...]:
+        return ()
+
+    @property
+    def symbols(self) -> List[str]:
+        return [f.symbol for f in self.output]
+
+    def field(self, symbol: str) -> Field:
+        for f in self.output:
+            if f.symbol == symbol:
+                return f
+        raise KeyError(symbol)
+
+
+@dataclasses.dataclass
+class TableScanNode(PlanNode):
+    handle: TableHandle
+    # output symbol -> connector column name
+    assignments: Dict[str, str]
+    output: Tuple[Field, ...]
+
+
+@dataclasses.dataclass
+class FilterNode(PlanNode):
+    source: PlanNode
+    predicate: RowExpression
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class ProjectNode(PlanNode):
+    source: PlanNode
+    # ordered (symbol -> expression over source symbols)
+    assignments: List[Tuple[str, RowExpression]]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggCall:
+    out_symbol: str
+    function: str                      # sum | count | avg | min | max
+    argument: Optional[RowExpression]  # None for count(*)
+    distinct: bool = False
+    output_type: Optional[Type] = None
+
+
+@dataclasses.dataclass
+class AggregationNode(PlanNode):
+    source: PlanNode
+    # group keys: (out symbol, expression over source)
+    keys: List[Tuple[str, RowExpression]]
+    aggregates: List[AggCall]
+    step: str  # single | partial | final
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class JoinNode(PlanNode):
+    join_type: str  # inner | left | right | full | cross
+    left: PlanNode   # probe
+    right: PlanNode  # build
+    # equi-join criteria: (left symbol, right symbol)
+    criteria: List[Tuple[str, str]]
+    output: Tuple[Field, ...]
+    # residual non-equi condition applied post-join
+    filter: Optional[RowExpression] = None
+
+    def sources(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass
+class SemiJoinNode(PlanNode):
+    source: PlanNode
+    filtering_source: PlanNode
+    source_key: str
+    filtering_key: str
+    negate: bool
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source, self.filtering_source)
+
+
+@dataclasses.dataclass
+class SortNode(PlanNode):
+    source: PlanNode
+    keys: List[str]
+    descending: List[bool]
+    nulls_first: List[bool]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class TopNNode(PlanNode):
+    source: PlanNode
+    n: int
+    keys: List[str]
+    descending: List[bool]
+    nulls_first: List[bool]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class LimitNode(PlanNode):
+    source: PlanNode
+    n: int
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class DistinctNode(PlanNode):
+    source: PlanNode
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class ValuesNode(PlanNode):
+    # rows of typed literal values (python values per Field type)
+    rows: List[List[Any]]
+    output: Tuple[Field, ...]
+
+
+@dataclasses.dataclass
+class UnionNode(PlanNode):
+    inputs: List[PlanNode]
+    # per input: mapping output symbol -> that input's symbol
+    symbol_maps: List[Dict[str, str]]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return tuple(self.inputs)
+
+
+@dataclasses.dataclass
+class EnforceSingleRowNode(PlanNode):
+    source: PlanNode
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class OutputNode(PlanNode):
+    source: PlanNode
+    # user-visible column names, in order, referencing source symbols
+    names: List[str]
+    source_symbols: List[str]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class ExchangeNode(PlanNode):
+    """Marks a data redistribution point (reference:
+    sql/planner/plan/ExchangeNode; SystemPartitioningHandle.java:59-67).
+    scheme: gather | repartition | broadcast; inserted by the
+    distributed planner (AddExchanges analog)."""
+    source: PlanNode
+    scheme: str
+    partition_keys: List[str]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+def plan_text(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style tree rendering (reference: planPrinter/)."""
+    pad = "  " * indent
+    name = type(node).__name__.replace("Node", "")
+    details = ""
+    if isinstance(node, TableScanNode):
+        details = f"[{node.handle}]"
+    elif isinstance(node, FilterNode):
+        details = f"[{node.predicate}]"
+    elif isinstance(node, AggregationNode):
+        details = f"[keys={[k for k, _ in node.keys]} " \
+                  f"aggs={[a.function for a in node.aggregates]} " \
+                  f"step={node.step}]"
+    elif isinstance(node, JoinNode):
+        details = f"[{node.join_type} on {node.criteria}]"
+    elif isinstance(node, (SortNode, TopNNode)):
+        details = f"[{node.keys}]"
+    elif isinstance(node, LimitNode):
+        details = f"[{node.n}]"
+    elif isinstance(node, ExchangeNode):
+        details = f"[{node.scheme} keys={node.partition_keys}]"
+    elif isinstance(node, OutputNode):
+        details = f"[{node.names}]"
+    lines = [f"{pad}{name}{details} => {[f.symbol for f in node.output]}"]
+    for s in node.sources():
+        lines.append(plan_text(s, indent + 1))
+    return "\n".join(lines)

@@ -1,0 +1,179 @@
+"""Logical plan optimizer rules (reference: sql/planner/PlanOptimizers
+— we implement the load-bearing subset: PredicatePushDown.java:112 +
+EliminateCrossJoins + PruneUnreferencedOutputs (in local_planner)).
+
+`rewrite_cross_joins` turns Filter-over-cross-join-trees (comma-join SQL
+like TPC-H Q3/Q5) into left-deep equi-join trees, pushing single-side
+conjuncts down to their source relation so filters run before joins."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from presto_tpu.expr.ir import (
+    Call, InputRef, RowExpression, SpecialForm, walk,
+)
+from presto_tpu.planner import nodes as N
+from presto_tpu.types import BOOLEAN
+
+
+def optimize(root: N.PlanNode) -> N.PlanNode:
+    return _rewrite(root)
+
+
+def _rewrite(node: N.PlanNode) -> N.PlanNode:
+    # rewrite children first
+    for attr in ("source", "left", "right", "filtering_source"):
+        if hasattr(node, attr):
+            setattr(node, attr, _rewrite(getattr(node, attr)))
+    if isinstance(node, N.UnionNode):
+        node.inputs = [_rewrite(x) for x in node.inputs]
+    if isinstance(node, N.FilterNode):
+        return _rewrite_filter(node)
+    return node
+
+
+def _split_conjuncts(e: RowExpression) -> List[RowExpression]:
+    if isinstance(e, SpecialForm) and e.form == "and":
+        out: List[RowExpression] = []
+        for a in e.args:
+            out.extend(_split_conjuncts(a))
+        return out
+    return [e]
+
+
+def _combine_conjuncts(parts: List[RowExpression]) -> RowExpression:
+    assert parts
+    e = parts[0]
+    for p in parts[1:]:
+        e = SpecialForm("and", (e, p), BOOLEAN)
+    return e
+
+
+def _refs(e: RowExpression) -> Set[str]:
+    return {x.name for x in walk(e) if isinstance(x, InputRef)}
+
+
+def _flatten_cross(node: N.PlanNode, leaves: List[N.PlanNode]) -> bool:
+    """Collect the leaves of a maximal cross-join subtree."""
+    if isinstance(node, N.JoinNode) and node.join_type == "cross" \
+            and node.filter is None and not node.criteria:
+        _flatten_cross(node.left, leaves)
+        _flatten_cross(node.right, leaves)
+        return True
+    leaves.append(node)
+    return False
+
+
+def _rewrite_filter(node: N.FilterNode) -> N.PlanNode:
+    leaves: List[N.PlanNode] = []
+    if not _flatten_cross(node.source, leaves) or len(leaves) < 2:
+        return node
+    conjuncts = _split_conjuncts(node.predicate)
+    leaf_syms = [{f.symbol for f in leaf.output} for leaf in leaves]
+
+    # 1. push single-side conjuncts down onto their leaf
+    pushed: List[List[RowExpression]] = [[] for _ in leaves]
+    remaining: List[RowExpression] = []
+    join_preds: List[Tuple[RowExpression, str, str]] = []
+    for c in conjuncts:
+        refs = _refs(c)
+        homes = [i for i, syms in enumerate(leaf_syms) if refs & syms]
+        if len(homes) == 1 and refs <= leaf_syms[homes[0]]:
+            pushed[homes[0]].append(c)
+            continue
+        pair = _equi_symbols(c)
+        if pair is not None:
+            l, r = pair
+            li = next((i for i, s in enumerate(leaf_syms) if l in s), None)
+            ri = next((i for i, s in enumerate(leaf_syms) if r in s), None)
+            if li is not None and ri is not None and li != ri:
+                join_preds.append((c, l, r))
+                continue
+        remaining.append(c)
+
+    new_leaves: List[N.PlanNode] = []
+    for leaf, preds in zip(leaves, pushed):
+        if preds:
+            out = tuple(leaf.output)
+            new_leaves.append(
+                N.FilterNode(leaf, _combine_conjuncts(preds), out))
+        else:
+            new_leaves.append(leaf)
+
+    # 2. greedy left-deep join tree over the predicate graph
+    used = [False] * len(new_leaves)
+    order = _initial_leaf(join_preds, leaf_syms, new_leaves)
+    current = new_leaves[order]
+    used[order] = True
+    current_syms = set(leaf_syms[order])
+    unused_preds = list(join_preds)
+    while not all(used):
+        # find a leaf connected to the current tree
+        best = None
+        for (c, l, r) in unused_preds:
+            for i, syms in enumerate(leaf_syms):
+                if used[i]:
+                    continue
+                if (l in current_syms and r in syms) or \
+                        (r in current_syms and l in syms):
+                    best = i
+                    break
+            if best is not None:
+                break
+        if best is None:  # disconnected: true cross join
+            best = next(i for i, u in enumerate(used) if not u)
+            criteria: List[Tuple[str, str]] = []
+            taken: List[RowExpression] = []
+        else:
+            criteria = []
+            taken = []
+            for (c, l, r) in unused_preds:
+                if l in current_syms and r in leaf_syms[best]:
+                    criteria.append((l, r))
+                    taken.append(c)
+                elif r in current_syms and l in leaf_syms[best]:
+                    criteria.append((r, l))
+                    taken.append(c)
+        unused_preds = [p for p in unused_preds if p[0] not in
+                        [t for t in taken]]
+        leaf = new_leaves[best]
+        out = tuple(list(current.output) + list(leaf.output))
+        jt = "inner" if criteria else "cross"
+        current = N.JoinNode(jt, current, leaf, criteria, out)
+        current_syms |= leaf_syms[best]
+        used[best] = True
+
+    # leftover join preds (e.g. third-table equalities) become filters
+    remaining.extend(p[0] for p in unused_preds)
+    if remaining:
+        return N.FilterNode(current, _combine_conjuncts(remaining),
+                            node.output)
+    # preserve the original filter's (possibly narrower) output
+    if [f.symbol for f in current.output] != \
+            [f.symbol for f in node.output]:
+        keep = {f.symbol for f in node.output}
+        current.output = tuple(f for f in current.output
+                               if f.symbol in keep)
+    return current
+
+
+def _initial_leaf(join_preds, leaf_syms, leaves) -> int:
+    """Start from the largest relation so it stays on the probe side
+    (builds should be the smaller inputs). Heuristic: a leaf that is a
+    bare TableScan of a fact-sized table, detected by connected degree —
+    the most-connected leaf is usually the fact table."""
+    degree = [0] * len(leaves)
+    for (_, l, r) in join_preds:
+        for i, syms in enumerate(leaf_syms):
+            if l in syms or r in syms:
+                degree[i] += 1
+    return max(range(len(leaves)), key=lambda i: degree[i])
+
+
+def _equi_symbols(c: RowExpression) -> Optional[Tuple[str, str]]:
+    if isinstance(c, Call) and c.name == "equal":
+        a, b = c.args
+        if isinstance(a, InputRef) and isinstance(b, InputRef):
+            return (a.name, b.name)
+    return None

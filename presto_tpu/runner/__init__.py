@@ -1,0 +1,6 @@
+"""Query runners (reference: presto-main testing/LocalQueryRunner.java:236
+— the single-process full-SQL harness the whole test pyramid keys off)."""
+
+from presto_tpu.runner.local import (
+    LocalRunner, MaterializedResult, Session, CatalogManager, QueryError,
+)

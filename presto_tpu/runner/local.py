@@ -1,0 +1,238 @@
+"""LocalRunner: parse -> plan -> prune -> pipelines -> drivers -> result
+in one process with no RPC (reference: testing/LocalQueryRunner.java:665
+execute -> executeInternal -> createDrivers, plus the round-robin drive
+loop standing in for TaskExecutor time slicing)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from presto_tpu.batch import Batch
+from presto_tpu.connectors.spi import Connector, TableHandle
+from presto_tpu.operators.base import DriverContext
+from presto_tpu.operators.driver import Driver
+from presto_tpu.parser import parse_statement, tree as T
+from presto_tpu.planner import nodes as N
+from presto_tpu.planner.analyzer import AnalysisError, plan_statement
+from presto_tpu.planner.local_planner import (
+    LocalExecutionPlan, LocalExecutionPlanner,
+)
+from presto_tpu.schema import RelationSchema
+
+
+class QueryError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Session:
+    catalog: str = "tpch"
+    schema: str = "tiny"
+    properties: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class CatalogManager:
+    """Reference: metadata/CatalogManager + MetadataManager.java:124."""
+
+    def __init__(self):
+        self._connectors: Dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._connectors[name] = connector
+
+    def connector(self, name: str) -> Connector:
+        if name not in self._connectors:
+            raise QueryError(f"catalog {name!r} does not exist")
+        return self._connectors[name]
+
+    def catalogs(self) -> List[str]:
+        return sorted(self._connectors)
+
+    def resolve_table(self, parts: Tuple[str, ...], session: Session
+                      ) -> Tuple[TableHandle, RelationSchema]:
+        if len(parts) == 1:
+            handle = TableHandle(session.catalog, session.schema,
+                                 parts[0])
+        elif len(parts) == 2:
+            handle = TableHandle(session.catalog, parts[0], parts[1])
+        elif len(parts) == 3:
+            handle = TableHandle(parts[0], parts[1], parts[2])
+        else:
+            raise QueryError(f"invalid table name {'.'.join(parts)}")
+        conn = self.connector(handle.catalog)
+        try:
+            schema = conn.metadata.get_table_schema(handle)
+        except KeyError:
+            raise QueryError(f"table {handle} does not exist") from None
+        return handle, schema
+
+
+class MaterializedResult:
+    def __init__(self, names: List[str], batches: List[Batch],
+                 fields: Tuple[N.Field, ...]):
+        self.names = names
+        self.batches = batches
+        self.fields = fields
+
+    @property
+    def row_count(self) -> int:
+        return sum(b.num_valid() for b in self.batches)
+
+    def rows(self) -> List[Tuple]:
+        out: List[Tuple] = []
+        for b in self.batches:
+            out.extend(b.to_pylist())
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+        if not self.batches:
+            return pd.DataFrame(columns=self.names)
+        frames = [b.to_pandas() for b in self.batches]
+        df = pd.concat(frames, ignore_index=True)
+        df.columns = self.names
+        return df
+
+    def __repr__(self):
+        return f"MaterializedResult({self.row_count} rows: {self.names})"
+
+
+class LocalRunner:
+    def __init__(self, catalog: str = "tpch", schema: str = "tiny",
+                 properties: Optional[Dict[str, Any]] = None):
+        from presto_tpu.connectors.tpch import TpchConnector
+        self.catalogs = CatalogManager()
+        self.catalogs.register("tpch", TpchConnector())
+        self.session = Session(catalog, schema, dict(properties or {}))
+
+    def register_connector(self, name: str, connector: Connector):
+        self.catalogs.register(name, connector)
+
+    # ------------------------------------------------------------------
+
+    def execute(self, sql: str) -> MaterializedResult:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, T.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, (T.ShowTables, T.ShowSchemas, T.ShowCatalogs,
+                             T.ShowColumns, T.ShowSession)):
+            return self._show(stmt)
+        if isinstance(stmt, T.SetSession):
+            return self._set_session(stmt)
+        if not isinstance(stmt, T.Query):
+            raise QueryError(
+                f"unsupported statement {type(stmt).__name__}")
+        try:
+            plan = plan_statement(stmt, self.catalogs, self.session)
+        except AnalysisError as e:
+            raise QueryError(str(e)) from e
+        from presto_tpu.planner.optimizer import optimize
+        plan = optimize(plan)
+        return self._run_plan(plan)
+
+    def create_plan(self, sql: str) -> N.OutputNode:
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, T.Query):
+            raise QueryError("create_plan expects a query")
+        return plan_statement(stmt, self.catalogs, self.session)
+
+    def _run_plan(self, plan: N.OutputNode) -> MaterializedResult:
+        planner = LocalExecutionPlanner(self.catalogs, self.session)
+        lplan = planner.plan(plan)
+        self._drive(lplan)
+        return MaterializedResult(lplan.result_names, lplan.result_sink,
+                                  lplan.result_fields)
+
+    @staticmethod
+    def _drive(lplan: LocalExecutionPlan,
+               max_rounds: int = 2_000_000) -> None:
+        dctx = DriverContext()
+        drivers = [Driver([f.create(dctx) for f in pipe])
+                   for pipe in lplan.pipelines]
+        rounds = 0
+        while True:
+            all_done = True
+            progress = False
+            for d in drivers:
+                if d.is_finished():
+                    continue
+                all_done = False
+                progress = d.process() or progress
+            if all_done:
+                break
+            rounds += 1
+            if rounds > max_rounds:
+                raise QueryError("query did not converge (deadlock?)")
+        for d in drivers:
+            d.close()
+
+    # -- metadata statements -------------------------------------------
+
+    def _explain(self, stmt: T.Explain) -> MaterializedResult:
+        inner = stmt.statement
+        if not isinstance(inner, T.Query):
+            raise QueryError("EXPLAIN supports queries only")
+        plan = plan_statement(inner, self.catalogs, self.session)
+        from presto_tpu.planner.local_planner import prune_unused_columns
+        from presto_tpu.planner.optimizer import optimize
+        plan = optimize(plan)
+        prune_unused_columns(plan)
+        if stmt.analyze:
+            result = self._run_plan(plan)
+            text = N.plan_text(plan) + \
+                f"\n-- rows: {result.row_count}"
+        else:
+            text = N.plan_text(plan)
+        return self._text_result("Query Plan", text.split("\n"))
+
+    def _show(self, stmt) -> MaterializedResult:
+        if isinstance(stmt, T.ShowCatalogs):
+            return self._text_result("Catalog", self.catalogs.catalogs())
+        if isinstance(stmt, T.ShowSchemas):
+            conn = self.catalogs.connector(
+                stmt.catalog or self.session.catalog)
+            return self._text_result("Schema",
+                                     conn.metadata.list_schemas())
+        if isinstance(stmt, T.ShowTables):
+            conn = self.catalogs.connector(self.session.catalog)
+            schema = stmt.schema[-1] if stmt.schema \
+                else self.session.schema
+            return self._text_result("Table",
+                                     conn.metadata.list_tables(schema))
+        if isinstance(stmt, T.ShowColumns):
+            handle, schema = self.catalogs.resolve_table(
+                stmt.table, self.session)
+            rows = [(c.name, c.type.display()) for c in schema.columns]
+            from presto_tpu.types import VARCHAR
+            names = ["Column", "Type"]
+            b = Batch.from_pydict({
+                "column": ([r[0] for r in rows], VARCHAR),
+                "type": ([r[1] for r in rows], VARCHAR)})
+            return MaterializedResult(
+                names, [b],
+                tuple(N.Field(n, VARCHAR) for n in names))
+        if isinstance(stmt, T.ShowSession):
+            rows = sorted(self.session.properties.items())
+            return self._text_result(
+                "Property", [f"{k}={v}" for k, v in rows])
+        raise QueryError("unsupported SHOW")
+
+    def _set_session(self, stmt: T.SetSession) -> MaterializedResult:
+        from presto_tpu.planner.analyzer import _Analyzer, Scope
+        from presto_tpu.planner.analyzer import PlannerContext
+        ctx = PlannerContext(self.catalogs, self.session)
+        an = _Analyzer(Scope([]), ctx)
+        from presto_tpu.expr.ir import Literal
+        e = an.analyze(stmt.value)
+        if not isinstance(e, Literal):
+            raise QueryError("SET SESSION value must be a constant")
+        self.session.properties[stmt.name] = e.value
+        return self._text_result("result", ["SET SESSION"])
+
+    def _text_result(self, name: str, lines: List[str]
+                     ) -> MaterializedResult:
+        from presto_tpu.types import VARCHAR
+        b = Batch.from_pydict({name: (list(lines), VARCHAR)})
+        return MaterializedResult([name], [b],
+                                  (N.Field(name, VARCHAR),))

@@ -1,0 +1,152 @@
+"""Parser tests over TPC-H-style syntax (reference analog:
+presto-parser TestSqlParser)."""
+
+import pytest
+
+from presto_tpu.parser import parse_statement, ParseError
+from presto_tpu.parser import tree as T
+
+
+def test_simple_select():
+    q = parse_statement("SELECT a, b + 1 AS c FROM t WHERE a > 5")
+    assert isinstance(q, T.Query)
+    spec = q.body
+    assert len(spec.select) == 2
+    assert spec.select[1].alias == "c"
+    assert isinstance(spec.where, T.BinaryOp)
+
+
+def test_tpch_q1_parses():
+    q = parse_statement("""
+        select returnflag, linestatus,
+            sum(quantity) as sum_qty,
+            sum(extendedprice * (1 - discount) * (1 + tax)) as sum_charge,
+            avg(discount) as avg_disc, count(*) as count_order
+        from lineitem
+        where shipdate <= date '1998-12-01' - interval '90' day
+        group by returnflag, linestatus
+        order by returnflag, linestatus
+    """)
+    spec = q.body
+    assert len(spec.select) == 6
+    assert spec.select[5].expr.is_star
+    assert len(spec.group_by) == 2
+    assert len(q.order_by) == 2
+
+
+def test_tpch_q3_parses():
+    q = parse_statement("""
+        select l.orderkey, sum(l.extendedprice * (1 - l.discount)) as revenue,
+               o.orderdate, o.shippriority
+        from customer c, orders o, lineitem l
+        where c.mktsegment = 'BUILDING'
+          and c.custkey = o.custkey and l.orderkey = o.orderkey
+          and o.orderdate < date '1995-03-15'
+        group by l.orderkey, o.orderdate, o.shippriority
+        order by revenue desc, o.orderdate
+        limit 10
+    """)
+    assert q.limit == 10
+    assert q.order_by[0].descending
+    join = q.body.from_
+    assert isinstance(join, T.Join) and join.join_type == "cross"
+
+
+def test_joins_and_subqueries():
+    q = parse_statement("""
+        with big as (select orderkey from orders where totalprice > 100)
+        select * from lineitem l
+        join big b on l.orderkey = b.orderkey
+        left join part p on l.partkey = p.partkey
+        where l.suppkey in (select suppkey from supplier)
+          and exists (select 1 from nation)
+          and l.quantity between 1 and 10
+    """)
+    assert len(q.ctes) == 1
+    w = q.body.where
+    assert isinstance(w, T.BinaryOp) and w.op == "and"
+
+
+def test_case_in_like():
+    q = parse_statement("""
+        select case when a = 1 then 'one' when a = 2 then 'two'
+                    else 'many' end,
+               case b when 0 then 'z' end,
+               c in (1, 2, 3),
+               d like '%x%_' escape '\\',
+               e is not null,
+               cast(f as decimal(10,2))
+        from t
+    """)
+    items = q.body.select
+    assert isinstance(items[0].expr, T.Case)
+    assert items[0].expr.operand is None
+    assert items[1].expr.operand is not None
+    assert isinstance(items[2].expr, T.InList)
+    assert isinstance(items[3].expr, T.Like)
+    assert items[4].expr.negated
+    assert items[5].expr.type_name == "decimal(10,2)"
+
+
+def test_union_values_explain():
+    q = parse_statement(
+        "select 1 union all select 2 union select 3")
+    assert isinstance(q.body, T.SetOperation)
+    assert q.body.distinct          # outer: UNION (distinct)
+    assert not q.body.left.distinct  # inner: UNION ALL
+    v = parse_statement("values (1, 'a'), (2, 'b')")
+    assert isinstance(v.body, T.ValuesRelation)
+    e = parse_statement("explain analyze select 1")
+    assert isinstance(e, T.Explain) and e.analyze
+
+
+def test_window_function():
+    q = parse_statement("""
+        select row_number() over (partition by a order by b desc) rn,
+               sum(x) over (order by y rows between unbounded preceding
+                            and current row)
+        from t
+    """)
+    fc = q.body.select[0].expr
+    assert fc.window is not None
+    assert fc.window.order_by[0].descending
+
+
+def test_show_and_session():
+    assert isinstance(parse_statement("show tables"), T.ShowTables)
+    assert isinstance(parse_statement("show schemas from tpch"),
+                      T.ShowSchemas)
+    s = parse_statement("set session max_groups = 1024")
+    assert isinstance(s, T.SetSession)
+
+
+def test_extract_substring():
+    q = parse_statement(
+        "select extract(year from orderdate), substring(phone, 1, 2),"
+        " substring(phone from 1 for 2) from orders")
+    assert isinstance(q.body.select[0].expr, T.Extract)
+    assert q.body.select[1].expr.name == "substr"
+    assert len(q.body.select[2].expr.args) == 3
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse_statement("select from where")
+    with pytest.raises(ParseError):
+        parse_statement("select 1 extra_garbage moreso 5 +")
+    with pytest.raises(ParseError):
+        parse_statement("select a from t join u")  # missing ON/USING
+
+
+def test_qualified_star_and_aliases():
+    q = parse_statement("select t.*, u.x y from s.t t, u")
+    assert isinstance(q.body.select[0], T.Star)
+    assert q.body.select[0].qualifier == ("t",)
+    assert q.body.select[1].alias == "y"
+
+
+def test_scalar_subquery():
+    q = parse_statement(
+        "select (select max(x) from t) from u where a > "
+        "(select avg(b) from v)")
+    assert isinstance(q.body.select[0].expr, T.ScalarSubquery)
