@@ -1,0 +1,15 @@
+"""Mesh-parallel execution: the TPU-native replacement for the
+reference's exchange/shuffle machinery (SURVEY.md §2.3-2.4).
+
+Presto moves rows between workers with an HTTP shuffle
+(PartitionedOutputOperator partitions pages into per-consumer buffers;
+ExchangeClient pulls them). Here a worker is a mesh slot on one chip and
+the hash shuffle is a single `jax.lax.all_to_all` over ICI inside a
+shard_mapped program — no serde, no HTTP, no copies through the host.
+"""
+
+from presto_tpu.parallel.mesh import make_mesh, worker_axis
+from presto_tpu.parallel.shuffle import (
+    ShardedBatch, shard_batch, unshard_batch, hash_repartition,
+    broadcast_batch,
+)

@@ -1,0 +1,34 @@
+"""Device mesh construction (the analog of the reference's node set:
+InternalNodeManager + NodeScheduler pick worker nodes; here the "cluster"
+is a jax.sharding.Mesh over TPU chips and placement is a sharding spec).
+
+One flat `workers` axis is the default: Presto's exchanges are all
+point-to-point over a flat worker set, which maps onto a 1-D mesh whose
+collectives ride ICI. Multi-axis meshes (e.g. ("host", "chip")) slot in
+where DCN/ICI topology matters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+#: Name of the mesh axis that plays the role of "worker nodes".
+worker_axis = "workers"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None,
+              axis: str = worker_axis) -> Mesh:
+    """A 1-D mesh of `n_devices` (default: all visible devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))

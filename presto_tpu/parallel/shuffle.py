@@ -1,0 +1,194 @@
+"""Hash repartitioning as an ICI all_to_all (the TPU-native rebuild of
+the reference's shuffle: PartitionedOutputOperator.partitionPage
+operator/PartitionedOutputOperator.java:360-417 producing per-consumer
+buffers in PartitionedOutputBuffer.java:48, pulled over HTTP by
+ExchangeClient.java:81).
+
+A `ShardedBatch` is a Batch whose arrays carry a leading `workers` mesh
+axis: global shape [W, rows] sharded so each chip holds one [rows] slice.
+`hash_repartition` runs one shard_mapped program per chip:
+
+  1. dest[i]   = hash(key columns)[i] mod W           (row -> consumer)
+  2. bucketize = stable sort by dest + segment offsets -> scatter rows
+                 into a [W, rows] send buffer (bucket d = rows for chip d;
+                 a chip holds <= rows live rows, so bucket capacity =
+                 rows is always overflow-free)
+  3. jax.lax.all_to_all over the `workers` axis swaps buckets so chip d
+     receives bucket d from every chip
+  4. flatten [W, rows] -> [W*rows] — the received batch
+
+Equal keys land on equal chips, which is the contract partial->final
+aggregation, partitioned joins, and distinct rely on. Presto's LZ4
+serde + token-acked HTTP long-poll collapses into one XLA collective.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from presto_tpu.batch import Batch, Column, bucket_capacity
+from presto_tpu.ops import common
+from presto_tpu.parallel.mesh import worker_axis
+
+
+class ShardedBatch:
+    """A Batch distributed over the `workers` mesh axis.
+
+    `batch.columns[*].data` has global shape [W * rows_per_worker] with a
+    NamedSharding that gives each chip one contiguous [rows_per_worker]
+    slice (the analog of one worker's task input queue).
+    """
+
+    def __init__(self, batch: Batch, mesh: Mesh,
+                 axis: str = worker_axis):
+        self.batch = batch
+        self.mesh = mesh
+        self.axis = axis
+
+    @property
+    def n_workers(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    @property
+    def rows_per_worker(self) -> int:
+        return self.batch.capacity // self.n_workers
+
+
+def _row_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(batch: Batch, mesh: Mesh,
+                axis: str = worker_axis) -> ShardedBatch:
+    """Distribute a host/single-device Batch row-wise over the mesh
+    (round-robin free: rows are already position-agnostic). Pads the
+    capacity up so it divides evenly."""
+    w = mesh.shape[axis]
+    cap = batch.capacity
+    per = -(-cap // w)
+    per = bucket_capacity(per)
+    target = per * w
+    if target != cap:
+        batch = batch.compact(target)
+    sh = _row_sharding(mesh, axis)
+    cols = {
+        n: Column(jax.device_put(c.data, sh), jax.device_put(c.mask, sh),
+                  c.type, c.dictionary)
+        for n, c in batch.columns.items()
+    }
+    rv = jax.device_put(batch.row_valid, sh)
+    return ShardedBatch(Batch(cols, rv), mesh, axis)
+
+
+def unshard_batch(sb: ShardedBatch) -> Batch:
+    """Gather to one addressable batch (root-stage output)."""
+    rep = NamedSharding(sb.mesh, P())
+    cols = {
+        n: Column(jax.device_put(c.data, rep), jax.device_put(c.mask, rep),
+                  c.type, c.dictionary)
+        for n, c in sb.batch.columns.items()
+    }
+    return Batch(cols, jax.device_put(sb.batch.row_valid, rep))
+
+
+# ---------------------------------------------------------------------------
+# The shuffle kernel (per-chip body run under shard_map)
+
+
+def _bucketize(dest: jnp.ndarray, valid: jnp.ndarray, n_parts: int,
+               arrays: Sequence[jnp.ndarray]
+               ) -> List[jnp.ndarray]:
+    """Scatter rows into [n_parts, rows] send buffers by dest bucket.
+
+    Rows with valid=False go nowhere. Stable sort keeps input order
+    within a bucket (not required by SQL, keeps results deterministic).
+    """
+    rows = dest.shape[0]
+    dest = jnp.where(valid, dest, n_parts)  # invalid -> dropped bucket
+    order = jnp.argsort(dest, stable=True)
+    sdest = dest[order]
+    # offset of each bucket's first row among the sorted rows
+    counts = jax.ops.segment_sum(jnp.ones_like(sdest), sdest,
+                                 num_segments=n_parts + 1)
+    offsets = jnp.concatenate([jnp.zeros(1, counts.dtype),
+                               jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(rows) - offsets[sdest]
+    out = []
+    for a in arrays:
+        buf = jnp.zeros((n_parts + 1, rows), a.dtype)
+        buf = buf.at[sdest, pos].set(a[order], mode="drop")
+        out.append(buf[:n_parts])
+    return out
+
+
+def _shuffle_body(n_parts: int, axis: str, n_key: int,
+                  row_valid: jnp.ndarray,
+                  key_datas: Tuple[jnp.ndarray, ...],
+                  key_masks: Tuple[jnp.ndarray, ...],
+                  datas: Tuple[jnp.ndarray, ...],
+                  masks: Tuple[jnp.ndarray, ...]):
+    """Per-chip: route local rows to consumers, exchange, flatten."""
+    h = common.row_hash(list(zip(key_datas, key_masks)))
+    dest = jnp.abs(h) % n_parts
+    send = _bucketize(dest.astype(jnp.int32), row_valid, n_parts,
+                      list(datas) + list(masks) + [row_valid])
+    recv = [jax.lax.all_to_all(b, axis, 0, 0, tiled=True) for b in send]
+    flat = [b.reshape(-1) for b in recv]
+    nd = len(datas)
+    out_datas = tuple(flat[:nd])
+    out_masks = tuple(flat[nd:2 * nd])
+    out_valid = flat[2 * nd]
+    return out_datas, out_masks, out_valid
+
+
+def hash_repartition(sb: ShardedBatch, key_names: Sequence[str]
+                     ) -> ShardedBatch:
+    """Repartition so rows with equal keys land on the same chip.
+
+    Output rows_per_worker = W * input rows_per_worker (each chip can in
+    the worst case receive every other chip's full slice; no overflow is
+    possible by construction). Callers that need the batch small again
+    compact after aggregation."""
+    mesh, axis = sb.mesh, sb.axis
+    w = sb.n_workers
+    b = sb.batch
+    names = b.names
+    key_idx = [names.index(k) for k in key_names]
+    datas = tuple(b.columns[n].data for n in names)
+    masks = tuple(b.columns[n].mask for n in names)
+    key_datas = tuple(datas[i] for i in key_idx)
+    key_masks = tuple(masks[i] for i in key_idx)
+
+    body = functools.partial(_shuffle_body, w, axis, len(key_idx))
+    spec = P(axis)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec,) * 5,
+        out_specs=(spec, spec, spec))
+    out_datas, out_masks, out_valid = fn(
+        b.row_valid, key_datas, key_masks, datas, masks)
+    cols = {
+        n: Column(d, m, b.columns[n].type, b.columns[n].dictionary)
+        for n, d, m in zip(names, out_datas, out_masks)
+    }
+    return ShardedBatch(Batch(cols, out_valid), mesh, axis)
+
+
+def broadcast_batch(batch: Batch, mesh: Mesh,
+                    axis: str = worker_axis) -> Batch:
+    """Replicate a batch to every chip (the analog of
+    FIXED_BROADCAST_DISTRIBUTION + BroadcastOutputBuffer for small join
+    build sides — SystemPartitioningHandle.java:63)."""
+    rep = NamedSharding(mesh, P())
+    cols = {
+        n: Column(jax.device_put(c.data, rep),
+                  jax.device_put(c.mask, rep), c.type, c.dictionary)
+        for n, c in batch.columns.items()
+    }
+    return Batch(cols, jax.device_put(batch.row_valid, rep))
