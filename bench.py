@@ -1,0 +1,75 @@
+"""Headline benchmark: TPC-H Q1 end-to-end through the SQL engine.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+
+Q1 is the reference's own canonical operator benchmark
+(presto-benchmark HandTpchQuery1.java — scan + filter + project +
+hash aggregation over lineitem), run here through the full stack:
+parse -> analyze -> plan -> optimize -> jit'd XLA kernels.
+
+vs_baseline is rows/sec relative to JAVA_BASELINE_ROWS_PER_SEC, an
+estimate of the single-node Java operator pipeline on Q1 (the reference
+publishes no absolute numbers — BASELINE.md; the estimate is the
+HandTpchQuery1 class of result on one modern core, ~10M rows/s).
+"""
+
+import json
+import sys
+import time
+
+SCHEMA = "sf1"          # 6,001,215 lineitem rows at SF1 scaling
+BATCH_ROWS = 1 << 20
+JAVA_BASELINE_ROWS_PER_SEC = 1.0e7
+
+Q1 = """
+select returnflag, linestatus,
+       sum(quantity) as sum_qty,
+       sum(extendedprice) as sum_base_price,
+       sum(extendedprice * (1 - discount)) as sum_disc_price,
+       sum(extendedprice * (1 - discount) * (1 + tax)) as sum_charge,
+       avg(quantity) as avg_qty,
+       avg(extendedprice) as avg_price,
+       avg(discount) as avg_disc,
+       count(*) as count_order
+from lineitem
+where shipdate <= date '1998-09-02'
+group by returnflag, linestatus
+order by returnflag, linestatus
+"""
+
+
+def main() -> None:
+    from presto_tpu.runner import LocalRunner
+
+    runner = LocalRunner("tpch", SCHEMA)
+    runner.session.properties["batch_rows"] = BATCH_ROWS
+    conn = runner.catalogs.connector("tpch")
+    gen = conn._gens[SCHEMA]
+    import numpy as np
+    # actual lineitem cardinality (rows("lineitem") is the order count;
+    # each order expands to 1-7 lines)
+    n_rows = int(gen.line_counts(
+        np.arange(gen.rows("orders")) + 1).sum())
+
+    result = runner.execute(Q1)          # warmup: compile + first run
+    assert len(result.rows()) == 4, result.rows()
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        runner.execute(Q1)
+        times.append(time.perf_counter() - t0)
+        print(f"run: {times[-1]:.3f}s", file=sys.stderr)
+    best = min(times)
+    rows_per_sec = n_rows / best
+
+    print(json.dumps({
+        "metric": f"tpch_q1_{SCHEMA}_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / JAVA_BASELINE_ROWS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,10 +47,16 @@ Env = Dict[str, CVal]
 
 @dataclasses.dataclass
 class CompiledExpr:
-    """fn(env) -> (data, mask); `dictionary` set when type is a string."""
+    """fn(env) -> (data, mask); `dictionary` set when type is a string.
+
+    `ir` is the source RowExpression — frozen/hashable, used as the cache
+    key that lets operators reuse jit-compiled kernels across queries
+    (the analog of the reference's generated-class cache in
+    PageFunctionCompiler.java:118's CacheBuilder)."""
     fn: Callable[[Env], CVal]
     type: Type
     dictionary: Optional[Tuple[str, ...]] = None
+    ir: Optional[RowExpression] = None
 
 
 class ExpressionCompileError(Exception):
@@ -59,7 +65,9 @@ class ExpressionCompileError(Exception):
 
 def compile_expression(expr: RowExpression,
                        schema: Dict[str, ColumnSchema]) -> CompiledExpr:
-    return _Compiler(schema).compile(expr)
+    ce = _Compiler(schema).compile(expr)
+    ce.ir = expr
+    return ce
 
 
 # ---------------------------------------------------------------------------

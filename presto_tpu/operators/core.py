@@ -8,6 +8,7 @@ LimitOperator, and the PageConsumerOperator test sink
 
 from __future__ import annotations
 
+import collections
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -109,12 +110,37 @@ class TableScanOperatorFactory(OperatorFactory):
             self._factory())
 
 
+#: jit-kernel LRU cache keyed by the (hashable) expression IR so re-running
+#: a query — or another query with the same filter/projection forest —
+#: reuses the compiled XLA program (reference analog: PageFunctionCompiler's
+#: size-bounded generated-class cache, sql/gen/PageFunctionCompiler.java:118).
+_FP_KERNEL_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_FP_KERNEL_CACHE_MAX = 512
+
+
 def make_filter_project_kernel(
         filter_expr: Optional[CompiledExpr],
-        projections: Sequence[Tuple[str, CompiledExpr]]):
+        projections: Sequence[Tuple[str, CompiledExpr]],
+        input_dicts: Optional[Tuple[Tuple[str, tuple], ...]] = None):
     """Build the jitted batch->batch kernel. XLA fuses the whole
     expression forest with the mask updates (the PageProcessor analog,
-    operator/project/PageProcessor.java:57)."""
+    operator/project/PageProcessor.java:57).
+
+    `input_dicts` is the (name, dictionary) tuple of the dict-encoded
+    input columns the expressions were compiled against. It MUST be part
+    of the cache key: compiled kernels bake input dictionaries into
+    constants (LIKE lookup tables, string-comparison ranks), so the same
+    IR compiled against another schema is a different kernel."""
+    try:
+        key = (filter_expr.ir if filter_expr else None,
+               tuple((n, ce.ir, ce.dictionary) for n, ce in projections),
+               input_dicts)
+        cached = _FP_KERNEL_CACHE.get(key)
+        if cached is not None:
+            _FP_KERNEL_CACHE.move_to_end(key)
+            return cached
+    except TypeError:  # unhashable literal somewhere — just don't cache
+        key = None
 
     @jax.jit
     def kernel(batch: Batch) -> Batch:
@@ -132,6 +158,10 @@ def make_filter_project_kernel(
             cols[name] = Column(d, m, ce.type, ce.dictionary)
         return Batch(cols, rv)
 
+    if key is not None:
+        _FP_KERNEL_CACHE[key] = kernel
+        while len(_FP_KERNEL_CACHE) > _FP_KERNEL_CACHE_MAX:
+            _FP_KERNEL_CACHE.popitem(last=False)
     return kernel
 
 
@@ -163,9 +193,11 @@ class FilterProjectOperator(Operator):
 class FilterProjectOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int,
                  filter_expr: Optional[CompiledExpr],
-                 projections: Sequence[Tuple[str, CompiledExpr]]):
+                 projections: Sequence[Tuple[str, CompiledExpr]],
+                 input_dicts: Optional[Tuple[Tuple[str, tuple], ...]] = None):
         super().__init__(operator_id, "filter_project")
-        self._kernel = make_filter_project_kernel(filter_expr, projections)
+        self._kernel = make_filter_project_kernel(filter_expr, projections,
+                                                  input_dicts)
 
     def create(self, driver_context: DriverContext) -> Operator:
         return FilterProjectOperator(

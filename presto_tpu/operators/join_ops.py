@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.batch import Batch, bucket_capacity, remap_column
@@ -65,10 +66,11 @@ class HashBuildOperator(Operator):
         if self._finished:
             return
         self._finished = True
-        total = sum(b.num_valid() for b in self._batches)
+        # one device->host sync for the whole build side (not per batch)
+        total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
         cap = bucket_capacity(max(total, 1))
         if self._batches:
-            merged = Batch.concat(self._batches, cap)
+            merged = Batch.concat(self._batches, cap, live_rows=total)
         else:
             raise RuntimeError("empty build side needs schema plumbing")
         self.bridge.table = join_ops.build(merged, self.key_names)

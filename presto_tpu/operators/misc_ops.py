@@ -51,9 +51,10 @@ class NestedLoopBuildOperator(Operator):
         if not self._batches:
             raise RuntimeError("empty cross-join build needs schema "
                                "plumbing (planner bug)")
-        total = sum(b.num_valid() for b in self._batches)
+        total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
         self.bridge.batch = Batch.concat(
-            self._batches, bucket_capacity(max(total, 1)))
+            self._batches, bucket_capacity(max(total, 1)),
+            live_rows=total)
         self._batches = []
 
     def is_finished(self) -> bool:
@@ -145,12 +146,12 @@ class EnforceSingleRowOperator(Operator):
         if not self._finishing or self._emitted:
             return None
         self._emitted = True
-        total = sum(b.num_valid() for b in self._batches)
+        total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
         if total > 1:
             raise RuntimeError(
                 "Scalar sub-query has returned multiple rows")
         if total == 1:
-            merged = Batch.concat(self._batches, 16)
+            merged = Batch.concat(self._batches, 16, live_rows=total)
             self._batches = []
             return self._count_out(merged)
         # no rows: one row of NULLs

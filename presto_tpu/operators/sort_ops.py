@@ -5,6 +5,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
+
 from presto_tpu.batch import Batch, bucket_capacity
 from presto_tpu.operators.base import (
     DriverContext, Operator, OperatorContext, OperatorFactory,
@@ -39,8 +41,12 @@ class OrderByOperator(Operator):
         self._emitted = True
         if not self._batches:
             return None
-        total = sum(b.num_valid() for b in self._batches)
-        merged = Batch.concat(self._batches, bucket_capacity(max(total, 1)))
+        # one deferred device-side count for ALL batches (a single host
+        # sync), so selective queries sort only live rows, not the full
+        # padded scan capacity
+        total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
+        merged = Batch.concat(self._batches, bucket_capacity(max(total, 1)),
+                              live_rows=total)
         self._batches = []
         out = sort_kernels.sort_batch(merged, self.key_names,
                                       self.descending, self.nulls_first)

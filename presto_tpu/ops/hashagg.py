@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from presto_tpu.batch import Batch, Column
+from presto_tpu.batch import Batch, Column, bucket_capacity
 from presto_tpu.ops import common
 from presto_tpu.types import BIGINT, DOUBLE, Type
 
@@ -67,6 +67,7 @@ def _ident_for(reduce: str, dtype) -> jnp.ndarray:
     return jnp.asarray(info.max if reduce == "min" else info.min, dtype)
 
 
+@functools.lru_cache(maxsize=None)
 def make_sum(input_type: Type, output_type: Type) -> AggFunction:
     dt = output_type.np_dtype
 
@@ -82,6 +83,7 @@ def make_sum(input_type: Type, output_type: Type) -> AggFunction:
                        (output_type, BIGINT))
 
 
+@functools.lru_cache(maxsize=None)
 def make_count(input_type: Optional[Type]) -> AggFunction:
     def init(value, w):
         return (w.astype(np.int64),)
@@ -92,6 +94,7 @@ def make_count(input_type: Optional[Type]) -> AggFunction:
                        init, final, BIGINT, (BIGINT,))
 
 
+@functools.lru_cache(maxsize=None)
 def make_avg(input_type: Type) -> AggFunction:
     # avg computes in float64 (Presto: avg(decimal) keeps decimal — we
     # finalize back to the decimal scale in the operator's projection).
@@ -107,6 +110,7 @@ def make_avg(input_type: Type) -> AggFunction:
                        (DOUBLE, BIGINT))
 
 
+@functools.lru_cache(maxsize=None)
 def make_min(input_type: Type) -> AggFunction:
     dt = input_type.np_dtype
     ident = _ident_for("min", dt)
@@ -120,6 +124,7 @@ def make_min(input_type: Type) -> AggFunction:
                        init, final, input_type, (input_type, BIGINT))
 
 
+@functools.lru_cache(maxsize=None)
 def make_max(input_type: Type) -> AggFunction:
     dt = input_type.np_dtype
     ident = _ident_for("max", dt)
@@ -263,6 +268,158 @@ def agg_step(state: GroupByState,
 
     return GroupByState(new_keys, new_states, new_valid,
                         state.overflow | (num_groups > max_groups))
+
+
+# ---------------------------------------------------------------------------
+# Direct-indexing aggregation for small key domains (the analog of the
+# reference's BigintGroupByHash specialization, operator/BigintGroupByHash
+# — and of low-cardinality group-by optimizations generally). When every
+# group key is dictionary-encoded or boolean, the combined code domain is
+# known statically; the group id IS the table slot, so grouping needs no
+# sort at all: one segment-reduce per state array over a fixed [G] table.
+# This is the TPU-happy path: pure streaming VPU work, no argsort.
+
+
+@dataclasses.dataclass
+class DirectState:
+    """Slot-indexed accumulator: slot = mixed-radix key code."""
+    states: List[Tuple[jnp.ndarray, ...]]
+    present: jnp.ndarray  # bool [G] — slot has seen a live row
+
+
+jax.tree_util.register_pytree_node(
+    DirectState,
+    lambda s: ((s.states, s.present), None),
+    lambda _, c: DirectState(*c),
+)
+
+
+def direct_init(aggs: Sequence[AggFunction], num_slots: int) -> DirectState:
+    states = []
+    for a in aggs:
+        states.append(tuple(
+            jnp.full(num_slots, _ident_for(r, dt), dt)
+            for dt, r in zip(a.state_dtypes, a.reduces)))
+    return DirectState(states, jnp.zeros(num_slots, bool))
+
+
+def direct_step(state: DirectState,
+                row_valid: jnp.ndarray,
+                key_codes: Sequence[CVal],
+                domains: Tuple[int, ...],
+                agg_inputs: Sequence,
+                agg_weights: Sequence[jnp.ndarray],
+                aggs: Sequence[AggFunction],
+                merge: Sequence[bool] | None = None) -> DirectState:
+    """Accumulate one batch into the slot table. NULL keys get their own
+    slot (code == domain), mirroring SQL's NULL-is-a-group semantics."""
+    merge = merge or [False] * len(aggs)
+    num_slots = state.present.shape[0]
+    gid = jnp.zeros(row_valid.shape[0], jnp.int32)
+    for (code, mask), dom in zip(key_codes, domains):
+        c = jnp.where(mask, code.astype(jnp.int32), dom)
+        gid = gid * (dom + 1) + c
+    gid = jnp.where(row_valid, gid, num_slots)  # dead rows -> drop slot
+
+    new_states = []
+    for agg, st, inp, w, is_merge in zip(aggs, state.states, agg_inputs,
+                                         agg_weights, merge):
+        if is_merge:
+            contrib = tuple(
+                jnp.where(w, p, _ident_for(r, dt)).astype(dt)
+                for p, dt, r in zip(inp, agg.state_dtypes, agg.reduces))
+        else:
+            contrib = agg.init(inp, w)
+        merged = []
+        for arr, c, r in zip(st, contrib, agg.reduces):
+            if r == "sum":
+                red = jax.ops.segment_sum(
+                    c.astype(arr.dtype), gid, num_segments=num_slots + 1)
+                merged.append(arr + red[:num_slots])
+            elif r == "min":
+                red = jax.ops.segment_min(
+                    c.astype(arr.dtype), gid, num_segments=num_slots + 1)
+                merged.append(jnp.minimum(arr, red[:num_slots]))
+            else:
+                red = jax.ops.segment_max(
+                    c.astype(arr.dtype), gid, num_segments=num_slots + 1)
+                merged.append(jnp.maximum(arr, red[:num_slots]))
+        new_states.append(tuple(merged))
+
+    seen = jax.ops.segment_max(row_valid.astype(jnp.int32), gid,
+                               num_segments=num_slots + 1)[:num_slots]
+    return DirectState(new_states, state.present | (seen > 0))
+
+
+def _decode_slots(state: DirectState, key_names: Sequence[str],
+                  key_types: Sequence[Type],
+                  key_dicts: Sequence[Optional[tuple]],
+                  domains: Tuple[int, ...]
+                  ) -> Tuple[Dict[str, Column], jnp.ndarray]:
+    """Key columns decoded from the slot index (mixed radix, most-
+    significant key first) plus the output row_valid. A global
+    aggregation (no keys) emits exactly one row even over zero input
+    rows (count(*) = 0)."""
+    num_slots = state.present.shape[0]
+    slot = jnp.arange(num_slots)
+    cols: Dict[str, Column] = {}
+    stride = num_slots
+    for name, typ, dic, dom in zip(key_names, key_types, key_dicts,
+                                   domains):
+        stride //= (dom + 1)
+        code = (slot // stride) % (dom + 1)
+        mask = (code < dom) & state.present
+        cols[name] = Column(code.astype(typ.np_dtype), mask, typ, dic)
+    rv = state.present if key_names else jnp.ones_like(state.present)
+    return cols, rv
+
+
+def _pad_to_bucket(cols: Dict[str, Column], rv: jnp.ndarray) -> Batch:
+    """Pad a slot-table batch up to the power-of-two capacity bucket so
+    downstream jitted kernels keep the small bucketed shape set."""
+    cap = bucket_capacity(rv.shape[0])
+    pad = cap - rv.shape[0]
+    if pad:
+        cols = {
+            n: Column(jnp.pad(c.data, (0, pad)), jnp.pad(c.mask, (0, pad)),
+                      c.type, c.dictionary)
+            for n, c in cols.items()
+        }
+        rv = jnp.pad(rv, (0, pad))
+    return Batch(cols, rv)
+
+
+def direct_finalize(state: DirectState, key_names: Sequence[str],
+                    key_types: Sequence[Type],
+                    key_dicts: Sequence[Optional[tuple]],
+                    domains: Tuple[int, ...],
+                    out_names: Sequence[str],
+                    aggs: Sequence[AggFunction]) -> Batch:
+    """One output row per present slot."""
+    cols, rv = _decode_slots(state, key_names, key_types, key_dicts,
+                             domains)
+    for name, agg, st in zip(out_names, aggs, state.states):
+        d, m = agg.final(st)
+        cols[name] = Column(d.astype(agg.output_type.np_dtype),
+                            m & rv, agg.output_type, None)
+    return _pad_to_bucket(cols, rv)
+
+
+def direct_intermediate(state: DirectState, key_names: Sequence[str],
+                        key_types: Sequence[Type],
+                        key_dicts: Sequence[Optional[tuple]],
+                        domains: Tuple[int, ...],
+                        out_names: Sequence[str],
+                        aggs: Sequence[AggFunction]) -> Batch:
+    """Partial states as columns for the shuffle (keys decoded as in
+    direct_finalize; state arrays exposed as <out>__s{i})."""
+    cols, rv = _decode_slots(state, key_names, key_types, key_dicts,
+                             domains)
+    for name, agg, st in zip(out_names, aggs, state.states):
+        for i, (arr, it) in enumerate(zip(st, agg.intermediate_types)):
+            cols[f"{name}__s{i}"] = Column(arr.astype(it.np_dtype),
+                                           rv, it, None)
+    return _pad_to_bucket(cols, rv)
 
 
 def finalize(state: GroupByState, key_names: Sequence[str],

@@ -86,15 +86,20 @@ def shard_batch(batch: Batch, mesh: Mesh,
     return ShardedBatch(Batch(cols, rv), mesh, axis)
 
 
-def unshard_batch(sb: ShardedBatch) -> Batch:
-    """Gather to one addressable batch (root-stage output)."""
-    rep = NamedSharding(sb.mesh, P())
+def _replicate(batch: Batch, mesh: Mesh) -> Batch:
+    """Copy a batch onto every device (replicated sharding)."""
+    rep = NamedSharding(mesh, P())
     cols = {
         n: Column(jax.device_put(c.data, rep), jax.device_put(c.mask, rep),
                   c.type, c.dictionary)
-        for n, c in sb.batch.columns.items()
+        for n, c in batch.columns.items()
     }
-    return Batch(cols, jax.device_put(sb.batch.row_valid, rep))
+    return Batch(cols, jax.device_put(batch.row_valid, rep))
+
+
+def unshard_batch(sb: ShardedBatch) -> Batch:
+    """Gather to one addressable batch (root-stage output)."""
+    return _replicate(sb.batch, sb.mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -127,7 +132,7 @@ def _bucketize(dest: jnp.ndarray, valid: jnp.ndarray, n_parts: int,
     return out
 
 
-def _shuffle_body(n_parts: int, axis: str, n_key: int,
+def _shuffle_body(n_parts: int, axis: str,
                   row_valid: jnp.ndarray,
                   key_datas: Tuple[jnp.ndarray, ...],
                   key_masks: Tuple[jnp.ndarray, ...],
@@ -165,7 +170,7 @@ def hash_repartition(sb: ShardedBatch, key_names: Sequence[str]
     key_datas = tuple(datas[i] for i in key_idx)
     key_masks = tuple(masks[i] for i in key_idx)
 
-    body = functools.partial(_shuffle_body, w, axis, len(key_idx))
+    body = functools.partial(_shuffle_body, w, axis)
     spec = P(axis)
     fn = jax.shard_map(
         body, mesh=mesh,
@@ -185,10 +190,4 @@ def broadcast_batch(batch: Batch, mesh: Mesh,
     """Replicate a batch to every chip (the analog of
     FIXED_BROADCAST_DISTRIBUTION + BroadcastOutputBuffer for small join
     build sides — SystemPartitioningHandle.java:63)."""
-    rep = NamedSharding(mesh, P())
-    cols = {
-        n: Column(jax.device_put(c.data, rep),
-                  jax.device_put(c.mask, rep), c.type, c.dictionary)
-        for n, c in batch.columns.items()
-    }
-    return Batch(cols, jax.device_put(batch.row_valid, rep))
+    return _replicate(batch, mesh)

@@ -49,6 +49,16 @@ class LocalPlanningError(Exception):
     pass
 
 
+def _schema_dicts(schema: Dict[str, ColumnSchema]
+                  ) -> Tuple[Tuple[str, tuple], ...]:
+    """Hashable (name, dictionary) token of a compile schema's dict-encoded
+    columns — part of the filter/project kernel cache key, because compiled
+    kernels bake input dictionaries into constants (LIKE lookup tables,
+    string comparison ranks)."""
+    return tuple(sorted((n, cs.dictionary) for n, cs in schema.items()
+                        if cs.dictionary is not None))
+
+
 def _schema_of(node: N.PlanNode) -> Dict[str, ColumnSchema]:
     return {f.symbol: ColumnSchema(f.symbol, f.type, f.dictionary)
             for f in node.output}
@@ -79,7 +89,8 @@ class LocalExecutionPlanner:
                 (sym, compile_expression(InputRef(sym, cs.type),
                                          src_schema)))
         pipeline.append(FilterProjectOperatorFactory(
-            self._next_id(), None, projections))
+            self._next_id(), None, projections,
+            _schema_dicts(src_schema)))
         pipeline.append(OutputCollectorOperatorFactory(
             self._next_id(), sink))
         self._pipelines.append(pipeline)
@@ -155,7 +166,7 @@ class LocalExecutionPlanner:
                                           schema))
             for f in node.output]
         pipe.append(FilterProjectOperatorFactory(
-            self._next_id(), pred, projections))
+            self._next_id(), pred, projections, _schema_dicts(schema)))
 
     def _visit_ProjectNode(self, node: N.ProjectNode, pipe: List):
         self._visit(node.source, pipe)
@@ -163,7 +174,7 @@ class LocalExecutionPlanner:
         projections = [(sym, compile_expression(e, schema))
                        for sym, e in node.assignments]
         pipe.append(FilterProjectOperatorFactory(
-            self._next_id(), None, projections))
+            self._next_id(), None, projections, _schema_dicts(schema)))
 
     def _visit_AggregationNode(self, node: N.AggregationNode, pipe: List):
         self._visit(node.source, pipe)
@@ -245,7 +256,8 @@ class LocalExecutionPlanner:
                     InputRef(f.symbol, f.type), schema))
                 for f in node.output]
             pipe.append(FilterProjectOperatorFactory(
-                self._next_id(), pred, projections))
+                self._next_id(), pred, projections,
+                _schema_dicts(schema)))
 
     def _visit_SemiJoinNode(self, node: N.SemiJoinNode, pipe: List):
         bridge = JoinBridge()

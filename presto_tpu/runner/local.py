@@ -138,11 +138,26 @@ class LocalRunner:
         return plan_statement(stmt, self.catalogs, self.session)
 
     def _run_plan(self, plan: N.OutputNode) -> MaterializedResult:
-        planner = LocalExecutionPlanner(self.catalogs, self.session)
-        lplan = planner.plan(plan)
-        self._drive(lplan)
-        return MaterializedResult(lplan.result_names, lplan.result_sink,
-                                  lplan.result_fields)
+        from presto_tpu.operators.aggregation import GroupLimitExceeded
+        session = self.session
+        while True:
+            planner = LocalExecutionPlanner(self.catalogs, session)
+            lplan = planner.plan(plan)
+            try:
+                self._drive(lplan)
+            except GroupLimitExceeded as e:
+                # group-by table overflowed: re-run the whole query with a
+                # larger table (query-level retry keeps the per-batch hot
+                # loop free of device->host syncs)
+                if e.suggested > 1 << 26:
+                    raise QueryError(
+                        "group-by exceeds max supported groups") from e
+                session = dataclasses.replace(
+                    session, properties={**session.properties,
+                                         "max_groups": e.suggested})
+                continue
+            return MaterializedResult(lplan.result_names, lplan.result_sink,
+                                      lplan.result_fields)
 
     @staticmethod
     def _drive(lplan: LocalExecutionPlan,

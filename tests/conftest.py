@@ -21,6 +21,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# sitecustomize.py (axon TPU plugin) imports jax at interpreter start, so
+# JAX_PLATFORMS was captured from the env *before* the mutation above. Override
+# via jax.config, which wins as long as no backend has been initialized yet
+# (conftest imports before any test module).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
