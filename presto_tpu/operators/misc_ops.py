@@ -125,6 +125,53 @@ def _cross_product(probe: Batch, build: Batch, out_cap: int) -> Batch:
     return Batch(cols, live)
 
 
+class AssignUniqueIdOperator(Operator):
+    """Appends a unique BIGINT row-id column (reference:
+    AssignUniqueIdOperator): id = batch_offset + position. Padding rows
+    get ids too (harmless — their row_valid is False)."""
+
+    def __init__(self, ctx: OperatorContext, symbol: str):
+        super().__init__(ctx)
+        self.symbol = symbol
+        self._offset = 0
+        self._pending: Optional[Batch] = None
+        self._finishing = False
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        from presto_tpu.types import BIGINT
+        ids = self._offset + jnp.arange(batch.capacity, dtype=jnp.int64)
+        self._offset += batch.capacity
+        cols = dict(batch.columns)
+        cols[self.symbol] = Column(ids, jnp.ones(batch.capacity, bool),
+                                   BIGINT, None)
+        self._pending = Batch(cols, batch.row_valid)
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class AssignUniqueIdOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, symbol: str):
+        super().__init__(operator_id, "assign_unique_id")
+        self.symbol = symbol
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return AssignUniqueIdOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.symbol)
+
+
 class EnforceSingleRowOperator(Operator):
     """Scalar subquery contract (reference: EnforceSingleRowOperator):
     error on >1 row; a 0-row input yields one all-NULL row."""

@@ -384,10 +384,13 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
     else:
         rewrites = {}
 
-    # 4. HAVING
+    # 4. HAVING (scalar subqueries allowed, e.g. Q11's threshold)
     if spec.having is not None:
+        having_ast = spec.having
+        if _contains_subquery(having_ast):
+            rp, having_ast = _plan_scalar_subqueries(having_ast, rp, ctx)
         an = _Analyzer(rp.scope, ctx, rewrites)
-        pred = _coerce_to(an.analyze(spec.having), BOOLEAN)
+        pred = _coerce_to(an.analyze(having_ast), BOOLEAN)
         out = tuple(N.Field(f.symbol, f.type, f.dictionary)
                     for f in rp.scope.fields)
         rp = RelationPlan(N.FilterNode(rp.node, fold_constants(pred), out),
@@ -588,18 +591,51 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
     for o in order_items:
         _collect_agg_calls(o.expr, calls)
 
+    # DISTINCT aggregates (e.g. Q16's count(distinct suppkey)): insert a
+    # pre-aggregation producing the distinct (group keys, arg) rows, then
+    # aggregate plainly on top (the reference reaches the same shape via
+    # MarkDistinctOperator; a grouped pre-distinct is the streaming-
+    # kernel-friendly equivalent).
+    distinct_calls = [c for c in calls if c.distinct]
+    dsym = d_t = d_dic = None
+    if distinct_calls:
+        if any(not c.distinct for c in calls):
+            raise AnalysisError("mixing DISTINCT and plain aggregates "
+                                "not yet supported")
+        argkeys = {_ast_key(c.args[0]) for c in distinct_calls if c.args}
+        if len(argkeys) != 1 or any(c.is_star for c in distinct_calls):
+            raise AnalysisError("multiple different DISTINCT arguments "
+                                "not yet supported")
+        arg0 = fold_constants(an.analyze(distinct_calls[0].args[0]))
+        d_t, d_dic = arg0.type, an.dictionary_of(arg0)
+        dsym = ctx.symbols.new("distinct_arg")
+        pre_fields = tuple(
+            [N.Field(s, e.type, d) for s, e, d, _ in keys]
+            + [N.Field(dsym, d_t, d_dic)])
+        pre = N.AggregationNode(
+            rp.node, [(s, e) for s, e, _, _ in keys] + [(dsym, arg0)],
+            [], "single", pre_fields)
+        pre_scope = Scope(
+            [ScopeField(None, s, s, e.type, d) for s, e, d, _ in keys]
+            + [ScopeField(None, dsym, dsym, d_t, d_dic)],
+            rp.scope.parent)
+        rp = RelationPlan(pre, pre_scope)
+        an = _Analyzer(rp.scope, ctx)
+        # the outer aggregation re-groups the pre-distinct rows by the
+        # (already computed) key columns
+        keys = [(s, InputRef(s, e.type), d, k) for s, e, d, k in keys]
+
     agg_nodes: List[N.AggCall] = []
     rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
     for c in calls:
         key = _ast_key(c)
         if key in rewrites:
             continue
-        if c.distinct:
-            raise AnalysisError(
-                f"{c.name}(DISTINCT ...) not yet supported")
         if c.filter is not None:
             raise AnalysisError("FILTER (WHERE ...) not yet supported")
-        if c.is_star or not c.args:
+        if c.distinct:
+            arg, arg_t, dic = InputRef(dsym, d_t), d_t, d_dic
+        elif c.is_star or not c.args:
             arg, arg_t, dic = None, None, None
         else:
             if len(c.args) != 1:
@@ -816,18 +852,55 @@ def _equi_pair(conj: T.Node, an: "_Analyzer", left_syms, right_syms):
 # WHERE with subqueries
 # ---------------------------------------------------------------------------
 
+def _contains_subquery(node) -> bool:
+    if isinstance(node, (T.ScalarSubquery, T.InSubquery, T.Exists)):
+        return True
+    if isinstance(node, T.Node):
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, T.Node) and _contains_subquery(v):
+                return True
+            if isinstance(v, (list, tuple)):
+                if any(isinstance(x, T.Node) and _contains_subquery(x)
+                       for x in v):
+                    return True
+    return False
+
+
+def _filter_on(rp: RelationPlan, conjs: List[T.Node],
+               ctx: PlannerContext) -> RelationPlan:
+    pred_ast = conjs[0]
+    for c in conjs[1:]:
+        pred_ast = T.BinaryOp("and", pred_ast, c)
+    an = _Analyzer(rp.scope, ctx)
+    pred = _coerce_to(an.analyze(pred_ast), BOOLEAN)
+    out = tuple(N.Field(f.symbol, f.type, f.dictionary)
+                for f in rp.scope.fields)
+    return RelationPlan(
+        N.FilterNode(rp.node, fold_constants(pred), out), rp.scope)
+
+
 def _plan_where(where: T.Node, rp: RelationPlan,
                 ctx: PlannerContext) -> RelationPlan:
+    """Plan conjuncts in three tiers: (1) subquery-free conjuncts as a
+    Filter directly over the FROM tree — this keeps Filter(cross-join
+    tree) adjacent so the optimizer's equi-join rewrite can see it
+    (Q2/Q18 would otherwise cross-join the whole FROM list); then (2)
+    IN/EXISTS conjuncts as semi joins; then (3) conjuncts containing
+    scalar subqueries, filtered above the joined-in subquery values."""
     conjuncts = _split_conjuncts(where)
-    plain: List[T.Node] = []
-    for conj in conjuncts:
+    plain = [c for c in conjuncts if not _contains_subquery(c)]
+    rest = [c for c in conjuncts if _contains_subquery(c)]
+    if plain:
+        rp = _filter_on(rp, plain, ctx)
+    scalar: List[T.Node] = []
+    for conj in rest:
         rp, handled = _plan_subquery_conjunct(conj, rp, ctx)
         if not handled:
-            plain.append(conj)
-    if plain:
-        # scalar subqueries inside remaining conjuncts
-        pred_ast = plain[0]
-        for c in plain[1:]:
+            scalar.append(conj)
+    if scalar:
+        pred_ast = scalar[0]
+        for c in scalar[1:]:
             pred_ast = T.BinaryOp("and", pred_ast, c)
         rp, pred_ast = _plan_scalar_subqueries(pred_ast, rp, ctx)
         an = _Analyzer(rp.scope, ctx)
@@ -857,8 +930,11 @@ def _plan_subquery_conjunct(conj: T.Node, rp: RelationPlan,
         vsym = _as_symbol(value)
         if vsym is None:
             raise AnalysisError("IN value must be a column for now")
-        sub_rp, extra_keys = _plan_correlated_query(
+        sub_rp, extra_keys, residual = _plan_correlated_query(
             node.query, ctx, rp.scope)
+        if residual:
+            raise AnalysisError("correlated IN with non-equality "
+                                "correlation not yet supported")
         if len(sub_rp.scope.fields) != 1:
             raise AnalysisError("IN subquery must return one column")
         fsym = sub_rp.scope.fields[0].symbol
@@ -866,7 +942,6 @@ def _plan_subquery_conjunct(conj: T.Node, rp: RelationPlan,
                     for f in rp.scope.fields)
         if extra_keys:
             # correlated IN: semi join on (value, corr...) multi-key
-            node_out = N.SemiJoinMultiNode = None  # placeholder
             raise AnalysisError(
                 "correlated IN subqueries not yet supported")
         sj = N.SemiJoinNode(rp.node, sub_rp.node, vsym, fsym, negated,
@@ -874,9 +949,19 @@ def _plan_subquery_conjunct(conj: T.Node, rp: RelationPlan,
         return RelationPlan(sj, rp.scope), True
     if isinstance(node, T.Exists):
         negated = negated != node.negated
-        sub_rp, corr = _plan_correlated_query(node.query, ctx, rp.scope)
+        sub_rp, corr, residual = _plan_correlated_query(
+            node.query, ctx, rp.scope)
         out = tuple(N.Field(f.symbol, f.type, f.dictionary)
                     for f in rp.scope.fields)
+        if residual:
+            # general decorrelation (Q21's `l2.suppkey <> l1.suppkey`):
+            # tag probe rows with unique ids, join on the equality keys,
+            # filter the residual over the joined pairs, then semi join
+            # the surviving ids back (reference: AssignUniqueIdOperator
+            # + TransformCorrelatedExistsApply-style rewrite)
+            rp2 = _plan_exists_general(rp, sub_rp, corr, residual,
+                                       negated, ctx)
+            return rp2, True
         if corr:
             # correlated EXISTS -> semi join on the correlation keys
             if len(corr) != 1:
@@ -910,20 +995,23 @@ def _plan_subquery_conjunct(conj: T.Node, rp: RelationPlan,
 def _plan_correlated_query(q: T.Query, ctx: PlannerContext,
                            outer_scope: Scope):
     """Plan a subquery that may reference the outer scope through
-    top-level equality conjuncts. Returns (plan, [(outer_sym,
-    inner_sym)]); the correlated conjuncts are stripped from the
-    subquery and turned into join keys (classic decorrelation)."""
+    top-level conjuncts. Returns (plan, corr, residual): `corr` is
+    [(outer_sym, inner_sym)] equality pairs stripped into join keys
+    (classic decorrelation); `residual` is the conjunct ASTs that
+    reference the outer scope non-equally (handled by the caller via
+    unique-id decorrelation)."""
     if not isinstance(q.body, T.QuerySpec) or q.ctes:
         rp, _ = plan_query(q, ctx, None)
-        return rp, []
+        return rp, [], []
     spec = q.body
     inner_rp = _plan_relation(spec.from_, ctx, None) \
         if spec.from_ is not None else None
     if inner_rp is None:
         rp, _ = plan_query(q, ctx, None)
-        return rp, []
+        return rp, [], []
     corr: List[Tuple[str, str]] = []
     remaining: List[T.Node] = []
+    residual: List[T.Node] = []
     if spec.where is not None:
         inner_an = _Analyzer(inner_rp.scope, ctx)
         outer_an = _Analyzer(outer_scope, ctx)
@@ -931,11 +1019,18 @@ def _plan_correlated_query(q: T.Query, ctx: PlannerContext,
             pair = _correlation_pair(conj, inner_an, outer_an)
             if pair:
                 corr.append(pair)
+                continue
+            if _contains_subquery(conj):
+                # nested subqueries are planned by _plan_where against
+                # the inner scope (they may correlate to it)
+                remaining.append(conj)
+            elif _references_outer(conj, inner_rp.scope, outer_scope):
+                residual.append(conj)
             else:
                 remaining.append(conj)
-    if not corr:
+    if not corr and not residual:
         rp, _ = plan_query(q, ctx, None)
-        return rp, []
+        return rp, [], []
     # rebuild the subquery without the correlated conjuncts; keep the
     # correlation columns in its select so the semi join can key on them
     new_where = None
@@ -966,11 +1061,78 @@ def _plan_correlated_query(q: T.Query, ctx: PlannerContext,
             if s is not None:
                 sel_fields.append(next(
                     f for f in rp2.scope.fields if f.symbol == s))
-    fields = sel_fields + [
-        f for f in rp2.scope.fields if f.symbol in inner_syms
-        and all(f.symbol != g.symbol for g in sel_fields)]
-    scope = Scope(fields)
-    return RelationPlan(rp2.node, scope), corr
+    if residual:
+        # the caller's residual filter may reference any inner column —
+        # expose the full inner scope (qualifiers intact)
+        scope = Scope(list(rp2.scope.fields))
+    else:
+        fields = sel_fields + [
+            f for f in rp2.scope.fields if f.symbol in inner_syms
+            and all(f.symbol != g.symbol for g in sel_fields)]
+        scope = Scope(fields)
+    return RelationPlan(rp2.node, scope), corr, residual
+
+
+def _references_outer(node, inner_scope: Scope,
+                      outer_scope: Scope) -> bool:
+    """True if any identifier in `node` (no nested subqueries) resolves
+    only against the outer scope."""
+    if isinstance(node, T.Identifier):
+        try:
+            inner_scope.resolve(node.parts)
+            return False
+        except AnalysisError:
+            try:
+                outer_scope.resolve(node.parts)
+                return True
+            except AnalysisError:
+                return False
+    if isinstance(node, T.Node):
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, T.Node) and \
+                    _references_outer(v, inner_scope, outer_scope):
+                return True
+            if isinstance(v, (list, tuple)):
+                if any(isinstance(x, T.Node) and
+                       _references_outer(x, inner_scope, outer_scope)
+                       for x in v):
+                    return True
+    return False
+
+
+def _plan_exists_general(rp: RelationPlan, sub_rp: RelationPlan,
+                         corr: List[Tuple[str, str]],
+                         residual: List[T.Node], negated: bool,
+                         ctx: PlannerContext) -> RelationPlan:
+    """EXISTS with non-equality correlation: assign each probe row a
+    unique id, inner-join probe x subquery on the equality keys, filter
+    the residual predicate over the joined pairs, and semi join the
+    surviving ids back onto the probe."""
+    idsym = ctx.symbols.new("unique")
+    probe_out = tuple(N.Field(f.symbol, f.type, f.dictionary)
+                      for f in rp.scope.fields) + (N.Field(idsym, BIGINT),)
+    probe = N.AssignUniqueIdNode(rp.node, idsym, probe_out)
+    sub_out = tuple(N.Field(f.symbol, f.type, f.dictionary)
+                    for f in sub_rp.scope.fields)
+    join_out = probe_out + sub_out
+    criteria = [(osym, isym) for osym, isym in corr]
+    joined = N.JoinNode("inner", probe, sub_rp.node, criteria, join_out)
+    comb_scope = Scope(list(rp.scope.fields) + list(sub_rp.scope.fields),
+                       rp.scope.parent)
+    an = _Analyzer(comb_scope, ctx)
+    pred_ast = residual[0]
+    for c in residual[1:]:
+        pred_ast = T.BinaryOp("and", pred_ast, c)
+    pred = _coerce_to(an.analyze(pred_ast), BOOLEAN)
+    filtered = N.FilterNode(joined, fold_constants(pred), join_out)
+    fid = ctx.symbols.new("unique")
+    ids = N.ProjectNode(filtered, [(fid, InputRef(idsym, BIGINT))],
+                        (N.Field(fid, BIGINT),))
+    sj_out = tuple(N.Field(f.symbol, f.type, f.dictionary)
+                   for f in rp.scope.fields)
+    sj = N.SemiJoinNode(probe, ids, idsym, fid, negated, sj_out)
+    return RelationPlan(sj, rp.scope)
 
 
 def _correlation_pair(conj: T.Node, inner_an: "_Analyzer",

@@ -304,6 +304,12 @@ class LocalExecutionPlanner:
         self._visit(node.source, pipe)
         pipe.append(misc_ops.enforce_single_row_factory(self._next_id()))
 
+    def _visit_AssignUniqueIdNode(self, node: N.AssignUniqueIdNode,
+                                  pipe: List):
+        self._visit(node.source, pipe)
+        pipe.append(misc_ops.AssignUniqueIdOperatorFactory(
+            self._next_id(), node.symbol))
+
     def _visit_UnionNode(self, node: N.UnionNode, pipe: List):
         queue = misc_ops.LocalQueue(len(node.inputs))
         for inp, symmap in zip(node.inputs, node.symbol_maps):
@@ -349,14 +355,104 @@ def prune_unused_columns(root: N.PlanNode) -> None:
     """Demand-driven column pruning, top-down (reference:
     PruneUnreferencedOutputs): each node narrows its output to what its
     consumer demands and propagates its own input needs to its sources.
-    Mutates the plan in place; symbols are globally unique."""
-    if isinstance(root, N.OutputNode):
-        _prune(root.source, set(root.source_symbols))
-        return
-    _prune(root, {f.symbol for f in root.output})
+    Mutates the plan in place; symbols are globally unique.
+
+    DAG-aware: a subtree shared by several parents (e.g. the probe side
+    of a unique-id decorrelation feeds both a join and a semi join)
+    accumulates demand from ALL parents before being narrowed — the
+    naive recursive narrowing would let the first parent's prune hide
+    columns the second parent still needs."""
+    # pass 0: count parent edges (Kahn topological order over the DAG)
+    pending: Dict[int, int] = {}
+    seen: set = set()
+
+    def walk(n: N.PlanNode) -> None:
+        for s in n.sources():
+            pending[id(s)] = pending.get(id(s), 0) + 1
+            if id(s) not in seen:
+                seen.add(id(s))
+                walk(s)
+    walk(root)
+
+    # pass 1: propagate demand top-down, processing a node only once all
+    # of its parents have contributed
+    demands: Dict[int, set] = {id(root): {f.symbol for f in root.output}}
+    order: List[N.PlanNode] = []
+    queue: List[N.PlanNode] = [root]
+    while queue:
+        node = queue.pop()
+        order.append(node)
+        for child, d in _child_demand(node, demands[id(node)]):
+            demands.setdefault(id(child), set()).update(d)
+            pending[id(child)] -= 1
+            if pending[id(child)] == 0:
+                queue.append(child)
+
+    # pass 2: narrow each node once, with its final accumulated demand
+    for node in order:
+        _apply_prune(node, demands[id(node)])
 
 
-def _prune(node: N.PlanNode, demand: set) -> None:
+def _child_demand(node: N.PlanNode, demand: set
+                  ) -> List[Tuple[N.PlanNode, set]]:
+    if isinstance(node, (N.TableScanNode, N.ValuesNode)):
+        return []
+    if isinstance(node, N.FilterNode):
+        child = set(demand)
+        _refs(node.predicate, child)
+        return [(node.source, child)]
+    if isinstance(node, N.ProjectNode):
+        child: set = set()
+        for s, e in node.assignments:
+            if s in demand:
+                _refs(e, child)
+        return [(node.source, child)]
+    if isinstance(node, N.AggregationNode):
+        child = set()
+        for _, e in node.keys:
+            _refs(e, child)
+        for a in node.aggregates:
+            if a.out_symbol in demand and a.argument is not None:
+                _refs(a.argument, child)
+        return [(node.source, child)]
+    if isinstance(node, N.JoinNode):
+        extra: set = set()
+        for l, r in node.criteria:
+            extra.add(l)
+            extra.add(r)
+        if node.filter is not None:
+            _refs(node.filter, extra)
+        want = demand | extra
+        left_syms = {f.symbol for f in node.left.output}
+        right_syms = {f.symbol for f in node.right.output}
+        return [(node.left, want & left_syms),
+                (node.right, want & right_syms)]
+    if isinstance(node, N.SemiJoinNode):
+        return [(node.source, demand | {node.source_key}),
+                (node.filtering_source, {node.filtering_key})]
+    if isinstance(node, (N.SortNode, N.TopNNode)):
+        return [(node.source, demand | set(node.keys))]
+    if isinstance(node, N.DistinctNode):
+        # DISTINCT is defined over exactly its output columns
+        return [(node.source, {f.symbol for f in node.output})]
+    if isinstance(node, (N.LimitNode, N.EnforceSingleRowNode,
+                         N.ExchangeNode)):
+        return [(node.source, set(demand))]
+    if isinstance(node, N.AssignUniqueIdNode):
+        return [(node.source, demand - {node.symbol})]
+    if isinstance(node, N.UnionNode):
+        out = []
+        for inp, m in zip(node.inputs, node.symbol_maps):
+            m2 = {o: src for o, src in m.items() if o in demand}
+            out.append((inp, set(m2.values())))
+        return out
+    if isinstance(node, N.OutputNode):
+        return [(node.source, set(node.source_symbols))]
+    raise LocalPlanningError(
+        f"prune: unhandled node {type(node).__name__}")
+
+
+def _apply_prune(node: N.PlanNode, demand: set) -> None:
     def narrowed(extra: set = frozenset()):
         want = demand | extra
         return tuple(f for f in node.output if f.symbol in want)
@@ -368,39 +464,19 @@ def _prune(node: N.PlanNode, demand: set) -> None:
             keep = {first[0]: first[1]}
         node.assignments = keep
         node.output = tuple(f for f in node.output if f.symbol in keep)
-        return
-    if isinstance(node, N.ValuesNode):
-        return
-    if isinstance(node, N.FilterNode):
-        node.output = narrowed()
-        child = set(demand)
-        _refs(node.predicate, child)
-        _prune(node.source, child)
-        return
-    if isinstance(node, N.ProjectNode):
+    elif isinstance(node, (N.ValuesNode, N.OutputNode, N.DistinctNode)):
+        pass
+    elif isinstance(node, N.ProjectNode):
         node.assignments = [(s, e) for s, e in node.assignments
                             if s in demand]
         node.output = narrowed()
-        child: set = set()
-        for _, e in node.assignments:
-            _refs(e, child)
-        _prune(node.source, child)
-        return
-    if isinstance(node, N.AggregationNode):
+    elif isinstance(node, N.AggregationNode):
         node.aggregates = [a for a in node.aggregates
                            if a.out_symbol in demand]
         keep = {s for s, _ in node.keys} | \
             {a.out_symbol for a in node.aggregates}
         node.output = tuple(f for f in node.output if f.symbol in keep)
-        child: set = set()
-        for _, e in node.keys:
-            _refs(e, child)
-        for a in node.aggregates:
-            if a.argument is not None:
-                _refs(a.argument, child)
-        _prune(node.source, child)
-        return
-    if isinstance(node, N.JoinNode):
+    elif isinstance(node, N.JoinNode):
         extra: set = set()
         for l, r in node.criteria:
             extra.add(l)
@@ -408,46 +484,20 @@ def _prune(node: N.PlanNode, demand: set) -> None:
         if node.filter is not None:
             _refs(node.filter, extra)
         node.output = narrowed(extra)
-        left_syms = {f.symbol for f in node.left.output}
-        right_syms = {f.symbol for f in node.right.output}
-        want = demand | extra
-        _prune(node.left, want & left_syms)
-        _prune(node.right, want & right_syms)
-        return
-    if isinstance(node, N.SemiJoinNode):
+    elif isinstance(node, N.SemiJoinNode):
         node.output = narrowed({node.source_key})
-        _prune(node.source, demand | {node.source_key})
-        _prune(node.filtering_source, {node.filtering_key})
-        return
-    if isinstance(node, (N.SortNode, N.TopNNode)):
+    elif isinstance(node, (N.SortNode, N.TopNNode)):
         node.output = narrowed(set(node.keys))
-        _prune(node.source, demand | set(node.keys))
-        return
-    if isinstance(node, N.DistinctNode):
-        # DISTINCT is defined over exactly its output columns
-        child = {f.symbol for f in node.output}
-        _prune(node.source, child)
-        return
-    if isinstance(node, (N.LimitNode, N.EnforceSingleRowNode,
-                         N.ExchangeNode)):
-        node.output = narrowed()
-        _prune(node.source, set(demand))
-        return
-    if isinstance(node, N.UnionNode):
+    elif isinstance(node, N.AssignUniqueIdNode):
+        node.output = narrowed({node.symbol})
+    elif isinstance(node, N.UnionNode):
         node.output = narrowed()
         keep_syms = {f.symbol for f in node.output}
-        new_maps = []
-        for inp, m in zip(node.inputs, node.symbol_maps):
-            m2 = {out: src for out, src in m.items() if out in keep_syms}
-            new_maps.append(m2)
-            _prune(inp, set(m2.values()))
-        node.symbol_maps = new_maps
-        return
-    if isinstance(node, N.OutputNode):
-        _prune(node.source, set(node.source_symbols))
-        return
-    raise LocalPlanningError(
-        f"prune: unhandled node {type(node).__name__}")
+        node.symbol_maps = [
+            {o: src for o, src in m.items() if o in keep_syms}
+            for m in node.symbol_maps]
+    else:
+        node.output = narrowed()
 
 
 def _refs(e: RowExpression, out: set) -> None:

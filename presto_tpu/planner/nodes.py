@@ -192,6 +192,19 @@ class EnforceSingleRowNode(PlanNode):
 
 
 @dataclasses.dataclass
+class AssignUniqueIdNode(PlanNode):
+    """Appends a unique BIGINT row id column (reference:
+    AssignUniqueIdOperator) — used by general subquery decorrelation to
+    re-identify probe rows after a join."""
+    source: PlanNode
+    symbol: str
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
 class OutputNode(PlanNode):
     source: PlanNode
     # user-visible column names, in order, referencing source symbols
