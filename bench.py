@@ -11,15 +11,34 @@ vs_baseline is rows/sec relative to JAVA_BASELINE_ROWS_PER_SEC, an
 estimate of the single-node Java operator pipeline on Q1 (the reference
 publishes no absolute numbers — BASELINE.md; the estimate is the
 HandTpchQuery1 class of result on one modern core, ~10M rows/s).
+
+Methodology: the reported number is the WARM rows/s — timed runs follow
+a warmup that compiles the kernels and populates the connector's
+device-batch scan cache, so data generation and host->device transfer
+are excluded (the Java baseline likewise excludes data-load: the
+reference's benchmark pre-loads pages via LocalQueryRunner before
+timing). The cold (first-run) time is printed to stderr for reference.
+
+Robustness: the actual run happens in a CHILD process under a hard
+subprocess timeout — backend init through the remote TPU tunnel can
+hang inside native plugin-discovery code where no in-process deadline
+(signal/alarm) can interrupt it. If the native-backend child fails or
+hangs, a CPU child (axon sitecustomize bypassed) runs instead, so one
+JSON line is ALWAYS emitted.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
+import traceback
 
 SCHEMA = "sf1"          # 6,001,215 lineitem rows at SF1 scaling
 BATCH_ROWS = 1 << 20
 JAVA_BASELINE_ROWS_PER_SEC = 1.0e7
+METRIC = f"tpch_q1_{SCHEMA}_rows_per_sec"
+CHILD_TIMEOUT_S = 2400
 
 Q1 = """
 select returnflag, linestatus,
@@ -38,7 +57,8 @@ order by returnflag, linestatus
 """
 
 
-def main() -> None:
+def _run_bench() -> float:
+    """Execute warm Q1 runs; returns rows/sec."""
     from presto_tpu.runner import LocalRunner
 
     runner = LocalRunner("tpch", SCHEMA)
@@ -51,7 +71,10 @@ def main() -> None:
     n_rows = int(gen.line_counts(
         np.arange(gen.rows("orders")) + 1).sum())
 
+    t0 = time.perf_counter()
     result = runner.execute(Q1)          # warmup: compile + first run
+    print(f"cold (compile + datagen + transfer): "
+          f"{time.perf_counter() - t0:.3f}s", file=sys.stderr)
     assert len(result.rows()) == 4, result.rows()
 
     times = []
@@ -61,14 +84,86 @@ def main() -> None:
         times.append(time.perf_counter() - t0)
         print(f"run: {times[-1]:.3f}s", file=sys.stderr)
     best = min(times)
-    rows_per_sec = n_rows / best
+    return n_rows / best
 
-    print(json.dumps({
-        "metric": f"tpch_q1_{SCHEMA}_rows_per_sec",
+
+def _emit(rows_per_sec: float, **extra) -> None:
+    line = {
+        "metric": METRIC,
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / JAVA_BASELINE_ROWS_PER_SEC, 4),
-    }))
+    }
+    line.update(extra)
+    print(json.dumps(line))
+
+
+def _child_main() -> int:
+    """Run the bench in this process and print the JSON line."""
+    try:
+        rows_per_sec = _run_bench()
+    except Exception:  # noqa: BLE001 - always emit the JSON line
+        traceback.print_exc()
+        _emit(0.0, error=traceback.format_exc(limit=3)[-500:])
+        return 1
+    extra = {}
+    if os.environ.get("PRESTO_TPU_BENCH_PLATFORM"):
+        extra["platform"] = os.environ["PRESTO_TPU_BENCH_PLATFORM"]
+    _emit(rows_per_sec, **extra)
+    return 0
+
+
+def main() -> int:
+    if os.environ.get("PRESTO_TPU_BENCH_CHILD") == "1":
+        return _child_main()
+
+    attempts = [
+        ("native", {}),
+        # the axon plugin sitecustomize (PYTHONPATH) can hang discovery
+        # even when cpu is selected — clear it for the fallback child
+        ("cpu_fallback", {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                          "PRESTO_TPU_BENCH_PLATFORM": "cpu_fallback"}),
+    ]
+    for name, env_mod in attempts:
+        env = {**os.environ, **env_mod, "PRESTO_TPU_BENCH_CHILD": "1"}
+        print(f"bench attempt: {name}", file=sys.stderr)
+        # cheap probe child first: a wedged TPU tunnel hangs inside
+        # native plugin discovery; bound that to 300s instead of a full
+        # bench timeout
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; "
+                 "jnp.zeros(()).block_until_ready(); "
+                 "print(jax.default_backend())"],
+                env=env, timeout=300, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"backend probe for {name} hung (300s); skipping",
+                  file=sys.stderr)
+            continue
+        if probe.returncode != 0:
+            print(f"backend probe for {name} failed:\n"
+                  f"{probe.stderr[-1500:]}", file=sys.stderr)
+            continue
+        print(f"backend: {probe.stdout.strip()}", file=sys.stderr)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                timeout=CHILD_TIMEOUT_S, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"bench attempt {name} timed out after "
+                  f"{CHILD_TIMEOUT_S}s", file=sys.stderr)
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        json_lines = [l for l in proc.stdout.splitlines()
+                      if l.startswith("{")]
+        if proc.returncode == 0 and json_lines:
+            print(json_lines[-1])
+            return 0
+        print(f"bench attempt {name} failed (rc={proc.returncode})",
+              file=sys.stderr)
+    _emit(0.0, error="all bench attempts failed or timed out")
+    return 0
 
 
 if __name__ == "__main__":

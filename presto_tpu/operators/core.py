@@ -131,16 +131,23 @@ def make_filter_project_kernel(
     of the cache key: compiled kernels bake input dictionaries into
     constants (LIKE lookup tables, string-comparison ranks), so the same
     IR compiled against another schema is a different kernel."""
-    try:
-        key = (filter_expr.ir if filter_expr else None,
-               tuple((n, ce.ir, ce.dictionary) for n, ce in projections),
-               input_dicts)
-        cached = _FP_KERNEL_CACHE.get(key)
-        if cached is not None:
-            _FP_KERNEL_CACHE.move_to_end(key)
-            return cached
-    except TypeError:  # unhashable literal somewhere — just don't cache
+    # A CompiledExpr built directly (ir=None) is indistinguishable from
+    # "no filter" / another ir=None projection in the key — never cache
+    # those, a collision would silently return the wrong kernel.
+    exprs = ([filter_expr] if filter_expr else []) + [ce for _, ce in projections]
+    if any(ce.ir is None for ce in exprs):
         key = None
+    else:
+        try:
+            key = (filter_expr.ir if filter_expr else None,
+                   tuple((n, ce.ir, ce.dictionary) for n, ce in projections),
+                   input_dicts)
+            cached = _FP_KERNEL_CACHE.get(key)
+            if cached is not None:
+                _FP_KERNEL_CACHE.move_to_end(key)
+                return cached
+        except TypeError:  # unhashable literal somewhere — just don't cache
+            key = None
 
     @jax.jit
     def kernel(batch: Batch) -> Batch:

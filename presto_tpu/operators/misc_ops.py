@@ -297,6 +297,97 @@ class LocalQueueSourceOperator(Operator):
         return self.queue.finished
 
 
+class Spool:
+    """Materialized output of a subtree shared by several plan parents
+    (planner-level CSE). Filled ONCE by a SpoolSinkOperator pipeline and
+    replayed to every consumer, so a DAG-shaped plan (e.g. the probe
+    side of a unique-id EXISTS decorrelation feeding both a JoinNode and
+    a SemiJoinNode) executes the shared subtree exactly once — rather
+    than twice with a fragile bit-identical-replay assumption.
+
+    Batches are released (slot set to None) once every registered
+    consumer's cursor has passed them, so device memory is not pinned
+    for the whole query."""
+
+    def __init__(self):
+        self.batches: List[Optional[Batch]] = []
+        self.done = False
+        self._cursors: List[int] = []
+
+    def register_consumer(self) -> int:
+        self._cursors.append(0)
+        return len(self._cursors) - 1
+
+    def advance(self, consumer: int, position: int) -> None:
+        self._cursors[consumer] = position
+        floor = min(self._cursors)
+        for i in range(floor):
+            self.batches[i] = None
+
+
+class SpoolSinkOperator(Operator):
+    def __init__(self, ctx: OperatorContext, spool: Spool):
+        super().__init__(ctx)
+        self.spool = spool
+        self._finished = False
+
+    def needs_input(self) -> bool:
+        return not self._finished
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        self.spool.batches.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self.spool.done = True
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+    def close(self) -> None:
+        self.finish()
+
+
+class SpoolSourceOperator(Operator):
+    """Replays a finished spool; each consumer has its own cursor."""
+
+    def __init__(self, ctx: OperatorContext, spool: Spool,
+                 consumer: int):
+        super().__init__(ctx)
+        self.spool = spool
+        self._consumer = consumer
+        self._i = 0
+
+    def needs_input(self) -> bool:
+        return False
+
+    def add_input(self, batch: Batch) -> None:
+        raise RuntimeError("source takes no input")
+
+    def is_blocked(self):
+        return False if self.spool.done else "waiting for spool fill"
+
+    def get_output(self) -> Optional[Batch]:
+        if self.spool.done and self._i < len(self.spool.batches):
+            b = self.spool.batches[self._i]
+            assert b is not None, "spool batch released before replay"
+            self._i += 1
+            self.spool.advance(self._consumer, self._i)
+            return self._count_out(b)
+        return None
+
+    def finish(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        return self.spool.done and self._i >= len(self.spool.batches)
+
+
 class _SimpleFactory(OperatorFactory):
     def __init__(self, operator_id: int, name: str, fn):
         super().__init__(operator_id, name)
@@ -332,3 +423,15 @@ def queue_sink_factory(op_id: int, queue: LocalQueue,
 def queue_source_factory(op_id: int, queue: LocalQueue):
     return _SimpleFactory(op_id, "local_source",
                           lambda ctx: LocalQueueSourceOperator(ctx, queue))
+
+
+def spool_sink_factory(op_id: int, spool: Spool):
+    return _SimpleFactory(op_id, "spool_sink",
+                          lambda ctx: SpoolSinkOperator(ctx, spool))
+
+
+def spool_source_factory(op_id: int, spool: Spool):
+    consumer = spool.register_consumer()
+    return _SimpleFactory(
+        op_id, "spool_source",
+        lambda ctx: SpoolSourceOperator(ctx, spool, consumer))

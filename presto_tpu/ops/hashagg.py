@@ -15,9 +15,14 @@ implements partial and final aggregation (final consumes partial states
 as its input contributions), which is what makes the
 partial -> shuffle -> final plan shape work unchanged.
 
-Overflow: if distinct groups exceed max_groups the step reports it in
-`overflow` (checked host-side at operator level; the operator re-runs
-with a bigger bucket — the analog of MultiChannelGroupByHash rehash :87).
+Overflow: if distinct groups exceed max_groups the overflow flag
+accumulates ON DEVICE and surfaces as GroupLimitExceeded when the
+operator drains (AggregationOperator.get_output) — no per-batch host
+sync. The retry is QUERY-level: LocalRunner._run_plan catches
+GroupLimitExceeded and re-executes with a larger max_groups (the analog
+of MultiChannelGroupByHash rehash :87). Any OTHER driver of
+AggregationOperator (e.g. a distributed stage runner) must handle
+GroupLimitExceeded itself or pre-size max_groups.
 """
 
 from __future__ import annotations

@@ -70,6 +70,8 @@ class LocalExecutionPlanner:
         self.session = session
         self._pipelines: List[List] = []
         self._op_id = 0
+        self._shared: set = set()
+        self._spools: Dict[int, misc_ops.Spool] = {}
 
     def _next_id(self) -> int:
         self._op_id += 1
@@ -77,6 +79,7 @@ class LocalExecutionPlanner:
 
     def plan(self, root: N.OutputNode) -> LocalExecutionPlan:
         prune_unused_columns(root)
+        self._shared = _shared_nodes(root)
         sink: List[Batch] = []
         pipeline: List = []
         self._visit(root.source, pipeline)
@@ -100,6 +103,27 @@ class LocalExecutionPlanner:
     # ------------------------------------------------------------------
 
     def _visit(self, node: N.PlanNode, pipe: List) -> None:
+        # A node with several plan parents (DAG) is computed ONCE into a
+        # Spool and replayed to each consumer — the reference dedups via
+        # planner CSE; without this the shared subtree would execute once
+        # per parent (ADVICE r1: EXISTS probe ran twice).
+        nid = id(node)
+        if nid in self._shared:
+            spool = self._spools.get(nid)
+            if spool is None:
+                spool = misc_ops.Spool()
+                self._spools[nid] = spool
+                sp: List = []
+                self._dispatch(node, sp)
+                sp.append(misc_ops.spool_sink_factory(self._next_id(),
+                                                      spool))
+                self._pipelines.append(sp)
+            pipe.append(misc_ops.spool_source_factory(self._next_id(),
+                                                      spool))
+            return
+        self._dispatch(node, pipe)
+
+    def _dispatch(self, node: N.PlanNode, pipe: List) -> None:
         m = getattr(self, f"_visit_{type(node).__name__}", None)
         if m is None:
             raise LocalPlanningError(
@@ -198,17 +222,8 @@ class LocalExecutionPlanner:
 
     @staticmethod
     def _make_agg(a: N.AggCall, arg_ce: Optional[CompiledExpr]):
-        if a.function == "count":
-            return hashagg.make_count(arg_ce.type if arg_ce else None)
-        if a.function == "sum":
-            return hashagg.make_sum(arg_ce.type, a.output_type)
-        if a.function == "avg":
-            return hashagg.make_avg(arg_ce.type)
-        if a.function == "min":
-            return hashagg.make_min(arg_ce.type)
-        if a.function == "max":
-            return hashagg.make_max(arg_ce.type)
-        raise LocalPlanningError(f"unknown aggregate {a.function}")
+        t = a.input_type or (arg_ce.type if arg_ce else None)
+        return agg_function_for(a.function, t, a.output_type)
 
     def _visit_JoinNode(self, node: N.JoinNode, pipe: List):
         if node.join_type == "cross":
@@ -332,6 +347,24 @@ class LocalExecutionPlanner:
 
 # ---------------------------------------------------------------------------
 
+def agg_function_for(name: str, input_type: Optional[Type],
+                     output_type: Optional[Type]) -> hashagg.AggFunction:
+    """Resolve an aggregate name + argument type to its state machine.
+    Shared by local planning and the AddExchanges partial/final split
+    (both sides must construct bit-identical state layouts)."""
+    if name == "count":
+        return hashagg.make_count(input_type)
+    if name == "sum":
+        return hashagg.make_sum(input_type, output_type)
+    if name == "avg":
+        return hashagg.make_avg(input_type)
+    if name == "min":
+        return hashagg.make_min(input_type)
+    if name == "max":
+        return hashagg.make_max(input_type)
+    raise LocalPlanningError(f"unknown aggregate {name}")
+
+
 def _unified_key_dicts(probe: N.PlanNode, build: N.PlanNode,
                        criteria) -> Optional[List[Optional[tuple]]]:
     """For string join keys, the union dictionary both sides re-encode
@@ -351,6 +384,26 @@ def _unified_key_dicts(probe: N.PlanNode, build: N.PlanNode,
     return out if any_string else None
 
 
+def _parent_counts(root: N.PlanNode) -> Dict[int, int]:
+    """Parent-edge count per node id over the plan DAG."""
+    counts: Dict[int, int] = {}
+    seen: set = set()
+
+    def walk(n: N.PlanNode) -> None:
+        for s in n.sources():
+            counts[id(s)] = counts.get(id(s), 0) + 1
+            if id(s) not in seen:
+                seen.add(id(s))
+                walk(s)
+    walk(root)
+    return counts
+
+
+def _shared_nodes(root: N.PlanNode) -> set:
+    """ids of plan nodes with more than one parent (DAG sharing)."""
+    return {nid for nid, c in _parent_counts(root).items() if c > 1}
+
+
 def prune_unused_columns(root: N.PlanNode) -> None:
     """Demand-driven column pruning, top-down (reference:
     PruneUnreferencedOutputs): each node narrows its output to what its
@@ -363,16 +416,7 @@ def prune_unused_columns(root: N.PlanNode) -> None:
     naive recursive narrowing would let the first parent's prune hide
     columns the second parent still needs."""
     # pass 0: count parent edges (Kahn topological order over the DAG)
-    pending: Dict[int, int] = {}
-    seen: set = set()
-
-    def walk(n: N.PlanNode) -> None:
-        for s in n.sources():
-            pending[id(s)] = pending.get(id(s), 0) + 1
-            if id(s) not in seen:
-                seen.add(id(s))
-                walk(s)
-    walk(root)
+    pending = _parent_counts(root)
 
     # pass 1: propagate demand top-down, processing a node only once all
     # of its parents have contributed

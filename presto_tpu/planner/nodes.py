@@ -77,6 +77,10 @@ class AggCall:
     argument: Optional[RowExpression]  # None for count(*)
     distinct: bool = False
     output_type: Optional[Type] = None
+    # effective input type, set on FINAL-step calls (argument is None
+    # there — the operator merges <out>__s{i} state columns instead) so
+    # the state layout matches the partial side exactly
+    input_type: Optional[Type] = None
 
 
 @dataclasses.dataclass
