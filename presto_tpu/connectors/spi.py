@@ -48,6 +48,12 @@ class ConnectorMetadata(abc.ABC):
     @abc.abstractmethod
     def get_table_schema(self, handle: TableHandle) -> RelationSchema: ...
 
+    def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
+        """Optional table cardinality estimate feeding the optimizer's
+        cost decisions (reference: ConnectorMetadata.getTableStatistics /
+        presto-main cost/StatsCalculator). None = unknown."""
+        return None
+
 
 class ConnectorSplitManager(abc.ABC):
     @abc.abstractmethod

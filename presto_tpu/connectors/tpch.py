@@ -459,6 +459,12 @@ class _TpchMetadata(ConnectorMetadata):
         gen = self._gens[handle.schema]
         return gen.schema(handle.table)
 
+    def estimate_row_count(self, handle: TableHandle) -> int:
+        gen = self._gens[handle.schema]
+        if handle.table == "lineitem":
+            return gen.rows("orders") * 4  # ~4 lines per order
+        return gen.rows(handle.table)
+
 
 class _TpchSplitManager(ConnectorSplitManager):
     def __init__(self, gens: Dict[str, TpchGenerator]):

@@ -214,17 +214,32 @@ class FilterProjectOperatorFactory(OperatorFactory):
 
 class LimitOperator(Operator):
     """LIMIT n (reference: LimitOperator). Tracks emitted rows as a
-    device scalar to avoid per-batch recompiles."""
+    device scalar to avoid per-batch recompiles.
+
+    Early termination never BLOCKS on the device: the limit-reached
+    flag is fetched asynchronously and only consulted once its transfer
+    has completed (`is_ready`), so the hot loop stays free of
+    device->host roundtrips — at worst the operator pulls a couple of
+    extra batches before noticing the limit was hit (each is still
+    correctly truncated by limit_batch)."""
 
     def __init__(self, ctx: OperatorContext, n: int):
         super().__init__(ctx)
         self._n = n
         self._emitted = jnp.asarray(0, jnp.int64)
+        self._flag = None  # device bool: emitted >= n
         self._pending: Optional[Batch] = None
         self._finishing = False
         self._done = False
 
     def needs_input(self) -> bool:
+        if not self._done and self._flag is not None:
+            try:
+                ready = self._flag.is_ready()
+            except AttributeError:  # non-Array (e.g. np scalar)
+                ready = True
+            if ready and bool(self._flag):
+                self._done = True  # stop pulling input
         return self._pending is None and not self._finishing \
             and not self._done
 
@@ -232,12 +247,15 @@ class LimitOperator(Operator):
         self._count_in(batch)
         out = sort_ops.limit_batch(batch, self._n, self._emitted)
         self._emitted = self._emitted + jnp.sum(out.row_valid)
+        self._flag = self._emitted >= self._n
+        try:
+            self._flag.copy_to_host_async()
+        except AttributeError:
+            pass
         self._pending = out
 
     def get_output(self) -> Optional[Batch]:
         out, self._pending = self._pending, None
-        if out is not None and int(self._emitted) >= self._n:
-            self._done = True  # early termination: stop pulling input
         return self._count_out(out)
 
     def finish(self) -> None:

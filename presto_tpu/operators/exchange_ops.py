@@ -1,0 +1,284 @@
+"""Mesh exchange runtime: the data plane between plan fragments.
+
+The reference moves pages between tasks through per-task OutputBuffers
+(execution/buffer/PartitionedOutputBuffer.java:48) pulled over HTTP by
+ExchangeClient.java:81. Here all fragment tasks live in one SPMD host
+process, so an exchange is an in-process object that routes device
+batches between producer and consumer task queues:
+
+  - repartition (hash keys): producers contribute one batch each per
+    "wave"; the wave runs ONE compiled shard_map program whose
+    jax.lax.all_to_all rides ICI (parallel/shuffle.wave_repartition).
+    Consumers receive compacted batches sized to their live rows.
+  - repartition (no keys): round-robin whole batches across consumers
+    (FIXED_ARBITRARY_DISTRIBUTION).
+  - gather: every batch to the single consumer task's device.
+  - broadcast: every batch replicated to every consumer device.
+  - passthrough: producer i -> consumer i (fragment cut of a shared
+    subtree; no data movement).
+
+Producer/consumer progress is driven by the same round-robin driver
+loop as every other operator, so stages stream (P5): a wave fires as
+soon as each producer has one batch pending (finished producers are
+padded with empty batches).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+from presto_tpu.ops import common
+from presto_tpu.parallel.shuffle import wave_repartition
+
+
+class MeshExchange:
+    """One exchange edge: N producer tasks -> M consumer task queues."""
+
+    def __init__(self, exchange_id: int, scheme: str,
+                 partition_keys: Sequence[str],
+                 hash_dicts, key_dictionaries,
+                 mesh, n_producers: int, n_consumers: int):
+        self.exchange_id = exchange_id
+        self.scheme = scheme
+        self.partition_keys = list(partition_keys)
+        self.mesh = mesh
+        self.devices = list(mesh.devices.reshape(-1)) if mesh is not None \
+            else [None]
+        self.n_producers = n_producers
+        self.n_consumers = n_consumers
+        self.queues: List[collections.deque] = [
+            collections.deque() for _ in range(n_consumers)]
+        self._pending: List[collections.deque] = [
+            collections.deque() for _ in range(n_producers)]
+        self._done = [False] * n_producers
+        self._template: Optional[Batch] = None
+        self._rr = 0
+        self._flushed = False
+        # per-key remap tables: original dictionary codes -> unified
+        # hash dictionary codes (None for non-string keys)
+        self._remaps = None
+        if hash_dicts is not None:
+            self._remaps = []
+            for dic, hd in zip(key_dictionaries, hash_dicts):
+                if hd is None or dic is None:
+                    self._remaps.append(None)
+                else:
+                    index = {v: i for i, v in enumerate(hd)}
+                    self._remaps.append(jnp.asarray(
+                        np.array([index[v] for v in dic] or [0],
+                                 dtype=np.int32)))
+
+    # -- producer side -----------------------------------------------------
+
+    def push(self, producer: int, batch: Batch) -> None:
+        if self._template is None:
+            self._template = batch
+        scheme = self.scheme
+        if scheme == "gather":
+            self.queues[0].append(self._place(batch, 0))
+        elif scheme == "broadcast":
+            for c in range(self.n_consumers):
+                self.queues[c].append(self._place(batch, c))
+        elif scheme == "passthrough":
+            self.queues[producer].append(batch)
+        elif scheme == "repartition" and not self.partition_keys:
+            c = self._rr % self.n_consumers
+            self._rr += 1
+            self.queues[c].append(self._place(batch, c))
+        elif scheme == "repartition":
+            if self.n_consumers == 1 and self.n_producers == 1:
+                self.queues[0].append(batch)
+            elif self._collective:
+                self._pending[producer].append(batch)
+                self._try_wave()
+            else:
+                self._hash_split(batch)
+        else:
+            raise ValueError(f"unknown exchange scheme {scheme}")
+
+    def producer_done(self, producer: int) -> None:
+        if not self._done[producer]:
+            self._done[producer] = True
+            if self.scheme == "repartition" and self.partition_keys \
+                    and self._collective:
+                self._try_wave()
+
+    # -- consumer side -----------------------------------------------------
+
+    def pop(self, consumer: int) -> Optional[Batch]:
+        q = self.queues[consumer]
+        return q.popleft() if q else None
+
+    def has_output(self, consumer: int) -> bool:
+        return bool(self.queues[consumer])
+
+    def finished(self, consumer: int) -> bool:
+        return (all(self._done)
+                and not self.queues[consumer]
+                and not any(self._pending))
+
+    # -- internals ---------------------------------------------------------
+
+    @property
+    def _collective(self) -> bool:
+        w = len(self.devices)
+        return (self.n_producers == w and self.n_consumers == w
+                and w > 1)
+
+    def _place(self, batch: Batch, consumer: int) -> Batch:
+        dev = self.devices[consumer] if consumer < len(self.devices) \
+            else self.devices[0]
+        if dev is None:
+            return batch
+        return jax.device_put(batch, dev)
+
+    def _hash_split(self, batch: Batch) -> None:
+        """Non-collective repartition (producer/consumer counts differ
+        from the mesh width, e.g. a single VALUES fragment spreading to
+        W workers): split one batch by hash, route each slice."""
+        cols = []
+        for i, k in enumerate(self.partition_keys):
+            c = batch.columns[k]
+            d = c.data
+            if self._remaps is not None and self._remaps[i] is not None:
+                d = self._remaps[i][d]
+            cols.append((d, c.mask))
+        h = common.row_hash(cols)
+        dest = (jnp.abs(h) % self.n_consumers).astype(jnp.int32)
+        for c in range(self.n_consumers):
+            part = Batch(batch.columns, batch.row_valid & (dest == c))
+            self.queues[c].append(self._place(part, c))
+
+    def _pad_batch(self, cap: int, producer: int) -> Batch:
+        t = self._template
+        cols = {
+            n: Column(jnp.zeros((cap,), c.data.dtype),
+                      jnp.zeros((cap,), bool), c.type, c.dictionary)
+            for n, c in t.columns.items()
+        }
+        b = Batch(cols, jnp.zeros((cap,), bool))
+        return jax.device_put(b, self.devices[producer])
+
+    def _try_wave(self) -> None:
+        while True:
+            have = [bool(p) for p in self._pending]
+            if all(h or d for h, d in zip(have, self._done)):
+                if not any(have):
+                    return  # nothing left to flush
+            else:
+                return  # wait for slower producers
+            cap = max(p[0].capacity for p in self._pending if p)
+            wave = []
+            for i, p in enumerate(self._pending):
+                wave.append(p.popleft() if p
+                            else self._pad_batch(cap, i))
+            outs = wave_repartition(self.mesh, wave,
+                                    self.partition_keys,
+                                    key_remaps=self._remaps)
+            for c, b in enumerate(outs):
+                self.queues[c].append(b)
+
+
+class ExchangeSinkOperator(Operator):
+    """Tail of a producer task's pipeline; tees every batch into each
+    consumer edge of this fragment's output (the analog of one
+    OutputBuffer with several buffer ids)."""
+
+    def __init__(self, ctx: OperatorContext,
+                 exchanges: Sequence[MeshExchange], producer: int):
+        super().__init__(ctx)
+        self.exchanges = list(exchanges)
+        self.producer = producer
+        self._finished = False
+
+    def needs_input(self) -> bool:
+        return not self._finished
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        for ex in self.exchanges:
+            ex.push(self.producer, batch)
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            for ex in self.exchanges:
+                ex.producer_done(self.producer)
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+    def close(self) -> None:
+        self.finish()
+
+
+class ExchangeSourceOperator(Operator):
+    """Head of a consumer task's pipeline (reference:
+    ExchangeOperator.java:35 pulling from ExchangeClient)."""
+
+    def __init__(self, ctx: OperatorContext, exchange: MeshExchange,
+                 consumer: int):
+        super().__init__(ctx)
+        self.exchange = exchange
+        self.consumer = consumer
+
+    def needs_input(self) -> bool:
+        return False
+
+    def add_input(self, batch: Batch) -> None:
+        raise RuntimeError("exchange source takes no input")
+
+    def is_blocked(self):
+        if self.exchange.has_output(self.consumer) or \
+                self.exchange.finished(self.consumer):
+            return False
+        return f"waiting for exchange {self.exchange.exchange_id}"
+
+    def get_output(self) -> Optional[Batch]:
+        b = self.exchange.pop(self.consumer)
+        return self._count_out(b) if b is not None else None
+
+    def finish(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        return self.exchange.finished(self.consumer) \
+            and not self.exchange.has_output(self.consumer)
+
+
+class ExchangeSinkOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int,
+                 exchanges: Sequence[MeshExchange], producer: int):
+        super().__init__(operator_id, "exchange_sink")
+        self.exchanges = exchanges
+        self.producer = producer
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return ExchangeSinkOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.exchanges, self.producer)
+
+
+class ExchangeSourceOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, exchange: MeshExchange,
+                 consumer: int):
+        super().__init__(operator_id, "exchange_source")
+        self.exchange = exchange
+        self.consumer = consumer
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return ExchangeSourceOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.exchange, self.consumer)

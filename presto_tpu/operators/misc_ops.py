@@ -130,9 +130,14 @@ class AssignUniqueIdOperator(Operator):
     AssignUniqueIdOperator): id = batch_offset + position. Padding rows
     get ids too (harmless — their row_valid is False)."""
 
-    def __init__(self, ctx: OperatorContext, symbol: str):
+    def __init__(self, ctx: OperatorContext, symbol: str,
+                 start: int = 0, stride: int = 1):
         super().__init__(ctx)
         self.symbol = symbol
+        # ids = start + k * stride keeps ids unique across the tasks of
+        # a distributed fragment (task t of W uses start=t, stride=W)
+        self._start = start
+        self._stride = stride
         self._offset = 0
         self._pending: Optional[Batch] = None
         self._finishing = False
@@ -143,7 +148,8 @@ class AssignUniqueIdOperator(Operator):
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
         from presto_tpu.types import BIGINT
-        ids = self._offset + jnp.arange(batch.capacity, dtype=jnp.int64)
+        ids = self._start + self._stride * (
+            self._offset + jnp.arange(batch.capacity, dtype=jnp.int64))
         self._offset += batch.capacity
         cols = dict(batch.columns)
         cols[self.symbol] = Column(ids, jnp.ones(batch.capacity, bool),
@@ -162,14 +168,17 @@ class AssignUniqueIdOperator(Operator):
 
 
 class AssignUniqueIdOperatorFactory(OperatorFactory):
-    def __init__(self, operator_id: int, symbol: str):
+    def __init__(self, operator_id: int, symbol: str,
+                 start: int = 0, stride: int = 1):
         super().__init__(operator_id, "assign_unique_id")
         self.symbol = symbol
+        self.start = start
+        self.stride = stride
 
     def create(self, driver_context: DriverContext) -> Operator:
         return AssignUniqueIdOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self.symbol)
+            self.symbol, self.start, self.stride)
 
 
 class EnforceSingleRowOperator(Operator):

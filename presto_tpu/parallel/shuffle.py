@@ -132,13 +132,12 @@ def _bucketize(dest: jnp.ndarray, valid: jnp.ndarray, n_parts: int,
     return out
 
 
-def _shuffle_body(n_parts: int, axis: str,
+def _shuffle_core(n_parts: int, axis: str,
                   row_valid: jnp.ndarray,
-                  key_datas: Tuple[jnp.ndarray, ...],
-                  key_masks: Tuple[jnp.ndarray, ...],
-                  datas: Tuple[jnp.ndarray, ...],
-                  masks: Tuple[jnp.ndarray, ...]):
-    """Per-chip: route local rows to consumers, exchange, flatten."""
+                  key_datas, key_masks, datas, masks):
+    """Per-chip shuffle pipeline shared by every repartition entry
+    point: hash keys -> bucketize -> all_to_all -> flatten. Returns the
+    flat received (datas, masks, row_valid)."""
     h = common.row_hash(list(zip(key_datas, key_masks)))
     dest = jnp.abs(h) % n_parts
     send = _bucketize(dest.astype(jnp.int32), row_valid, n_parts,
@@ -146,10 +145,18 @@ def _shuffle_body(n_parts: int, axis: str,
     recv = [jax.lax.all_to_all(b, axis, 0, 0, tiled=True) for b in send]
     flat = [b.reshape(-1) for b in recv]
     nd = len(datas)
-    out_datas = tuple(flat[:nd])
-    out_masks = tuple(flat[nd:2 * nd])
-    out_valid = flat[2 * nd]
-    return out_datas, out_masks, out_valid
+    return tuple(flat[:nd]), tuple(flat[nd:2 * nd]), flat[2 * nd]
+
+
+def _shuffle_body(n_parts: int, axis: str,
+                  row_valid: jnp.ndarray,
+                  key_datas: Tuple[jnp.ndarray, ...],
+                  key_masks: Tuple[jnp.ndarray, ...],
+                  datas: Tuple[jnp.ndarray, ...],
+                  masks: Tuple[jnp.ndarray, ...]):
+    """Per-chip: route local rows to consumers, exchange, flatten."""
+    return _shuffle_core(n_parts, axis, row_valid, key_datas, key_masks,
+                         datas, masks)
 
 
 def hash_repartition(sb: ShardedBatch, key_names: Sequence[str]
@@ -191,3 +198,119 @@ def broadcast_batch(batch: Batch, mesh: Mesh,
     FIXED_BROADCAST_DISTRIBUTION + BroadcastOutputBuffer for small join
     build sides — SystemPartitioningHandle.java:63)."""
     return _replicate(batch, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Wave shuffle: the engine's exchange-operator entry point.
+#
+# One "wave" = one batch per worker. The compiled SPMD program (cached
+# per mesh/shape/signature so repeated waves never retrace) hashes,
+# all_to_alls, then PACKS the received rows to the front of each shard
+# and counts them — the host reads the [W] counts once per wave and
+# slices every consumer's shard down to its capacity bucket, which fixes
+# the W× capacity blow-up of chained shuffles (each consumer batch ends
+# up sized to its live rows, not to W * producer capacity).
+
+
+@functools.lru_cache(maxsize=256)
+def _wave_program(mesh: Mesh, axis: str, w: int, n_keys: int,
+                  n_cols: int):
+    spec = P(axis)
+
+    def body(row_valid, key_datas, key_masks, datas, masks):
+        r_datas, r_masks, valid = _shuffle_core(
+            w, axis, row_valid, key_datas, key_masks, datas, masks)
+        # pack live rows to the front (per-shard compaction)
+        order = jnp.argsort(~valid, stable=True)
+        out_datas = tuple(f[order] for f in r_datas)
+        out_masks = tuple(f[order] for f in r_masks)
+        out_valid = valid[order]
+        count = jnp.sum(valid).reshape(1)
+        return out_datas, out_masks, out_valid, count
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec,) * 5,
+        out_specs=(spec, spec, spec, spec)))
+
+
+def _as_global(arrays, mesh: Mesh, axis: str, cap: int):
+    """Assemble per-device shards into one sharded global array
+    (zero-copy when each shard already lives on its mesh device)."""
+    w = len(arrays)
+    sh = NamedSharding(mesh, P(axis))
+    devs = list(mesh.devices.reshape(-1))
+    placed = []
+    for a, d in zip(arrays, devs):
+        if a.devices() != {d}:
+            a = jax.device_put(a, d)
+        placed.append(a)
+    return jax.make_array_from_single_device_arrays(
+        (w * cap,) + placed[0].shape[1:], sh, placed)
+
+
+def wave_repartition(mesh: Mesh, batches, key_names,
+                     key_remaps=None, axis: str = worker_axis):
+    """Hash-repartition one wave (one Batch per worker) over ICI.
+
+    `key_remaps[i]`, when set, is an int32 device array re-encoding that
+    string key's dictionary codes onto the unified hash dictionary so
+    equal strings hash equally on every producer.
+
+    Returns the list of per-consumer Batches (consumer i's batch lives
+    on mesh device i), each compacted and sliced to the capacity bucket
+    of its live rows.
+    """
+    w = len(batches)
+    assert w == mesh.shape[axis]
+    cap = max(b.capacity for b in batches)
+    batches = [b if b.capacity == cap else b.compact(cap)
+               for b in batches]
+    names = batches[0].names
+    tmpl = batches[0]
+
+    key_datas, key_masks = [], []
+    for i, k in enumerate(key_names):
+        datas, masks = [], []
+        for b in batches:
+            c = b.columns[k]
+            d = c.data
+            if key_remaps is not None and key_remaps[i] is not None:
+                d = key_remaps[i][d]
+            datas.append(d)
+            masks.append(c.mask)
+        key_datas.append(_as_global(datas, mesh, axis, cap))
+        key_masks.append(_as_global(masks, mesh, axis, cap))
+
+    g_datas = tuple(
+        _as_global([b.columns[n].data for b in batches], mesh, axis,
+                   cap) for n in names)
+    g_masks = tuple(
+        _as_global([b.columns[n].mask for b in batches], mesh, axis,
+                   cap) for n in names)
+    g_valid = _as_global([b.row_valid for b in batches], mesh, axis,
+                         cap)
+
+    fn = _wave_program(mesh, axis, w, len(key_names), len(names))
+    out_datas, out_masks, out_valid, counts = fn(
+        g_valid, tuple(key_datas), tuple(key_masks), g_datas, g_masks)
+
+    counts = np.asarray(counts)  # ONE host sync per wave
+    out = []
+    for c in range(w):
+        cap2 = bucket_capacity(max(int(counts[c]), 1))
+        cols = {}
+        for n, gd, gm in zip(names, out_datas, out_masks):
+            col = tmpl.columns[n]
+            cols[n] = Column(_shard(gd, c)[:cap2],
+                             _shard(gm, c)[:cap2],
+                             col.type, col.dictionary)
+        out.append(Batch(cols, _shard(out_valid, c)[:cap2]))
+    return out
+
+
+def _shard(garr, index: int):
+    """The `index`-th row-shard of a sharded global array (on-device)."""
+    shards = sorted(garr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return shards[index].data

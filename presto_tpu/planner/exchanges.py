@@ -1,0 +1,555 @@
+"""Distributed planning: exchange insertion + plan fragmentation.
+
+The AddExchanges analog (reference:
+sql/planner/optimizations/AddExchanges.java:145) walks the optimized
+logical plan bottom-up tracking each subtree's partitioning property
+(SystemPartitioningHandle.java:59-67 — SINGLE / SOURCE / FIXED_HASH)
+and inserts ExchangeNodes where the consumer's required distribution
+differs:
+
+  - aggregation: PARTIAL per worker -> hash repartition on group keys
+    (or gather when no keys) -> FINAL merge, via the operator's
+    partial/final state-column protocol
+  - joins / semijoins: broadcast the build side when its estimated
+    cardinality is under `broadcast_join_threshold_rows`, else hash
+    repartition both sides on the join keys (equal strings must land on
+    equal workers, so repartition hashes through a unified dictionary)
+  - distinct: hash repartition on the distinct columns
+  - sort / limit / topN / enforce-single-row / output: gather, with
+    per-worker partial limit/topN before the gather
+  - shared DAG subtrees (planner CSE) are forced into their own
+    fragment so they execute exactly once, feeding every consumer
+    through its own exchange (the reference materializes shared
+    subtrees through output buffers with several buffer ids)
+
+The fragmenter (reference: sql/planner/PlanFragmenter.java:144) then
+cuts the plan at ExchangeNodes into Fragments whose leaves are
+RemoteSourceNodes; the MeshRunner maps each fragment onto mesh tasks
+(single -> 1 task, distributed -> one task per mesh device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.expr.ir import InputRef
+from presto_tpu.planner import nodes as N
+from presto_tpu.planner.local_planner import (
+    _shared_nodes, agg_function_for,
+)
+from presto_tpu.types import DOUBLE, Type
+
+
+# ---------------------------------------------------------------------------
+# Cardinality estimation (reference: presto-main cost/ — the minimal
+# stats the broadcast-vs-partitioned decision needs; no histograms)
+
+_UNKNOWN_ROWS = 1e9  # unknown = assume large -> partitioned join
+
+
+def estimate_rows(node: N.PlanNode, catalogs,
+                  _memo: Optional[Dict[int, float]] = None) -> float:
+    memo = _memo if _memo is not None else {}
+    if id(node) in memo:
+        return memo[id(node)]
+    est = _estimate(node, catalogs, memo)
+    memo[id(node)] = est
+    return est
+
+
+def _estimate(node: N.PlanNode, catalogs, memo) -> float:
+    def src(n):
+        return estimate_rows(n, catalogs, memo)
+
+    if isinstance(node, N.TableScanNode):
+        try:
+            conn = catalogs.connector(node.handle.catalog)
+            n = conn.metadata.estimate_row_count(node.handle)
+        except Exception:
+            n = None
+        return float(n) if n is not None else _UNKNOWN_ROWS
+    if isinstance(node, N.ValuesNode):
+        return float(len(node.rows))
+    if isinstance(node, N.FilterNode):
+        return 0.33 * src(node.source)
+    if isinstance(node, N.AggregationNode):
+        if not node.keys:
+            return 1.0
+        return max(1.0, 0.1 * src(node.source))
+    if isinstance(node, N.DistinctNode):
+        return max(1.0, 0.3 * src(node.source))
+    if isinstance(node, N.JoinNode):
+        l, r = src(node.left), src(node.right)
+        if node.join_type == "cross" or not node.criteria:
+            return l * r
+        return max(l, r)
+    if isinstance(node, N.SemiJoinNode):
+        return src(node.source)
+    if isinstance(node, (N.LimitNode, N.TopNNode)):
+        return min(float(node.n), src(node.source))
+    if isinstance(node, N.EnforceSingleRowNode):
+        return 1.0
+    if isinstance(node, N.UnionNode):
+        return sum(src(x) for x in node.inputs)
+    if isinstance(node, N.RemoteSourceNode):
+        return _UNKNOWN_ROWS
+    srcs = node.sources()
+    return src(srcs[0]) if srcs else _UNKNOWN_ROWS
+
+
+# ---------------------------------------------------------------------------
+# Partitioning properties
+
+P_SINGLE = "single"
+P_SOURCE = "source"
+P_HASH = "hashed"
+
+
+@dataclasses.dataclass(frozen=True)
+class Props:
+    """Distribution of a subtree's output rows across workers."""
+    kind: str
+    keys: Tuple[str, ...] = ()
+    dicts: Tuple[Optional[Tuple[str, ...]], ...] = ()
+
+
+SINGLE = Props(P_SINGLE)
+SOURCE = Props(P_SOURCE)
+
+
+def add_exchanges(root: N.OutputNode, catalogs, session) -> N.OutputNode:
+    """Insert ExchangeNodes; mutates the plan in place and returns it."""
+    return _Exchanger(catalogs, session).run(root)
+
+
+class _Exchanger:
+    def __init__(self, catalogs, session):
+        self.catalogs = catalogs
+        self.threshold = int(session.properties.get(
+            "broadcast_join_threshold_rows", 100_000))
+        self._memo: Dict[int, Tuple[N.PlanNode, Props]] = {}
+        self._shared: set = set()
+        self._est_memo: Dict[int, float] = {}
+
+    def run(self, root: N.OutputNode) -> N.OutputNode:
+        self._shared = _shared_nodes(root)
+        src, props = self._rw(root.source)
+        root.source = self._to_single(src, props)
+        return root
+
+    # -- helpers -----------------------------------------------------------
+
+    def _exchange(self, node: N.PlanNode, scheme: str,
+                  keys: Tuple[str, ...] = (),
+                  hash_dicts=None) -> N.ExchangeNode:
+        # replace rather than stack a passthrough cut point
+        if isinstance(node, N.ExchangeNode) and \
+                node.scheme == "passthrough":
+            node = node.source
+        return N.ExchangeNode(node, scheme, list(keys),
+                              tuple(node.output),
+                              list(hash_dicts) if hash_dicts else None)
+
+    def _to_single(self, node: N.PlanNode, props: Props) -> N.PlanNode:
+        if props.kind == P_SINGLE:
+            return node
+        return self._exchange(node, "gather")
+
+    def _ensure_hashed(self, node: N.PlanNode, props: Props,
+                       keys: Tuple[str, ...], hash_dicts) -> N.PlanNode:
+        dicts = tuple(hash_dicts) if hash_dicts \
+            else (None,) * len(keys)
+        if props.kind == P_HASH and props.keys == keys \
+                and props.dicts == dicts:
+            return node
+        return self._exchange(node, "repartition", keys, dicts)
+
+    def _est(self, node: N.PlanNode) -> float:
+        return estimate_rows(node, self.catalogs, self._est_memo)
+
+    # -- the walk ----------------------------------------------------------
+
+    def _rw(self, node: N.PlanNode) -> Tuple[N.PlanNode, Props]:
+        if id(node) in self._memo:
+            new, props = self._memo[id(node)]
+            return self._cut(new, props)
+        shared = id(node) in self._shared
+        new, props = self._dispatch(node)
+        if shared:
+            self._memo[id(node)] = (new, props)
+            return self._cut(new, props)
+        return new, props
+
+    def _cut(self, node: N.PlanNode, props: Props):
+        """Force a fragment boundary above a shared subtree; the
+        fragmenter maps every exchange over the same source to ONE
+        producer fragment with several consumer edges."""
+        return (N.ExchangeNode(node, "passthrough", [],
+                               tuple(node.output)), props)
+
+    def _dispatch(self, node: N.PlanNode) -> Tuple[N.PlanNode, Props]:
+        m = getattr(self, f"_rw_{type(node).__name__}", None)
+        if m is not None:
+            return m(node)
+        # default: single-source node preserving its child distribution
+        src, props = self._rw(node.source)
+        node.source = src
+        return node, props
+
+    def _rw_TableScanNode(self, node):
+        return node, SOURCE
+
+    def _rw_ValuesNode(self, node):
+        return node, SINGLE
+
+    def _rw_SortNode(self, node):
+        src, props = self._rw(node.source)
+        node.source = self._to_single(src, props)
+        return node, SINGLE
+
+    def _rw_EnforceSingleRowNode(self, node):
+        src, props = self._rw(node.source)
+        node.source = self._to_single(src, props)
+        return node, SINGLE
+
+    def _rw_LimitNode(self, node):
+        src, props = self._rw(node.source)
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        partial = N.LimitNode(src, node.n, tuple(src.output))
+        gather = self._exchange(partial, "gather")
+        return N.LimitNode(gather, node.n, node.output), SINGLE
+
+    def _rw_TopNNode(self, node):
+        src, props = self._rw(node.source)
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        partial = N.TopNNode(src, node.n, list(node.keys),
+                             list(node.descending),
+                             list(node.nulls_first), tuple(src.output))
+        gather = self._exchange(partial, "gather")
+        return N.TopNNode(gather, node.n, node.keys, node.descending,
+                          node.nulls_first, node.output), SINGLE
+
+    def _rw_DistinctNode(self, node):
+        src, props = self._rw(node.source)
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        keys = tuple(f.symbol for f in node.output)
+        node.source = self._ensure_hashed(src, props, keys, None)
+        return node, Props(P_HASH, keys, (None,) * len(keys))
+
+    def _rw_WindowNode(self, node):
+        src, props = self._rw(node.source)
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        if not node.partition_by:
+            # a window over the whole relation needs every row
+            node.source = self._to_single(src, props)
+            return node, SINGLE
+        keys = tuple(node.partition_by)
+        node.source = self._ensure_hashed(src, props, keys, None)
+        return node, Props(P_HASH, keys, (None,) * len(keys))
+
+    def _rw_UnionNode(self, node):
+        rewritten = [self._rw(x) for x in node.inputs]
+        if all(p.kind == P_SINGLE for _, p in rewritten):
+            node.inputs = [n for n, _ in rewritten]
+            return node, SINGLE
+        inputs = []
+        for n, p in rewritten:
+            if p.kind == P_SINGLE:
+                # spread a single-task input over the workers so its
+                # subtree is not duplicated in a distributed fragment
+                n = self._exchange(n, "repartition", ())
+            inputs.append(n)
+        node.inputs = inputs
+        return node, SOURCE
+
+    # -- aggregation -------------------------------------------------------
+
+    def _rw_AggregationNode(self, node: N.AggregationNode):
+        src, props = self._rw(node.source)
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        key_syms = tuple(s for s, _ in node.keys)
+        if any(a.distinct for a in node.aggregates):
+            # distinct aggs cannot split partial/final: co-locate whole
+            # groups, then run a SINGLE-step aggregation per worker
+            if not key_syms:
+                node.source = self._to_single(src, props)
+                return node, SINGLE
+            src = self._materialize_keys(node, src)
+            node.source = self._ensure_hashed(
+                src, props, key_syms, None)
+            return node, Props(P_HASH, key_syms,
+                               (None,) * len(key_syms))
+        return self._split_aggregation(node, src, props)
+
+    def _materialize_keys(self, node: N.AggregationNode,
+                          src: N.PlanNode) -> N.PlanNode:
+        """Project group-key expressions to their output symbols below
+        the exchange, rewriting node.keys to bare InputRefs."""
+        if all(isinstance(e, InputRef) and e.name == s
+               for s, e in node.keys):
+            return src
+        assignments = [(f.symbol, InputRef(f.symbol, f.type))
+                       for f in src.output]
+        out_fields = list(src.output)
+        for s, e in node.keys:
+            assignments.append((s, e))
+            out_fields.append(node.field(s))
+        proj = N.ProjectNode(src, assignments, tuple(out_fields))
+        node.keys = [(s, InputRef(s, node.field(s).type))
+                     for s, _ in node.keys]
+        return proj
+
+    def _split_aggregation(self, node: N.AggregationNode,
+                           src: N.PlanNode, props: Props):
+        key_syms = tuple(s for s, _ in node.keys)
+        partial_calls: List[N.AggCall] = []
+        final_calls: List[N.AggCall] = []
+        state_fields: List[N.Field] = []
+        for a in node.aggregates:
+            eff_in = self._effective_input_type(a)
+            partial_calls.append(N.AggCall(
+                a.out_symbol, a.function, a.argument, False,
+                a.output_type, eff_in))
+            final_calls.append(N.AggCall(
+                a.out_symbol, a.function, None, False,
+                a.output_type, eff_in))
+            fn = agg_function_for(a.function, eff_in, a.output_type)
+            state_dict = self._arg_dictionary(node, a)
+            for i, st in enumerate(fn.intermediate_types):
+                d = state_dict if (st.is_string and i == 0) else None
+                state_fields.append(
+                    N.Field(f"{a.out_symbol}__s{i}", st, d))
+        key_fields = [node.field(s) for s in key_syms]
+        partial = N.AggregationNode(
+            src, list(node.keys), partial_calls, "partial",
+            tuple(key_fields) + tuple(state_fields))
+        if key_syms:
+            ex = self._exchange(partial, "repartition", key_syms,
+                                None)
+            final_props = Props(P_HASH, key_syms,
+                                (None,) * len(key_syms))
+        else:
+            ex = self._exchange(partial, "gather")
+            final_props = SINGLE
+        final_keys = [(s, InputRef(s, node.field(s).type))
+                      for s in key_syms]
+        final = N.AggregationNode(ex, final_keys, final_calls, "final",
+                                  node.output)
+        return final, final_props
+
+    @staticmethod
+    def _effective_input_type(a: N.AggCall) -> Optional[Type]:
+        if a.argument is None:
+            return None
+        t = a.argument.type
+        if a.function == "avg" and t.is_decimal:
+            return DOUBLE  # matches the local planner's pre-agg cast
+        return t
+
+    @staticmethod
+    def _arg_dictionary(node: N.AggregationNode, a: N.AggCall):
+        if a.function in ("min", "max"):
+            try:
+                return node.field(a.out_symbol).dictionary
+            except KeyError:
+                return None
+        return None
+
+    # -- joins -------------------------------------------------------------
+
+    def _rw_JoinNode(self, node: N.JoinNode):
+        left, lp = self._rw(node.left)
+        right, rp = self._rw(node.right)
+        if lp.kind == P_SINGLE and rp.kind == P_SINGLE:
+            node.left, node.right = left, right
+            return node, SINGLE
+        if node.join_type == "cross" or not node.criteria:
+            # nested-loop: replicate the build (right) side
+            node.left = left
+            node.right = self._exchange(right, "broadcast")
+            return node, lp
+        # the local planner probes with the row-preserving side: for a
+        # RIGHT join it swaps, making the LEFT child the build side
+        build_attr = "left" if node.join_type == "right" else "right"
+        build_node = left if build_attr == "left" else right
+        build_props = lp if build_attr == "left" else rp
+        probe_props = rp if build_attr == "left" else lp
+        if self._est(build_node) <= self.threshold:
+            bc = self._exchange(build_node, "broadcast")
+            if build_attr == "left":
+                node.left, node.right = bc, right
+            else:
+                node.left, node.right = left, bc
+            if probe_props.kind == P_SINGLE:
+                return node, SINGLE
+            return node, probe_props
+        lkeys = tuple(l for l, _ in node.criteria)
+        rkeys = tuple(r for _, r in node.criteria)
+        dicts = tuple(
+            _pair_dict(_field(left, l), _field(right, r))
+            for (l, r) in node.criteria)
+        node.left = self._ensure_hashed(left, lp, lkeys, dicts)
+        node.right = self._ensure_hashed(right, rp, rkeys, dicts)
+        return node, Props(P_HASH, lkeys, dicts)
+
+    def _rw_SemiJoinNode(self, node: N.SemiJoinNode):
+        src, sp = self._rw(node.source)
+        filt, fp = self._rw(node.filtering_source)
+        if sp.kind == P_SINGLE and fp.kind == P_SINGLE:
+            node.source, node.filtering_source = src, filt
+            return node, SINGLE
+        if self._est(filt) <= self.threshold:
+            node.source = src
+            node.filtering_source = self._exchange(filt, "broadcast")
+            return (node, sp) if sp.kind != P_SINGLE else (node, SINGLE)
+        d = (_pair_dict(_field(src, node.source_key),
+                        _field(filt, node.filtering_key)),)
+        node.source = self._ensure_hashed(
+            src, sp, (node.source_key,), d)
+        node.filtering_source = self._ensure_hashed(
+            filt, fp, (node.filtering_key,), d)
+        return node, Props(P_HASH, (node.source_key,), d)
+
+
+def _field(node: N.PlanNode, symbol: str) -> N.Field:
+    return node.field(symbol)
+
+
+def _pair_dict(lf: N.Field, rf: N.Field):
+    if lf.dictionary is None and rf.dictionary is None:
+        return None
+    return tuple(sorted(set(lf.dictionary or ())
+                        | set(rf.dictionary or ())))
+
+
+# ---------------------------------------------------------------------------
+# Fragmentation (reference: PlanFragmenter.java:144, createSubPlans:168)
+
+
+@dataclasses.dataclass
+class ExchangeEdge:
+    """One consumer's view of a producer fragment's output (the analog
+    of an OutputBuffer id on the producer + a RemoteSourceNode on the
+    consumer)."""
+    exchange_id: int
+    producer: int                # fragment id
+    consumer: int                # fragment id
+    scheme: str
+    partition_keys: List[str]
+    hash_dicts: Optional[List[Optional[Tuple[str, ...]]]]
+    fields: Tuple[N.Field, ...]
+
+
+@dataclasses.dataclass
+class Fragment:
+    id: int
+    root: N.PlanNode
+    partitioning: str            # "single" | "distributed"
+    source_edges: List[int]      # exchange ids feeding this fragment
+
+
+@dataclasses.dataclass
+class FragmentedPlan:
+    root_id: int                 # the OutputNode fragment
+    fragments: Dict[int, Fragment]
+    edges: Dict[int, ExchangeEdge]
+
+    def producer_edges(self, fragment_id: int) -> List[ExchangeEdge]:
+        return [e for e in self.edges.values()
+                if e.producer == fragment_id]
+
+    def text(self) -> str:
+        lines = []
+        for fid in sorted(self.fragments):
+            f = self.fragments[fid]
+            lines.append(f"Fragment {fid} [{f.partitioning}]")
+            lines.append(N.plan_text(f.root, indent=1))
+        return "\n".join(lines)
+
+
+def fragment_plan(root: N.OutputNode) -> FragmentedPlan:
+    """Cut the exchanged plan into fragments. A shared producer subtree
+    (reached through several ExchangeNodes over the same source) becomes
+    ONE fragment with several consumer edges."""
+    f = _Fragmenter()
+    root_id = f.build(root)
+    return FragmentedPlan(root_id, f.fragments, f.edges)
+
+
+class _Fragmenter:
+    def __init__(self):
+        self.fragments: Dict[int, Fragment] = {}
+        self.edges: Dict[int, ExchangeEdge] = {}
+        self._frag_by_source: Dict[int, int] = {}
+        self._next_fragment = 0
+        self._next_exchange = 0
+
+    def build(self, root: N.PlanNode) -> int:
+        fid = self._next_fragment
+        self._next_fragment += 1
+        info = {"has_scan": False, "gather_in": False,
+                "source_edges": [], "passthrough_producers": []}
+        new_root = self._cut(root, fid, info)
+        if info["gather_in"]:
+            assert not info["has_scan"], \
+                "fragment mixes a gather input with a parallel scan"
+            part = "single"
+        elif info["has_scan"]:
+            part = "distributed"
+        elif info["passthrough_producers"]:
+            parts = {self.fragments[p].partitioning
+                     for p in info["passthrough_producers"]}
+            assert len(parts) == 1, \
+                "passthrough inputs with mixed partitioning"
+            part = parts.pop()
+        elif info["source_edges"]:
+            part = "distributed"
+        else:
+            part = "single"  # values / constants only
+        self.fragments[fid] = Fragment(fid, new_root, part,
+                                       info["source_edges"])
+        return fid
+
+    def _cut(self, node: N.PlanNode, fid: int, info) -> N.PlanNode:
+        if isinstance(node, N.ExchangeNode):
+            src_key = id(node.source)
+            producer = self._frag_by_source.get(src_key)
+            if producer is None:
+                producer = self.build(node.source)
+                self._frag_by_source[src_key] = producer
+            xid = self._next_exchange
+            self._next_exchange += 1
+            edge = ExchangeEdge(
+                xid, producer, fid, node.scheme,
+                list(node.partition_keys), node.hash_dicts,
+                tuple(node.output))
+            self.edges[xid] = edge
+            info["source_edges"].append(xid)
+            if node.scheme == "gather":
+                info["gather_in"] = True
+            if node.scheme == "passthrough":
+                info["passthrough_producers"].append(producer)
+            return N.RemoteSourceNode(producer, xid, node.scheme,
+                                      tuple(node.output))
+        if isinstance(node, N.TableScanNode):
+            info["has_scan"] = True
+            return node
+        for attr in ("source", "left", "right", "filtering_source"):
+            if hasattr(node, attr):
+                setattr(node, attr,
+                        self._cut(getattr(node, attr), fid, info))
+        if isinstance(node, N.UnionNode):
+            node.inputs = [self._cut(x, fid, info)
+                           for x in node.inputs]
+        return node

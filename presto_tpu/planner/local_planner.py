@@ -45,6 +45,18 @@ class LocalExecutionPlan:
     result_fields: Tuple[N.Field, ...]
 
 
+@dataclasses.dataclass
+class TaskContext:
+    """Identity of one fragment task on the mesh (reference: TaskId +
+    the split assignment NodeScheduler hands each task). `exchanges`
+    maps exchange ids to their MeshExchange runtime objects."""
+    index: int = 0
+    count: int = 1
+    device: object = None
+    exchanges: Dict[int, object] = dataclasses.field(
+        default_factory=dict)
+
+
 class LocalPlanningError(Exception):
     pass
 
@@ -65,9 +77,11 @@ def _schema_of(node: N.PlanNode) -> Dict[str, ColumnSchema]:
 
 
 class LocalExecutionPlanner:
-    def __init__(self, catalog_manager, session):
+    def __init__(self, catalog_manager, session,
+                 task: Optional[TaskContext] = None):
         self.catalogs = catalog_manager
         self.session = session
+        self.task = task or TaskContext()
         self._pipelines: List[List] = []
         self._op_id = 0
         self._shared: set = set()
@@ -99,6 +113,23 @@ class LocalExecutionPlanner:
         self._pipelines.append(pipeline)
         return LocalExecutionPlan(self._pipelines, sink, root.names,
                                   root.output)
+
+    def plan_fragment(self, root: N.PlanNode,
+                      sink_exchanges: Sequence) -> List[List]:
+        """Plan a non-root fragment for one task: pipelines whose tail
+        tees into this fragment's consumer exchange edges (reference:
+        LocalExecutionPlanner.plan for a fragment whose root is a
+        PartitionedOutput/TaskOutput operator)."""
+        from presto_tpu.operators.exchange_ops import (
+            ExchangeSinkOperatorFactory,
+        )
+        self._shared = _shared_nodes(root)
+        pipeline: List = []
+        self._visit(root, pipeline)
+        pipeline.append(ExchangeSinkOperatorFactory(
+            self._next_id(), list(sink_exchanges), self.task.index))
+        self._pipelines.append(pipeline)
+        return self._pipelines
 
     # ------------------------------------------------------------------
 
@@ -140,14 +171,32 @@ class LocalExecutionPlanner:
         target_splits = int(self.session.properties.get(
             "target_splits", 4))
         handle = node.handle
+        task = self.task
 
         def batch_iter():
-            splits = conn.split_manager.get_splits(handle, target_splits)
+            import jax as _jax
+            splits = conn.split_manager.get_splits(
+                handle, max(target_splits, task.count))
+            if task.count > 1:
+                # round-robin split assignment to this fragment's tasks
+                # (reference: NodeScheduler.java:65 split placement)
+                splits = splits[task.index::task.count]
             for s in splits:
                 for b in conn.page_source.batches(s, columns, batch_rows):
-                    yield b.rename(rename)
+                    b = b.rename(rename)
+                    if task.device is not None:
+                        b = _jax.device_put(b, task.device)
+                    yield b
         pipe.append(TableScanOperatorFactory(
             self._next_id(), f"scan:{handle.table}", batch_iter))
+
+    def _visit_RemoteSourceNode(self, node, pipe: List):
+        from presto_tpu.operators.exchange_ops import (
+            ExchangeSourceOperatorFactory,
+        )
+        exchange = self.task.exchanges[node.exchange_id]
+        pipe.append(ExchangeSourceOperatorFactory(
+            self._next_id(), exchange, self.task.index))
 
     def _visit_ValuesNode(self, node: N.ValuesNode, pipe: List):
         data = {}
@@ -289,6 +338,24 @@ class LocalExecutionPlanner:
             self._next_id(), bridge, [node.source_key], node.negate,
             build_keys=[node.filtering_key], key_dicts=key_dicts))
 
+    def _visit_WindowNode(self, node: N.WindowNode, pipe: List):
+        from presto_tpu.operators.window_ops import WindowOperatorFactory
+        from presto_tpu.ops.window import WindowCallSpec
+        self._visit(node.source, pipe)
+        src_schema = _schema_of(node.source)
+        calls = []
+        for c in node.calls:
+            out_dict = None
+            if c.argument is not None and c.output_type is not None \
+                    and c.output_type.is_string:
+                out_dict = src_schema[c.argument].dictionary
+            calls.append(WindowCallSpec(
+                c.out_symbol, c.function, c.argument, c.frame,
+                c.output_type, out_dict, c.offset))
+        pipe.append(WindowOperatorFactory(
+            self._next_id(), node.partition_by, node.order_by,
+            node.descending, node.nulls_first, calls))
+
     def _visit_SortNode(self, node: N.SortNode, pipe: List):
         self._visit(node.source, pipe)
         pipe.append(OrderByOperatorFactory(
@@ -322,8 +389,12 @@ class LocalExecutionPlanner:
     def _visit_AssignUniqueIdNode(self, node: N.AssignUniqueIdNode,
                                   pipe: List):
         self._visit(node.source, pipe)
+        # ids strided by task so they are unique across a distributed
+        # fragment's tasks (reference: AssignUniqueIdOperator packs the
+        # driver instance id into the high bits)
         pipe.append(misc_ops.AssignUniqueIdOperatorFactory(
-            self._next_id(), node.symbol))
+            self._next_id(), node.symbol,
+            start=self.task.index, stride=self.task.count))
 
     def _visit_UnionNode(self, node: N.UnionNode, pipe: List):
         queue = misc_ops.LocalQueue(len(node.inputs))
@@ -439,7 +510,8 @@ def prune_unused_columns(root: N.PlanNode) -> None:
 
 def _child_demand(node: N.PlanNode, demand: set
                   ) -> List[Tuple[N.PlanNode, set]]:
-    if isinstance(node, (N.TableScanNode, N.ValuesNode)):
+    if isinstance(node, (N.TableScanNode, N.ValuesNode,
+                         N.RemoteSourceNode)):
         return []
     if isinstance(node, N.FilterNode):
         child = set(demand)
@@ -476,6 +548,11 @@ def _child_demand(node: N.PlanNode, demand: set
                 (node.filtering_source, {node.filtering_key})]
     if isinstance(node, (N.SortNode, N.TopNNode)):
         return [(node.source, demand | set(node.keys))]
+    if isinstance(node, N.WindowNode):
+        child = (demand - {c.out_symbol for c in node.calls}) \
+            | set(node.partition_by) | set(node.order_by) \
+            | {c.argument for c in node.calls if c.argument}
+        return [(node.source, child)]
     if isinstance(node, N.DistinctNode):
         # DISTINCT is defined over exactly its output columns
         return [(node.source, {f.symbol for f in node.output})]
@@ -508,7 +585,10 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
             keep = {first[0]: first[1]}
         node.assignments = keep
         node.output = tuple(f for f in node.output if f.symbol in keep)
-    elif isinstance(node, (N.ValuesNode, N.OutputNode, N.DistinctNode)):
+    elif isinstance(node, (N.ValuesNode, N.OutputNode, N.DistinctNode,
+                           N.RemoteSourceNode)):
+        # a remote source's schema is fixed by its producer fragment;
+        # extra columns in received batches are simply ignored
         pass
     elif isinstance(node, N.ProjectNode):
         node.assignments = [(s, e) for s, e in node.assignments
@@ -532,6 +612,11 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
         node.output = narrowed({node.source_key})
     elif isinstance(node, (N.SortNode, N.TopNNode)):
         node.output = narrowed(set(node.keys))
+    elif isinstance(node, N.WindowNode):
+        node.calls = [c for c in node.calls if c.out_symbol in demand]
+        node.output = narrowed(
+            set(node.partition_by) | set(node.order_by)
+            | {c.argument for c in node.calls if c.argument})
     elif isinstance(node, N.AssignUniqueIdNode):
         node.output = narrowed({node.symbol})
     elif isinstance(node, N.UnionNode):

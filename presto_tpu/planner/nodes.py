@@ -208,6 +208,35 @@ class AssignUniqueIdNode(PlanNode):
         return (self.source,)
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """One window function call (reference: WindowNode.Function)."""
+    out_symbol: str
+    function: str                  # rank|dense_rank|row_number|lag|...
+    argument: Optional[str]        # source symbol (pre-projected)
+    frame: str                     # ops.window FULL/ROWS/RANGE mode
+    output_type: Optional[Type] = None
+    offset: int = 1                # lag/lead distance
+
+
+@dataclasses.dataclass
+class WindowNode(PlanNode):
+    """OVER(...) evaluation appending one column per call (reference:
+    sql/planner/plan/WindowNode + WindowOperator.java:62). Partition and
+    order keys are bare symbols — the analyzer pre-projects
+    expressions."""
+    source: PlanNode
+    partition_by: List[str]
+    order_by: List[str]
+    descending: List[bool]
+    nulls_first: List[bool]
+    calls: List[WindowCall]
+    output: Tuple[Field, ...]      # source fields + one per call
+
+    def sources(self):
+        return (self.source,)
+
+
 @dataclasses.dataclass
 class OutputNode(PlanNode):
     source: PlanNode
@@ -224,15 +253,40 @@ class OutputNode(PlanNode):
 class ExchangeNode(PlanNode):
     """Marks a data redistribution point (reference:
     sql/planner/plan/ExchangeNode; SystemPartitioningHandle.java:59-67).
-    scheme: gather | repartition | broadcast; inserted by the
-    distributed planner (AddExchanges analog)."""
+
+    scheme:
+      - "repartition": hash rows by `partition_keys` across workers
+        (FIXED_HASH_DISTRIBUTION); empty keys = batch round-robin
+        (FIXED_ARBITRARY_DISTRIBUTION)
+      - "gather": all rows to the single consumer task (SINGLE)
+      - "broadcast": replicate to all consumer tasks (FIXED_BROADCAST)
+      - "passthrough": task i -> task i, no movement (used to cut a
+        shared DAG subtree into its own fragment)
+
+    `hash_dicts` (repartition only): per partition key, an optional
+    unified string dictionary used ONLY for hashing — both sides of a
+    partitioned join must hash equal strings to equal workers even
+    though their columns carry different per-side dictionaries. The
+    emitted columns keep their original codes."""
     source: PlanNode
     scheme: str
     partition_keys: List[str]
     output: Tuple[Field, ...]
+    hash_dicts: Optional[List[Optional[Tuple[str, ...]]]] = None
 
     def sources(self):
         return (self.source,)
+
+
+@dataclasses.dataclass
+class RemoteSourceNode(PlanNode):
+    """Stands in for a cut child fragment inside a fragment's plan
+    (reference: sql/planner/plan/RemoteSourceNode — the consumer end of
+    an exchange after PlanFragmenter.java:144 splits the plan)."""
+    fragment_id: int
+    exchange_id: int
+    scheme: str
+    output: Tuple[Field, ...]
 
 
 def plan_text(node: PlanNode, indent: int = 0) -> str:
@@ -256,6 +310,12 @@ def plan_text(node: PlanNode, indent: int = 0) -> str:
         details = f"[{node.n}]"
     elif isinstance(node, ExchangeNode):
         details = f"[{node.scheme} keys={node.partition_keys}]"
+    elif isinstance(node, RemoteSourceNode):
+        details = f"[fragment={node.fragment_id} {node.scheme}]"
+    elif isinstance(node, WindowNode):
+        details = f"[partition={node.partition_by} " \
+                  f"order={node.order_by} " \
+                  f"calls={[c.function for c in node.calls]}]"
     elif isinstance(node, OutputNode):
         details = f"[{node.names}]"
     lines = [f"{pad}{name}{details} => {[f.symbol for f in node.output]}"]

@@ -4,3 +4,4 @@
 from presto_tpu.runner.local import (
     LocalRunner, MaterializedResult, Session, CatalogManager, QueryError,
 )
+from presto_tpu.runner.mesh import MeshRunner

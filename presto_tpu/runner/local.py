@@ -162,9 +162,16 @@ class LocalRunner:
     @staticmethod
     def _drive(lplan: LocalExecutionPlan,
                max_rounds: int = 2_000_000) -> None:
+        LocalRunner.drive_pipelines(lplan.pipelines, max_rounds)
+
+    @staticmethod
+    def drive_pipelines(pipelines: List[List],
+                        max_rounds: int = 2_000_000) -> None:
+        """Round-robin all drivers to completion (the TaskExecutor
+        stand-in; shared by the local and mesh runners)."""
         dctx = DriverContext()
         drivers = [Driver([f.create(dctx) for f in pipe])
-                   for pipe in lplan.pipelines]
+                   for pipe in pipelines]
         rounds = 0
         while True:
             all_done = True
