@@ -156,9 +156,19 @@ def window_kernel(batch: Batch,
                 d = sd[idx]
                 m = jnp.where(in_part, sm[idx], False)
             elif c.function == "first_value":
+                # every supported frame starts UNBOUNDED PRECEDING
                 d = sd[pstart]
                 m = sm[pstart]
-            else:  # last_value (default frame: up to peer end)
+            elif c.frame == ROWS_RUNNING:  # last_value = current row
+                d, m = sd, sm
+            elif c.frame == FULL:  # last valid row of the partition
+                part_end = jnp.maximum(jax.ops.segment_max(
+                    jnp.where(svalid, pos, -1), pid,
+                    num_segments=cap + 1,
+                    indices_are_sorted=True)[pid], 0)
+                d = sd[part_end]
+                m = sm[part_end]
+            else:  # last_value, RANGE: last row of the peer group
                 d = sd[peer_end]
                 m = sm[peer_end]
             out_cols[c.out_name] = Column(d[inv], (m & svalid)[inv],

@@ -148,15 +148,6 @@ def _shuffle_core(n_parts: int, axis: str,
     return tuple(flat[:nd]), tuple(flat[nd:2 * nd]), flat[2 * nd]
 
 
-def _shuffle_body(n_parts: int, axis: str,
-                  row_valid: jnp.ndarray,
-                  key_datas: Tuple[jnp.ndarray, ...],
-                  key_masks: Tuple[jnp.ndarray, ...],
-                  datas: Tuple[jnp.ndarray, ...],
-                  masks: Tuple[jnp.ndarray, ...]):
-    """Per-chip: route local rows to consumers, exchange, flatten."""
-    return _shuffle_core(n_parts, axis, row_valid, key_datas, key_masks,
-                         datas, masks)
 
 
 def hash_repartition(sb: ShardedBatch, key_names: Sequence[str]
@@ -177,7 +168,7 @@ def hash_repartition(sb: ShardedBatch, key_names: Sequence[str]
     key_datas = tuple(datas[i] for i in key_idx)
     key_masks = tuple(masks[i] for i in key_idx)
 
-    body = functools.partial(_shuffle_body, w, axis)
+    body = functools.partial(_shuffle_core, w, axis)
     spec = P(axis)
     fn = jax.shard_map(
         body, mesh=mesh,

@@ -396,6 +396,18 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
         rp = RelationPlan(N.FilterNode(rp.node, fold_constants(pred), out),
                           rp.scope)
 
+    # 4.5 window functions (evaluated after aggregation/HAVING, before
+    # the SELECT projection — reference: StatementAnalyzer's
+    # analyzeWindowFunctions + LogicalPlanner window planning)
+    window_calls: List[T.FunctionCall] = []
+    for item in select_items:
+        _collect_window_calls(item.expr, window_calls)
+    for item in order_items:
+        _collect_window_calls(item.expr, window_calls)
+    if window_calls:
+        rp, win_rewrites = _plan_windows(window_calls, rp, ctx, rewrites)
+        rewrites = {**rewrites, **win_rewrites}
+
     # 5. SELECT projection (+ hidden sort columns)
     an = _Analyzer(rp.scope, ctx, rewrites)
     assignments: List[Tuple[str, RowExpression]] = []
@@ -554,6 +566,168 @@ def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
     # min/max preserve type
     assert arg_type is not None
     return arg_type
+
+
+#: ranking / positional window functions (aggregates also allowed OVER)
+WINDOW_FUNCTIONS = {"rank", "dense_rank", "row_number", "lag", "lead",
+                    "first_value", "last_value"}
+
+
+def _collect_window_calls(node, out: List[T.FunctionCall]):
+    if isinstance(node, T.FunctionCall) and node.window is not None:
+        if not any(_ast_key(node) == _ast_key(o) for o in out):
+            out.append(node)
+        return  # no windows nested inside window arguments
+    if isinstance(node, (T.ScalarSubquery, T.InSubquery, T.Exists)):
+        return
+    if isinstance(node, T.Node):
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, T.Node):
+                _collect_window_calls(v, out)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, T.Node):
+                        _collect_window_calls(x, out)
+
+
+def _window_frame_mode(w: T.WindowSpec) -> str:
+    """Map a frame clause to the kernel's mode (ops/window.py);
+    reference: WindowFrame defaults in SqlBase.g4 / StatementAnalyzer."""
+    from presto_tpu.ops import window as wk
+    if not w.order_by:
+        return wk.FULL
+    if w.frame is None:
+        return wk.RANGE_RUNNING  # SQL default with ORDER BY
+    ftype, start, end = w.frame
+    if start == "unbounded preceding" and end == "unbounded following":
+        return wk.FULL
+    if start == "unbounded preceding" and end == "current row":
+        return wk.ROWS_RUNNING if ftype == "rows" else wk.RANGE_RUNNING
+    raise AnalysisError(f"unsupported window frame {w.frame}")
+
+
+def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
+                  ctx: PlannerContext, rewrites):
+    """Plan one WindowNode per distinct OVER() spec, chained; returns
+    the new relation plan plus rewrites mapping each call's AST to its
+    output symbol (consumed by the SELECT/ORDER BY analyzers)."""
+    from presto_tpu.ops import window as wk
+
+    groups: Dict[tuple, List[T.FunctionCall]] = {}
+    for c in calls:
+        groups.setdefault(_ast_key(c.window), []).append(c)
+
+    node = rp.node
+    scope_fields = list(rp.scope.fields)
+    out_rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
+
+    for group in groups.values():
+        w = group[0].window
+        an = _Analyzer(Scope(scope_fields, rp.scope.parent), ctx,
+                       rewrites)
+        assignments = [(f.symbol, InputRef(f.symbol, f.type))
+                       for f in scope_fields]
+        proj_fields = [N.Field(f.symbol, f.type, f.dictionary)
+                       for f in scope_fields]
+        added: Dict[tuple, str] = {}
+
+        def to_symbol(ast: T.Node, hint: str) -> str:
+            e = fold_constants(an.analyze(ast))
+            if isinstance(e, InputRef):
+                return e.name
+            key = _ast_key(ast)
+            if key in added:
+                return added[key]
+            sym = ctx.symbols.new(hint)
+            assignments.append((sym, e))
+            proj_fields.append(N.Field(sym, e.type,
+                                       an.dictionary_of(e)))
+            added[key] = sym
+            return sym
+
+        part_syms = [to_symbol(p, "wpart") for p in w.partition_by]
+        order_syms, desc, nf = [], [], []
+        for item in w.order_by:
+            order_syms.append(to_symbol(item.expr, "worder"))
+            d = item.descending
+            desc.append(d)
+            nf.append(item.nulls_first if item.nulls_first is not None
+                      else d)
+        frame = _window_frame_mode(w)
+
+        def field_of(sym: str) -> N.Field:
+            # proj_fields grows as to_symbol projects helper columns —
+            # resolve at call time, not from a snapshot
+            return next(f for f in proj_fields if f.symbol == sym)
+
+        wcalls: List[N.WindowCall] = []
+        call_fields: List[N.Field] = []
+        for c in group:
+            name = c.name
+            if c.distinct:
+                raise AnalysisError(
+                    f"DISTINCT is not supported in window {name}")
+            if name not in WINDOW_FUNCTIONS and \
+                    name not in AGG_FUNCTIONS:
+                raise AnalysisError(f"unknown window function {name}")
+            offset = 1
+            arg_sym = None
+            if name in ("rank", "dense_rank", "row_number"):
+                if c.args:
+                    raise AnalysisError(f"{name}() takes no arguments")
+                out_type: Type = BIGINT
+                cframe = frame
+            elif name in ("lag", "lead", "first_value", "last_value"):
+                if not c.args:
+                    raise AnalysisError(f"{name} requires an argument")
+                if not w.order_by:
+                    raise AnalysisError(f"{name} requires ORDER BY")
+                arg_sym = to_symbol(c.args[0], name)
+                if name in ("lag", "lead") and len(c.args) > 1:
+                    off = fold_constants(an.analyze(c.args[1]))
+                    if not isinstance(off, Literal):
+                        raise AnalysisError(
+                            f"{name} offset must be a constant")
+                    offset = int(off.value)
+                out_type = field_of(arg_sym).type
+                cframe = frame
+            else:  # aggregate OVER
+                if c.is_star or not c.args:
+                    arg_type = None
+                    if name != "count":
+                        raise AnalysisError(f"{name} requires an "
+                                            "argument")
+                else:
+                    a_ast = c.args[0]
+                    e = fold_constants(an.analyze(a_ast))
+                    if name == "avg" and e.type.is_decimal:
+                        a_ast = T.Cast(a_ast, "double")
+                    arg_sym = to_symbol(a_ast, name)
+                    arg_type = field_of(arg_sym).type
+                out_type = _agg_output_type(name, arg_type)
+                cframe = frame
+            sym = ctx.symbols.new(name)
+            dic = field_of(arg_sym).dictionary \
+                if arg_sym and out_type.is_string else None
+            wcalls.append(N.WindowCall(sym, name, arg_sym, cframe,
+                                       out_type, offset))
+            call_fields.append(N.Field(sym, out_type, dic))
+            out_rewrites[_ast_key(c)] = (sym, out_type, dic)
+
+        node = N.ProjectNode(node, assignments, tuple(proj_fields))
+        node = N.WindowNode(node, part_syms, order_syms, desc, nf,
+                            wcalls, tuple(proj_fields)
+                            + tuple(call_fields))
+        # call outputs join the scope (resolved only through rewrites);
+        # projected helper symbols stay hidden but remain addressable
+        # through the WindowNode's output until pruned
+        scope_fields = scope_fields + [
+            ScopeField(None, f.symbol, f.symbol, f.type, f.dictionary)
+            for f in call_fields]
+
+    return RelationPlan(node, Scope(scope_fields, rp.scope.parent)), \
+        out_rewrites
 
 
 def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,

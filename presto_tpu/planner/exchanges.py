@@ -375,8 +375,14 @@ class _Exchanger:
             node.left, node.right = left, right
             return node, SINGLE
         if node.join_type == "cross" or not node.criteria:
-            # nested-loop: replicate the build (right) side
+            # nested-loop: replicate the build (right) side; a SINGLE
+            # probe instead pulls the build to its one task — a single
+            # subtree embedded in a distributed fragment would be
+            # re-executed (duplicated) by every task
             node.left = left
+            if lp.kind == P_SINGLE:
+                node.right = self._to_single(right, rp)
+                return node, SINGLE
             node.right = self._exchange(right, "broadcast")
             return node, lp
         # the local planner probes with the row-preserving side: for a
@@ -386,13 +392,15 @@ class _Exchanger:
         build_props = lp if build_attr == "left" else rp
         probe_props = rp if build_attr == "left" else lp
         if self._est(build_node) <= self.threshold:
-            bc = self._exchange(build_node, "broadcast")
+            if probe_props.kind == P_SINGLE:
+                # keep the whole join on the probe's single task
+                bc = self._to_single(build_node, build_props)
+            else:
+                bc = self._exchange(build_node, "broadcast")
             if build_attr == "left":
                 node.left, node.right = bc, right
             else:
                 node.left, node.right = left, bc
-            if probe_props.kind == P_SINGLE:
-                return node, SINGLE
             return node, probe_props
         lkeys = tuple(l for l, _ in node.criteria)
         rkeys = tuple(r for _, r in node.criteria)
@@ -411,8 +419,11 @@ class _Exchanger:
             return node, SINGLE
         if self._est(filt) <= self.threshold:
             node.source = src
-            node.filtering_source = self._exchange(filt, "broadcast")
-            return (node, sp) if sp.kind != P_SINGLE else (node, SINGLE)
+            if sp.kind == P_SINGLE:
+                node.filtering_source = self._to_single(filt, fp)
+            else:
+                node.filtering_source = self._exchange(filt, "broadcast")
+            return node, sp
         d = (_pair_dict(_field(src, node.source_key),
                         _field(filt, node.filtering_key)),)
         node.source = self._ensure_hashed(

@@ -1,0 +1,125 @@
+"""Window functions vs the sqlite oracle (sqlite implements SQL window
+functions, so the same oracle scheme as the TPC-H suite applies —
+reference analog: AbstractTestWindowQueries)."""
+
+import pytest
+
+from test_tpch_suite import assert_rows_equal, normalize, to_sqlite
+from test_tpch_suite import oracle, runner  # noqa: F401 (fixtures)
+
+WINDOW_QUERIES = {
+    "row_number": """
+        select nationkey, name, acctbal,
+               row_number() over (partition by nationkey
+                                  order by acctbal desc) rn
+        from customer order by nationkey, rn""",
+    "rank_dense_rank": """
+        select mktsegment, rank() over (partition by mktsegment
+                                        order by nationkey) rk,
+               dense_rank() over (partition by mktsegment
+                                  order by nationkey) drk
+        from customer order by mktsegment, rk, drk""",
+    "running_sum_range": """
+        select orderkey, linenumber, quantity,
+               sum(quantity) over (partition by orderkey
+                                   order by linenumber) rsum,
+               count(*) over (partition by orderkey
+                              order by linenumber) rcnt
+        from lineitem where orderkey < 200
+        order by orderkey, linenumber""",
+    "rows_frame": """
+        select orderkey, linenumber, quantity,
+               sum(quantity) over (partition by orderkey
+                                   order by linenumber
+                                   rows unbounded preceding) rsum
+        from lineitem where orderkey < 200
+        order by orderkey, linenumber""",
+    "full_partition_aggs": """
+        select nationkey, acctbal,
+               sum(acctbal) over (partition by nationkey) s,
+               avg(acctbal) over (partition by nationkey) a,
+               min(acctbal) over (partition by nationkey) lo,
+               max(acctbal) over (partition by nationkey) hi,
+               count(*) over (partition by nationkey) n
+        from customer order by nationkey, acctbal""",
+    "no_partition": """
+        select orderkey, totalprice,
+               rank() over (order by totalprice desc) rk
+        from orders where orderkey < 300
+        order by rk, orderkey""",
+    "lag_lead": """
+        select orderkey, linenumber, quantity,
+               lag(quantity) over (partition by orderkey
+                                   order by linenumber) prev_q,
+               lead(quantity) over (partition by orderkey
+                                    order by linenumber) next_q,
+               lag(quantity, 2) over (partition by orderkey
+                                      order by linenumber) prev2
+        from lineitem where orderkey < 150
+        order by orderkey, linenumber""",
+    "first_last_value": """
+        select orderkey, linenumber, quantity,
+               first_value(quantity) over (partition by orderkey
+                                           order by linenumber) fv,
+               last_value(quantity) over (partition by orderkey
+                                          order by linenumber) lv
+        from lineitem where orderkey < 150
+        order by orderkey, linenumber""",
+    "window_over_aggregation": """
+        select nationkey, sum(acctbal) total,
+               rank() over (order by sum(acctbal) desc) rk
+        from customer group by nationkey
+        order by rk, nationkey""",
+    "window_in_order_by": """
+        select name, acctbal from customer
+        where nationkey = 5
+        order by row_number() over (order by acctbal desc)""",
+    "mixed_specs": """
+        select nationkey, acctbal,
+               row_number() over (partition by nationkey
+                                  order by acctbal) rn,
+               sum(acctbal) over () grand
+        from customer where nationkey < 4
+        order by nationkey, rn""",
+    "string_min_max": """
+        select nationkey,
+               min(name) over (partition by nationkey) lo,
+               max(name) over (partition by nationkey) hi
+        from customer where nationkey < 5
+        order by nationkey""",
+    "top_n_per_group_filter": """
+        select * from (
+          select nationkey, name, acctbal,
+                 row_number() over (partition by nationkey
+                                    order by acctbal desc) rn
+          from customer) t
+        where rn <= 2 order by nationkey, rn""",
+}
+
+
+@pytest.mark.parametrize("name", sorted(WINDOW_QUERIES))
+def test_window_query(name, runner, oracle):  # noqa: F811
+    sql = WINDOW_QUERIES[name]
+    res = runner.execute(sql)
+    types = [f.type.name for f in res.fields]
+    got = normalize(res.rows(), types)
+    exp = [tuple(r) for r in oracle.execute(to_sqlite(sql)).fetchall()]
+    assert_rows_equal(got, exp, name, ordered=True)
+
+
+@pytest.mark.parametrize("name", ["row_number", "running_sum_range",
+                                  "window_over_aggregation"])
+def test_window_on_mesh(name, oracle):  # noqa: F811
+    """Windows through the distributed path: partitioned windows
+    repartition on PARTITION BY; unpartitioned ones gather."""
+    import jax
+    from presto_tpu.runner import MeshRunner
+    sql = WINDOW_QUERIES[name]
+    r = MeshRunner("tpch", "tiny",
+                   {"broadcast_join_threshold_rows": 500}, n_workers=8)
+    res = r.execute(sql)
+    types = [f.type.name for f in res.fields]
+    got = normalize(res.rows(), types)
+    exp = [tuple(r) for r in oracle.execute(to_sqlite(sql)).fetchall()]
+    assert_rows_equal(got, exp, name, ordered=True)
+    jax.clear_caches()
