@@ -301,8 +301,13 @@ class _Compiler:
             return self._comparison(name, e, args)
         if name == "like":
             return self._like(e, args)
-        if name in _STRING_TO_STRING or name in _STRING_TO_INT:
+        if name in _STRING_TO_STRING or name in _STRING_TO_INT \
+                or name in _STRING_TO_BOOL:
             return self._string_fn(name, e, args)
+        if name == "concat":
+            return self._concat(e, args)
+        if name == "date_trunc":
+            return self._date_trunc(e, args)
         if name in ("add", "subtract", "multiply", "divide", "modulus"):
             return self._arith(name, e, args)
         if name == "negate":
@@ -487,6 +492,14 @@ class _Compiler:
             fn = col.fn
             return CompiledExpr(
                 lambda env: _apply_lookup(fn, tbl, env), BIGINT)
+        if name in _STRING_TO_BOOL:
+            impl = _STRING_TO_BOOL[name]
+            vals = np.array([impl(v, *lit_args) for v in dic] or [False],
+                            bool)
+            tbl = jnp.asarray(vals)
+            fn = col.fn
+            return CompiledExpr(
+                lambda env: _apply_lookup(fn, tbl, env), BOOLEAN)
         impl = _STRING_TO_STRING[name]
         mapped = [impl(v, *lit_args) for v in dic]
         new_dic = tuple(sorted(set(mapped)))
@@ -496,6 +509,92 @@ class _Compiler:
         fn = col.fn
         return CompiledExpr(lambda env: _apply_lookup(fn, tbl, env),
                             VARCHAR, new_dic)
+
+    #: safety cap on the product dictionary a multi-column concat builds
+    _CONCAT_DICT_MAX = 1 << 16
+
+    def _concat(self, e: Call, args) -> CompiledExpr:
+        """N-ary string concatenation over dictionary-coded inputs: the
+        result dictionary is the (sorted, deduped) cross product of the
+        input dictionaries, and the kernel is one table lookup on the
+        mixed-radix combination of input codes. Literal arguments are
+        single-entry dictionaries, so concat(col, '-', col2) costs
+        |dic1| * |dic2| table entries."""
+        import itertools
+        dics = []
+        for a in args:
+            if a.dictionary is None:
+                raise ExpressionCompileError(
+                    "concat argument has no dictionary (only varchar "
+                    "inputs are supported)")
+            dics.append(a.dictionary or ("",))
+        total = 1
+        for d in dics:
+            total *= max(len(d), 1)
+        if total > self._CONCAT_DICT_MAX:
+            raise ExpressionCompileError(
+                f"concat product dictionary too large ({total} > "
+                f"{self._CONCAT_DICT_MAX}); reduce input cardinality")
+        combos = ["".join(parts) for parts in itertools.product(*dics)]
+        new_dic = tuple(sorted(set(combos)))
+        index = {v: i for i, v in enumerate(new_dic)}
+        remap = np.array([index[v] for v in combos] or [0], np.int32)
+        tbl = jnp.asarray(remap)
+        fns = [a.fn for a in args]
+        strides = []
+        s = 1
+        for d in reversed(dics):
+            strides.append(s)
+            s *= max(len(d), 1)
+        strides = list(reversed(strides))
+
+        def f_concat(env):
+            code = None
+            mask = None
+            for fn, stride in zip(fns, strides):
+                d, m = fn(env)
+                c = d.astype(jnp.int32) * stride
+                code = c if code is None else code + c
+                mask = m if mask is None else mask & m
+            idx = jnp.clip(code, 0, tbl.shape[0] - 1)
+            return tbl[idx], mask
+        return CompiledExpr(f_concat, VARCHAR, new_dic)
+
+    def _date_trunc(self, e: Call, args) -> CompiledExpr:
+        if len(e.args) != 2:
+            raise ExpressionCompileError(
+                "date_trunc takes (unit, date)")
+        unit_e = e.args[0]
+        if not isinstance(unit_e, Literal):
+            raise ExpressionCompileError("date_trunc unit must be a "
+                                         "literal")
+        unit = str(unit_e.value).lower()
+        if unit not in ("day", "week", "month", "quarter", "year"):
+            raise ExpressionCompileError(
+                f"date_trunc: unsupported unit {unit!r}")
+        col = args[1]
+        fn = col.fn
+
+        def f_trunc(env):
+            d, m = fn(env)
+            days = d.astype(jnp.int64)
+            if unit == "day":
+                out = days
+            elif unit == "week":  # ISO week starts Monday
+                out = days - (D.extract_dow(days) - 1)
+            else:
+                y, mo, _ = D.civil_from_days(days)
+                if unit == "month":
+                    out = D.days_from_civil(y, mo, 1)
+                elif unit == "quarter":
+                    out = D.days_from_civil(y, ((mo - 1) // 3) * 3 + 1, 1)
+                elif unit == "year":
+                    out = D.days_from_civil(y, 1, 1)
+                else:
+                    raise ExpressionCompileError(
+                        f"date_trunc: unsupported unit {unit!r}")
+            return out.astype(np.int32), m
+        return CompiledExpr(f_trunc, DATE)
 
     def _arith(self, name: str, e: Call, args) -> CompiledExpr:
         a, b = args
@@ -796,6 +895,18 @@ _DATE_EXTRACT = {
     "day_of_year": D.extract_doy,
 }
 
+def _pad(v: str, n, pad: str, left: bool) -> str:
+    """Presto lpad/rpad: truncate to n when longer; multi-character pad
+    strings repeat (str.rjust only accepts one char)."""
+    n = int(n)
+    if len(v) >= n:
+        return v[:n]
+    if not pad:
+        raise ExpressionCompileError("pad string must not be empty")
+    fill = (pad * n)[:n - len(v)]
+    return fill + v if left else v + fill
+
+
 def _substr(v: str, start, length=None) -> str:
     """Presto substr: 1-based; negative start counts from the end
     (substr('hello', -2) = 'lo'); start 0 yields ''."""
@@ -819,11 +930,21 @@ _STRING_TO_STRING = {
     "rtrim": lambda v: v.rstrip(),
     "reverse": lambda v: v[::-1],
     "concat_lit": lambda v, suffix: v + suffix,
+    "replace": lambda v, find, repl="": v.replace(find, repl),
+    "lpad": lambda v, n, pad=" ": _pad(v, n, pad, left=True),
+    "rpad": lambda v, n, pad=" ": _pad(v, n, pad, left=False),
 }
 
 _STRING_TO_INT = {
     "length": lambda v: len(v),
     "strpos": lambda v, sub: v.find(sub) + 1,
+    "codepoint": lambda v: ord(v[0]) if v else 0,
+}
+
+_STRING_TO_BOOL = {
+    "starts_with": lambda v, prefix: v.startswith(prefix),
+    "ends_with": lambda v, suffix: v.endswith(suffix),
+    "contains_str": lambda v, sub: sub in v,
 }
 
 

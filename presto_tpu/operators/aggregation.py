@@ -52,6 +52,124 @@ class AggSpec:
 _jit_step = jax.jit(hashagg.agg_step, static_argnums=(5, 6))
 _jit_direct_step = jax.jit(hashagg.direct_step, static_argnums=(3, 6, 7))
 
+#: Whole-step kernel cache keyed by the expression IRs + agg layout so a
+#: re-executed (or structurally identical) query reuses the compiled XLA
+#: program. Fusing key/input evaluation INTO the fold step matters on
+#: remote backends: evaluated eagerly, each key expression and agg input
+#: costs a separate dispatch per batch (a device roundtrip each on a TPU
+#: tunnel) — fused, one dispatch moves a whole batch through
+#: eval + group-by (the PageProcessor-into-accumulator analog of
+#: sql/gen/AccumulatorCompiler).
+import collections as _collections
+
+_AGG_STEP_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
+_AGG_STEP_CACHE_MAX = 256
+
+
+def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
+                         specs: Sequence["AggSpec"], mode: str,
+                         domains: Optional[Tuple[int, ...]],
+                         input_dicts=None):
+    """Build (or fetch) the jitted (state, batch) -> state fold step.
+
+    `input_dicts` is the (name, dictionary) token of the dict-encoded
+    input columns the expressions were compiled against — compiled
+    closures bake those dictionaries into lookup-table constants, so
+    the same IR against different dictionaries is a DIFFERENT kernel
+    (same rule as the filter/project cache)."""
+    aggs = tuple(s.function for s in specs)
+    exprs = list(key_exprs) + [s.input for s in specs
+                               if s.input is not None]
+    key = None
+    if all(e.ir is not None for e in exprs):
+        try:
+            key = (mode, domains, input_dicts,
+                   tuple((ke.ir, ke.dictionary) for ke in key_exprs),
+                   tuple((s.out_name if mode == "final" else None,
+                          s.input.ir if s.input is not None else None,
+                          s.function) for s in specs))
+            cached = _AGG_STEP_CACHE.get(key)
+            if cached is not None:
+                _AGG_STEP_CACHE.move_to_end(key)
+                return cached
+        except TypeError:
+            key = None
+
+    @jax.jit
+    def kernel(state, batch: Batch):
+        env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
+        cap = batch.capacity
+        key_cols = []
+        for ke in key_exprs:
+            d, m = ke.fn(env)
+            key_cols.append((jnp.broadcast_to(d, (cap,)),
+                             jnp.broadcast_to(m, (cap,))))
+        agg_inputs, agg_weights, merge = [], [], []
+        for s in specs:
+            if mode == "final":
+                parts = tuple(
+                    batch.columns[f"{s.out_name}__s{i}"].data
+                    for i in range(len(s.function.state_dtypes)))
+                agg_inputs.append(parts)
+                agg_weights.append(batch.row_valid)
+                merge.append(True)
+            elif s.input is None:
+                agg_inputs.append(None)
+                agg_weights.append(batch.row_valid)
+                merge.append(False)
+            else:
+                d, m = s.input.fn(env)
+                agg_inputs.append(jnp.broadcast_to(d, (cap,)))
+                agg_weights.append(batch.row_valid
+                                   & jnp.broadcast_to(m, (cap,)))
+                merge.append(False)
+        if domains is not None:
+            return hashagg.direct_step(
+                state, batch.row_valid, key_cols, domains, agg_inputs,
+                agg_weights, aggs, tuple(merge))
+        return hashagg.agg_step(state, batch.row_valid, key_cols,
+                                agg_inputs, agg_weights, aggs,
+                                tuple(merge))
+
+    if key is not None:
+        _AGG_STEP_CACHE[key] = kernel
+        while len(_AGG_STEP_CACHE) > _AGG_STEP_CACHE_MAX:
+            _AGG_STEP_CACHE.popitem(last=False)
+    return kernel
+
+
+_AGG_FIN_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
+
+
+def make_agg_finalize_kernel(mode: str, key_names, key_types, key_dicts,
+                             domains, out_names, aggs):
+    """Jitted state -> output-batch drain (one dispatch instead of an
+    eager op per key/state column)."""
+    key = (mode, tuple(key_names), tuple(key_types), tuple(key_dicts),
+           domains, tuple(out_names), aggs)
+    cached = _AGG_FIN_CACHE.get(key)
+    if cached is not None:
+        _AGG_FIN_CACHE.move_to_end(key)
+        return cached
+
+    @jax.jit
+    def fin(state):
+        if domains is not None:
+            f = hashagg.direct_intermediate if mode == "partial" \
+                else hashagg.direct_finalize
+            return f(state, key_names, key_types, key_dicts, domains,
+                     out_names, aggs)
+        if mode == "partial":
+            return hashagg.intermediate_batch(
+                state, key_names, key_types, key_dicts, out_names, aggs)
+        return hashagg.finalize(state, key_names, key_types, key_dicts,
+                                out_names, aggs)
+
+    _AGG_FIN_CACHE[key] = fin
+    while len(_AGG_FIN_CACHE) > _AGG_STEP_CACHE_MAX:
+        _AGG_FIN_CACHE.popitem(last=False)
+    return fin
+
 #: Max slot-table size for the direct-indexing (sort-free) group-by path.
 DIRECT_SLOTS_MAX = 1 << 16
 
@@ -77,7 +195,7 @@ class AggregationOperator(Operator):
     def __init__(self, ctx: OperatorContext, key_names: Sequence[str],
                  key_exprs: Sequence[CompiledExpr],
                  specs: Sequence[AggSpec], mode: str,
-                 max_groups: int):
+                 max_groups: int, step_kernel=None):
         super().__init__(ctx)
         self.key_names = list(key_names)
         self.key_exprs = list(key_exprs)
@@ -85,6 +203,8 @@ class AggregationOperator(Operator):
         self.mode = mode  # "single" | "partial" | "final"
         self.max_groups = max_groups
         self._domains = _direct_domains(key_exprs)
+        self._kernel = step_kernel if step_kernel is not None else \
+            make_agg_step_kernel(key_exprs, specs, mode, self._domains)
         if self._domains is not None:
             slots = 1
             for d in self._domains:
@@ -98,40 +218,6 @@ class AggregationOperator(Operator):
         self._finishing = False
         self._emitted = False
 
-    # -- input evaluation --------------------------------------------------
-
-    def _eval_inputs(self, batch: Batch):
-        env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
-        cap = batch.capacity
-        key_cols = []
-        for ke in self.key_exprs:
-            d, m = ke.fn(env)
-            key_cols.append((jnp.broadcast_to(d, (cap,)),
-                             jnp.broadcast_to(m, (cap,))))
-        agg_inputs, agg_weights, merge = [], [], []
-        for s in self.specs:
-            if self.mode == "final":
-                # inputs are partial-state columns out__s{i}
-                parts = []
-                w = batch.row_valid
-                for i in range(len(s.function.state_dtypes)):
-                    c = batch.columns[f"{s.out_name}__s{i}"]
-                    parts.append(c.data)
-                agg_inputs.append(tuple(parts))
-                agg_weights.append(batch.row_valid)
-                merge.append(True)
-            elif s.input is None:
-                agg_inputs.append(None)
-                agg_weights.append(batch.row_valid)
-                merge.append(False)
-            else:
-                d, m = s.input.fn(env)
-                agg_inputs.append(jnp.broadcast_to(d, (cap,)))
-                agg_weights.append(batch.row_valid
-                                   & jnp.broadcast_to(m, (cap,)))
-                merge.append(False)
-        return key_cols, agg_inputs, agg_weights, merge
-
     # -- operator protocol -------------------------------------------------
 
     def needs_input(self) -> bool:
@@ -139,21 +225,12 @@ class AggregationOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
-        key_cols, agg_inputs, agg_weights, merge = self._eval_inputs(batch)
-        aggs = tuple(s.function for s in self.specs)
-        if self._domains is not None:
-            # sort-free slot-indexed path (small key domains)
-            self._state = _jit_direct_step(
-                self._state, batch.row_valid, key_cols, self._domains,
-                agg_inputs, agg_weights, aggs, tuple(merge))
-            return
-        # No per-batch overflow sync: the overflow flag accumulates on
-        # device (state.overflow) and is checked ONCE at get_output. A
-        # blocking device->host read per batch costs a full roundtrip
-        # (~190ms on a remote TPU tunnel) and serializes the pipeline.
-        self._state = _jit_step(
-            self._state, batch.row_valid, key_cols, agg_inputs,
-            agg_weights, aggs, tuple(merge))
+        # ONE dispatch per batch: expression eval + fold are fused, and
+        # no per-batch overflow sync — the flag accumulates on device
+        # (state.overflow) and is checked ONCE at get_output. A blocking
+        # device->host read per batch costs a full roundtrip (~190ms on
+        # a remote TPU tunnel) and serializes the pipeline.
+        self._state = self._kernel(self._state, batch)
 
     def get_output(self) -> Optional[Batch]:
         if not self._finishing or self._emitted:
@@ -166,23 +243,14 @@ class AggregationOperator(Operator):
             # sync-free)
             raise GroupLimitExceeded(self.max_groups * 4)
         self._emitted = True
-        key_types = [k.type for k in self.key_exprs]
-        key_dicts = [k.dictionary for k in self.key_exprs]
-        aggs = [s.function for s in self.specs]
-        names = [s.out_name for s in self.specs]
-        if self._domains is not None:
-            fin = (hashagg.direct_intermediate if self.mode == "partial"
-                   else hashagg.direct_finalize)
-            out = fin(self._state, self.key_names, key_types, key_dicts,
-                      self._domains, names, aggs)
-        elif self.mode == "partial":
-            out = hashagg.intermediate_batch(
-                self._state, self.key_names, key_types, key_dicts,
-                names, aggs)
-        else:
-            out = hashagg.finalize(
-                self._state, self.key_names, key_types, key_dicts,
-                names, aggs)
+        key_types = tuple(k.type for k in self.key_exprs)
+        key_dicts = tuple(k.dictionary for k in self.key_exprs)
+        aggs = tuple(s.function for s in self.specs)
+        names = tuple(s.out_name for s in self.specs)
+        fin = make_agg_finalize_kernel(
+            self.mode, tuple(self.key_names), key_types, key_dicts,
+            self._domains, names, aggs)
+        out = fin(self._state)
         # (global aggregation over zero rows already yields one live row:
         #  the kernel's global path pins group 0, so count(*) = 0 works)
         return self._count_out(out)
@@ -198,16 +266,19 @@ class AggregationOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, key_names: Sequence[str],
                  key_exprs: Sequence[CompiledExpr],
                  specs: Sequence[AggSpec], mode: str = "single",
-                 max_groups: int = 4096):
+                 max_groups: int = 4096, input_dicts=None):
         super().__init__(operator_id, f"aggregation({mode})")
         self.key_names = key_names
         self.key_exprs = key_exprs
         self.specs = specs
         self.mode = mode
         self.max_groups = max_groups
+        self._step_kernel = make_agg_step_kernel(
+            key_exprs, specs, mode, _direct_domains(key_exprs),
+            input_dicts)
 
     def create(self, driver_context: DriverContext) -> Operator:
         return AggregationOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.key_names, self.key_exprs, self.specs, self.mode,
-            self.max_groups)
+            self.max_groups, self._step_kernel)

@@ -143,6 +143,101 @@ def make_max(input_type: Type) -> AggFunction:
                        init, final, input_type, (input_type, BIGINT))
 
 
+@functools.lru_cache(maxsize=None)
+def make_variance(kind: str) -> AggFunction:
+    """var_samp/var_pop/stddev/stddev_pop via the mergeable
+    (n, sum, sum of squares) state (reference:
+    operator/aggregation/VarianceAggregation + CentralMomentsState —
+    we use the sum-of-squares form: states stay sum-mergeable across
+    partial/final without Welford's order dependence)."""
+    pop = kind.endswith("_pop")
+    sqrt = kind.startswith("stddev")
+
+    def init(value, w):
+        v = jnp.where(w, value, 0).astype(np.float64)
+        return (w.astype(np.int64), v, v * v)
+
+    def final(state):
+        n, s, ss = state
+        nf = jnp.maximum(n, 1).astype(np.float64)
+        m2 = ss - (s * s) / nf
+        denom = nf if pop else jnp.maximum(nf - 1, 1)
+        v = jnp.maximum(m2, 0.0) / denom
+        if sqrt:
+            v = jnp.sqrt(v)
+        mask = (n > 0) if pop else (n > 1)
+        return v, mask
+    return AggFunction(kind, (np.dtype(np.int64), np.dtype(np.float64),
+                              np.dtype(np.float64)),
+                       ("sum", "sum", "sum"), init, final, DOUBLE,
+                       (BIGINT, DOUBLE, DOUBLE))
+
+
+@functools.lru_cache(maxsize=None)
+def make_count_if() -> AggFunction:
+    def init(value, w):
+        return ((w & value.astype(bool)).astype(np.int64),)
+
+    def final(state):
+        return state[0], jnp.ones_like(state[0], bool)
+    return AggFunction("count_if", (np.dtype(np.int64),), ("sum",),
+                       init, final, BIGINT, (BIGINT,))
+
+
+@functools.lru_cache(maxsize=None)
+def make_bool_and(is_or: bool) -> AggFunction:
+    def init(value, w):
+        b = value.astype(bool)
+        if is_or:
+            v = (w & b).astype(np.int64)
+        else:
+            v = jnp.where(w, b, True).astype(np.int64)
+        return (v, w.astype(np.int64))
+
+    def final(state):
+        v, cnt = state
+        return v > 0, cnt > 0  # empty/all-null group -> NULL
+    from presto_tpu.types import BOOLEAN
+    return AggFunction("bool_or" if is_or else "bool_and",
+                       (np.dtype(np.int64), np.dtype(np.int64)),
+                       ("max" if is_or else "min", "sum"),
+                       init, final, BOOLEAN, (BOOLEAN, BIGINT))
+
+
+@functools.lru_cache(maxsize=None)
+def make_geometric_mean() -> AggFunction:
+    def init(value, w):
+        v = jnp.where(w, value, 1).astype(np.float64)
+        return (jnp.log(v), w.astype(np.int64))
+
+    def final(state):
+        slog, cnt = state
+        return jnp.exp(slog / jnp.maximum(cnt, 1)), cnt > 0
+    return AggFunction("geometric_mean",
+                       (np.dtype(np.float64), np.dtype(np.int64)),
+                       ("sum", "sum"), init, final, DOUBLE,
+                       (DOUBLE, BIGINT))
+
+
+@functools.lru_cache(maxsize=None)
+def make_checksum(input_type: Type) -> AggFunction:
+    """Order-independent content hash (reference:
+    aggregation/ChecksumAggregationFunction — XOR of row hashes; we sum
+    wrapping int64, equally order-independent). Deviation from the
+    reference: NULL arguments contribute nothing (the operator's
+    contribute-weight protocol cannot distinguish a NULL value in the
+    group from a row outside it), so checksum([1]) == checksum([1,
+    NULL]); pair with count(*) when null-sensitivity matters."""
+    def init(value, w):
+        h = common.hash64(value, w)
+        return (jnp.where(w, h, 0),)
+
+    def final(state):
+        return state[0], jnp.ones_like(state[0], bool)
+    return AggFunction("checksum", (np.dtype(np.int64),), ("sum",),
+                       init, final, BIGINT, (BIGINT,))
+
+
 AGG_FACTORIES = {
     "sum": make_sum,
     "count": make_count,

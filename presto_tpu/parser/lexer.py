@@ -13,7 +13,8 @@ KEYWORDS = {
     "as", "and", "or", "not", "in", "exists", "between", "like", "escape",
     "is", "null", "true", "false", "case", "when", "then", "else", "end",
     "cast", "join", "inner", "left", "right", "full", "outer", "cross",
-    "on", "using", "union", "all", "distinct", "with", "values", "date",
+    "on", "using", "union", "intersect", "except", "all", "distinct",
+    "with", "values", "date",
     "time", "timestamp", "interval", "extract", "asc", "desc", "nulls",
     "first", "last", "offset", "fetch", "next", "rows", "row", "only",
     "explain", "analyze", "show", "tables", "schemas", "catalogs",

@@ -213,13 +213,25 @@ class _Parser:
         return T.Query(body, order_by, limit, ctes, offset)
 
     def query_term(self) -> T.Node:
+        # INTERSECT binds tighter than UNION/EXCEPT (SqlBase.g4
+        # queryTerm precedence)
+        left = self.intersect_term()
+        while self.at_kw("union", "except"):
+            op = self.advance().value
+            distinct = not self.accept_kw("all")
+            self.accept_kw("distinct")
+            right = self.intersect_term()
+            left = T.SetOperation(op, distinct, left, right)
+        return left
+
+    def intersect_term(self) -> T.Node:
         left = self.query_primary()
-        while self.at_kw("union"):
+        while self.at_kw("intersect"):
             self.advance()
             distinct = not self.accept_kw("all")
             self.accept_kw("distinct")
             right = self.query_primary()
-            left = T.SetOperation("union", distinct, left, right)
+            left = T.SetOperation("intersect", distinct, left, right)
         return left
 
     def query_primary(self) -> T.Node:
@@ -611,7 +623,7 @@ class _Parser:
         if t.kind in ("ident", "qident") or (
                 t.kind == "keyword" and t.value in (
                     "year", "month", "day", "hour", "minute", "second",
-                    "left", "right")):
+                    "left", "right", "if", "quarter")):
             name = self.ident() if t.kind != "keyword" else \
                 self.advance().value
             if self.at_op("("):

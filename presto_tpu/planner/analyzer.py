@@ -32,7 +32,13 @@ from presto_tpu.types import (
     Type, UNKNOWN, VARCHAR, common_super_type, decimal_type, parse_type,
 )
 
-AGG_FUNCTIONS = {"sum", "count", "avg", "min", "max"}
+AGG_FUNCTIONS = {
+    "sum", "count", "avg", "min", "max",
+    "var_samp", "var_pop", "variance", "stddev", "stddev_samp",
+    "stddev_pop", "count_if", "bool_and", "bool_or", "every",
+    "geometric_mean", "checksum", "arbitrary", "any_value",
+    "approx_distinct",
+}
 
 
 class AnalysisError(Exception):
@@ -241,6 +247,8 @@ def _coerce_literal_value(e: Literal, typ: Type):
 
 def _plan_set_op(s: T.SetOperation, ctx: PlannerContext,
                  outer: Optional[Scope]):
+    if s.op in ("intersect", "except"):
+        return _plan_intersect_except(s, ctx, outer)
     if s.op != "union":
         raise AnalysisError(f"{s.op.upper()} not yet supported")
     parts: List[Tuple[RelationPlan, List[str]]] = []
@@ -254,6 +262,20 @@ def _plan_set_op(s: T.SetOperation, ctx: PlannerContext,
             parts.append(_plan_query_body(node, ctx, outer))
     flatten(s.left)
     flatten(s.right)
+    rp, first_names = _plan_union_parts(parts, ctx)
+    if s.distinct:
+        rp = RelationPlan(
+            N.DistinctNode(rp.node,
+                           tuple(N.Field(f.symbol, f.type, f.dictionary)
+                                 for f in rp.scope.fields)),
+            rp.scope)
+    return rp, first_names
+
+
+def _plan_union_parts(parts: List[Tuple[RelationPlan, List[str]]],
+                      ctx: PlannerContext):
+    """UNION ALL of pre-planned inputs: common row type, per-input
+    casts, unified string dictionaries."""
     first_rp, first_names = parts[0]
     width = len(first_rp.scope.fields)
     for rp, _ in parts[1:]:
@@ -307,11 +329,70 @@ def _plan_set_op(s: T.SetOperation, ctx: PlannerContext,
         out_fields.append(N.Field(f.symbol, f.type, dic))
         fields[i] = dataclasses.replace(f, dictionary=dic)
     node = N.UnionNode(inputs, maps, tuple(out_fields))
-    rp = RelationPlan(node, Scope(fields))
-    if s.distinct:
-        rp = RelationPlan(N.DistinctNode(node, tuple(out_fields)),
-                          rp.scope)
-    return rp, first_names
+    return RelationPlan(node, Scope(fields)), first_names
+
+
+def _plan_intersect_except(s: T.SetOperation, ctx: PlannerContext,
+                           outer: Optional[Scope]):
+    """INTERSECT/EXCEPT [DISTINCT] via the marker-count scheme the
+    reference's optimizer uses (ImplementIntersectAndExceptAsUnion.java):
+    UNION ALL both sides with a side-marker column, GROUP BY the row,
+    keep rows seen on the required sides. GROUP BY treats NULLs as
+    equal, which is exactly the set-operation NULL semantics (a join
+    formulation would drop NULL rows)."""
+    if not s.distinct:
+        raise AnalysisError(
+            f"{s.op.upper()} ALL is not supported")
+    parts = [_plan_query_body(s.left, ctx, outer),
+             _plan_query_body(s.right, ctx, outer)]
+    marked = []
+    for side, (rp, names) in enumerate(parts):
+        msym = ctx.symbols.new("setop_side")
+        assigns = [(f.symbol, InputRef(f.symbol, f.type))
+                   for f in rp.scope.fields]
+        assigns.append((msym, Literal(side, BIGINT)))
+        out = tuple([N.Field(f.symbol, f.type, f.dictionary)
+                     for f in rp.scope.fields]
+                    + [N.Field(msym, BIGINT)])
+        node = N.ProjectNode(rp.node, assigns, out)
+        scope = Scope(list(rp.scope.fields)
+                      + [ScopeField(None, msym, msym, BIGINT)])
+        marked.append((RelationPlan(node, scope), names))
+
+    union_rp, first_names = _plan_union_parts(marked, ctx)
+    fields = union_rp.scope.fields
+    data_fields, marker = fields[:-1], fields[-1]
+    mref = InputRef(marker.symbol, BIGINT)
+
+    def side_count(side: int, hint: str) -> N.AggCall:
+        cond = Call("equal", (mref, Literal(side, BIGINT)), BOOLEAN)
+        return N.AggCall(ctx.symbols.new(hint), "count_if", cond,
+                         False, BIGINT)
+    lc, rc = side_count(0, "lcount"), side_count(1, "rcount")
+    keys = [(f.symbol, InputRef(f.symbol, f.type)) for f in data_fields]
+    agg_out = tuple(
+        [N.Field(f.symbol, f.type, f.dictionary) for f in data_fields]
+        + [N.Field(lc.out_symbol, BIGINT), N.Field(rc.out_symbol,
+                                                   BIGINT)])
+    agg = N.AggregationNode(union_rp.node, keys, [lc, rc], "single",
+                            agg_out)
+
+    lref = InputRef(lc.out_symbol, BIGINT)
+    rref = InputRef(rc.out_symbol, BIGINT)
+    on_left = Call("greater_than", (lref, Literal(0, BIGINT)), BOOLEAN)
+    if s.op == "intersect":
+        on_right = Call("greater_than", (rref, Literal(0, BIGINT)),
+                        BOOLEAN)
+    else:  # except
+        on_right = Call("equal", (rref, Literal(0, BIGINT)), BOOLEAN)
+    filt = N.FilterNode(agg, SpecialForm("and", (on_left, on_right),
+                                         BOOLEAN), agg_out)
+    proj_fields = tuple(N.Field(f.symbol, f.type, f.dictionary)
+                        for f in data_fields)
+    proj = N.ProjectNode(
+        filt, [(f.symbol, InputRef(f.symbol, f.type))
+               for f in data_fields], proj_fields)
+    return RelationPlan(proj, Scope(list(data_fields))), first_names
 
 
 def _plan_query_body(body: T.Node, ctx: PlannerContext,
@@ -551,10 +632,13 @@ def _collect_agg_calls(node, out: List[T.FunctionCall]):
 
 
 def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
-    if fn == "count":
+    if fn in ("count", "count_if", "checksum"):
         return BIGINT
-    if fn == "avg":
+    if fn in ("avg", "var_samp", "var_pop", "variance", "stddev",
+              "stddev_samp", "stddev_pop", "geometric_mean"):
         return DOUBLE
+    if fn in ("bool_and", "bool_or", "every"):
+        return BOOLEAN
     if fn == "sum":
         if arg_type is None:
             raise AnalysisError("sum requires an argument")
@@ -563,8 +647,9 @@ def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
         if arg_type.is_integer:
             return BIGINT
         return DOUBLE
-    # min/max preserve type
-    assert arg_type is not None
+    # min/max/arbitrary/any_value preserve type
+    if arg_type is None:
+        raise AnalysisError(f"{fn} requires an argument")
     return arg_type
 
 
@@ -684,6 +769,10 @@ def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
                 if not w.order_by:
                     raise AnalysisError(f"{name} requires ORDER BY")
                 arg_sym = to_symbol(c.args[0], name)
+                if name in ("lag", "lead") and len(c.args) > 2:
+                    raise AnalysisError(
+                        f"{name} default-value argument is not "
+                        "supported yet (use coalesce around the call)")
                 if name in ("lag", "lead") and len(c.args) > 1:
                     off = fold_constants(an.analyze(c.args[1]))
                     if not isinstance(off, Literal):
@@ -693,6 +782,9 @@ def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
                 out_type = field_of(arg_sym).type
                 cframe = frame
             else:  # aggregate OVER
+                if name not in ("sum", "avg", "count", "min", "max"):
+                    raise AnalysisError(
+                        f"{name} is not supported as a window function")
                 if c.is_star or not c.args:
                     arg_type = None
                     if name != "count":
@@ -765,6 +857,15 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
     for o in order_items:
         _collect_agg_calls(o.expr, calls)
 
+    # approx_distinct(x) is satisfied exactly: rewrite to
+    # count(DISTINCT x) (an exact answer is within any approximation
+    # bound; the reference's HLL sketch trades exactness for fixed
+    # state — our sort-based pre-distinct already has bounded state)
+    for c in calls:
+        if c.name == "approx_distinct":
+            c.name = "count"
+            c.distinct = True
+
     # DISTINCT aggregates (e.g. Q16's count(distinct suppkey)): insert a
     # pre-aggregation producing the distinct (group keys, arg) rows, then
     # aggregate plainly on top (the reference reaches the same shape via
@@ -773,13 +874,12 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
     distinct_calls = [c for c in calls if c.distinct]
     dsym = d_t = d_dic = None
     if distinct_calls:
-        if any(not c.distinct for c in calls):
-            raise AnalysisError("mixing DISTINCT and plain aggregates "
-                                "not yet supported")
-        argkeys = {_ast_key(c.args[0]) for c in distinct_calls if c.args}
-        if len(argkeys) != 1 or any(c.is_star for c in distinct_calls):
-            raise AnalysisError("multiple different DISTINCT arguments "
-                                "not yet supported")
+        if any(c.is_star or not c.args for c in distinct_calls):
+            raise AnalysisError("DISTINCT aggregate requires an "
+                                "argument")
+        argkeys = {_ast_key(c.args[0]) for c in distinct_calls}
+        if any(not c.distinct for c in calls) or len(argkeys) != 1:
+            return _plan_mixed_distinct(keys, calls, rp, ctx, an)
         arg0 = fold_constants(an.analyze(distinct_calls[0].args[0]))
         d_t, d_dic = arg0.type, an.dictionary_of(arg0)
         dsym = ctx.symbols.new("distinct_arg")
@@ -815,11 +915,14 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
             if len(c.args) != 1:
                 raise AnalysisError(f"{c.name} takes one argument")
             arg = fold_constants(an.analyze(c.args[0]))
+            if c.name in ("count_if", "bool_and", "bool_or", "every"):
+                arg = _coerce_to(arg, BOOLEAN)
             arg_t, dic = arg.type, an.dictionary_of(arg)
         out_t = _agg_output_type(c.name, arg_t)
         sym = ctx.symbols.new(c.name)
         agg_nodes.append(N.AggCall(sym, c.name, arg, False, out_t))
-        out_dic = dic if c.name in ("min", "max") else None
+        out_dic = dic if c.name in ("min", "max", "arbitrary",
+                                    "any_value") else None
         rewrites[key] = (sym, out_t, out_dic)
 
     out_fields = tuple(
@@ -851,6 +954,153 @@ def _ast_key_for_sym(rewrites, sym):
         if s == sym:
             return k
     return None
+
+
+def _default_literal(t: Type) -> Literal:
+    if t.is_string:
+        return Literal("", t)
+    if t.name == "boolean":
+        return Literal(False, t)
+    if t.is_floating:
+        return Literal(0.0, t)
+    return Literal(0, t)
+
+
+def _plan_mixed_distinct(keys, calls, rp: RelationPlan,
+                         ctx: PlannerContext, an: "_Analyzer"):
+    """Mixed plain + DISTINCT aggregates, and/or several different
+    DISTINCT arguments: plan one aggregation branch per input stream —
+    the plain branch over raw rows, one pre-distinct branch per distinct
+    argument — and join the per-group results back on the group keys.
+    Joins compare keys null-safely through (is_null, coalesce) pairs so
+    NULL key groups survive (GROUP BY treats NULL as a group; a plain
+    equi-join would drop it). The reference reaches the same result with
+    MarkDistinctOperator masks (operator/MarkDistinctOperator.java); the
+    branch-join shape keeps every branch on the streaming agg kernels,
+    and the shared source subtree executes once (planner CSE spools it
+    locally; the fragmenter gives it one producer fragment on a mesh)."""
+    source_node = rp.node
+    rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
+    branches: List[Tuple[N.PlanNode, List[str]]] = []
+
+    def key_fields(syms):
+        return [N.Field(s2, e.type, d) for s2, (_, e, d, _)
+                in zip(syms, keys)]
+
+    # -- plain branch ------------------------------------------------------
+    plain_aggs: List[N.AggCall] = []
+    agg_fields: List[N.Field] = []
+    for c in calls:
+        if c.distinct or _ast_key(c) in rewrites:
+            continue
+        if c.filter is not None:
+            raise AnalysisError("FILTER (WHERE ...) not yet supported")
+        if c.is_star or not c.args:
+            arg, arg_t, dic = None, None, None
+        else:
+            arg = fold_constants(an.analyze(c.args[0]))
+            if c.name in ("count_if", "bool_and", "bool_or", "every"):
+                arg = _coerce_to(arg, BOOLEAN)
+            arg_t, dic = arg.type, an.dictionary_of(arg)
+        out_t = _agg_output_type(c.name, arg_t)
+        sym = ctx.symbols.new(c.name)
+        plain_aggs.append(N.AggCall(sym, c.name, arg, False, out_t))
+        out_dic = dic if c.name in ("min", "max", "arbitrary",
+                                    "any_value") else None
+        agg_fields.append(N.Field(sym, out_t, out_dic))
+        rewrites[_ast_key(c)] = (sym, out_t, out_dic)
+    if plain_aggs:
+        ksyms = [ctx.symbols.new("k") for _ in keys]
+        node = N.AggregationNode(
+            source_node,
+            [(s2, e) for s2, (_, e, _, _) in zip(ksyms, keys)],
+            plain_aggs, "single",
+            tuple(key_fields(ksyms)) + tuple(agg_fields))
+        branches.append((node, ksyms))
+
+    # -- one pre-distinct branch per distinct argument ---------------------
+    dgroups: Dict[tuple, List[T.FunctionCall]] = {}
+    for c in calls:
+        if c.distinct:
+            dgroups.setdefault(_ast_key(c.args[0]), []).append(c)
+    for group in dgroups.values():
+        arg0 = fold_constants(an.analyze(group[0].args[0]))
+        d_t, d_dic = arg0.type, an.dictionary_of(arg0)
+        ds = ctx.symbols.new("distinct_arg")
+        ksyms = [ctx.symbols.new("k") for _ in keys]
+        pre_fields = tuple(key_fields(ksyms)) + (N.Field(ds, d_t,
+                                                         d_dic),)
+        pre = N.AggregationNode(
+            source_node,
+            [(s2, e) for s2, (_, e, _, _) in zip(ksyms, keys)]
+            + [(ds, arg0)], [], "single", pre_fields)
+        aggs, afields = [], []
+        for c in group:
+            if _ast_key(c) in rewrites:
+                continue
+            out_t = _agg_output_type(c.name, d_t)
+            sym = ctx.symbols.new(c.name)
+            aggs.append(N.AggCall(sym, c.name, InputRef(ds, d_t),
+                                  False, out_t))
+            out_dic = d_dic if c.name in ("min", "max", "arbitrary",
+                                          "any_value") else None
+            afields.append(N.Field(sym, out_t, out_dic))
+            rewrites[_ast_key(c)] = (sym, out_t, out_dic)
+        outer = N.AggregationNode(
+            pre,
+            [(s2, InputRef(s2, e.type))
+             for s2, (_, e, _, _) in zip(ksyms, keys)],
+            aggs, "single", tuple(key_fields(ksyms)) + tuple(afields))
+        branches.append((outer, ksyms))
+
+    # -- join the branches on null-safe group keys -------------------------
+    def null_safe(node: N.PlanNode, ksyms):
+        assigns = [(f.symbol, InputRef(f.symbol, f.type))
+                   for f in node.output]
+        out = list(node.output)
+        scope2 = Scope([ScopeField(None, f.symbol, f.symbol, f.type,
+                                   f.dictionary) for f in node.output])
+        an2 = _Analyzer(scope2, ctx)
+        flags, vals = [], []
+        for s2 in ksyms:
+            f = node.field(s2)
+            fs = ctx.symbols.new("knull")
+            assigns.append((fs, SpecialForm(
+                "is_null", (InputRef(s2, f.type),), BOOLEAN)))
+            out.append(N.Field(fs, BOOLEAN))
+            flags.append(fs)
+            vs = ctx.symbols.new("kval")
+            e = SpecialForm("coalesce", (InputRef(s2, f.type),
+                                         _default_literal(f.type)),
+                            f.type)
+            assigns.append((vs, e))
+            out.append(N.Field(vs, f.type, an2.dictionary_of(e)))
+            vals.append(vs)
+        return N.ProjectNode(node, assigns, tuple(out)), flags, vals
+
+    node, key_syms = branches[0]
+    for bnode, bkeys in branches[1:]:
+        if keys:
+            left, lf, lv = null_safe(node, key_syms)
+            right, rf, rv = null_safe(bnode, bkeys)
+            criteria = list(zip(lf, rf)) + list(zip(lv, rv))
+            node = N.JoinNode("inner", left, right, criteria,
+                              tuple(left.output) + tuple(right.output))
+        else:
+            node = N.JoinNode("cross", node, bnode, [],
+                              tuple(node.output) + tuple(bnode.output))
+
+    # -- scope + rewrites --------------------------------------------------
+    fields = [ScopeField(None, s, s2, e.type, d)
+              for s2, (s, e, d, _) in zip(key_syms, keys)]
+    for k_ast, (sym, t, dic) in rewrites.items():
+        fields.append(ScopeField(None, sym, sym, t, dic))
+    final_rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
+    for s2, (_, e, d, k_ast) in zip(key_syms, keys):
+        final_rewrites[k_ast] = (s2, e.type, d)
+    final_rewrites.update(rewrites)
+    return RelationPlan(node, Scope(fields, rp.scope.parent)), \
+        final_rewrites
 
 
 # ---------------------------------------------------------------------------
@@ -1620,11 +1870,9 @@ class _Analyzer:
         if a.op in ("+", "-", "*", "/", "%"):
             return self._arith(a.op, l, r)
         if a.op == "||":
-            if not (l.type.is_string and isinstance(r, Literal)
-                    and r.type.is_string):
-                raise AnalysisError("|| currently supports "
-                                    "varchar || literal only")
-            return Call("concat_lit", (l, r), VARCHAR)
+            if not (l.type.is_string and r.type.is_string):
+                raise AnalysisError("|| requires varchar operands")
+            return Call("concat", (l, r), VARCHAR)
         raise AnalysisError(f"unsupported operator {a.op!r}")
 
     def _coerce_comparison(self, l, r):
@@ -1825,13 +2073,16 @@ class _Analyzer:
                 return args[0]
             return Call("round", tuple(args), DOUBLE)
         if name in ("substr", "upper", "lower", "trim", "ltrim",
-                    "rtrim", "reverse"):
+                    "rtrim", "reverse", "replace", "lpad", "rpad"):
             return Call(name, tuple(args), VARCHAR)
-        if name in ("length", "strpos"):
+        if name in ("length", "strpos", "codepoint"):
             return Call(name, tuple(args), BIGINT)
+        if name in ("starts_with", "ends_with"):
+            return Call(name, tuple(args), BOOLEAN)
         if name == "concat":
-            # concat(col, lit...) folds literals into one suffix
-            return Call("concat_lit", tuple(args), VARCHAR)
+            return Call("concat", tuple(args), VARCHAR)
+        if name == "date_trunc":
+            return Call("date_trunc", tuple(args), DATE)
         if name == "hash_code":
             return Call("hash_code", tuple(args), BIGINT)
         raise AnalysisError(f"unknown function {name!r}")

@@ -350,10 +350,11 @@ class _Exchanger:
 
     @staticmethod
     def _effective_input_type(a: N.AggCall) -> Optional[Type]:
+        from presto_tpu.planner.local_planner import DOUBLE_INPUT_AGGS
         if a.argument is None:
             return None
         t = a.argument.type
-        if a.function == "avg" and t.is_decimal:
+        if a.function in DOUBLE_INPUT_AGGS and t.is_decimal:
             return DOUBLE  # matches the local planner's pre-agg cast
         return t
 

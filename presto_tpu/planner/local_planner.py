@@ -259,7 +259,8 @@ class LocalExecutionPlanner:
             arg_ce = None
             if a.argument is not None:
                 arg = a.argument
-                if a.function == "avg" and arg.type.is_decimal:
+                if a.function in DOUBLE_INPUT_AGGS \
+                        and arg.type.is_decimal:
                     arg = SpecialForm("cast", (arg,), DOUBLE)
                 arg_ce = compile_expression(arg, schema)
             fn = self._make_agg(a, arg_ce)
@@ -267,7 +268,7 @@ class LocalExecutionPlanner:
         max_groups = int(self.session.properties.get("max_groups", 4096))
         pipe.append(AggregationOperatorFactory(
             self._next_id(), key_names, key_exprs, specs, node.step,
-            max_groups))
+            max_groups, input_dicts=_schema_dicts(schema)))
 
     @staticmethod
     def _make_agg(a: N.AggCall, arg_ce: Optional[CompiledExpr]):
@@ -418,6 +419,17 @@ class LocalExecutionPlanner:
 
 # ---------------------------------------------------------------------------
 
+#: aggregates whose DECIMAL argument is pre-cast to DOUBLE (the kernel
+#: state is float64); shared by local planning and AddExchanges so both
+#: sides of a partial/final split agree on the input type
+DOUBLE_INPUT_AGGS = frozenset({
+    "avg", "var_samp", "var_pop", "variance", "stddev", "stddev_samp",
+    "stddev_pop", "geometric_mean",
+})
+
+_VARIANCE_CANON = {"variance": "var_samp", "stddev_samp": "stddev"}
+
+
 def agg_function_for(name: str, input_type: Optional[Type],
                      output_type: Optional[Type]) -> hashagg.AggFunction:
     """Resolve an aggregate name + argument type to its state machine.
@@ -429,10 +441,20 @@ def agg_function_for(name: str, input_type: Optional[Type],
         return hashagg.make_sum(input_type, output_type)
     if name == "avg":
         return hashagg.make_avg(input_type)
-    if name == "min":
-        return hashagg.make_min(input_type)
-    if name == "max":
-        return hashagg.make_max(input_type)
+    if name in ("min", "max", "arbitrary", "any_value"):
+        fn = hashagg.make_min if name != "max" else hashagg.make_max
+        return fn(input_type)
+    if name in ("var_samp", "var_pop", "variance", "stddev",
+                "stddev_samp", "stddev_pop"):
+        return hashagg.make_variance(_VARIANCE_CANON.get(name, name))
+    if name == "count_if":
+        return hashagg.make_count_if()
+    if name in ("bool_and", "bool_or", "every"):
+        return hashagg.make_bool_and(name == "bool_or")
+    if name == "geometric_mean":
+        return hashagg.make_geometric_mean()
+    if name == "checksum":
+        return hashagg.make_checksum(input_type)
     raise LocalPlanningError(f"unknown aggregate {name}")
 
 
