@@ -307,6 +307,19 @@ class Batch:
         return big.compact(capacity, known_valid=live_rows)
 
 
+def empty_batch(schema_cols: Sequence[Tuple],
+                capacity: int = MIN_CAPACITY) -> "Batch":
+    """An all-invalid batch for a (name, type, dictionary) schema —
+    the stand-in when a source legitimately yields zero batches
+    (pruned scans, blackhole reads, empty build sides)."""
+    cols = {
+        name: Column(jnp.zeros(capacity, t.np_dtype),
+                     jnp.zeros(capacity, bool), t, dic)
+        for name, t, dic in schema_cols
+    }
+    return Batch(cols, jnp.zeros(capacity, bool))
+
+
 @jax.jit
 def _compact(batch: Batch) -> Batch:
     order = jnp.argsort(~batch.row_valid, stable=True)

@@ -1,0 +1,223 @@
+"""In-memory and blackhole connectors (reference: presto-memory — the
+writable test/staging connector CTAS and INSERT land in — and
+presto-blackhole, the perf sink that discards writes and serves empty
+scans).
+
+Memory tables hold device batches as written; string columns are
+re-encoded onto a per-table unified dictionary at append so later scans
+and joins see one consistent code space.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from presto_tpu.batch import Batch, remap_column
+from presto_tpu.connectors.spi import (
+    Connector, ConnectorMetadata, ConnectorPageSink, ConnectorPageSource,
+    ConnectorSplitManager, Split, TableHandle, TupleDomain,
+)
+from presto_tpu.schema import ColumnSchema, RelationSchema
+
+
+class _Table:
+    def __init__(self, schema: RelationSchema):
+        self.schema = schema
+        self.batches: List[Batch] = []
+        self.row_count = 0
+
+
+class _MemoryMetadata(ConnectorMetadata):
+    def __init__(self, tables: Dict[Tuple[str, str], _Table]):
+        self._tables = tables
+
+    def list_schemas(self) -> List[str]:
+        return sorted({s for s, _ in self._tables} | {"default"})
+
+    def list_tables(self, schema: str) -> List[str]:
+        return sorted(t for s, t in self._tables if s == schema)
+
+    def get_table_schema(self, handle: TableHandle) -> RelationSchema:
+        return self._tables[(handle.schema, handle.table)].schema
+
+    def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
+        t = self._tables.get((handle.schema, handle.table))
+        return t.row_count if t is not None else None
+
+
+class _MemorySplitManager(ConnectorSplitManager):
+    def __init__(self, tables: Dict[Tuple[str, str], _Table]):
+        self._tables = tables
+
+    def get_splits(self, handle: TableHandle,
+                   target_splits: int) -> List[Split]:
+        t = self._tables[(handle.schema, handle.table)]
+        n = max(len(t.batches), 1)
+        # one split per stored-batch range so scans parallelize
+        per = math.ceil(n / max(target_splits, 1))
+        return [Split(handle, (lo, min(lo + per, len(t.batches))),
+                      partition=i)
+                for i, lo in enumerate(range(0, len(t.batches), per))] \
+            or [Split(handle, (0, 0), partition=0)]
+
+
+class _MemoryPageSource(ConnectorPageSource):
+    def __init__(self, tables: Dict[Tuple[str, str], _Table]):
+        self._tables = tables
+
+    def batches(self, split: Split, columns: Sequence[str],
+                batch_rows: int,
+                constraint: Optional[TupleDomain] = None
+                ) -> Iterator[Batch]:
+        t = self._tables[(split.table.schema, split.table.table)]
+        lo, hi = split.info
+        for b in t.batches[lo:hi]:
+            yield Batch({n: b.columns[n] for n in columns}, b.row_valid)
+
+
+class _MemoryPageSink(ConnectorPageSink):
+    """Appends buffer; dictionary unification happens ONCE at finish()
+    (per-append re-encoding of already-stored batches would make an
+    n-batch string write O(n^2) in device remaps)."""
+
+    def __init__(self, tables: Dict[Tuple[str, str], _Table]):
+        self._tables = tables
+        self._pending: Dict[Tuple[str, str], List[Batch]] = {}
+
+    def create_table(self, handle: TableHandle,
+                     schema: RelationSchema) -> None:
+        key = (handle.schema, handle.table)
+        if key in self._tables:
+            raise ValueError(f"table {handle} already exists")
+        self._tables[key] = _Table(schema)
+
+    def append(self, handle: TableHandle, batch: Batch) -> None:
+        t = self._tables[(handle.schema, handle.table)]
+        key = (handle.schema, handle.table)
+        self._pending.setdefault(key, []).append(
+            Batch({cs.name: batch.columns[cs.name]
+                   for cs in t.schema.columns}, batch.row_valid))
+
+    def finish(self, handle: TableHandle) -> None:
+        key = (handle.schema, handle.table)
+        pending = self._pending.pop(key, [])
+        if not pending:
+            return
+        t = self._tables[key]
+        new_schema_cols = []
+        for cs in t.schema.columns:
+            if cs.dictionary is None and all(
+                    b.columns[cs.name].dictionary is None
+                    for b in pending):
+                new_schema_cols.append(cs)
+                continue
+            merged = set(cs.dictionary or ())
+            for b in pending:
+                merged |= set(b.columns[cs.name].dictionary or ())
+            merged = tuple(sorted(merged))
+            if merged != cs.dictionary:
+                # one re-encode pass over stored + pending batches
+                for store in (t.batches, pending):
+                    for i, old in enumerate(store):
+                        oc = dict(old.columns)
+                        oc[cs.name] = remap_column(oc[cs.name], merged)
+                        store[i] = Batch(oc, old.row_valid)
+                cs = ColumnSchema(cs.name, cs.type, merged)
+            new_schema_cols.append(cs)
+        t.schema = RelationSchema(new_schema_cols)
+        for b in pending:
+            t.batches.append(b)
+            t.row_count += b.num_valid()
+
+    def drop_table(self, handle: TableHandle) -> None:
+        self._pending.pop((handle.schema, handle.table), None)
+        del self._tables[(handle.schema, handle.table)]
+
+
+class MemoryConnector(Connector):
+    """Reference: /root/reference/presto-memory/ (MemoryMetadata,
+    MemoryPagesStore, MemoryPageSinkProvider)."""
+
+    name = "memory"
+
+    def __init__(self):
+        self._tables: Dict[Tuple[str, str], _Table] = {}
+        self._metadata = _MemoryMetadata(self._tables)
+        self._splits = _MemorySplitManager(self._tables)
+        self._source = _MemoryPageSource(self._tables)
+        self._sink = _MemoryPageSink(self._tables)
+
+    @property
+    def metadata(self):
+        return self._metadata
+
+    @property
+    def split_manager(self):
+        return self._splits
+
+    @property
+    def page_source(self):
+        return self._source
+
+    @property
+    def page_sink(self):
+        return self._sink
+
+
+class _BlackholeSink(ConnectorPageSink):
+    def __init__(self, tables: Dict[Tuple[str, str], _Table]):
+        self._tables = tables
+
+    def create_table(self, handle: TableHandle,
+                     schema: RelationSchema) -> None:
+        self._tables[(handle.schema, handle.table)] = _Table(schema)
+
+    def append(self, handle: TableHandle, batch: Batch) -> None:
+        # count, then discard (the write-throughput sink)
+        t = self._tables[(handle.schema, handle.table)]
+        t.row_count += batch.num_valid()
+
+    def drop_table(self, handle: TableHandle) -> None:
+        del self._tables[(handle.schema, handle.table)]
+
+
+class _BlackholeSource(ConnectorPageSource):
+    def batches(self, split: Split, columns: Sequence[str],
+                batch_rows: int,
+                constraint: Optional[TupleDomain] = None
+                ) -> Iterator[Batch]:
+        return iter(())
+
+
+class BlackholeConnector(Connector):
+    """Reference: /root/reference/presto-blackhole/ — writes are
+    swallowed (row count kept), reads are empty."""
+
+    name = "blackhole"
+
+    def __init__(self):
+        self._tables: Dict[Tuple[str, str], _Table] = {}
+        self._metadata = _MemoryMetadata(self._tables)
+        self._splits = _MemorySplitManager(self._tables)
+        self._source = _BlackholeSource()
+        self._sink = _BlackholeSink(self._tables)
+
+    @property
+    def metadata(self):
+        return self._metadata
+
+    @property
+    def split_manager(self):
+        return self._splits
+
+    @property
+    def page_source(self):
+        return self._source
+
+    @property
+    def page_sink(self):
+        return self._sink
+
+    def written_rows(self, schema: str, table: str) -> int:
+        return self._tables[(schema, table)].row_count

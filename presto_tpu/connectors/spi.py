@@ -38,6 +38,48 @@ class Split:
     partition: Optional[int] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class Domain:
+    """Allowed values of one column: an optional closed range and/or a
+    discrete IN-set, in the column's PHYSICAL representation (dates as
+    epoch days, decimals as unscaled ints). None bound = unbounded.
+    (Reference: presto-common common/predicate/Domain + Range.)"""
+    low: Any = None
+    high: Any = None
+    values: Optional[Tuple[Any, ...]] = None
+
+    def test(self, arr) -> "Any":
+        """Vectorized membership over a host numpy array."""
+        import numpy as np
+        keep = np.ones(len(arr), bool)
+        if self.low is not None:
+            keep &= arr >= self.low
+        if self.high is not None:
+            keep &= arr <= self.high
+        if self.values is not None:
+            keep &= np.isin(arr, np.asarray(self.values))
+        return keep
+
+
+@dataclasses.dataclass(frozen=True)
+class TupleDomain:
+    """Per-column constraint conjunction pushed into a scan (reference:
+    presto-common common/predicate/TupleDomain, threaded through
+    ConnectorPageSourceProvider). Hashable so page-source caches can key
+    on it. Pushdown is UNENFORCED: the engine keeps its filter, the
+    connector may use the constraint to skip or shrink work."""
+    domains: Tuple[Tuple[str, Domain], ...] = ()
+
+    def domain(self, column: str) -> Optional[Domain]:
+        for name, d in self.domains:
+            if name == column:
+                return d
+        return None
+
+    def __bool__(self):
+        return bool(self.domains)
+
+
 class ConnectorMetadata(abc.ABC):
     @abc.abstractmethod
     def list_schemas(self) -> List[str]: ...
@@ -63,11 +105,33 @@ class ConnectorSplitManager(abc.ABC):
 
 class ConnectorPageSource(abc.ABC):
     """Produces batches for one split (reference:
-    spi ConnectorPageSource.java:22)."""
+    spi ConnectorPageSource.java:22). `constraint` is the pushed-down
+    TupleDomain (may be ignored — the engine re-applies its filter)."""
 
     @abc.abstractmethod
     def batches(self, split: Split, columns: Sequence[str],
-                batch_rows: int) -> Iterator[Batch]: ...
+                batch_rows: int,
+                constraint: Optional[TupleDomain] = None
+                ) -> Iterator[Batch]: ...
+
+
+class ConnectorPageSink(abc.ABC):
+    """Accepts written batches for one table (reference:
+    spi ConnectorPageSink + ConnectorPageSinkProvider; commit protocol
+    collapsed to create/append/finish for in-process connectors)."""
+
+    @abc.abstractmethod
+    def create_table(self, handle: TableHandle,
+                     schema: RelationSchema) -> None: ...
+
+    @abc.abstractmethod
+    def append(self, handle: TableHandle, batch: Batch) -> None: ...
+
+    def finish(self, handle: TableHandle) -> None:
+        """Commit point (no-op for in-memory connectors)."""
+
+    def drop_table(self, handle: TableHandle) -> None:
+        raise NotImplementedError
 
 
 class Connector(abc.ABC):
@@ -84,3 +148,8 @@ class Connector(abc.ABC):
     @property
     @abc.abstractmethod
     def page_source(self) -> ConnectorPageSource: ...
+
+    @property
+    def page_sink(self) -> Optional[ConnectorPageSink]:
+        """None = read-only connector (writes are rejected)."""
+        return None

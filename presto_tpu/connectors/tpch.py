@@ -506,9 +506,10 @@ class _TpchPageSource(ConnectorPageSource):
                    for c in b.columns.values()) + b.row_valid.nbytes
 
     def batches(self, split: Split, columns: Sequence[str],
-                batch_rows: int) -> Iterator[Batch]:
+                batch_rows: int,
+                constraint=None) -> Iterator[Batch]:
         key = (split.table.schema, split.table.table, split.info,
-               tuple(columns), batch_rows)
+               tuple(columns), batch_rows, constraint)
         cached = self._cache.get(key)
         if cached is not None:
             self._cache.move_to_end(key)
@@ -526,6 +527,20 @@ class _TpchPageSource(ConnectorPageSource):
         for clo in range(lo, hi, step):
             chi = min(clo + step, hi)
             data = gen.generate(table, clo, chi)
+            if constraint:
+                # honor the pushed-down domain HOST-SIDE, before the
+                # device transfer: selective scans ship (and compute
+                # over) only surviving rows
+                keep = None
+                for col, dom in constraint.domains:
+                    if col not in data:
+                        continue
+                    k = dom.test(data[col])
+                    keep = k if keep is None else keep & k
+                if keep is not None:
+                    if not keep.any():
+                        continue  # chunk fully pruned
+                    data = {c: data[c][keep] for c in columns}
             arrays = {c: data[c] for c in columns}
             types = {c: schema.column(c).type for c in columns}
             dicts = {c: schema.column(c).dictionary for c in columns

@@ -43,11 +43,13 @@ class HashBuildOperator(Operator):
 
     def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
                  key_names: Tuple[str, ...],
-                 key_dicts: Optional[List[Optional[tuple]]] = None):
+                 key_dicts: Optional[List[Optional[tuple]]] = None,
+                 schema_cols: Optional[Sequence[tuple]] = None):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
         self.key_dicts = key_dicts
+        self.schema_cols = schema_cols
         self._batches: List[Batch] = []
         self._finished = False
 
@@ -71,6 +73,12 @@ class HashBuildOperator(Operator):
         cap = bucket_capacity(max(total, 1))
         if self._batches:
             merged = Batch.concat(self._batches, cap, live_rows=total)
+        elif self.schema_cols is not None:
+            # a pruned/empty build side is a legal input (e.g. a fully
+            # pushed-down scan): index an all-invalid batch
+            from presto_tpu.batch import empty_batch
+            merged = _remap_keys(empty_batch(self.schema_cols),
+                                 self.key_names, self.key_dicts)
         else:
             raise RuntimeError("empty build side needs schema plumbing")
         self.bridge.table = join_ops.build(merged, self.key_names)
@@ -201,16 +209,19 @@ def _remap_keys(batch: Batch, key_names, key_dicts) -> Batch:
 class HashBuildOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, bridge: JoinBridge,
                  key_names: Sequence[str],
-                 key_dicts: Optional[List[Optional[tuple]]] = None):
+                 key_dicts: Optional[List[Optional[tuple]]] = None,
+                 schema_cols: Optional[Sequence[tuple]] = None):
         super().__init__(operator_id, "hash_build")
         self.bridge = bridge
         self.key_names = tuple(key_names)
         self.key_dicts = key_dicts
+        self.schema_cols = schema_cols
 
     def create(self, driver_context: DriverContext) -> Operator:
         return HashBuildOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self.bridge, self.key_names, self.key_dicts)
+            self.bridge, self.key_names, self.key_dicts,
+            self.schema_cols)
 
 
 class LookupJoinOperatorFactory(OperatorFactory):

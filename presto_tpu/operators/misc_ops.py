@@ -28,9 +28,11 @@ class NestedLoopBridge:
 
 
 class NestedLoopBuildOperator(Operator):
-    def __init__(self, ctx: OperatorContext, bridge: NestedLoopBridge):
+    def __init__(self, ctx: OperatorContext, bridge: NestedLoopBridge,
+                 schema_cols: Optional[Sequence[tuple]] = None):
         super().__init__(ctx)
         self.bridge = bridge
+        self.schema_cols = schema_cols
         self._batches: List[Batch] = []
         self._finished = False
 
@@ -49,8 +51,12 @@ class NestedLoopBuildOperator(Operator):
             return
         self._finished = True
         if not self._batches:
-            raise RuntimeError("empty cross-join build needs schema "
-                               "plumbing (planner bug)")
+            if self.schema_cols is None:
+                raise RuntimeError("empty cross-join build needs "
+                                   "schema plumbing (planner bug)")
+            from presto_tpu.batch import empty_batch
+            self.bridge.batch = empty_batch(self.schema_cols)
+            return
         total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
         self.bridge.batch = Batch.concat(
             self._batches, bucket_capacity(max(total, 1)),
@@ -407,9 +413,11 @@ class _SimpleFactory(OperatorFactory):
                                         driver_context))
 
 
-def nested_loop_build_factory(op_id: int, bridge: NestedLoopBridge):
-    return _SimpleFactory(op_id, "nl_build",
-                          lambda ctx: NestedLoopBuildOperator(ctx, bridge))
+def nested_loop_build_factory(op_id: int, bridge: NestedLoopBridge,
+                              schema_cols=None):
+    return _SimpleFactory(
+        op_id, "nl_build",
+        lambda ctx: NestedLoopBuildOperator(ctx, bridge, schema_cols))
 
 
 def nested_loop_join_factory(op_id: int, bridge: NestedLoopBridge):

@@ -172,6 +172,7 @@ class LocalExecutionPlanner:
             "target_splits", 4))
         handle = node.handle
         task = self.task
+        constraint = node.constraint
 
         def batch_iter():
             import jax as _jax
@@ -182,7 +183,8 @@ class LocalExecutionPlanner:
                 # (reference: NodeScheduler.java:65 split placement)
                 splits = splits[task.index::task.count]
             for s in splits:
-                for b in conn.page_source.batches(s, columns, batch_rows):
+                for b in conn.page_source.batches(s, columns, batch_rows,
+                                                  constraint):
                     b = b.rename(rename)
                     if task.device is not None:
                         b = _jax.device_put(b, task.device)
@@ -281,7 +283,9 @@ class LocalExecutionPlanner:
             build_pipe: List = []
             self._visit(node.right, build_pipe)
             build_pipe.append(misc_ops.nested_loop_build_factory(
-                self._next_id(), bridge))
+                self._next_id(), bridge,
+                [(f.symbol, f.type, f.dictionary)
+                 for f in node.right.output]))
             self._pipelines.append(build_pipe)
             self._visit(node.left, pipe)
             pipe.append(misc_ops.nested_loop_join_factory(
@@ -300,7 +304,9 @@ class LocalExecutionPlanner:
             self._visit(build, build_pipe)
             build_pipe.append(HashBuildOperatorFactory(
                 self._next_id(), bridge, [r for _, r in criteria],
-                key_dicts))
+                key_dicts,
+                schema_cols=[(f.symbol, f.type, f.dictionary)
+                             for f in build.output]))
             self._pipelines.append(build_pipe)
             self._visit(probe, pipe)
             pipe.append(LookupJoinOperatorFactory(
@@ -332,7 +338,9 @@ class LocalExecutionPlanner:
         build_pipe: List = []
         self._visit(node.filtering_source, build_pipe)
         build_pipe.append(HashBuildOperatorFactory(
-            self._next_id(), bridge, [node.filtering_key], key_dicts))
+            self._next_id(), bridge, [node.filtering_key], key_dicts,
+            schema_cols=[(f.symbol, f.type, f.dictionary)
+                         for f in node.filtering_source.output]))
         self._pipelines.append(build_pipe)
         self._visit(node.source, pipe)
         pipe.append(SemiJoinOperatorFactory(

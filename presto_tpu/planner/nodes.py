@@ -47,6 +47,10 @@ class TableScanNode(PlanNode):
     # output symbol -> connector column name
     assignments: Dict[str, str]
     output: Tuple[Field, ...]
+    # pushed-down (unenforced) per-column constraint; the planner keeps
+    # the originating filter (reference: TableScanNode's enforced/
+    # unenforced TupleDomain split)
+    constraint: Any = None
 
 
 @dataclasses.dataclass

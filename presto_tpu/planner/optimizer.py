@@ -18,7 +18,95 @@ from presto_tpu.types import BOOLEAN
 
 
 def optimize(root: N.PlanNode) -> N.PlanNode:
-    return _rewrite(root)
+    root = _rewrite(root)
+    _push_scan_constraints(root)
+    return root
+
+
+def _push_scan_constraints(node: N.PlanNode,
+                           _seen: Optional[set] = None) -> None:
+    """Derive TupleDomains from Filter-over-TableScan conjuncts and
+    attach them to the scan (reference: PickTableLayout /
+    PredicatePushDown into ConnectorPageSourceProvider). The filter
+    stays in the plan — pushdown is advisory; connectors that honor it
+    shrink generation/decode/transfer work."""
+    seen = _seen if _seen is not None else set()
+    if id(node) in seen:
+        return
+    seen.add(id(node))
+    if isinstance(node, N.FilterNode) and \
+            isinstance(node.source, N.TableScanNode):
+        dom = _extract_domains(node.predicate, node.source)
+        if dom:
+            node.source.constraint = dom
+    for s in node.sources():
+        _push_scan_constraints(s, seen)
+
+
+def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
+    from presto_tpu.connectors.spi import Domain, TupleDomain
+    sym_to_col = dict(scan.assignments)
+    # only physical-value comparisons push down (strings are
+    # dictionary-coded per batch, so their codes are not stable)
+    ok_types = {"bigint", "integer", "double", "date", "boolean"}
+    doms: Dict[str, Dict[str, object]] = {}
+
+    def note(sym: str, kind: str, value):
+        col = sym_to_col.get(sym)
+        if col is None:
+            return
+        d = doms.setdefault(col, {})
+        if kind == "low":
+            d["low"] = value if "low" not in d else max(d["low"], value)
+        elif kind == "high":
+            d["high"] = value if "high" not in d \
+                else min(d["high"], value)
+        else:  # in-set intersection
+            vs = set(value)
+            d["values"] = tuple(sorted(vs & set(d["values"]))) \
+                if "values" in d else tuple(sorted(vs))
+
+    from presto_tpu.expr.ir import Literal
+    for c in _split_conjuncts(pred):
+        if isinstance(c, SpecialForm) and c.form == "in":
+            v, *items = c.args
+            if isinstance(v, InputRef) and v.type.name in ok_types \
+                    and all(isinstance(i, Literal)
+                            and i.value is not None for i in items):
+                note(v.name, "in", [i.value for i in items])
+            continue
+        if isinstance(c, Call) and len(c.args) == 2:
+            a, b = c.args
+            if isinstance(b, InputRef) and not isinstance(a, InputRef):
+                a, b = b, a
+                flip = {"less_than": "greater_than",
+                        "less_than_or_equal": "greater_than_or_equal",
+                        "greater_than": "less_than",
+                        "greater_than_or_equal": "less_than_or_equal",
+                        "equal": "equal"}
+                if c.name not in flip:
+                    continue
+                name = flip[c.name]
+            else:
+                name = c.name
+            if not (isinstance(a, InputRef) and isinstance(b, Literal)
+                    and a.type.name in ok_types
+                    and b.value is not None):
+                continue
+            v = b.value
+            if name == "equal":
+                note(a.name, "low", v)
+                note(a.name, "high", v)
+            elif name in ("less_than", "less_than_or_equal"):
+                note(a.name, "high", v)  # open bounds kept closed:
+                # the engine's filter still enforces strictness
+            elif name in ("greater_than", "greater_than_or_equal"):
+                note(a.name, "low", v)
+    if not doms:
+        return None
+    return TupleDomain(tuple(
+        (col, Domain(d.get("low"), d.get("high"), d.get("values")))
+        for col, d in sorted(doms.items())))
 
 
 def _rewrite(node: N.PlanNode) -> N.PlanNode:

@@ -49,17 +49,23 @@ class CatalogManager:
     def catalogs(self) -> List[str]:
         return sorted(self._connectors)
 
+    @staticmethod
+    def handle_for(parts: Tuple[str, ...],
+                   session: Session) -> TableHandle:
+        """Qualified name -> TableHandle with session defaults filled
+        in (the one place name resolution lives)."""
+        if len(parts) == 1:
+            return TableHandle(session.catalog, session.schema,
+                               parts[0])
+        if len(parts) == 2:
+            return TableHandle(session.catalog, parts[0], parts[1])
+        if len(parts) == 3:
+            return TableHandle(parts[0], parts[1], parts[2])
+        raise QueryError(f"invalid table name {'.'.join(parts)}")
+
     def resolve_table(self, parts: Tuple[str, ...], session: Session
                       ) -> Tuple[TableHandle, RelationSchema]:
-        if len(parts) == 1:
-            handle = TableHandle(session.catalog, session.schema,
-                                 parts[0])
-        elif len(parts) == 2:
-            handle = TableHandle(session.catalog, parts[0], parts[1])
-        elif len(parts) == 3:
-            handle = TableHandle(parts[0], parts[1], parts[2])
-        else:
-            raise QueryError(f"invalid table name {'.'.join(parts)}")
+        handle = self.handle_for(parts, session)
         conn = self.connector(handle.catalog)
         try:
             schema = conn.metadata.get_table_schema(handle)
@@ -101,9 +107,14 @@ class MaterializedResult:
 class LocalRunner:
     def __init__(self, catalog: str = "tpch", schema: str = "tiny",
                  properties: Optional[Dict[str, Any]] = None):
+        from presto_tpu.connectors.memory import (
+            BlackholeConnector, MemoryConnector,
+        )
         from presto_tpu.connectors.tpch import TpchConnector
         self.catalogs = CatalogManager()
         self.catalogs.register("tpch", TpchConnector())
+        self.catalogs.register("memory", MemoryConnector())
+        self.catalogs.register("blackhole", BlackholeConnector())
         self.session = Session(catalog, schema, dict(properties or {}))
 
     def register_connector(self, name: str, connector: Connector):
@@ -120,6 +131,12 @@ class LocalRunner:
             return self._show(stmt)
         if isinstance(stmt, T.SetSession):
             return self._set_session(stmt)
+        if isinstance(stmt, T.CreateTableAs):
+            return self._create_table_as(stmt)
+        if isinstance(stmt, T.InsertInto):
+            return self._insert_into(stmt)
+        if isinstance(stmt, T.DropTable):
+            return self._drop_table(stmt)
         if not isinstance(stmt, T.Query):
             raise QueryError(
                 f"unsupported statement {type(stmt).__name__}")
@@ -188,6 +205,127 @@ class LocalRunner:
                 raise QueryError("query did not converge (deadlock?)")
         for d in drivers:
             d.close()
+
+    # -- DDL / DML ------------------------------------------------------
+
+    def _handle_for(self, parts: Tuple[str, ...]) -> TableHandle:
+        return CatalogManager.handle_for(parts, self.session)
+
+    def _sink_for(self, handle: TableHandle):
+        conn = self.catalogs.connector(handle.catalog)
+        sink = conn.page_sink
+        if sink is None:
+            raise QueryError(
+                f"catalog {handle.catalog!r} does not support writes")
+        return sink
+
+    def _run_query_for_write(self, q: T.Query) -> MaterializedResult:
+        try:
+            plan = plan_statement(q, self.catalogs, self.session)
+        except AnalysisError as e:
+            raise QueryError(str(e)) from e
+        from presto_tpu.planner.optimizer import optimize
+        return self._run_plan(optimize(plan))
+
+    def _create_table_as(self, stmt: T.CreateTableAs
+                         ) -> MaterializedResult:
+        from presto_tpu.schema import ColumnSchema, RelationSchema
+        handle = self._handle_for(stmt.name)
+        sink = self._sink_for(handle)
+        conn = self.catalogs.connector(handle.catalog)
+        try:
+            conn.metadata.get_table_schema(handle)
+            exists = True
+        except KeyError:
+            exists = False
+        if exists:
+            if stmt.if_not_exists:
+                return self._text_result("result",
+                                         ["CREATE TABLE skipped"])
+            raise QueryError(f"table {handle} already exists")
+        result = self._run_query_for_write(stmt.query)
+        if len(set(result.names)) != len(result.names):
+            raise QueryError(
+                "CREATE TABLE AS query has duplicate column names; "
+                "alias them")
+        schema = RelationSchema([
+            ColumnSchema(n, f.type, f.dictionary)
+            for n, f in zip(result.names, result.fields)])
+        sink.create_table(handle, schema)
+        rename = {f.symbol: n
+                  for f, n in zip(result.fields, result.names)}
+        for b in result.batches:
+            sink.append(handle, b.rename(rename).select(result.names))
+        sink.finish(handle)
+        return self._text_result(
+            "result", [f"CREATE TABLE: {result.row_count} rows"])
+
+    def _insert_into(self, stmt: T.InsertInto) -> MaterializedResult:
+        import jax.numpy as jnp
+        from presto_tpu.batch import Column
+        handle = self._handle_for(stmt.name)
+        sink = self._sink_for(handle)
+        conn = self.catalogs.connector(handle.catalog)
+        try:
+            schema = conn.metadata.get_table_schema(handle)
+        except KeyError:
+            raise QueryError(f"table {handle} does not exist") from None
+        target_cols = stmt.columns or [c.name for c in schema.columns]
+        known = {c.name for c in schema.columns}
+        unknown = [c for c in target_cols if c not in known]
+        if unknown:
+            raise QueryError(
+                f"INSERT target column(s) {unknown} do not exist "
+                f"in {handle}")
+        if len(set(target_cols)) != len(target_cols):
+            raise QueryError("INSERT target columns must be distinct")
+        result = self._run_query_for_write(stmt.query)
+        if len(result.fields) != len(target_cols):
+            raise QueryError(
+                f"INSERT has {len(result.fields)} columns but "
+                f"{len(target_cols)} targets")
+        # INSERT matches by POSITION (duplicate query names are fine):
+        # target column name -> source symbol
+        by_target = dict(zip(target_cols,
+                             (f.symbol for f in result.fields)))
+        field_of = {f.symbol: f for f in result.fields}
+        for cs in schema.columns:
+            src = by_target.get(cs.name)
+            if src is None:
+                continue
+            ft = field_of[src]
+            if ft.type.name != cs.type.name:
+                raise QueryError(
+                    f"INSERT type mismatch on {cs.name}: "
+                    f"{ft.type.display()} vs {cs.type.display()}")
+        for b in result.batches:
+            cols = {}
+            for cs in schema.columns:
+                src = by_target.get(cs.name)
+                if src is not None:
+                    cols[cs.name] = b.columns[src]
+                else:  # unspecified target column -> NULLs
+                    cols[cs.name] = Column(
+                        jnp.zeros(b.capacity, cs.type.np_dtype),
+                        jnp.zeros(b.capacity, bool), cs.type,
+                        () if cs.type.is_string else None)
+            sink.append(handle, Batch(cols, b.row_valid))
+        sink.finish(handle)
+        return self._text_result(
+            "result", [f"INSERT: {result.row_count} rows"])
+
+    def _drop_table(self, stmt: T.DropTable) -> MaterializedResult:
+        handle = self._handle_for(stmt.name)
+        sink = self._sink_for(handle)
+        conn = self.catalogs.connector(handle.catalog)
+        try:
+            conn.metadata.get_table_schema(handle)
+        except KeyError:
+            if stmt.if_exists:
+                return self._text_result("result", ["DROP skipped"])
+            raise QueryError(f"table {handle} does not exist") from None
+        sink.drop_table(handle)
+        return self._text_result("result", ["DROP TABLE"])
 
     # -- metadata statements -------------------------------------------
 
