@@ -1,0 +1,3 @@
+"""Execution-control subsystems: memory accounting (reference:
+presto-memory-context + memory/MemoryPool.java) and, over time, the
+rest of the worker-side execution layer."""

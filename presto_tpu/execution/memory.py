@@ -1,0 +1,69 @@
+"""HBM accounting (reference: memory/MemoryPool.java:45 reserve:112 +
+presto-memory-context's hierarchical operator contexts).
+
+One pool per query bounds what materializing operators (sort, window,
+join builds, spools, exchange buffers) may pin in device memory.
+Reservations are HOST-side estimates from array byte sizes — exact for
+our fixed-capacity batches — so the hot path never syncs the device.
+On exhaustion the pool raises MemoryLimitExceeded; the MeshRunner
+reacts by re-running bucket-wise (grouped execution, the Lifespan
+analog — execution/Lifespan.java:26), trading one pass for G smaller
+ones instead of dying like a plain OOM would.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from presto_tpu.batch import Batch
+
+
+class MemoryLimitExceeded(Exception):
+    def __init__(self, tag: str, requested: int, reserved: int,
+                 budget: int):
+        super().__init__(
+            f"memory budget exceeded by {tag}: requested {requested:,}B "
+            f"with {reserved:,}B reserved of {budget:,}B")
+        self.tag = tag
+        self.requested = requested
+
+
+def batch_bytes(b: Batch) -> int:
+    return sum(c.data.dtype.itemsize * c.data.size
+               + c.mask.dtype.itemsize * c.mask.size
+               for c in b.columns.values()) \
+        + b.row_valid.dtype.itemsize * b.row_valid.size
+
+
+class MemoryPool:
+    """Per-query device-memory ledger. `budget` None = unlimited
+    (accounting still tracks peaks for EXPLAIN ANALYZE)."""
+
+    def __init__(self, budget: Optional[int] = None):
+        self.budget = budget
+        self.reserved = 0
+        self.peak = 0
+        self._by_tag: Dict[str, int] = {}
+        self.peak_by_tag: Dict[str, int] = {}
+
+    def reserve(self, tag: str, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        if self.budget is not None \
+                and self.reserved + nbytes > self.budget:
+            raise MemoryLimitExceeded(tag, nbytes, self.reserved,
+                                      self.budget)
+        self.reserved += nbytes
+        self._by_tag[tag] = self._by_tag.get(tag, 0) + nbytes
+        self.peak = max(self.peak, self.reserved)
+        self.peak_by_tag[tag] = max(self.peak_by_tag.get(tag, 0),
+                                    self._by_tag[tag])
+
+    def free(self, tag: str, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        self.reserved -= nbytes
+        self._by_tag[tag] = self._by_tag.get(tag, 0) - nbytes
+
+    def free_all(self, tag: str) -> None:
+        self.reserved -= self._by_tag.pop(tag, 0)

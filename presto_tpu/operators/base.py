@@ -15,15 +15,33 @@ from presto_tpu.batch import Batch
 @dataclasses.dataclass
 class OperatorStats:
     """Per-operator counters surfaced through EXPLAIN ANALYZE / REST
-    (reference: operator/OperatorStats.java)."""
+    (reference: operator/OperatorStats.java).
+
+    Row counts accumulate as DEVICE scalars (async adds, no host sync
+    on the hot path) and materialize once when the query drains; busy
+    time is only meaningful in profiled runs, where the driver blocks
+    on each operator's output (device-inclusive timing)."""
     input_batches: int = 0
     input_rows: int = 0
     output_batches: int = 0
     output_rows: int = 0
     busy_seconds: float = 0.0
+    input_rows_dev: Any = None
+    output_rows_dev: Any = None
+
+    def materialize(self) -> None:
+        """One host sync per counter, at drain time."""
+        if self.input_rows_dev is not None:
+            self.input_rows = int(self.input_rows_dev)
+        if self.output_rows_dev is not None:
+            self.output_rows = int(self.output_rows_dev)
 
     def snapshot(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        self.materialize()
+        d = dataclasses.asdict(self)
+        d.pop("input_rows_dev")
+        d.pop("output_rows_dev")
+        return d
 
 
 @dataclasses.dataclass
@@ -31,6 +49,9 @@ class DriverContext:
     """Execution context shared by the operators of one driver."""
     session: Any = None
     memory: Any = None  # MemoryContext, wired in execution/memory.py
+    #: profiled execution (EXPLAIN ANALYZE): count rows per operator and
+    #: time each output with a device barrier
+    profile: bool = False
 
 
 class OperatorContext:
@@ -40,6 +61,24 @@ class OperatorContext:
         self.name = name
         self.driver_context = driver_context
         self.stats = OperatorStats()
+        # pool tag must be unique per operator INSTANCE: operator ids
+        # restart per planner, and mesh tasks/lifespan generations all
+        # share one query pool
+        self.tag = f"{name}#{operator_id}@{id(self):x}"
+
+    # -- memory accounting (reference: OperatorContext's local memory
+    # context chaining up to the query MemoryPool) --------------------
+
+    def reserve_batch(self, batch: Batch) -> None:
+        pool = self.driver_context.memory
+        if pool is not None:
+            from presto_tpu.execution.memory import batch_bytes
+            pool.reserve(self.tag, batch_bytes(batch))
+
+    def release_all(self) -> None:
+        pool = self.driver_context.memory
+        if pool is not None:
+            pool.free_all(self.tag)
 
 
 class Operator(abc.ABC):
@@ -80,11 +119,23 @@ class Operator(abc.ABC):
     # -- stats helpers ------------------------------------------------------
 
     def _count_in(self, batch: Batch) -> None:
-        self.ctx.stats.input_batches += 1
+        s = self.ctx.stats
+        s.input_batches += 1
+        if self.ctx.driver_context.profile:
+            import jax.numpy as jnp
+            n = jnp.sum(batch.row_valid)
+            s.input_rows_dev = n if s.input_rows_dev is None \
+                else s.input_rows_dev + n
 
     def _count_out(self, batch: Optional[Batch]) -> Optional[Batch]:
         if batch is not None:
-            self.ctx.stats.output_batches += 1
+            s = self.ctx.stats
+            s.output_batches += 1
+            if self.ctx.driver_context.profile:
+                import jax.numpy as jnp
+                n = jnp.sum(batch.row_valid)
+                s.output_rows_dev = n if s.output_rows_dev is None \
+                    else s.output_rows_dev + n
         return batch
 
 

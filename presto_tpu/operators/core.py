@@ -40,9 +40,7 @@ class ValuesOperator(SourceOperator):
 
     def get_output(self) -> Optional[Batch]:
         if self._batches:
-            b = self._batches.pop(0)
-            self.ctx.stats.output_batches += 1
-            return b
+            return self._count_out(self._batches.pop(0))
         self._finished = True
         return None
 
@@ -86,10 +84,9 @@ class TableScanOperator(SourceOperator):
         except StopIteration:
             self._finished = True
             return None
-        self.ctx.stats.output_batches += 1
-        # (live-row counts would force a device sync per batch; row stats
-        #  are filled in lazily by EXPLAIN ANALYZE, not on the hot path)
-        return b
+        # (live-row counts stay device-side; EXPLAIN ANALYZE
+        #  materializes them once at drain)
+        return self._count_out(b)
 
     def finish(self) -> None:
         pass

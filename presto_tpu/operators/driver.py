@@ -38,6 +38,7 @@ class Driver:
     def _process_once(self) -> bool:
         ops = self.operators
         moved = False
+        profile = ops[0].ctx.driver_context.profile
         # walk adjacent pairs, moving at most one batch per pair
         # (Driver.processInternal:371)
         for i in range(len(ops) - 1):
@@ -47,6 +48,13 @@ class Driver:
             if nxt.needs_input() and not current.is_finished():
                 t0 = time.perf_counter()
                 batch = current.get_output()
+                if profile and batch is not None:
+                    # device-inclusive timing: charge this operator for
+                    # the async work its output depends on (profiled
+                    # runs trade pipeline overlap for attribution, like
+                    # the reference's EXPLAIN ANALYZE overhead)
+                    import jax
+                    jax.block_until_ready(batch)
                 current.ctx.stats.busy_seconds += time.perf_counter() - t0
                 if batch is not None:
                     t0 = time.perf_counter()
@@ -88,4 +96,5 @@ class Driver:
         if not self._closed:
             for op in self.operators:
                 op.close()
+                op.ctx.release_all()
             self._closed = True

@@ -41,12 +41,24 @@ from presto_tpu.parallel.shuffle import wave_repartition
 
 
 class MeshExchange:
-    """One exchange edge: N producer tasks -> M consumer task queues."""
+    """One exchange edge: N producer tasks -> M consumer task queues.
+
+    Grouped (bucket-wise) execution: with `lifespans` G > 1 the hash
+    space is split W x G (reference: execution/Lifespan.java:26 driver
+    groups); rows for the CURRENT lifespan queue on their consumer's
+    device, rows for later lifespans spill to HOST memory (the TPU
+    analog of Presto's disk spill — HBM is the scarce tier, host RAM
+    is the big one) and return to the device when advance_lifespan()
+    starts their bucket. Producers that themselves run bucket-wise
+    signal done once per lifespan; `producer_finishes` sets how many
+    signals complete one producer."""
 
     def __init__(self, exchange_id: int, scheme: str,
                  partition_keys: Sequence[str],
                  hash_dicts, key_dictionaries,
-                 mesh, n_producers: int, n_consumers: int):
+                 mesh, n_producers: int, n_consumers: int,
+                 lifespans: int = 1, producer_finishes: int = 1,
+                 pool=None):
         self.exchange_id = exchange_id
         self.scheme = scheme
         self.partition_keys = list(partition_keys)
@@ -55,14 +67,24 @@ class MeshExchange:
             else [None]
         self.n_producers = n_producers
         self.n_consumers = n_consumers
+        self.lifespans = lifespans
+        self.current_lifespan = 0
+        self.pool = pool
+        self._tag = f"exchange#{exchange_id}"
+        self._finish_signals = [0] * n_producers
+        self._finishes_required = producer_finishes
         self.queues: List[collections.deque] = [
             collections.deque() for _ in range(n_consumers)]
+        # host-spooled batches per (lifespan, consumer), numpy pytrees
+        self._spooled: Dict[int, List[collections.deque]] = {
+            g: [collections.deque() for _ in range(n_consumers)]
+            for g in range(1, lifespans)
+        }
         self._pending: List[collections.deque] = [
             collections.deque() for _ in range(n_producers)]
         self._done = [False] * n_producers
         self._template: Optional[Batch] = None
         self._rr = 0
-        self._flushed = False
         # per-key remap tables: original dictionary codes -> unified
         # hash dictionary codes (None for non-string keys)
         self._remaps = None
@@ -77,6 +99,22 @@ class MeshExchange:
                         np.array([index[v] for v in dic] or [0],
                                  dtype=np.int32)))
 
+    # -- memory accounting -------------------------------------------------
+
+    def _reserve(self, batch: Batch) -> None:
+        if self.pool is not None:
+            from presto_tpu.execution.memory import batch_bytes
+            self.pool.reserve(self._tag, batch_bytes(batch))
+
+    def _free(self, batch: Batch) -> None:
+        if self.pool is not None:
+            from presto_tpu.execution.memory import batch_bytes
+            self.pool.free(self._tag, batch_bytes(batch))
+
+    def _enqueue(self, consumer: int, batch: Batch) -> None:
+        self._reserve(batch)
+        self.queues[consumer].append(batch)
+
     # -- producer side -----------------------------------------------------
 
     def push(self, producer: int, batch: Batch) -> None:
@@ -84,19 +122,20 @@ class MeshExchange:
             self._template = batch
         scheme = self.scheme
         if scheme == "gather":
-            self.queues[0].append(self._place(batch, 0))
+            self._enqueue(0, self._place(batch, 0))
         elif scheme == "broadcast":
             for c in range(self.n_consumers):
-                self.queues[c].append(self._place(batch, c))
+                self._enqueue(c, self._place(batch, c))
         elif scheme == "passthrough":
-            self.queues[producer].append(batch)
+            self._enqueue(producer, batch)
         elif scheme == "repartition" and not self.partition_keys:
             c = self._rr % self.n_consumers
             self._rr += 1
-            self.queues[c].append(self._place(batch, c))
+            self._enqueue(c, self._place(batch, c))
         elif scheme == "repartition":
-            if self.n_consumers == 1 and self.n_producers == 1:
-                self.queues[0].append(batch)
+            if self.n_consumers == 1 and self.n_producers == 1 \
+                    and self.lifespans == 1:
+                self._enqueue(0, batch)
             elif self._collective:
                 self._pending[producer].append(batch)
                 self._try_wave()
@@ -106,17 +145,80 @@ class MeshExchange:
             raise ValueError(f"unknown exchange scheme {scheme}")
 
     def producer_done(self, producer: int) -> None:
-        if not self._done[producer]:
+        self._finish_signals[producer] += 1
+        if self._finish_signals[producer] >= self._finishes_required \
+                and not self._done[producer]:
             self._done[producer] = True
             if self.scheme == "repartition" and self.partition_keys \
                     and self._collective:
                 self._try_wave()
 
+    # -- lifespans ---------------------------------------------------------
+
+    def lifespan_drained(self) -> bool:
+        """Current bucket fully delivered and consumed?"""
+        return (all(self._done) and not any(self._pending)
+                and not any(self.queues))
+
+    def has_next_lifespan(self) -> bool:
+        return self.current_lifespan + 1 < self.lifespans
+
+    def advance_lifespan(self) -> None:
+        """Reload the next bucket's host-spooled batches onto their
+        consumer devices."""
+        self.current_lifespan += 1
+        g = self.current_lifespan
+        for c, dq in enumerate(self._spooled.pop(g, [])):
+            while dq:
+                host_batch = dq.popleft()
+                self._enqueue(c, jax.device_put(
+                    host_batch, self.devices[c]
+                    if c < len(self.devices) else self.devices[0]))
+
+    def _key_hash(self, batch: Batch):
+        """|hash| of the partition keys, through the unified-dictionary
+        remaps (the one place this is computed)."""
+        cols = []
+        for i, k in enumerate(self.partition_keys):
+            c = batch.columns[k]
+            d = c.data
+            if self._remaps is not None and self._remaps[i] is not None:
+                d = self._remaps[i][d]
+            cols.append((d, c.mask))
+        return jnp.abs(common.row_hash(cols))
+
+    def _lifespan_of(self, h):
+        return (h // max(self.n_consumers, 1)) % self.lifespans
+
+    def _deliver_buckets(self, consumer: int, columns, base_mask,
+                         g_of_row) -> None:
+        """Current bucket to the consumer's device queue; later buckets
+        spill to host (numpy pytrees, no HBM reserved)."""
+        for g in range(self.current_lifespan, self.lifespans):
+            part = Batch(columns, base_mask & (g_of_row == g))
+            if g == self.current_lifespan:
+                self._enqueue(consumer, part)
+            else:
+                self._spooled[g][consumer].append(
+                    jax.device_get(part))
+
+    def _route_lifespan(self, consumer: int, batch: Batch) -> None:
+        if self.lifespans == 1:
+            self._enqueue(consumer, batch)
+            return
+        g_of_row = self._lifespan_of(self._key_hash(batch))
+        self._deliver_buckets(consumer, batch.columns, batch.row_valid,
+                              g_of_row)
+
     # -- consumer side -----------------------------------------------------
 
     def pop(self, consumer: int) -> Optional[Batch]:
         q = self.queues[consumer]
-        return q.popleft() if q else None
+        if not q:
+            return None
+        b = q.popleft()
+        self._free(b)
+        return b
 
     def has_output(self, consumer: int) -> bool:
         return bool(self.queues[consumer])
@@ -144,19 +246,23 @@ class MeshExchange:
     def _hash_split(self, batch: Batch) -> None:
         """Non-collective repartition (producer/consumer counts differ
         from the mesh width, e.g. a single VALUES fragment spreading to
-        W workers): split one batch by hash, route each slice."""
-        cols = []
-        for i, k in enumerate(self.partition_keys):
-            c = batch.columns[k]
-            d = c.data
-            if self._remaps is not None and self._remaps[i] is not None:
-                d = self._remaps[i][d]
-            cols.append((d, c.mask))
-        h = common.row_hash(cols)
-        dest = (jnp.abs(h) % self.n_consumers).astype(jnp.int32)
+        W workers): split one batch by hash, route each slice. The key
+        hash is computed once for both destination and lifespan."""
+        h = self._key_hash(batch)
+        dest = (h % self.n_consumers).astype(jnp.int32)
+        g_of_row = self._lifespan_of(h) if self.lifespans > 1 else None
         for c in range(self.n_consumers):
-            part = Batch(batch.columns, batch.row_valid & (dest == c))
-            self.queues[c].append(self._place(part, c))
+            part = self._place(
+                Batch(batch.columns, batch.row_valid & (dest == c)), c)
+            if g_of_row is None:
+                self._enqueue(c, part)
+            else:
+                self._deliver_buckets(c, part.columns, part.row_valid,
+                                      jax.device_put(
+                                          g_of_row,
+                                          self.devices[c])
+                                      if self.devices[c] is not None
+                                      else g_of_row)
 
     def _pad_batch(self, cap: int, producer: int) -> Batch:
         t = self._template
@@ -185,7 +291,7 @@ class MeshExchange:
                                     self.partition_keys,
                                     key_remaps=self._remaps)
             for c, b in enumerate(outs):
-                self.queues[c].append(b)
+                self._route_lifespan(c, b)
 
 
 class ExchangeSinkOperator(Operator):

@@ -58,6 +58,8 @@ class HashBuildOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        self.ctx.reserve_batch(batch)  # held until close: the built
+        # table the bridge exposes is the same order of magnitude
         self._batches.append(_remap_keys(batch, self.key_names,
                                          self.key_dicts))
 

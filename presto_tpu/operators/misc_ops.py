@@ -41,6 +41,7 @@ class NestedLoopBuildOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        self.ctx.reserve_batch(batch)
         self._batches.append(batch)
 
     def get_output(self) -> Optional[Batch]:
@@ -351,6 +352,7 @@ class SpoolSinkOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        self.ctx.reserve_batch(batch)
         self.spool.batches.append(batch)
 
     def get_output(self) -> Optional[Batch]:

@@ -33,6 +33,7 @@ class OrderByOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        self.ctx.reserve_batch(batch)
         self._batches.append(batch)
 
     def get_output(self) -> Optional[Batch]:
@@ -50,6 +51,7 @@ class OrderByOperator(Operator):
         self._batches = []
         out = sort_kernels.sort_batch(merged, self.key_names,
                                       self.descending, self.nulls_first)
+        self.ctx.release_all()  # accumulated input handed downstream
         return self._count_out(out)
 
     def finish(self) -> None:

@@ -38,6 +38,7 @@ class WindowOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        self.ctx.reserve_batch(batch)
         self._batches.append(batch)
 
     def get_output(self) -> Optional[Batch]:
@@ -54,6 +55,7 @@ class WindowOperator(Operator):
         out = window_kernel(merged, self.part_names, self.order_names,
                             self.descending, self.nulls_first,
                             self.calls)
+        self.ctx.release_all()
         return self._count_out(out)
 
     def finish(self) -> None:

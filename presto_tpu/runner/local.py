@@ -154,14 +154,27 @@ class LocalRunner:
             raise QueryError("create_plan expects a query")
         return plan_statement(stmt, self.catalogs, self.session)
 
-    def _run_plan(self, plan: N.OutputNode) -> MaterializedResult:
+    def _run_plan(self, plan: N.OutputNode,
+                  profile: bool = False) -> MaterializedResult:
+        from presto_tpu.execution.memory import MemoryPool
         from presto_tpu.operators.aggregation import GroupLimitExceeded
+        import time as _time
         session = self.session
         while True:
             planner = LocalExecutionPlanner(self.catalogs, session)
             lplan = planner.plan(plan)
+            t0 = _time.perf_counter()
+            budget = session.properties.get("hbm_budget_bytes")
+            pool = MemoryPool(int(budget) if budget else None)
+            from presto_tpu.execution.memory import MemoryLimitExceeded
             try:
-                self._drive(lplan)
+                drivers = self.drive_pipelines(lplan.pipelines,
+                                               profile=profile,
+                                               pool=pool)
+            except MemoryLimitExceeded as e:
+                raise QueryError(
+                    f"{e} — raise hbm_budget_bytes or run on a "
+                    "MeshRunner, which retries bucket-wise") from e
             except GroupLimitExceeded as e:
                 # group-by table overflowed: re-run the whole query with a
                 # larger table (query-level retry keeps the per-batch hot
@@ -173,20 +186,23 @@ class LocalRunner:
                     session, properties={**session.properties,
                                          "max_groups": e.suggested})
                 continue
+            if profile:
+                # snapshot the stats TEXT now and drop the driver refs:
+                # holding operators would pin their buffered device
+                # batches for the runner's lifetime
+                self._last_profile = self._render_operator_stats(
+                    drivers, _time.perf_counter() - t0, pool)
             return MaterializedResult(lplan.result_names, lplan.result_sink,
                                       lplan.result_fields)
 
     @staticmethod
-    def _drive(lplan: LocalExecutionPlan,
-               max_rounds: int = 2_000_000) -> None:
-        LocalRunner.drive_pipelines(lplan.pipelines, max_rounds)
-
-    @staticmethod
     def drive_pipelines(pipelines: List[List],
-                        max_rounds: int = 2_000_000) -> None:
+                        max_rounds: int = 2_000_000,
+                        profile: bool = False,
+                        pool=None) -> List[Driver]:
         """Round-robin all drivers to completion (the TaskExecutor
         stand-in; shared by the local and mesh runners)."""
-        dctx = DriverContext()
+        dctx = DriverContext(profile=profile, memory=pool)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
         rounds = 0
@@ -205,6 +221,7 @@ class LocalRunner:
                 raise QueryError("query did not converge (deadlock?)")
         for d in drivers:
             d.close()
+        return drivers
 
     # -- DDL / DML ------------------------------------------------------
 
@@ -339,12 +356,41 @@ class LocalRunner:
         plan = optimize(plan)
         prune_unused_columns(plan)
         if stmt.analyze:
-            result = self._run_plan(plan)
-            text = N.plan_text(plan) + \
+            result = self._run_plan(plan, profile=True)
+            text = N.plan_text(plan) + "\n\n" + self._last_profile + \
                 f"\n-- rows: {result.row_count}"
         else:
             text = N.plan_text(plan)
         return self._text_result("Query Plan", text.split("\n"))
+
+    @staticmethod
+    def _render_operator_stats(drivers: List[Driver], wall: float,
+                               pool=None) -> str:
+        """Per-operator execution stats (reference: planPrinter's
+        EXPLAIN ANALYZE fragment rendering over OperatorStats)."""
+        lines = []
+        busy_total = 0.0
+        peaks = pool.peak_by_tag if pool is not None else {}
+        for pi, d in enumerate(drivers):
+            lines.append(f"Pipeline {pi}:")
+            for op in reversed(d.operators):
+                s = op.ctx.stats
+                s.materialize()
+                busy_total += s.busy_seconds
+                mem = peaks.get(op.ctx.tag, 0)
+                mem_s = f"  peak mem: {mem / 1e6:.1f}MB" if mem else ""
+                lines.append(
+                    f"  {op.ctx.name} [id={op.ctx.operator_id}]  "
+                    f"rows: {s.input_rows:,} -> {s.output_rows:,}  "
+                    f"batches: {s.input_batches} -> "
+                    f"{s.output_batches}  "
+                    f"busy: {s.busy_seconds * 1e3:.1f}ms{mem_s}")
+        lines.append(f"wall: {wall * 1e3:.1f}ms, "
+                     f"operator busy sum: {busy_total * 1e3:.1f}ms")
+        if pool is not None and pool.peak:
+            lines.append(f"peak reserved device memory: "
+                         f"{pool.peak / 1e6:.1f}MB")
+        return "\n".join(lines)
 
     def _show(self, stmt) -> MaterializedResult:
         if isinstance(stmt, T.ShowCatalogs):

@@ -42,7 +42,9 @@ class MeshRunner(LocalRunner):
 
     # ------------------------------------------------------------------
 
-    def _run_plan(self, plan: N.OutputNode) -> MaterializedResult:
+    def _run_plan(self, plan: N.OutputNode,
+                  profile: bool = False) -> MaterializedResult:
+        from presto_tpu.execution.memory import MemoryLimitExceeded
         from presto_tpu.operators.aggregation import GroupLimitExceeded
         prune_unused_columns(plan)
         plan = add_exchanges(plan, self.catalogs, self.session)
@@ -50,7 +52,7 @@ class MeshRunner(LocalRunner):
         session = self.session
         while True:
             try:
-                return self._run_fragments(fplan, session)
+                return self._run_fragments(fplan, session, profile)
             except GroupLimitExceeded as e:
                 if e.suggested > 1 << 26:
                     raise QueryError(
@@ -58,14 +60,70 @@ class MeshRunner(LocalRunner):
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "max_groups": e.suggested})
+            except MemoryLimitExceeded as e:
+                # grouped (bucket-wise) execution retry: split the hash
+                # space into lifespans so only 1/G of each shuffled
+                # working set is on device at once (P6 — the reference
+                # decides this at plan time from bucketing;
+                # PlanFragmenter.java:243-260)
+                if not any(self._grouped_eligible(fplan, f)
+                           for f in fplan.fragments.values()):
+                    raise QueryError(
+                        f"{e} — no fragment is eligible for bucket-wise "
+                        "execution; raise hbm_budget_bytes") from e
+                cur = int(session.properties.get("lifespans", 1))
+                new = max(cur * 4, 4)
+                if new > 256:
+                    raise QueryError(
+                        f"query exceeds the HBM budget even with {cur} "
+                        f"lifespans: {e}") from e
+                session = dataclasses.replace(
+                    session, properties={**session.properties,
+                                         "lifespans": new})
 
     def _task_count(self, fragment) -> int:
         return 1 if fragment.partitioning == "single" \
             else self.n_workers
 
-    def _run_fragments(self, fplan: FragmentedPlan,
-                       session) -> MaterializedResult:
-        # one MeshExchange per edge
+    @staticmethod
+    def _grouped_eligible(fplan: FragmentedPlan, fragment) -> bool:
+        """A fragment can run bucket-wise iff every input is a KEYED
+        repartition (the lifespan hash then splits groups/join rows
+        consistently) and nothing inside depends on whole-input state
+        across buckets (scans stream splits; unique-id generators would
+        restart per lifespan)."""
+        if fragment.partitioning != "distributed":
+            return False
+        edges = [fplan.edges[x] for x in fragment.source_edges]
+        if not edges or any(e.scheme != "repartition"
+                            or not e.partition_keys for e in edges):
+            return False
+        bad = [False]
+
+        def walk(n):
+            if isinstance(n, (N.TableScanNode, N.AssignUniqueIdNode)):
+                bad[0] = True
+            for s in n.sources():
+                walk(s)
+        walk(fragment.root)
+        return not bad[0]
+
+    def _run_fragments(self, fplan: FragmentedPlan, session,
+                       profile: bool = False) -> MaterializedResult:
+        import time as _time
+        from presto_tpu.execution.memory import MemoryPool
+        from presto_tpu.operators.base import DriverContext
+        from presto_tpu.operators.driver import Driver
+
+        budget = session.properties.get("hbm_budget_bytes")
+        pool = MemoryPool(int(budget) if budget else None)
+        G = int(session.properties.get("lifespans", 1))
+        lifespans_of = {
+            fid: (G if G > 1
+                  and self._grouped_eligible(fplan, frag) else 1)
+            for fid, frag in fplan.fragments.items()
+        }
+
         exchanges: Dict[int, MeshExchange] = {}
         for xid, edge in fplan.edges.items():
             producer = fplan.fragments[edge.producer]
@@ -78,18 +136,24 @@ class MeshRunner(LocalRunner):
                 xid, edge.scheme, edge.partition_keys,
                 edge.hash_dicts, key_dicts, self.mesh,
                 n_producers=self._task_count(producer),
-                n_consumers=self._task_count(consumer))
+                n_consumers=self._task_count(consumer),
+                lifespans=lifespans_of[edge.consumer],
+                producer_finishes=lifespans_of[edge.producer],
+                pool=pool)
 
-        all_pipelines: List[List] = []
+        dctx = DriverContext(profile=profile, memory=pool)
         result = None
-        # producers before consumers: fragment ids are assigned in
-        # bottom-up creation order by the fragmenter
-        for fid in sorted(fplan.fragments,
-                          key=lambda f: (f != fplan.root_id, -f)):
+        all_drivers: List[Driver] = []
+        instance_drivers: Dict[int, List[Driver]] = {}
+        remaining_lifespans: Dict[int, int] = {}
+
+        def spawn_fragment(fid: int) -> List[Driver]:
             fragment = fplan.fragments[fid]
             n_tasks = self._task_count(fragment)
             sink_edges = [exchanges[e.exchange_id]
                           for e in fplan.producer_edges(fid)]
+            created: List[Driver] = []
+            nonlocal result
             for t in range(n_tasks):
                 task = TaskContext(
                     index=t, count=n_tasks,
@@ -101,16 +165,83 @@ class MeshRunner(LocalRunner):
                 if fid == fplan.root_id:
                     assert n_tasks == 1, "root fragment must be single"
                     lplan = planner.plan(fragment.root)
-                    all_pipelines.extend(lplan.pipelines)
+                    pipelines = lplan.pipelines
                     result = lplan
                 else:
-                    all_pipelines.extend(planner.plan_fragment(
-                        fragment.root, sink_edges))
+                    pipelines = planner.plan_fragment(fragment.root,
+                                                      sink_edges)
+                created.extend(Driver([f.create(dctx) for f in pipe])
+                               for pipe in pipelines)
+            return created
+
+        for fid in fplan.fragments:
+            drivers = spawn_fragment(fid)
+            all_drivers.extend(drivers)
+            instance_drivers[fid] = drivers
+            remaining_lifespans[fid] = lifespans_of[fid] - 1
         assert result is not None
-        self.drive_pipelines(all_pipelines)
+
+        t0 = _time.perf_counter()
+        self._drive_phased(fplan, all_drivers, instance_drivers,
+                           remaining_lifespans, exchanges,
+                           spawn_fragment)
+        if profile:
+            self._last_profile = self._render_operator_stats(
+                all_drivers, _time.perf_counter() - t0, pool)
         return MaterializedResult(result.result_names,
                                   result.result_sink,
                                   result.result_fields)
+
+    @staticmethod
+    def _drive_phased(fplan, all_drivers, instance_drivers,
+                      remaining_lifespans, exchanges, spawn_fragment,
+                      max_rounds: int = 2_000_000) -> None:
+        """Round-robin drive with lifespan phases: when the loop stalls
+        because a grouped fragment's current bucket is drained, advance
+        its input exchanges to the next bucket and spawn fresh task
+        instances (reference: SqlTaskExecution's per-driver-group
+        lifecycles, SqlTaskExecution.java:193-207)."""
+        rounds = 0
+        while True:
+            all_done = True
+            progress = False
+            for d in list(all_drivers):
+                if d.is_finished():
+                    continue
+                all_done = False
+                progress = d.process() or progress
+            if all_done:
+                break
+            if not progress:
+                advanced = False
+                for fid, left in remaining_lifespans.items():
+                    if left <= 0:
+                        continue
+                    if not all(d.is_finished()
+                               for d in instance_drivers[fid]):
+                        continue
+                    in_exchanges = [
+                        exchanges[fplan.edges[x].exchange_id]
+                        for x in fplan.fragments[fid].source_edges]
+                    if not all(ex.lifespan_drained()
+                               for ex in in_exchanges):
+                        continue
+                    for d in instance_drivers[fid]:
+                        d.close()
+                    for ex in in_exchanges:
+                        ex.advance_lifespan()
+                    fresh = spawn_fragment(fid)
+                    instance_drivers[fid] = fresh
+                    all_drivers.extend(fresh)
+                    remaining_lifespans[fid] = left - 1
+                    advanced = True
+                if advanced:
+                    continue
+            rounds += 1
+            if rounds > max_rounds:
+                raise QueryError("query did not converge (deadlock?)")
+        for d in all_drivers:
+            d.close()
 
     # ------------------------------------------------------------------
 
