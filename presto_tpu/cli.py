@@ -1,0 +1,89 @@
+"""Command-line SQL console (reference: presto-cli Console.java:68).
+
+Modes:
+  python -m presto_tpu.cli --execute "select 1"            # in-process
+  python -m presto_tpu.cli --mesh 8 --execute "..."        # mesh runner
+  python -m presto_tpu.cli --server http://host:port \
+      --execute "..."                                      # remote
+  python -m presto_tpu.cli                                 # REPL
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _format_rows(names, rows) -> str:
+    cols = [str(n) for n in names]
+    table = [[("NULL" if v is None else str(v)) for v in r]
+             for r in rows]
+    widths = [len(c) for c in cols]
+    for r in table:
+        for i, v in enumerate(r):
+            widths[i] = max(widths[i], len(v))
+    def fmt(vals):
+        return " | ".join(v.ljust(w) for v, w in zip(vals, widths))
+    lines = [fmt(cols), "-+-".join("-" * w for w in widths)]
+    lines += [fmt(r) for r in table]
+    lines.append(f"({len(table)} row{'s' if len(table) != 1 else ''})")
+    return "\n".join(lines)
+
+
+def _run_one(sql: str, args, runner) -> int:
+    try:
+        if args.server:
+            from presto_tpu.server.coordinator import StatementClient
+            columns, data = StatementClient(args.server).execute(sql)
+            print(_format_rows([c["name"] for c in columns], data))
+        else:
+            res = runner.execute(sql)
+            print(_format_rows(res.names, res.rows()))
+        return 0
+    except Exception as e:  # noqa: BLE001 — console surface
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="presto-tpu")
+    p.add_argument("--execute", "-e", help="run one statement and exit")
+    p.add_argument("--server", help="coordinator URL (client protocol)")
+    p.add_argument("--catalog", default="tpch")
+    p.add_argument("--schema", default="tiny")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="run distributed over an N-device mesh")
+    args = p.parse_args(argv)
+
+    runner = None
+    if not args.server:
+        if args.mesh:
+            from presto_tpu.runner import MeshRunner
+            runner = MeshRunner(args.catalog, args.schema,
+                                n_workers=args.mesh)
+        else:
+            from presto_tpu.runner import LocalRunner
+            runner = LocalRunner(args.catalog, args.schema)
+
+    if args.execute:
+        return _run_one(args.execute, args, runner)
+
+    # REPL
+    buf = []
+    while True:
+        try:
+            line = input("presto-tpu> " if not buf else "        -> ")
+        except EOFError:
+            return 0
+        buf.append(line)
+        if line.rstrip().endswith(";") or line.strip() == "":
+            sql = "\n".join(buf).strip().rstrip(";")
+            buf = []
+            if sql in ("quit", "exit"):
+                return 0
+            if sql:
+                _run_one(sql, args, runner)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -193,12 +193,20 @@ class MeshExchange:
     def _deliver_buckets(self, consumer: int, columns, base_mask,
                          g_of_row) -> None:
         """Current bucket to the consumer's device queue; later buckets
-        spill to host (numpy pytrees, no HBM reserved)."""
+        spill to host (numpy pytrees, no HBM reserved). Spilled buckets
+        are COMPACTED to their live rows first — shipping G-1
+        full-capacity copies that differ only in their mask would
+        multiply host RAM and PCIe traffic by G."""
+        from presto_tpu.batch import bucket_capacity
         for g in range(self.current_lifespan, self.lifespans):
             part = Batch(columns, base_mask & (g_of_row == g))
             if g == self.current_lifespan:
                 self._enqueue(consumer, part)
             else:
+                n = int(jnp.sum(part.row_valid))
+                if n == 0:
+                    continue
+                part = part.compact(bucket_capacity(n), known_valid=n)
                 self._spooled[g][consumer].append(
                     jax.device_get(part))
 

@@ -89,6 +89,12 @@ class HashBuildOperator(Operator):
     def is_finished(self) -> bool:
         return self._finished
 
+    def close(self) -> None:
+        # drop the build table so a closed lifespan instance releases
+        # its REAL HBM, not just its pool ledger entry
+        self._batches = []
+        self.bridge.table = None
+
 
 class LookupJoinOperator(Operator):
     """Probe side (reference: LookupJoinOperator.java:53, processProbe:392).

@@ -67,6 +67,10 @@ class NestedLoopBuildOperator(Operator):
     def is_finished(self) -> bool:
         return self._finished
 
+    def close(self) -> None:
+        self._batches = []
+        self.bridge.batch = None
+
 
 class NestedLoopJoinOperator(Operator):
     """Cross product; build sides here are small by construction

@@ -191,7 +191,8 @@ class LocalRunner:
                 # holding operators would pin their buffered device
                 # batches for the runner's lifetime
                 self._last_profile = self._render_operator_stats(
-                    drivers, _time.perf_counter() - t0, pool)
+                    self.snapshot_driver_stats(drivers),
+                    _time.perf_counter() - t0, pool)
             return MaterializedResult(lplan.result_names, lplan.result_sink,
                                       lplan.result_fields)
 
@@ -364,23 +365,35 @@ class LocalRunner:
         return self._text_result("Query Plan", text.split("\n"))
 
     @staticmethod
-    def _render_operator_stats(drivers: List[Driver], wall: float,
+    def snapshot_driver_stats(drivers: List[Driver]) -> List[List]:
+        """Materialize per-operator stats WITHOUT retaining operators
+        (which would pin their device buffers)."""
+        out = []
+        for d in drivers:
+            ops = []
+            for op in d.operators:
+                op.ctx.stats.materialize()
+                ops.append((op.ctx.name, op.ctx.operator_id,
+                            op.ctx.tag, op.ctx.stats))
+            out.append(ops)
+        return out
+
+    @staticmethod
+    def _render_operator_stats(driver_stats: List[List], wall: float,
                                pool=None) -> str:
         """Per-operator execution stats (reference: planPrinter's
         EXPLAIN ANALYZE fragment rendering over OperatorStats)."""
         lines = []
         busy_total = 0.0
         peaks = pool.peak_by_tag if pool is not None else {}
-        for pi, d in enumerate(drivers):
+        for pi, ops in enumerate(driver_stats):
             lines.append(f"Pipeline {pi}:")
-            for op in reversed(d.operators):
-                s = op.ctx.stats
-                s.materialize()
+            for name, op_id, tag, s in reversed(ops):
                 busy_total += s.busy_seconds
-                mem = peaks.get(op.ctx.tag, 0)
+                mem = peaks.get(tag, 0)
                 mem_s = f"  peak mem: {mem / 1e6:.1f}MB" if mem else ""
                 lines.append(
-                    f"  {op.ctx.name} [id={op.ctx.operator_id}]  "
+                    f"  {name} [id={op_id}]  "
                     f"rows: {s.input_rows:,} -> {s.output_rows:,}  "
                     f"batches: {s.input_batches} -> "
                     f"{s.output_batches}  "

@@ -71,6 +71,20 @@ class MeshRunner(LocalRunner):
                     raise QueryError(
                         f"{e} — no fragment is eligible for bucket-wise "
                         "execution; raise hbm_budget_bytes") from e
+                # logical operator identity: name#id is stable across
+                # retries (ids restart per planner deterministically);
+                # the @instance suffix is not
+                oom_op = e.tag.split("@")[0]
+                if getattr(self, "_last_oom_tag", None) == oom_op and \
+                        int(session.properties.get("lifespans", 1)) > 1:
+                    # the same reservation overflowed again after a
+                    # grouped attempt — lifespans don't help this
+                    # operator (e.g. it sits in an ineligible fragment)
+                    raise QueryError(
+                        f"{e} — bucket-wise execution did not reduce "
+                        "this operator's footprint; raise "
+                        "hbm_budget_bytes") from e
+                self._last_oom_tag = oom_op
                 cur = int(session.properties.get("lifespans", 1))
                 new = max(cur * 4, 4)
                 if new > 256:
@@ -182,12 +196,14 @@ class MeshRunner(LocalRunner):
         assert result is not None
 
         t0 = _time.perf_counter()
+        stat_snaps: List[List] = []
         self._drive_phased(fplan, all_drivers, instance_drivers,
                            remaining_lifespans, exchanges,
-                           spawn_fragment)
+                           spawn_fragment,
+                           stat_snaps if profile else None)
         if profile:
             self._last_profile = self._render_operator_stats(
-                all_drivers, _time.perf_counter() - t0, pool)
+                stat_snaps, _time.perf_counter() - t0, pool)
         return MaterializedResult(result.result_names,
                                   result.result_sink,
                                   result.result_fields)
@@ -195,17 +211,30 @@ class MeshRunner(LocalRunner):
     @staticmethod
     def _drive_phased(fplan, all_drivers, instance_drivers,
                       remaining_lifespans, exchanges, spawn_fragment,
+                      stat_snaps: Optional[List] = None,
                       max_rounds: int = 2_000_000) -> None:
         """Round-robin drive with lifespan phases: when the loop stalls
         because a grouped fragment's current bucket is drained, advance
         its input exchanges to the next bucket and spawn fresh task
         instances (reference: SqlTaskExecution's per-driver-group
-        lifecycles, SqlTaskExecution.java:193-207)."""
+        lifecycles, SqlTaskExecution.java:193-207). Closed generations
+        are DROPPED from the active set so their operators (and the
+        device buffers they reference) become collectable — HBM must
+        actually shrink per bucket, not just in the pool ledger."""
+        from presto_tpu.runner.local import LocalRunner
+
+        def retire(drivers):
+            for d in drivers:
+                d.close()
+            if stat_snaps is not None:
+                stat_snaps.extend(
+                    LocalRunner.snapshot_driver_stats(drivers))
+
         rounds = 0
         while True:
             all_done = True
             progress = False
-            for d in list(all_drivers):
+            for d in all_drivers:
                 if d.is_finished():
                     continue
                 all_done = False
@@ -221,13 +250,16 @@ class MeshRunner(LocalRunner):
                                for d in instance_drivers[fid]):
                         continue
                     in_exchanges = [
-                        exchanges[fplan.edges[x].exchange_id]
-                        for x in fplan.fragments[fid].source_edges]
+                        exchanges[x] for x in
+                        fplan.fragments[fid].source_edges]
                     if not all(ex.lifespan_drained()
                                for ex in in_exchanges):
                         continue
-                    for d in instance_drivers[fid]:
-                        d.close()
+                    retiring = instance_drivers[fid]
+                    retire(retiring)
+                    gone = set(map(id, retiring))
+                    all_drivers[:] = [d for d in all_drivers
+                                      if id(d) not in gone]
                     for ex in in_exchanges:
                         ex.advance_lifespan()
                     fresh = spawn_fragment(fid)
@@ -240,8 +272,7 @@ class MeshRunner(LocalRunner):
             rounds += 1
             if rounds > max_rounds:
                 raise QueryError("query did not converge (deadlock?)")
-        for d in all_drivers:
-            d.close()
+        retire(all_drivers)
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,9 @@
+"""Distributed control plane: coordinator + worker processes with an
+HTTP/JSON control plane and an HTTP page data plane (reference layers
+L7-L9 — execution/scheduler/, server/, presto-client).
+
+On a real TPU deployment each worker owns one host's chips and the
+intra-slice shuffle stays on ICI (MeshRunner); this package is the DCN
+tier: cross-process task dispatch, exchange-over-HTTP fallback, the
+queued/executing client protocol, and the CLI.
+"""

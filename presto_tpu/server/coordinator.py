@@ -1,0 +1,240 @@
+"""Coordinator: dispatch + scheduling + client protocol (reference:
+dispatcher/DispatchManager.java:143, execution/scheduler/
+SqlQueryScheduler.java:114, server/protocol/QueuedStatementResource
+.java:156 / ExecutingStatementResource.java:73, and presto-client's
+StatementClientV1 nextUri loop).
+
+The coordinator plans and fragments a query, POSTs one task per worker
+per distributed fragment (task spec = SQL + session + fragment id — the
+worker re-derives the deterministic plan), runs the single-partition
+fragments itself (root output lands here), and serves the two-phase
+queued/executing client protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from presto_tpu.server.node import (
+    Node, build_http_exchanges, derive_fragments, http_get, http_post,
+)
+
+
+class _Query:
+    def __init__(self, sql: str):
+        self.id = uuid.uuid4().hex[:16]
+        self.sql = sql
+        self.state = "QUEUED"
+        self.error: Optional[str] = None
+        self.columns: Optional[List[dict]] = None
+        self.data: Optional[List[list]] = None
+
+
+class Coordinator(Node):
+    def __init__(self, worker_urls: List[str],
+                 catalog: str = "tpch", schema: str = "tiny",
+                 properties: Optional[dict] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.worker_urls = list(worker_urls)
+        self.catalog = catalog
+        self.schema = schema
+        self.properties = dict(properties or {})
+        self.queries: Dict[str, _Query] = {}
+
+    # -- health / membership (reference: failureDetector/
+    # HeartbeatFailureDetector pinging discovered nodes) ---------------
+
+    def check_workers(self) -> None:
+        for url in self.worker_urls:
+            info = json.loads(http_get(f"{url}/v1/info", timeout=10))
+            if info.get("state") != "active":
+                raise RuntimeError(f"worker {url} is not active: "
+                                   f"{info}")
+
+    # -- client protocol ---------------------------------------------------
+
+    def handle_post(self, path: str, body: bytes) -> bytes:
+        if path == "/v1/statement":
+            q = _Query(body.decode())
+            self.queries[q.id] = q
+            threading.Thread(target=self._run_query, args=(q,),
+                             daemon=True).start()
+            return json.dumps({
+                "id": q.id,
+                "nextUri": f"{self.url}/v1/statement/executing/"
+                           f"{q.id}/0",
+            }).encode()
+        return super().handle_post(path, body)
+
+    def handle_get(self, path: str) -> bytes:
+        if path.startswith("/v1/statement/executing/"):
+            qid = path.split("/")[4]
+            q = self.queries[qid]
+            out = {"id": q.id, "stats": {"state": q.state}}
+            if q.state == "FINISHED":
+                out["columns"] = q.columns
+                out["data"] = q.data
+            elif q.state == "FAILED":
+                out["error"] = {"message": q.error}
+            else:
+                out["nextUri"] = f"{self.url}/v1/statement/executing/" \
+                                 f"{qid}/0"
+            return json.dumps(out).encode()
+        return super().handle_get(path)
+
+    # -- query execution ---------------------------------------------------
+
+    def _run_query(self, q: _Query) -> None:
+        try:
+            result = self.execute(q.sql)
+            q.columns = [
+                {"name": n, "type": f.type.display()}
+                for n, f in zip(result.names, result.fields)]
+            rows = result.rows()
+            q.data = [list(r) for r in rows]
+            q.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001
+            q.error = f"{type(e).__name__}: {e}"
+            q.state = "FAILED"
+
+    def execute(self, sql: str):
+        """Distributed execution: schedule fragments over the workers,
+        run the single-partition fragments locally, return the root
+        result (the DistributedQueryRunner-style entry point)."""
+        from presto_tpu.planner.local_planner import (
+            LocalExecutionPlanner, TaskContext,
+        )
+        from presto_tpu.runner.local import (
+            LocalRunner, MaterializedResult,
+        )
+        runner = LocalRunner(self.catalog, self.schema, self.properties)
+        fplan = derive_fragments(runner, sql)
+        query_id = uuid.uuid4().hex[:12]
+        exchanges = build_http_exchanges(
+            query_id, fplan, self.worker_urls, self.url, self.registry)
+
+        # dispatch distributed fragments: one task per worker
+        # (reference: SqlStageExecution.scheduleTask -> HttpRemoteTask)
+        remote: List[tuple] = []
+        for fid, fragment in fplan.fragments.items():
+            if fragment.partitioning != "distributed":
+                continue
+            for t, wurl in enumerate(self.worker_urls):
+                task_id = f"{query_id}.{fid}.{t}"
+                spec = {
+                    "task_id": task_id,
+                    "query_id": query_id,
+                    "sql": sql,
+                    "session": {"catalog": self.catalog,
+                                "schema": self.schema,
+                                "properties": self.properties},
+                    "fragment_id": fid,
+                    "task_index": t,
+                    "n_tasks": len(self.worker_urls),
+                    "worker_urls": self.worker_urls,
+                    "coordinator_url": self.url,
+                }
+                http_post(f"{wurl}/v1/task",
+                          json.dumps(spec).encode())
+                remote.append((task_id, wurl))
+
+        # run single-partition fragments here (root last -> result)
+        result = None
+        pipelines: List[list] = []
+        for fid, fragment in fplan.fragments.items():
+            if fragment.partitioning != "single":
+                continue
+            task = TaskContext(index=0, count=1, device=None,
+                               exchanges=exchanges)
+            planner = LocalExecutionPlanner(
+                runner.catalogs, runner.session, task=task)
+            if fid == fplan.root_id:
+                lplan = planner.plan(fragment.root)
+                pipelines.extend(lplan.pipelines)
+                result = lplan
+            else:
+                sinks = [exchanges[e.exchange_id]
+                         for e in fplan.producer_edges(fid)]
+                pipelines.extend(planner.plan_fragment(fragment.root,
+                                                       sinks))
+        assert result is not None
+
+        failure: List[str] = []
+        stop = threading.Event()
+
+        def watch():
+            # failure detection: poll remote task state; a failed task
+            # fails the query (reference: ContinuousTaskStatusFetcher
+            # + RequestErrorTracker)
+            while not stop.is_set():
+                for task_id, wurl in remote:
+                    try:
+                        st = json.loads(http_get(
+                            f"{wurl}/v1/task/{task_id}", timeout=10))
+                    except Exception as e:  # noqa: BLE001
+                        failure.append(f"worker {wurl} unreachable: "
+                                       f"{e}")
+                        return
+                    if st["state"] == "failed":
+                        failure.append(
+                            f"task {task_id} failed: {st['error']}")
+                        return
+                time.sleep(0.2)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        try:
+            drivers = self._drive_with_failures(pipelines, failure)
+        finally:
+            stop.set()
+        if failure:
+            raise RuntimeError(failure[0])
+        return MaterializedResult(result.result_names,
+                                  result.result_sink,
+                                  result.result_fields)
+
+    @staticmethod
+    def _drive_with_failures(pipelines, failure: List[str]):
+        from presto_tpu.operators.base import DriverContext
+        from presto_tpu.operators.driver import Driver
+        dctx = DriverContext()
+        drivers = [Driver([f.create(dctx) for f in pipe])
+                   for pipe in pipelines]
+        while True:
+            if failure:
+                raise RuntimeError(failure[0])
+            all_done = True
+            for d in drivers:
+                if not d.is_finished():
+                    all_done = False
+                    d.process()
+            if all_done:
+                return drivers
+
+
+class StatementClient:
+    """Minimal client protocol driver (reference: presto-client
+    StatementClientV1.advance:323 following nextUri)."""
+
+    def __init__(self, server: str):
+        self.server = server.rstrip("/")
+
+    def execute(self, sql: str, timeout: float = 600.0):
+        resp = json.loads(http_post(f"{self.server}/v1/statement",
+                                    sql.encode()))
+        deadline = time.time() + timeout
+        while True:
+            state = json.loads(http_get(resp["nextUri"]))
+            s = state["stats"]["state"]
+            if s == "FINISHED":
+                return state["columns"], state["data"]
+            if s == "FAILED":
+                raise RuntimeError(state["error"]["message"])
+            if time.time() > deadline:
+                raise TimeoutError(f"query {resp['id']} timed out")
+            time.sleep(0.1)

@@ -1,0 +1,371 @@
+"""Worker/coordinator HTTP node: task RPC + exchange data plane
+(reference: server/TaskResource.java:93 task create/update + results
+long-poll, server/remotetask/HttpRemoteTask.java:128 on the caller
+side, AsyncPageTransportServlet.java:68 for the page hot path).
+
+Design notes for the TPU deployment shape:
+  - one worker process per HOST; the chips inside a host/slice stay on
+    the MeshRunner's ICI collectives. THIS tier is the DCN fallback:
+    batches that must cross processes travel as compacted npz pages
+    over HTTP, pushed to the consuming node (the reference pulls;
+    push keeps the skeleton free of result-token state)
+  - plans are not serialized: a task spec carries the original SQL +
+    session and the worker re-derives the (deterministic) fragment
+    plan, executing only its fragment — the presto-on-spark trick of
+    shipping work by description, not by object graph
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import traceback
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.batch import Batch
+from presto_tpu.server.serde import batch_from_bytes, batch_to_bytes
+
+
+def http_post(url: str, body: bytes, timeout: float = 60.0) -> bytes:
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def http_get(url: str, timeout: float = 60.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+class ExchangeRegistry:
+    """Incoming side of every exchange this node consumes: queues per
+    (exchange_key, consumer_task) plus end-of-stream accounting.
+    Exchange keys are "<query_id>:<exchange_id>" — plain exchange ids
+    restart at 0 for every query, and the registry outlives queries."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues: Dict[Tuple[str, int], collections.deque] = \
+            collections.defaultdict(collections.deque)
+        self._eos: Dict[Tuple[str, int], set] = \
+            collections.defaultdict(set)
+        self._expected: Dict[str, int] = {}
+
+    def expect_producers(self, key: str, count: int) -> None:
+        with self._lock:
+            self._expected[key] = count
+
+    def receive(self, key: str, consumer: int,
+                payload: bytes) -> None:
+        batch = batch_from_bytes(payload)
+        with self._lock:
+            self._queues[(key, consumer)].append(batch)
+
+    def receive_eos(self, key: str, consumer: int,
+                    producer: int) -> None:
+        with self._lock:
+            self._eos[(key, consumer)].add(producer)
+
+    def pop(self, key: str, consumer: int) -> Optional[Batch]:
+        with self._lock:
+            q = self._queues[(key, consumer)]
+            return q.popleft() if q else None
+
+    def has_output(self, key: str, consumer: int) -> bool:
+        with self._lock:
+            return bool(self._queues[(key, consumer)])
+
+    def finished(self, key: str, consumer: int) -> bool:
+        with self._lock:
+            done = len(self._eos[(key, consumer)]) \
+                >= self._expected.get(key, 1 << 30)
+            return done and not self._queues[(key, consumer)]
+
+
+class HttpExchange:
+    """MeshExchange-compatible facade over the DCN data plane: pushes
+    route batches to consumer NODES over HTTP; pops read this node's
+    registry queues (filled by the HTTP handler thread)."""
+
+    def __init__(self, exchange_key: str, scheme: str,
+                 partition_keys, hash_dicts, key_dictionaries,
+                 consumer_urls: List[str], n_producers: int,
+                 registry: ExchangeRegistry):
+        import jax.numpy as jnp
+        import numpy as np
+        self.exchange_id = exchange_key
+        self.scheme = scheme
+        self.partition_keys = list(partition_keys)
+        self.consumer_urls = consumer_urls
+        self.n_consumers = len(consumer_urls)
+        self.registry = registry
+        registry.expect_producers(exchange_key, n_producers)
+        self._rr = 0
+        self._remaps = None
+        if hash_dicts is not None:
+            self._remaps = []
+            for dic, hd in zip(key_dictionaries, hash_dicts):
+                if hd is None or dic is None:
+                    self._remaps.append(None)
+                else:
+                    index = {v: i for i, v in enumerate(hd)}
+                    self._remaps.append(jnp.asarray(
+                        np.array([index[v] for v in dic] or [0],
+                                 dtype=np.int32)))
+
+    # -- producer side (outgoing HTTP) -------------------------------------
+
+    def _send(self, consumer: int, batch: Batch) -> None:
+        url = f"{self.consumer_urls[consumer]}/v1/exchange/" \
+              f"{self.exchange_id}/{consumer}"
+        http_post(url, batch_to_bytes(batch))
+
+    def push(self, producer: int, batch: Batch) -> None:
+        import jax.numpy as jnp
+        from presto_tpu.ops import common
+        if self.scheme == "gather":
+            self._send(0, batch)
+        elif self.scheme == "broadcast":
+            for c in range(self.n_consumers):
+                self._send(c, batch)
+        elif self.scheme == "passthrough":
+            self._send(producer, batch)
+        elif self.scheme == "repartition" and not self.partition_keys:
+            c = self._rr % self.n_consumers
+            self._rr += 1
+            self._send(c, batch)
+        else:
+            cols = []
+            for i, k in enumerate(self.partition_keys):
+                col = batch.columns[k]
+                d = col.data
+                if self._remaps is not None \
+                        and self._remaps[i] is not None:
+                    d = self._remaps[i][d]
+                cols.append((jnp.asarray(d), jnp.asarray(col.mask)))
+            h = jnp.abs(common.row_hash(cols))
+            dest = (h % self.n_consumers).astype(jnp.int32)
+            for c in range(self.n_consumers):
+                part = Batch(batch.columns,
+                             jnp.asarray(batch.row_valid)
+                             & (dest == c))
+                self._send(c, part)
+
+    def producer_done(self, producer: int) -> None:
+        for c in range(self.n_consumers):
+            http_post(
+                f"{self.consumer_urls[c]}/v1/exchange/"
+                f"{self.exchange_id}/{c}/eos?producer={producer}",
+                b"")
+
+    # -- consumer side (local registry) ------------------------------------
+
+    def pop(self, consumer: int) -> Optional[Batch]:
+        return self.registry.pop(self.exchange_id, consumer)
+
+    def has_output(self, consumer: int) -> bool:
+        return self.registry.has_output(self.exchange_id, consumer)
+
+    def finished(self, consumer: int) -> bool:
+        return self.registry.finished(self.exchange_id, consumer)
+
+
+class TaskState:
+    def __init__(self):
+        self.state = "running"
+        self.error: Optional[str] = None
+
+
+class NodeHandler(BaseHTTPRequestHandler):
+    node: "Node" = None  # bound by serve()
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _reply(self, code: int, body: bytes = b"",
+               ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(n)
+
+    def do_GET(self):
+        try:
+            body = self.node.handle_get(self.path)
+        except KeyError:
+            self._reply(404, b'{"error": "not found"}')
+            return
+        self._reply(200, body)
+
+    def do_POST(self):
+        try:
+            body = self.node.handle_post(self.path, self._read_body())
+            self._reply(200, body)
+        except Exception as e:  # noqa: BLE001 — surface to caller
+            self._reply(500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}",
+                 "trace": traceback.format_exc(limit=5)}).encode())
+
+
+class Node:
+    """Shared HTTP node: exchange receipt + task RPC. The coordinator
+    subclass adds the client protocol."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.registry = ExchangeRegistry()
+        self.tasks: Dict[str, TaskState] = {}
+        handler = type("BoundHandler", (NodeHandler,), {"node": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+
+    # -- routing -----------------------------------------------------------
+
+    def handle_get(self, path: str) -> bytes:
+        if path == "/v1/info":
+            return json.dumps({"state": "active"}).encode()
+        if path.startswith("/v1/task/"):
+            tid = path.rsplit("/", 1)[1]
+            t = self.tasks[tid]
+            return json.dumps({"state": t.state,
+                               "error": t.error}).encode()
+        raise KeyError(path)
+
+    def handle_post(self, path: str, body: bytes) -> bytes:
+        if path.startswith("/v1/exchange/"):
+            rest = path[len("/v1/exchange/"):]
+            if "/eos" in rest:
+                head, query = rest.split("/eos", 1)
+                xid_s, consumer_s = head.rsplit("/", 1)
+                producer = int(query.split("producer=")[1])
+                self.registry.receive_eos(xid_s, int(consumer_s),
+                                          producer)
+                return b"{}"
+            xid_s, consumer_s = rest.rsplit("/", 1)
+            self.registry.receive(xid_s, int(consumer_s), body)
+            return b"{}"
+        if path == "/v1/task":
+            spec = json.loads(body.decode())
+            self.create_task(spec)
+            return json.dumps({"taskId": spec["task_id"]}).encode()
+        raise KeyError(path)
+
+    # -- task execution ----------------------------------------------------
+
+    def create_task(self, spec: dict) -> None:
+        state = TaskState()
+        self.tasks[spec["task_id"]] = state
+        threading.Thread(target=self._run_task, args=(spec, state),
+                         daemon=True).start()
+
+    def _run_task(self, spec: dict, state: TaskState) -> None:
+        try:
+            self.execute_fragment(spec)
+            state.state = "finished"
+        except Exception as e:  # noqa: BLE001
+            state.state = "failed"
+            state.error = f"{type(e).__name__}: {e}\n" \
+                          f"{traceback.format_exc(limit=8)}"
+
+    def execute_fragment(self, spec: dict) -> None:
+        """Re-derive the fragment plan from SQL (deterministic) and run
+        this node's task of fragment `fragment_id`."""
+        from presto_tpu.planner.local_planner import (
+            LocalExecutionPlanner, TaskContext,
+        )
+        from presto_tpu.runner.local import LocalRunner
+        runner = LocalRunner(spec["session"]["catalog"],
+                             spec["session"]["schema"],
+                             spec["session"]["properties"])
+        fplan = derive_fragments(runner, spec["sql"])
+        fid = spec["fragment_id"]
+        fragment = fplan.fragments[fid]
+        exchanges = build_http_exchanges(
+            spec["query_id"], fplan, spec["worker_urls"],
+            spec["coordinator_url"], self.registry)
+        task = TaskContext(index=spec["task_index"],
+                           count=spec["n_tasks"], device=None,
+                           exchanges=exchanges)
+        planner = LocalExecutionPlanner(runner.catalogs, runner.session,
+                                        task=task)
+        sinks = [exchanges[e.exchange_id]
+                 for e in fplan.producer_edges(fid)]
+        pipelines = planner.plan_fragment(fragment.root, sinks)
+        LocalRunner.drive_pipelines(pipelines)
+
+
+def derive_fragments(runner, sql: str):
+    """SQL -> the same FragmentedPlan on every node (symbol allocation
+    and fragment numbering are deterministic)."""
+    from presto_tpu.planner.exchanges import (
+        add_exchanges, fragment_plan,
+    )
+    from presto_tpu.planner.local_planner import prune_unused_columns
+    from presto_tpu.planner.optimizer import optimize
+    plan = optimize(runner.create_plan(sql))
+    prune_unused_columns(plan)
+    plan = add_exchanges(plan, runner.catalogs, runner.session)
+    return fragment_plan(plan)
+
+
+def build_http_exchanges(query_id: str, fplan,
+                         worker_urls: List[str],
+                         coordinator_url: str,
+                         registry: ExchangeRegistry) -> Dict[int,
+                                                             HttpExchange]:
+    """One HttpExchange per edge; consumer URL table depends on the
+    consumer fragment's distribution (single -> coordinator)."""
+    out: Dict[int, HttpExchange] = {}
+    for xid, edge in fplan.edges.items():
+        consumer = fplan.fragments[edge.consumer]
+        producer = fplan.fragments[edge.producer]
+        consumer_urls = [coordinator_url] \
+            if consumer.partitioning == "single" else list(worker_urls)
+        n_producers = 1 if producer.partitioning == "single" \
+            else len(worker_urls)
+        key_dicts = []
+        for k in edge.partition_keys:
+            f = next((f for f in edge.fields if f.symbol == k), None)
+            key_dicts.append(f.dictionary if f else None)
+        out[xid] = HttpExchange(
+            f"{query_id}:{xid}", edge.scheme, edge.partition_keys,
+            edge.hash_dicts, key_dicts, consumer_urls, n_producers,
+            registry)
+    return out
+
+
+def worker_main() -> None:
+    """Entry point for a worker process:
+    python -m presto_tpu.server.node --port 8081"""
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+    node = Node(args.host, args.port)
+    node.start()
+    print(json.dumps({"url": node.url}), flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        node.stop()
+
+
+if __name__ == "__main__":
+    worker_main()

@@ -1,0 +1,57 @@
+"""Batch wire format for the DCN data plane (reference:
+execution/buffer/PagesSerde — LZ4-compressed pages over HTTP; here
+npz-compressed numpy columns + a JSON schema header).
+
+Only live rows travel: batches are compacted before serialization, so
+the wire never carries padding lanes.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Tuple
+
+import numpy as np
+
+from presto_tpu.batch import Batch, Column, bucket_capacity
+from presto_tpu.types import parse_type
+
+
+def batch_to_bytes(batch: Batch) -> bytes:
+    import jax
+    # compact: ship live rows only
+    n = batch.num_valid()
+    b = batch.compact(bucket_capacity(max(n, 1)), known_valid=n)
+    host = jax.device_get(b)
+    header = {
+        "columns": [
+            {"name": name, "type": c.type.display(),
+             "dictionary": list(c.dictionary)
+             if c.dictionary is not None else None}
+            for name, c in host.columns.items()
+        ],
+    }
+    arrays = {}
+    for i, (name, c) in enumerate(host.columns.items()):
+        arrays[f"d{i}"] = np.asarray(c.data)
+        arrays[f"m{i}"] = np.asarray(c.mask)
+    arrays["rv"] = np.asarray(host.row_valid)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    payload = buf.getvalue()
+    head = json.dumps(header).encode()
+    return len(head).to_bytes(4, "big") + head + payload
+
+
+def batch_from_bytes(data: bytes) -> Batch:
+    hlen = int.from_bytes(data[:4], "big")
+    header = json.loads(data[4:4 + hlen].decode())
+    npz = np.load(io.BytesIO(data[4 + hlen:]))
+    cols = {}
+    for i, meta in enumerate(header["columns"]):
+        dic = tuple(meta["dictionary"]) \
+            if meta["dictionary"] is not None else None
+        cols[meta["name"]] = Column(
+            npz[f"d{i}"], npz[f"m{i}"], parse_type(meta["type"]), dic)
+    return Batch(cols, npz["rv"])

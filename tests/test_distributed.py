@@ -1,0 +1,121 @@
+"""Coordinator + worker PROCESSES over the HTTP control/data plane
+(reference: presto-tests DistributedQueryRunner.java:85 — except the
+reference boots in-JVM servers; real subprocesses are a stronger
+isolation check and our workers are cheap).
+
+Covers: task dispatch RPC, exchange-over-DCN (hash repartition +
+broadcast + gather over HTTP), the queued/executing client protocol,
+worker failure surfacing, and the CLI against the coordinator."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    workers = []
+    urls = []
+    for _ in range(2):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "presto_tpu.server.node",
+             "--port", "0"],
+            cwd="/root/repo", env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        line = proc.stdout.readline()
+        urls.append(json.loads(line)["url"])
+        workers.append(proc)
+    from presto_tpu.server.coordinator import Coordinator
+    coord = Coordinator(urls, "tpch", "tiny",
+                        {"broadcast_join_threshold_rows": 500})
+    coord.start()
+    coord.check_workers()
+    yield coord
+    coord.stop()
+    for w in workers:
+        w.send_signal(signal.SIGTERM)
+    for w in workers:
+        try:
+            w.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            w.kill()
+
+
+@pytest.fixture(scope="module")
+def local_rows():
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+
+    def run(sql):
+        return r.execute(sql).rows()
+    return run
+
+
+def test_q1_through_cluster(cluster, local_rows):
+    """TPC-H Q1 via 1 coordinator + 2 worker processes: partial agg on
+    the workers, shuffle over HTTP, final merge + sort on the
+    coordinator path."""
+    sys.path.insert(0, "/root/repo/tests")
+    from tpch_queries import QUERIES
+    got = cluster.execute(QUERIES[1]).rows()
+    want = local_rows(QUERIES[1])
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float):
+                assert abs(gv - wv) < 1e-6 * max(abs(wv), 1)
+            else:
+                assert gv == wv
+
+
+def test_join_through_cluster(cluster, local_rows):
+    sql = ("select n.name, count(*) c from customer c "
+           "join nation n on c.nationkey = n.nationkey "
+           "group by n.name order by c desc, n.name limit 5")
+    assert cluster.execute(sql).rows() == local_rows(sql)
+
+
+def test_client_protocol(cluster):
+    from presto_tpu.server.coordinator import StatementClient
+    client = StatementClient(cluster.url)
+    columns, data = client.execute(
+        "select returnflag, count(*) c from lineitem "
+        "group by returnflag order by returnflag")
+    assert [c["name"] for c in columns] == ["returnflag", "c"]
+    assert [row[0] for row in data] == ["A", "N", "R"]
+
+
+def test_client_protocol_failure(cluster):
+    from presto_tpu.server.coordinator import StatementClient
+    client = StatementClient(cluster.url)
+    with pytest.raises(RuntimeError, match="does not exist"):
+        client.execute("select * from no_such_table")
+
+
+def test_cli_against_cluster(cluster):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    out = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.cli",
+         "--server", cluster.url,
+         "-e", "select count(*) n from orders"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "1500" in out.stdout
+
+
+def test_cli_local():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    out = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.cli",
+         "-e", "select 1 + 1 two"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "two" in out.stdout and "2" in out.stdout

@@ -48,8 +48,19 @@ def test_explain_analyze_mesh():
 
 def test_plain_queries_have_no_profile_overhead():
     """Row-count device accumulators only exist under EXPLAIN
-    ANALYZE; normal runs keep stats at zero rows."""
+    ANALYZE; normal runs must not add per-batch jnp.sum dispatches."""
+    from presto_tpu.planner.local_planner import LocalExecutionPlanner
+    from presto_tpu.planner.optimizer import optimize
     from presto_tpu.runner import LocalRunner
     r = LocalRunner("tpch", "tiny")
-    res = r.execute("select count(*) from nation")
-    assert res.rows() == [(25,)]
+    plan = optimize(r.create_plan(
+        "select nationkey, count(*) from customer group by nationkey"))
+    lplan = LocalExecutionPlanner(r.catalogs, r.session).plan(plan)
+    drivers = LocalRunner.drive_pipelines(lplan.pipelines)
+    assert sum(b.num_valid() for b in lplan.result_sink) == 25
+    for d in drivers:
+        for op in d.operators:
+            s = op.ctx.stats
+            assert s.input_rows_dev is None \
+                and s.output_rows_dev is None, op.ctx.name
+            assert s.input_rows == 0 and s.output_rows == 0
