@@ -403,6 +403,36 @@ def direct_init(aggs: Sequence[AggFunction], num_slots: int) -> DirectState:
     return DirectState(states, jnp.zeros(num_slots, bool))
 
 
+# Below this slot count, reduce into the slot table with a masked
+# one-hot reduction instead of segment_*: segment ops lower to scatter,
+# which XLA serializes on TPU (~0.5s per 6M-row f64 array measured on
+# v5e through the tunnel); the [rows, slots] masked reduce fuses into a
+# single streaming VPU pass (~1000x faster at small slot counts).
+_ONEHOT_SLOT_LIMIT = 256
+
+
+def _slot_reduce(contrib: jnp.ndarray, gid: jnp.ndarray, num_slots: int,
+                 reduce: str, dtype) -> jnp.ndarray:
+    """Reduce per-row contributions into `num_slots` slots (drop slot
+    `num_slots` discarded). gid is int32 in [0, num_slots]."""
+    c = contrib.astype(dtype)
+    if num_slots <= _ONEHOT_SLOT_LIMIT:
+        oh = gid[:, None] == jnp.arange(num_slots, dtype=gid.dtype)[None, :]
+        masked = jnp.where(oh, c[:, None], _ident_for(reduce, dtype))
+        if reduce == "sum":
+            return jnp.sum(masked, axis=0)
+        if reduce == "min":
+            return jnp.min(masked, axis=0)
+        return jnp.max(masked, axis=0)
+    if reduce == "sum":
+        red = jax.ops.segment_sum(c, gid, num_segments=num_slots + 1)
+    elif reduce == "min":
+        red = jax.ops.segment_min(c, gid, num_segments=num_slots + 1)
+    else:
+        red = jax.ops.segment_max(c, gid, num_segments=num_slots + 1)
+    return red[:num_slots]
+
+
 def direct_step(state: DirectState,
                 row_valid: jnp.ndarray,
                 key_codes: Sequence[CVal],
@@ -432,22 +462,17 @@ def direct_step(state: DirectState,
             contrib = agg.init(inp, w)
         merged = []
         for arr, c, r in zip(st, contrib, agg.reduces):
+            red = _slot_reduce(c, gid, num_slots, r, arr.dtype)
             if r == "sum":
-                red = jax.ops.segment_sum(
-                    c.astype(arr.dtype), gid, num_segments=num_slots + 1)
-                merged.append(arr + red[:num_slots])
+                merged.append(arr + red)
             elif r == "min":
-                red = jax.ops.segment_min(
-                    c.astype(arr.dtype), gid, num_segments=num_slots + 1)
-                merged.append(jnp.minimum(arr, red[:num_slots]))
+                merged.append(jnp.minimum(arr, red))
             else:
-                red = jax.ops.segment_max(
-                    c.astype(arr.dtype), gid, num_segments=num_slots + 1)
-                merged.append(jnp.maximum(arr, red[:num_slots]))
+                merged.append(jnp.maximum(arr, red))
         new_states.append(tuple(merged))
 
-    seen = jax.ops.segment_max(row_valid.astype(jnp.int32), gid,
-                               num_segments=num_slots + 1)[:num_slots]
+    seen = _slot_reduce(row_valid.astype(jnp.int32), gid, num_slots,
+                        "max", jnp.int32)
     return DirectState(new_states, state.present | (seen > 0))
 
 
