@@ -40,6 +40,47 @@ from presto_tpu.ops import common
 from presto_tpu.parallel.shuffle import wave_repartition
 
 
+def build_remap_tables(hash_dicts, key_dictionaries):
+    """Per-key remap tables: original dictionary codes -> unified hash
+    dictionary codes (None for non-string keys). Shared by the ICI
+    (MeshExchange) and DCN (HttpExchange) tiers so partition routing can
+    never desynchronize between them."""
+    if hash_dicts is None:
+        return None
+    remaps = []
+    for dic, hd in zip(key_dictionaries, hash_dicts):
+        if hd is None or dic is None:
+            remaps.append(None)
+        else:
+            index = {v: i for i, v in enumerate(hd)}
+            remaps.append(jnp.asarray(
+                np.array([index[v] for v in dic] or [0],
+                         dtype=np.int32)))
+    return remaps
+
+
+def partition_key_hash(batch: Batch, partition_keys: Sequence[str],
+                       remaps) -> jnp.ndarray:
+    """|hash| of the partition keys through the unified-dictionary
+    remaps — the ONE place the exchange partition hash is computed (both
+    exchange tiers and lifespan bucketing route through here)."""
+    cols = []
+    for i, k in enumerate(partition_keys):
+        c = batch.columns[k]
+        d = c.data
+        if remaps is not None and remaps[i] is not None:
+            d = remaps[i][d]
+        cols.append((d, c.mask))
+    return jnp.abs(common.row_hash(cols))
+
+
+def edge_key_dicts(edge) -> List:
+    """Dictionaries of an edge's partition-key fields (in key order)."""
+    return [next((f.dictionary for f in edge.fields if f.symbol == k),
+                 None)
+            for k in edge.partition_keys]
+
+
 class MeshExchange:
     """One exchange edge: N producer tasks -> M consumer task queues.
 
@@ -85,19 +126,7 @@ class MeshExchange:
         self._done = [False] * n_producers
         self._template: Optional[Batch] = None
         self._rr = 0
-        # per-key remap tables: original dictionary codes -> unified
-        # hash dictionary codes (None for non-string keys)
-        self._remaps = None
-        if hash_dicts is not None:
-            self._remaps = []
-            for dic, hd in zip(key_dictionaries, hash_dicts):
-                if hd is None or dic is None:
-                    self._remaps.append(None)
-                else:
-                    index = {v: i for i, v in enumerate(hd)}
-                    self._remaps.append(jnp.asarray(
-                        np.array([index[v] for v in dic] or [0],
-                                 dtype=np.int32)))
+        self._remaps = build_remap_tables(hash_dicts, key_dictionaries)
 
     # -- memory accounting -------------------------------------------------
 
@@ -176,16 +205,8 @@ class MeshExchange:
                     if c < len(self.devices) else self.devices[0]))
 
     def _key_hash(self, batch: Batch):
-        """|hash| of the partition keys, through the unified-dictionary
-        remaps (the one place this is computed)."""
-        cols = []
-        for i, k in enumerate(self.partition_keys):
-            c = batch.columns[k]
-            d = c.data
-            if self._remaps is not None and self._remaps[i] is not None:
-                d = self._remaps[i][d]
-            cols.append((d, c.mask))
-        return jnp.abs(common.row_hash(cols))
+        return partition_key_hash(batch, self.partition_keys,
+                                  self._remaps)
 
     def _lifespan_of(self, h):
         return (h // max(self.n_consumers, 1)) % self.lifespans

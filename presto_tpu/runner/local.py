@@ -111,8 +111,10 @@ class LocalRunner:
             BlackholeConnector, MemoryConnector,
         )
         from presto_tpu.connectors.tpch import TpchConnector
+        from presto_tpu.connectors.tpcds import TpcdsConnector
         self.catalogs = CatalogManager()
         self.catalogs.register("tpch", TpchConnector())
+        self.catalogs.register("tpcds", TpcdsConnector())
         self.catalogs.register("memory", MemoryConnector())
         self.catalogs.register("blackhole", BlackholeConnector())
         self.session = Session(catalog, schema, dict(properties or {}))
@@ -198,16 +200,26 @@ class LocalRunner:
 
     @staticmethod
     def drive_pipelines(pipelines: List[List],
-                        max_rounds: int = 2_000_000,
+                        max_idle_s: float = 600.0,
                         profile: bool = False,
-                        pool=None) -> List[Driver]:
+                        pool=None, cancel=None) -> List[Driver]:
         """Round-robin all drivers to completion (the TaskExecutor
-        stand-in; shared by the local and mesh runners)."""
+        stand-in; shared by the local runner and worker tasks).
+
+        Progress is judged by wall clock, not round count: a task whose
+        input arrives over the network exchange (a producer on another
+        node may still be compiling) legitimately spins for a while, so
+        no-progress rounds sleep briefly and only a `max_idle_s` stretch
+        with zero progress is treated as a deadlock. `cancel` is an
+        optional () -> bool polled each round (task abort)."""
+        import time as _time
         dctx = DriverContext(profile=profile, memory=pool)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
-        rounds = 0
+        idle_since: Optional[float] = None
         while True:
+            if cancel is not None and cancel():
+                raise QueryError("task cancelled")
             all_done = True
             progress = False
             for d in drivers:
@@ -217,9 +229,17 @@ class LocalRunner:
                 progress = d.process() or progress
             if all_done:
                 break
-            rounds += 1
-            if rounds > max_rounds:
-                raise QueryError("query did not converge (deadlock?)")
+            if progress:
+                idle_since = None
+                continue
+            now = _time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since > max_idle_s:
+                raise QueryError(
+                    f"query made no progress for {max_idle_s:.0f}s "
+                    "(deadlock?)")
+            _time.sleep(0.002)
         for d in drivers:
             d.close()
         return drivers

@@ -17,7 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
-from presto_tpu.operators.exchange_ops import MeshExchange
+from presto_tpu.operators.exchange_ops import MeshExchange, edge_key_dicts
 from presto_tpu.parallel.mesh import make_mesh
 from presto_tpu.planner import nodes as N
 from presto_tpu.planner.exchanges import (
@@ -50,6 +50,9 @@ class MeshRunner(LocalRunner):
         plan = add_exchanges(plan, self.catalogs, self.session)
         fplan = fragment_plan(plan)
         session = self.session
+        # query-local OOM escalation state: (operator, lifespans at the
+        # failure, bytes it asked for) of the previous OOM
+        prev_oom = None
         while True:
             try:
                 return self._run_fragments(fplan, session, profile)
@@ -75,17 +78,20 @@ class MeshRunner(LocalRunner):
                 # retries (ids restart per planner deterministically);
                 # the @instance suffix is not
                 oom_op = e.tag.split("@")[0]
-                if getattr(self, "_last_oom_tag", None) == oom_op and \
-                        int(session.properties.get("lifespans", 1)) > 1:
-                    # the same reservation overflowed again after a
-                    # grouped attempt — lifespans don't help this
-                    # operator (e.g. it sits in an ineligible fragment)
-                    raise QueryError(
-                        f"{e} — bucket-wise execution did not reduce "
-                        "this operator's footprint; raise "
-                        "hbm_budget_bytes") from e
-                self._last_oom_tag = oom_op
                 cur = int(session.properties.get("lifespans", 1))
+                if prev_oom is not None:
+                    p_op, p_g, p_req = prev_oom
+                    if p_op == oom_op and cur > p_g \
+                            and e.requested >= 0.75 * p_req:
+                        # escalating lifespans did not shrink this
+                        # operator's request — it sits in an ineligible
+                        # fragment or holds per-bucket-invariant state;
+                        # more buckets won't help
+                        raise QueryError(
+                            f"{e} — bucket-wise execution did not "
+                            "reduce this operator's footprint; raise "
+                            "hbm_budget_bytes") from e
+                prev_oom = (oom_op, cur, e.requested)
                 new = max(cur * 4, 4)
                 if new > 256:
                     raise QueryError(
@@ -142,13 +148,9 @@ class MeshRunner(LocalRunner):
         for xid, edge in fplan.edges.items():
             producer = fplan.fragments[edge.producer]
             consumer = fplan.fragments[edge.consumer]
-            key_dicts = []
-            for k in edge.partition_keys:
-                f = next((f for f in edge.fields if f.symbol == k), None)
-                key_dicts.append(f.dictionary if f else None)
             exchanges[xid] = MeshExchange(
                 xid, edge.scheme, edge.partition_keys,
-                edge.hash_dicts, key_dicts, self.mesh,
+                edge.hash_dicts, edge_key_dicts(edge), self.mesh,
                 n_producers=self._task_count(producer),
                 n_consumers=self._task_count(consumer),
                 lifespans=lifespans_of[edge.consumer],

@@ -32,6 +32,7 @@ class _Query:
         self.error: Optional[str] = None
         self.columns: Optional[List[dict]] = None
         self.data: Optional[List[list]] = None
+        self.done_at: Optional[float] = None  # set at terminal state
 
 
 class Coordinator(Node):
@@ -60,6 +61,7 @@ class Coordinator(Node):
 
     def handle_post(self, path: str, body: bytes) -> bytes:
         if path == "/v1/statement":
+            self._prune_queries()
             q = _Query(body.decode())
             self.queries[q.id] = q
             threading.Thread(target=self._run_query, args=(q,),
@@ -89,6 +91,17 @@ class Coordinator(Node):
 
     # -- query execution ---------------------------------------------------
 
+    def _prune_queries(self, ttl_s: float = 600.0) -> None:
+        """Evict terminal queries (and their buffered result rows)
+        `ttl_s` after they FINISHED/FAILED — the clock starts at
+        completion so a slow query's results stay fetchable. pop()
+        keeps concurrent handler threads from double-deleting."""
+        now = time.monotonic()
+        for qid in [qid for qid, q in list(self.queries.items())
+                    if q.done_at is not None
+                    and now - q.done_at > ttl_s]:
+            self.queries.pop(qid, None)
+
     def _run_query(self, q: _Query) -> None:
         try:
             result = self.execute(q.sql)
@@ -101,6 +114,8 @@ class Coordinator(Node):
         except Exception as e:  # noqa: BLE001
             q.error = f"{type(e).__name__}: {e}"
             q.state = "FAILED"
+        finally:
+            q.done_at = time.monotonic()
 
     def execute(self, sql: str):
         """Distributed execution: schedule fragments over the workers,
@@ -114,6 +129,12 @@ class Coordinator(Node):
         )
         runner = LocalRunner(self.catalog, self.schema, self.properties)
         fplan = derive_fragments(runner, sql)
+        if not self.worker_urls and any(
+                f.partitioning == "distributed"
+                for f in fplan.fragments.values()):
+            raise RuntimeError(
+                "query requires distributed fragments but the "
+                "coordinator has no workers")
         query_id = uuid.uuid4().hex[:12]
         exchanges = build_http_exchanges(
             query_id, fplan, self.worker_urls, self.url, self.registry)
@@ -192,6 +213,16 @@ class Coordinator(Node):
             drivers = self._drive_with_failures(pipelines, failure)
         finally:
             stop.set()
+            # release this query's resources everywhere: abort surviving
+            # remote tasks (on failure they'd otherwise keep running and
+            # pushing pages) and drop exchange state on every node
+            self.release_query(query_id)
+            for wurl in self.worker_urls:
+                try:
+                    http_post(f"{wurl}/v1/query/{query_id}/release",
+                              b"", timeout=10)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
         if failure:
             raise RuntimeError(failure[0])
         return MaterializedResult(result.result_names,
@@ -199,22 +230,39 @@ class Coordinator(Node):
                                   result.result_fields)
 
     @staticmethod
-    def _drive_with_failures(pipelines, failure: List[str]):
+    def _drive_with_failures(pipelines, failure: List[str],
+                             max_idle_s: float = 600.0):
         from presto_tpu.operators.base import DriverContext
         from presto_tpu.operators.driver import Driver
         dctx = DriverContext()
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
+        idle_since = None
         while True:
             if failure:
                 raise RuntimeError(failure[0])
             all_done = True
+            progress = False
             for d in drivers:
                 if not d.is_finished():
                     all_done = False
-                    d.process()
+                    progress = d.process() or progress
             if all_done:
                 return drivers
+            if progress:
+                idle_since = None
+                continue
+            # waiting on worker pages: sleep instead of pinning a core,
+            # and bound the wait by wall clock (a hung-but-not-failed
+            # worker must not wedge the coordinator forever)
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since > max_idle_s:
+                raise RuntimeError(
+                    f"query made no progress for {max_idle_s:.0f}s "
+                    "(hung worker?)")
+            time.sleep(0.002)
 
 
 class StatementClient:

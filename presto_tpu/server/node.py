@@ -20,12 +20,14 @@ from __future__ import annotations
 import collections
 import json
 import threading
+import time
 import traceback
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.batch import Batch
+from presto_tpu.operators.exchange_ops import edge_key_dicts
 from presto_tpu.server.serde import batch_from_bytes, batch_to_bytes
 
 
@@ -46,6 +48,8 @@ class ExchangeRegistry:
     Exchange keys are "<query_id>:<exchange_id>" — plain exchange ids
     restart at 0 for every query, and the registry outlives queries."""
 
+    _RELEASED_MAX = 4096
+
     def __init__(self):
         self._lock = threading.Lock()
         self._queues: Dict[Tuple[str, int], collections.deque] = \
@@ -53,6 +57,14 @@ class ExchangeRegistry:
         self._eos: Dict[Tuple[str, int], set] = \
             collections.defaultdict(set)
         self._expected: Dict[str, int] = {}
+        # query ids whose state was dropped: straggler pages from their
+        # surviving producers are discarded instead of re-creating
+        # entries no one will ever pop (bounded FIFO)
+        self._released: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+
+    def _is_released(self, key: str) -> bool:
+        return key.split(":", 1)[0] in self._released
 
     def expect_producers(self, key: str, count: int) -> None:
         with self._lock:
@@ -60,14 +72,19 @@ class ExchangeRegistry:
 
     def receive(self, key: str, consumer: int,
                 payload: bytes) -> None:
+        with self._lock:
+            if self._is_released(key):
+                return
         batch = batch_from_bytes(payload)
         with self._lock:
-            self._queues[(key, consumer)].append(batch)
+            if not self._is_released(key):
+                self._queues[(key, consumer)].append(batch)
 
     def receive_eos(self, key: str, consumer: int,
                     producer: int) -> None:
         with self._lock:
-            self._eos[(key, consumer)].add(producer)
+            if not self._is_released(key):
+                self._eos[(key, consumer)].add(producer)
 
     def pop(self, key: str, consumer: int) -> Optional[Batch]:
         with self._lock:
@@ -84,6 +101,22 @@ class ExchangeRegistry:
                 >= self._expected.get(key, 1 << 30)
             return done and not self._queues[(key, consumer)]
 
+    def drop_query(self, query_id: str) -> None:
+        """Release every queue/eos/expectation of a finished or failed
+        query (keys are "<query_id>:<exchange_id>") and remember the id
+        so straggler pages still in flight are discarded on arrival."""
+        prefix = f"{query_id}:"
+        with self._lock:
+            self._released[query_id] = None
+            while len(self._released) > self._RELEASED_MAX:
+                self._released.popitem(last=False)
+            for d in (self._queues, self._eos):
+                for k in [k for k in d if k[0].startswith(prefix)]:
+                    del d[k]
+            for k in [k for k in self._expected
+                      if k.startswith(prefix)]:
+                del self._expected[k]
+
 
 class HttpExchange:
     """MeshExchange-compatible facade over the DCN data plane: pushes
@@ -94,8 +127,7 @@ class HttpExchange:
                  partition_keys, hash_dicts, key_dictionaries,
                  consumer_urls: List[str], n_producers: int,
                  registry: ExchangeRegistry):
-        import jax.numpy as jnp
-        import numpy as np
+        from presto_tpu.operators.exchange_ops import build_remap_tables
         self.exchange_id = exchange_key
         self.scheme = scheme
         self.partition_keys = list(partition_keys)
@@ -104,17 +136,7 @@ class HttpExchange:
         self.registry = registry
         registry.expect_producers(exchange_key, n_producers)
         self._rr = 0
-        self._remaps = None
-        if hash_dicts is not None:
-            self._remaps = []
-            for dic, hd in zip(key_dictionaries, hash_dicts):
-                if hd is None or dic is None:
-                    self._remaps.append(None)
-                else:
-                    index = {v: i for i, v in enumerate(hd)}
-                    self._remaps.append(jnp.asarray(
-                        np.array([index[v] for v in dic] or [0],
-                                 dtype=np.int32)))
+        self._remaps = build_remap_tables(hash_dicts, key_dictionaries)
 
     # -- producer side (outgoing HTTP) -------------------------------------
 
@@ -138,15 +160,11 @@ class HttpExchange:
             self._rr += 1
             self._send(c, batch)
         else:
-            cols = []
-            for i, k in enumerate(self.partition_keys):
-                col = batch.columns[k]
-                d = col.data
-                if self._remaps is not None \
-                        and self._remaps[i] is not None:
-                    d = self._remaps[i][d]
-                cols.append((jnp.asarray(d), jnp.asarray(col.mask)))
-            h = jnp.abs(common.row_hash(cols))
+            from presto_tpu.operators.exchange_ops import (
+                partition_key_hash,
+            )
+            h = partition_key_hash(batch, self.partition_keys,
+                                   self._remaps)
             dest = (h % self.n_consumers).astype(jnp.int32)
             for c in range(self.n_consumers):
                 part = Batch(batch.columns,
@@ -177,6 +195,8 @@ class TaskState:
     def __init__(self):
         self.state = "running"
         self.error: Optional[str] = None
+        self.cancel = threading.Event()
+        self.done_at: Optional[float] = None  # set at terminal state
 
 
 class NodeHandler(BaseHTTPRequestHandler):
@@ -202,6 +222,11 @@ class NodeHandler(BaseHTTPRequestHandler):
             body = self.node.handle_get(self.path)
         except KeyError:
             self._reply(404, b'{"error": "not found"}')
+            return
+        except Exception as e:  # noqa: BLE001 — surface to caller
+            self._reply(500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}",
+                 "trace": traceback.format_exc(limit=5)}).encode())
             return
         self._reply(200, body)
 
@@ -240,6 +265,11 @@ class Node:
     def handle_get(self, path: str) -> bytes:
         if path == "/v1/info":
             return json.dumps({"state": "active"}).encode()
+        if path == "/v1/tasks":
+            # observability + test support (reference: /v1/task listing)
+            return json.dumps({
+                tid: {"state": t.state, "error": t.error}
+                for tid, t in list(self.tasks.items())}).encode()
         if path.startswith("/v1/task/"):
             tid = path.rsplit("/", 1)[1]
             t = self.tasks[tid]
@@ -264,26 +294,59 @@ class Node:
             spec = json.loads(body.decode())
             self.create_task(spec)
             return json.dumps({"taskId": spec["task_id"]}).encode()
+        if path.startswith("/v1/query/") and path.endswith("/release"):
+            # end-of-query resource release (reference: TaskResource
+            # DELETE /v1/task/{taskId}): abort the query's tasks and
+            # drop its exchange state
+            qid = path.split("/")[3]
+            self.release_query(qid)
+            return b"{}"
         raise KeyError(path)
 
     # -- task execution ----------------------------------------------------
 
     def create_task(self, spec: dict) -> None:
+        self._prune_tasks()
         state = TaskState()
         self.tasks[spec["task_id"]] = state
         threading.Thread(target=self._run_task, args=(spec, state),
                          daemon=True).start()
 
+    def _prune_tasks(self, ttl_s: float = 600.0) -> None:
+        """Evict tasks `ttl_s` after they reached a terminal state (the
+        clock starts at completion, not creation — a finished task of a
+        still-running query must stay observable by the coordinator's
+        watcher). pop() keeps concurrent handler threads from
+        double-deleting."""
+        now = time.monotonic()
+        for tid in [tid for tid, t in list(self.tasks.items())
+                    if t.done_at is not None
+                    and now - t.done_at > ttl_s]:
+            self.tasks.pop(tid, None)
+
+    def release_query(self, query_id: str) -> None:
+        for tid, t in list(self.tasks.items()):
+            if tid.startswith(f"{query_id}."):
+                t.cancel.set()
+        self.registry.drop_query(query_id)
+
     def _run_task(self, spec: dict, state: TaskState) -> None:
         try:
-            self.execute_fragment(spec)
+            self.execute_fragment(spec, state.cancel)
             state.state = "finished"
         except Exception as e:  # noqa: BLE001
-            state.state = "failed"
-            state.error = f"{type(e).__name__}: {e}\n" \
-                          f"{traceback.format_exc(limit=8)}"
+            if state.cancel.is_set():
+                state.state = "aborted"
+            else:
+                state.state = "failed"
+                state.error = f"{type(e).__name__}: {e}\n" \
+                              f"{traceback.format_exc(limit=8)}"
+        finally:
+            state.done_at = time.monotonic()
 
-    def execute_fragment(self, spec: dict) -> None:
+    def execute_fragment(self, spec: dict,
+                         cancel: Optional[threading.Event] = None
+                         ) -> None:
         """Re-derive the fragment plan from SQL (deterministic) and run
         this node's task of fragment `fragment_id`."""
         from presto_tpu.planner.local_planner import (
@@ -307,7 +370,9 @@ class Node:
         sinks = [exchanges[e.exchange_id]
                  for e in fplan.producer_edges(fid)]
         pipelines = planner.plan_fragment(fragment.root, sinks)
-        LocalRunner.drive_pipelines(pipelines)
+        LocalRunner.drive_pipelines(
+            pipelines,
+            cancel=cancel.is_set if cancel is not None else None)
 
 
 def derive_fragments(runner, sql: str):
@@ -339,14 +404,10 @@ def build_http_exchanges(query_id: str, fplan,
             if consumer.partitioning == "single" else list(worker_urls)
         n_producers = 1 if producer.partitioning == "single" \
             else len(worker_urls)
-        key_dicts = []
-        for k in edge.partition_keys:
-            f = next((f for f in edge.fields if f.symbol == k), None)
-            key_dicts.append(f.dictionary if f else None)
         out[xid] = HttpExchange(
             f"{query_id}:{xid}", edge.scheme, edge.partition_keys,
-            edge.hash_dicts, key_dicts, consumer_urls, n_producers,
-            registry)
+            edge.hash_dicts, edge_key_dicts(edge), consumer_urls,
+            n_producers, registry)
     return out
 
 

@@ -110,6 +110,35 @@ def test_cli_against_cluster(cluster):
     assert "1500" in out.stdout
 
 
+def test_query_resources_released(cluster):
+    """End-of-query cleanup: exchange state for the query is dropped
+    from the coordinator registry and every WORKER task reaches a
+    terminal state (no leaked queues / running tasks)."""
+    from presto_tpu.server.node import http_get
+    cluster.execute("select returnflag, count(*) from lineitem "
+                    "group by returnflag")
+    time.sleep(0.5)  # eos posts from workers may still be in flight
+    assert not cluster.registry._queues and not cluster.registry._eos \
+        and not cluster.registry._expected
+    seen = 0
+    for wurl in cluster.worker_urls:
+        tasks = json.loads(http_get(f"{wurl}/v1/tasks"))
+        for tid, t in tasks.items():
+            assert t["state"] != "running", (tid, t)
+            seen += 1
+    assert seen > 0  # the workers really did run tasks
+
+
+def test_zero_workers_rejected():
+    from presto_tpu.server.coordinator import Coordinator
+    coord = Coordinator([], "tpch", "tiny")
+    try:
+        with pytest.raises(RuntimeError, match="no workers"):
+            coord.execute("select count(*) from orders")
+    finally:
+        coord.httpd.server_close()
+
+
 def test_cli_local():
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
     out = subprocess.run(
