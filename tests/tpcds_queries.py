@@ -1,0 +1,248 @@
+"""TPC-DS-shaped query battery (BASELINE config 4; reference analog:
+presto-tpcds + the TPC-DS spec queries).
+
+Queries keep the spec's shapes (star joins over date_dim/item/
+demographics, case-bucket sums, returns joining back to sales, window
+ratios) with predicates adapted to this connector's generated value
+domains so every query returns rows at the tiny scale. Numbered by the
+spec query each is modeled on."""
+
+QUERIES = {
+    # q3: brand revenue for a manufacturer set in November
+    3: """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manufact_id <= 500
+  and d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, brand_id
+limit 100
+""",
+    # q7: demographic + promotion item averages
+    7: """
+select i_item_id,
+       avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    # q19: brand revenue by manager for a month, customer/store
+    # address mismatch
+    19: """
+select i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id <= 40 and d_moy = 11 and d_year = 1999
+  and ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ss_store_sk = s_store_sk
+  and ca_zip <> s_zip
+group by i_brand_id, i_brand, i_manufact_id, i_manufact
+order by ext_price desc, i_brand_id, i_manufact_id
+limit 100
+""",
+    # q22-shape (no rollup yet): inventory quantity-on-hand by product
+    22: """
+select i_product_name, avg(inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 1200 and 1211
+group by i_product_name
+order by qoh, i_product_name
+limit 100
+""",
+    # q26: catalog demographic/promotion averages
+    26: """
+select i_item_id,
+       avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+  and cd_gender = 'F' and cd_marital_status = 'W'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    # q42: category revenue for a month
+    42: """
+select d_year, i_category_id, i_category,
+       sum(ss_ext_sales_price) s
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and d_moy = 11 and d_year = 2000
+group by d_year, i_category_id, i_category
+order by s desc, d_year, i_category_id, i_category
+limit 100
+""",
+    # q52: brand revenue for a month
+    52: """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and d_moy = 12 and d_year = 1998
+group by d_year, i_brand_id, i_brand
+order by d_year, ext_price desc, brand_id
+limit 100
+""",
+    # q55: brand revenue for a manager range
+    55: """
+select i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id <= 30 and d_moy = 11 and d_year = 2001
+group by i_brand_id, i_brand
+order by ext_price desc, brand_id
+limit 100
+""",
+    # q62: web shipping latency case-buckets by warehouse/mode/site
+    62: """
+select substr(w_warehouse_name, 1, 20) wname, sm_type, web_name,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk <= 30
+                then 1 else 0 end) as d30,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 30
+                 and ws_ship_date_sk - ws_sold_date_sk <= 60
+                then 1 else 0 end) as d60,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 60
+                then 1 else 0 end) as dmore
+from web_sales, warehouse, ship_mode, web_site, date_dim
+where d_month_seq between 1200 and 1211
+  and ws_ship_date_sk = d_date_sk
+  and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by substr(w_warehouse_name, 1, 20), sm_type, web_name
+order by wname, sm_type, web_name
+limit 100
+""",
+    # q65-shape: items whose store revenue is below half the store avg
+    65: """
+select s_store_name, i_item_desc, sc.revenue
+from store,
+     item,
+     (select ss_store_sk, ss_item_sk,
+             sum(ss_sales_price) as revenue
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1211
+      group by ss_store_sk, ss_item_sk) sc,
+     (select ss_store_sk, avg(revenue) as ave
+      from (select ss_store_sk, ss_item_sk,
+                   sum(ss_sales_price) as revenue
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk
+              and d_month_seq between 1200 and 1211
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb
+where sb.ss_store_sk = sc.ss_store_sk
+  and sc.revenue <= 0.5 * sb.ave
+  and s_store_sk = sc.ss_store_sk
+  and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc, sc.revenue
+limit 100
+""",
+    # q96: count at a store during an evening half-hour
+    96: """
+select count(*) cnt
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = t_time_sk
+  and ss_hdemo_sk = hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and t_hour = 20 and t_minute >= 30
+  and hd_dep_count >= 5
+order by cnt
+limit 100
+""",
+    # q98: item revenue with a windowed class-revenue ratio
+    98: """
+select i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100.0000 /
+           sum(sum(ss_ext_sales_price))
+               over (partition by i_class) as revenueratio
+from store_sales, item, date_dim
+where ss_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ss_sold_date_sk = d_date_sk
+  and d_year = 1999
+group by i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_desc, revenueratio
+""",
+    # returns joined back to their sales rows (q17/q25 join spine)
+    101: """
+select i_item_id,
+       count(*) n,
+       sum(sr_return_quantity) ret_qty,
+       sum(ss_quantity) sold_qty
+from store_sales, store_returns, item
+where sr_ticket_number = ss_ticket_number
+  and sr_item_sk = ss_item_sk
+  and ss_item_sk = i_item_sk
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    # q16-shape: catalog orders shipped from one state, with an
+    # EXISTS sibling-order test and NOT EXISTS returns test
+    102: """
+select count(distinct cs_order_number) as order_count,
+       sum(cs_ext_ship_cost) as total_shipping_cost
+from catalog_sales cs1, date_dim, customer_address, call_center
+where cs1.cs_ship_date_sk = d_date_sk
+  and cs1.cs_ship_addr_sk = ca_address_sk
+  and cs1.cs_call_center_sk = cc_call_center_sk
+  and d_year = 2000
+  and exists (select 1 from catalog_sales cs2
+              where cs1.cs_order_number = cs2.cs_order_number
+                and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  and not exists (select 1 from catalog_returns cr1
+                  where cs1.cs_order_number = cr1.cr_order_number)
+""",
+    # q79-shape: per-customer per-ticket store revenue with
+    # demographics filter
+    103: """
+select c_last_name, c_first_name, ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk,
+             sum(ss_coupon_amt) amt,
+             sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (hd_dep_count = 3 or hd_vehicle_count > 2)
+        and d_dow = 1
+        and d_year between 1998 and 2000
+      group by ss_ticket_number, ss_customer_sk) ms, customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, ss_ticket_number, amt, profit
+limit 100
+""",
+    # windowed rank over category revenue (q67 spine, no rollup)
+    104: """
+select i_category, i_class, sumsales, rk
+from (select i_category, i_class, sum(ss_ext_sales_price) sumsales,
+             rank() over (partition by i_category
+                          order by sum(ss_ext_sales_price) desc) rk
+      from store_sales, date_dim, item
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and d_year = 2001
+      group by i_category, i_class) t
+where rk <= 3
+order by i_category, rk, i_class
+""",
+}
