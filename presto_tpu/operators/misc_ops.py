@@ -192,6 +192,92 @@ class AssignUniqueIdOperatorFactory(OperatorFactory):
             self.symbol, self.start, self.stride)
 
 
+class GroupIdOperator(Operator):
+    """GROUPING SETS replication (reference: GroupIdOperator.java): each
+    input batch is emitted once per grouping set with the key columns
+    NOT in that set masked to NULL, plus a constant group-id column and
+    one constant column per grouping(...) call. Aggregation args flow
+    through unchanged — only the materialized key copies are nulled."""
+
+    def __init__(self, ctx: OperatorContext,
+                 groupings: Sequence[Tuple[str, ...]],
+                 gid_symbol: str,
+                 grouping_outputs: Sequence[Tuple[str, Tuple[int, ...]]]):
+        super().__init__(ctx)
+        self.groupings = list(groupings)
+        self.gid_symbol = gid_symbol
+        self.grouping_outputs = list(grouping_outputs)
+        self._all_keys = set().union(*map(set, self.groupings)) \
+            if self.groupings else set()
+        # constant gid/grouping columns cached per batch capacity
+        self._consts: Dict[int, List[Dict[str, Column]]] = {}
+        self._pending: List[Batch] = []
+        self._finishing = False
+
+    def needs_input(self) -> bool:
+        return not self._pending and not self._finishing
+
+    def _const_cols(self, cap: int) -> List[Dict[str, Column]]:
+        from presto_tpu.types import BIGINT
+        cached = self._consts.get(cap)
+        if cached is None:
+            true_mask = jnp.ones(cap, bool)
+            cached = []
+            for g in range(len(self.groupings)):
+                cols = {self.gid_symbol: Column(
+                    jnp.full(cap, g, jnp.int64), true_mask, BIGINT,
+                    None)}
+                for sym, vals in self.grouping_outputs:
+                    cols[sym] = Column(
+                        jnp.full(cap, vals[g], jnp.int64), true_mask,
+                        BIGINT, None)
+                cached.append(cols)
+            self._consts[cap] = cached
+        return cached
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        cap = batch.capacity
+        consts = self._const_cols(cap)
+        null_mask = jnp.zeros(cap, bool)
+        for g, present in enumerate(self.groupings):
+            cols = dict(batch.columns)
+            for name in self._all_keys:
+                if name not in present:
+                    col = batch.columns[name]
+                    cols[name] = Column(col.data, null_mask,
+                                        col.type, col.dictionary)
+            cols.update(consts[g])
+            self._pending.append(Batch(cols, batch.row_valid))
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._pending:
+            return None
+        return self._count_out(self._pending.pop(0))
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._pending
+
+
+class GroupIdOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int,
+                 groupings: Sequence[Tuple[str, ...]],
+                 gid_symbol: str,
+                 grouping_outputs: Sequence[Tuple[str, Tuple[int, ...]]]):
+        super().__init__(operator_id, "group_id")
+        self.groupings = groupings
+        self.gid_symbol = gid_symbol
+        self.grouping_outputs = grouping_outputs
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return GroupIdOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.groupings, self.gid_symbol, self.grouping_outputs)
+
+
 class EnforceSingleRowOperator(Operator):
     """Scalar subquery contract (reference: EnforceSingleRowOperator):
     error on >1 row; a 0-row input yields one all-NULL row."""

@@ -59,6 +59,13 @@ def lex_order(keys: Sequence[CVal],
             sort_val = _negate_for_desc(key)
         else:
             sort_val = key
+        # canonicalize NULLs before the value sort: masked rows carry
+        # arbitrary payloads, and sorting by them would scatter the
+        # null block and destroy the contiguity of less-significant
+        # keys within it (the nulls-first/last pass below then moves
+        # one cohesive block, stably)
+        zero = jnp.zeros((), sort_val.dtype)
+        sort_val = jnp.where(kmask, sort_val, zero)
         order = jnp.argsort(sort_val, stable=True)
         perm = perm[order]
         # second stable pass moves NULLs to front/back without disturbing

@@ -291,9 +291,9 @@ class _Parser:
         group_by: List[T.Node] = []
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expr())
+            group_by.append(self.grouping_element())
             while self.accept_op(","):
-                group_by.append(self.expr())
+                group_by.append(self.grouping_element())
         having = self.expr() if self.accept_kw("having") else None
         return T.QuerySpec(select, distinct, from_, where, group_by,
                            having)
@@ -337,6 +337,47 @@ class _Parser:
         return T.SortItem(e, desc, nulls_first)
 
     # -- relations ---------------------------------------------------------
+
+    def _at_ident(self, name: str, offset: int = 0) -> bool:
+        t = self.toks[min(self.i + offset, len(self.toks) - 1)]
+        return t.kind == "ident" and t.value.lower() == name
+
+    def grouping_element(self) -> T.Node:
+        """GROUP BY element: plain expression, ROLLUP(...), CUBE(...),
+        or GROUPING SETS ((...), ...) (reference: SqlBase.g4
+        groupingElement). rollup/cube/grouping are contextual — plain
+        identifiers elsewhere (grouping(...) stays a function call)."""
+        for kind in ("rollup", "cube"):
+            if self._at_ident(kind) and self.toks[self.i + 1].kind \
+                    == "op" and self.toks[self.i + 1].value == "(":
+                self.advance()
+                self.expect_op("(")
+                items = [self.expr()]
+                while self.accept_op(","):
+                    items.append(self.expr())
+                self.expect_op(")")
+                return T.GroupingSetsSpec(kind, items)
+        if self._at_ident("grouping") and self._at_ident("sets", 1):
+            self.advance()
+            self.advance()
+            self.expect_op("(")
+            sets: List[List[T.Node]] = []
+            while True:
+                if self.accept_op("("):
+                    s: List[T.Node] = []
+                    if not self.accept_op(")"):
+                        s.append(self.expr())
+                        while self.accept_op(","):
+                            s.append(self.expr())
+                        self.expect_op(")")
+                    sets.append(s)
+                else:
+                    sets.append([self.expr()])
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return T.GroupingSetsSpec("sets", sets)
+        return self.expr()
 
     def table_refs(self) -> T.Node:
         left = self.joined_table()

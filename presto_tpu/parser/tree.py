@@ -211,6 +211,15 @@ class SortItem(Node):
 
 
 @dataclasses.dataclass
+class GroupingSetsSpec(Node):
+    """One GROUP BY element of the grouping-sets family (reference:
+    SqlBase.g4 groupingElement: rollup/cube/groupingSet).
+    rollup/cube: items is List[Node]; sets: items is List[List[Node]]."""
+    kind: str                    # rollup | cube | sets
+    items: List
+
+
+@dataclasses.dataclass
 class QuerySpec(Node):
     select: List[Node]           # SelectItem | Star
     distinct: bool

@@ -822,31 +822,128 @@ def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
         out_rewrites
 
 
+def _resolve_group_item(g, select_items, rp: RelationPlan):
+    """A GROUP BY item may be an ordinal, a select alias, or an
+    expression over the source scope."""
+    if isinstance(g, T.NumberLit):
+        idx = int(g.text) - 1
+        if not (0 <= idx < len(select_items)):
+            raise AnalysisError("GROUP BY ordinal out of range")
+        return select_items[idx].expr
+    if isinstance(g, T.Identifier) and len(g.parts) == 1:
+        # select alias or input column; alias wins only if not a col
+        try:
+            rp.scope.resolve(g.parts)
+        except AnalysisError:
+            match = [i for i in select_items if i.alias == g.parts[0]]
+            if match:
+                return match[0].expr
+    return g
+
+
+def _expand_grouping_sets(group_by) -> List[List]:
+    """GROUP BY elements -> the list of grouping sets (each a list of
+    item ASTs): the cross-product concatenation of each element's sets
+    per the SQL spec (plain expr = one single-item set; ROLLUP(e1..en) =
+    prefixes longest-first; CUBE = power set; GROUPING SETS as given)."""
+    per_elem: List[List[List]] = []
+    for g in group_by:
+        if not isinstance(g, T.GroupingSetsSpec):
+            per_elem.append([[g]])
+        elif g.kind == "rollup":
+            per_elem.append([list(g.items[:i])
+                             for i in range(len(g.items), -1, -1)])
+        elif g.kind == "cube":
+            n = len(g.items)
+            if n > 10:
+                raise AnalysisError("CUBE over more than 10 columns")
+            per_elem.append([
+                [e for i, e in enumerate(g.items) if mask >> i & 1]
+                for mask in range((1 << n) - 1, -1, -1)])
+        else:
+            per_elem.append([list(s) for s in g.items])
+    sets: List[List] = [[]]
+    for elem in per_elem:
+        # cap checked per accumulation step: materializing the full
+        # cross product first would let CUBE x CUBE build millions of
+        # lists before a rejection
+        if len(sets) * len(elem) > 64:
+            raise AnalysisError("too many grouping sets (max 64)")
+        sets = [s + e for s in sets for e in elem]
+    return sets
+
+
+def _collect_grouping_calls(node, out: List[T.FunctionCall]):
+    if isinstance(node, T.FunctionCall):
+        if node.name == "grouping" and node.window is None:
+            out.append(node)
+            return
+        if node.name in AGG_FUNCTIONS and node.window is None:
+            return  # grouping() never nests inside aggregates
+    if isinstance(node, (T.ScalarSubquery, T.InSubquery, T.Exists)):
+        return
+    if isinstance(node, T.Node):
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, T.Node):
+                _collect_grouping_calls(v, out)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, T.Node):
+                        _collect_grouping_calls(x, out)
+
+
 def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
                       rp: RelationPlan, ctx: PlannerContext):
     an = _Analyzer(rp.scope, ctx)
-    # group keys
+    # expand GROUPING SETS/ROLLUP/CUBE; the unique key expressions
+    # across all sets (first-appearance order) become the key columns
+    sets = _expand_grouping_sets(spec.group_by)
+    multi = len(sets) > 1
+    key_asts: List = []
+    seen_keys: set = set()
+    set_keys: List[List[tuple]] = []  # per set: ast keys present
+    for s in sets:
+        present = []
+        for g in s:
+            g_ast = _resolve_group_item(g, select_items, rp)
+            k = _ast_key(g_ast)
+            if k not in seen_keys:
+                seen_keys.add(k)
+                key_asts.append(g_ast)
+            if k not in present:
+                present.append(k)
+        set_keys.append(present)
+
     keys: List[Tuple[str, RowExpression, Optional[tuple], tuple]] = []
-    for g in spec.group_by:
-        if isinstance(g, T.NumberLit):
-            idx = int(g.text) - 1
-            if not (0 <= idx < len(select_items)):
-                raise AnalysisError("GROUP BY ordinal out of range")
-            g_ast = select_items[idx].expr
-        elif isinstance(g, T.Identifier) and len(g.parts) == 1:
-            # select alias or input column; alias wins only if not a col
-            g_ast = g
-            try:
-                rp.scope.resolve(g.parts)
-            except AnalysisError:
-                match = [i for i in select_items if i.alias == g.parts[0]]
-                if match:
-                    g_ast = match[0].expr
-        else:
-            g_ast = g
+    for g_ast in key_asts:
         e = fold_constants(an.analyze(g_ast))
         sym = ctx.symbols.new(_derive_name(g_ast))
         keys.append((sym, e, an.dictionary_of(e), _ast_key(g_ast)))
+
+    extra_rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
+    if multi:
+        rp, an, keys, extra_rewrites = _plan_group_id(
+            spec, select_items, order_items, rp, ctx, keys, set_keys)
+    else:
+        # grouping() over a single grouping set is the constant 0
+        # (nothing is ever rolled up); plan it as a constant key so the
+        # ordinary rewrite machinery applies
+        gcalls: List[T.FunctionCall] = []
+        for i in select_items:
+            _collect_grouping_calls(i.expr, gcalls)
+        if spec.having is not None:
+            _collect_grouping_calls(spec.having, gcalls)
+        for o in order_items:
+            _collect_grouping_calls(o.expr, gcalls)
+        for c in gcalls:
+            ck = _ast_key(c)
+            if ck in extra_rewrites:
+                continue
+            sym = ctx.symbols.new("grouping")
+            keys.append((sym, Literal(0, BIGINT), None,
+                         ("#grouping", sym)))
+            extra_rewrites[ck] = (sym, BIGINT, None)
 
     # aggregate calls from select + having + order by
     calls: List[T.FunctionCall] = []
@@ -879,7 +976,14 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
                                 "argument")
         argkeys = {_ast_key(c.args[0]) for c in distinct_calls}
         if any(not c.distinct for c in calls) or len(argkeys) != 1:
-            return _plan_mixed_distinct(keys, calls, rp, ctx, an)
+            rp_md, rw_md = _plan_mixed_distinct(keys, calls, rp, ctx, an)
+            # grouping()/gid columns were planned as keys; the branch
+            # join renamed every key, so route each grouping() AST to
+            # the renamed symbol via its sentinel key
+            for ck, (sym, t, d) in extra_rewrites.items():
+                repl = rw_md.get(("#grouping", sym))
+                rw_md[ck] = repl if repl is not None else (sym, t, d)
+            return rp_md, rw_md
         arg0 = fold_constants(an.analyze(distinct_calls[0].args[0]))
         d_t, d_dic = arg0.type, an.dictionary_of(arg0)
         dsym = ctx.symbols.new("distinct_arg")
@@ -946,7 +1050,78 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
     for s, e, d, k in keys:
         final_rewrites[k] = (s, e.type, d)
     final_rewrites.update(rewrites)
+    final_rewrites.update(extra_rewrites)  # grouping(...) -> gid column
     return RelationPlan(node, new_scope), final_rewrites
+
+
+def _plan_group_id(spec, select_items, order_items, rp: RelationPlan,
+                   ctx: PlannerContext, keys, set_keys):
+    """Insert Project (materialize key columns) + GroupIdNode below the
+    aggregation for multi-set grouping. Returns the updated relation,
+    analyzer, key list (key copies + gid + grouping() columns — all
+    ordinary aggregation keys), and the grouping()-call rewrites."""
+    src_fields = tuple(rp.node.output)
+    proj_assign = [(f.symbol, InputRef(f.symbol, f.type))
+                   for f in src_fields] \
+        + [(s, e) for s, e, _, _ in keys]
+    proj_fields = src_fields + tuple(
+        N.Field(s, e.type, d) for s, e, d, _ in keys)
+    proj = N.ProjectNode(rp.node, proj_assign, proj_fields)
+
+    keymap = {k: s for s, _, _, k in keys}
+    groupings = [tuple(keymap[k] for k in present)
+                 for present in set_keys]
+    gid_sym = ctx.symbols.new("groupid")
+
+    # grouping(...) calls -> per-set constant bitmask columns
+    gcalls: List[T.FunctionCall] = []
+    for i in select_items:
+        _collect_grouping_calls(i.expr, gcalls)
+    if spec.having is not None:
+        _collect_grouping_calls(spec.having, gcalls)
+    for o in order_items:
+        _collect_grouping_calls(o.expr, gcalls)
+    grouping_outputs: List[Tuple[str, Tuple[int, ...]]] = []
+    extra_rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
+    for c in gcalls:
+        ck = _ast_key(c)
+        if ck in extra_rewrites:
+            continue
+        arg_syms = []
+        for a in c.args:
+            ak = _ast_key(_resolve_group_item(a, select_items, rp))
+            if ak not in keymap:
+                raise AnalysisError(
+                    "grouping() arguments must be grouping columns")
+            arg_syms.append(keymap[ak])
+        vals = []
+        for present in groupings:
+            v = 0
+            for a_sym in arg_syms:
+                v = (v << 1) | (0 if a_sym in present else 1)
+            vals.append(v)
+        gsym = ctx.symbols.new("grouping")
+        grouping_outputs.append((gsym, tuple(vals)))
+        extra_rewrites[ck] = (gsym, BIGINT, None)
+
+    out_fields = proj_fields + tuple(
+        [N.Field(gid_sym, BIGINT, None)]
+        + [N.Field(gs, BIGINT, None) for gs, _ in grouping_outputs])
+    gnode = N.GroupIdNode(proj, groupings,
+                          tuple(s for s, _, _, _ in keys), gid_sym,
+                          grouping_outputs, out_fields)
+    rp2 = RelationPlan(gnode, rp.scope)
+    an2 = _Analyzer(rp2.scope, ctx)
+    # key copies (now materialized input columns) + gid + grouping()
+    # columns all become ordinary aggregation keys; the sentinel ast
+    # keys can never collide with a real expression's key
+    new_keys = [(s, InputRef(s, e.type), d, k) for s, e, d, k in keys]
+    new_keys.append((gid_sym, InputRef(gid_sym, BIGINT), None,
+                     ("#groupid", gid_sym)))
+    for gs, _v in grouping_outputs:
+        new_keys.append((gs, InputRef(gs, BIGINT), None,
+                         ("#grouping", gs)))
+    return rp2, an2, new_keys, extra_rewrites
 
 
 def _ast_key_for_sym(rewrites, sym):

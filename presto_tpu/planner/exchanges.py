@@ -92,6 +92,8 @@ def _estimate(node: N.PlanNode, catalogs, memo) -> float:
         return 1.0
     if isinstance(node, N.UnionNode):
         return sum(src(x) for x in node.inputs)
+    if isinstance(node, N.GroupIdNode):
+        return len(node.groupings) * src(node.source)
     if isinstance(node, N.RemoteSourceNode):
         return _UNKNOWN_ROWS
     srcs = node.sources()

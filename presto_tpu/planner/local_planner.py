@@ -405,6 +405,12 @@ class LocalExecutionPlanner:
             self._next_id(), node.symbol,
             start=self.task.index, stride=self.task.count))
 
+    def _visit_GroupIdNode(self, node: N.GroupIdNode, pipe: List):
+        self._visit(node.source, pipe)
+        pipe.append(misc_ops.GroupIdOperatorFactory(
+            self._next_id(), node.groupings, node.gid_symbol,
+            node.grouping_outputs))
+
     def _visit_UnionNode(self, node: N.UnionNode, pipe: List):
         queue = misc_ops.LocalQueue(len(node.inputs))
         for inp, symmap in zip(node.inputs, node.symbol_maps):
@@ -591,6 +597,9 @@ def _child_demand(node: N.PlanNode, demand: set
         return [(node.source, set(demand))]
     if isinstance(node, N.AssignUniqueIdNode):
         return [(node.source, demand - {node.symbol})]
+    if isinstance(node, N.GroupIdNode):
+        drop = {node.gid_symbol} | {s for s, _ in node.grouping_outputs}
+        return [(node.source, (demand - drop) | set(node.all_keys))]
     if isinstance(node, N.UnionNode):
         out = []
         for inp, m in zip(node.inputs, node.symbol_maps):
@@ -649,6 +658,10 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
             | {c.argument for c in node.calls if c.argument})
     elif isinstance(node, N.AssignUniqueIdNode):
         node.output = narrowed({node.symbol})
+    elif isinstance(node, N.GroupIdNode):
+        node.output = narrowed(
+            set(node.all_keys) | {node.gid_symbol}
+            | {s for s, _ in node.grouping_outputs})
     elif isinstance(node, N.UnionNode):
         node.output = narrowed()
         keep_syms = {f.symbol for f in node.output}

@@ -212,6 +212,24 @@ class AssignUniqueIdNode(PlanNode):
         return (self.source,)
 
 
+@dataclasses.dataclass
+class GroupIdNode(PlanNode):
+    """Replicates its input once per grouping set, NULLing the key
+    columns excluded from each set and appending a literal group-id
+    column (reference: operator/GroupIdOperator.java + the planner's
+    GroupIdNode for GROUPING SETS/ROLLUP/CUBE). `grouping_outputs` are
+    grouping(...)-call columns: a per-set constant bitmask."""
+    source: PlanNode
+    groupings: List[Tuple[str, ...]]   # key symbols PRESENT per set
+    all_keys: Tuple[str, ...]          # union of keys, stable order
+    gid_symbol: str
+    grouping_outputs: List[Tuple[str, Tuple[int, ...]]]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
 @dataclasses.dataclass(frozen=True)
 class WindowCall:
     """One window function call (reference: WindowNode.Function)."""
