@@ -1,0 +1,88 @@
+"""Native (C++) host-runtime components, built on demand with the
+system toolchain and loaded via ctypes (no pybind11 dependency).
+
+The compute path is JAX/XLA; these are the host-side pieces the
+reference implements in its performance-sensitive runtime: the page
+codec for the shuffle wire (reference: PagesSerdeFactory.java:31,
+airlift-compress). Every component has a pure-Python fallback, so the
+engine never hard-depends on a working compiler."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build(source: str, tag: str) -> Optional[str]:
+    """Compile `source` into a cached .so keyed by content hash.
+    Concurrent builders (worker processes starting together) race
+    benignly: each builds to a private temp file and os.replace()s the
+    same destination atomically."""
+    tmp = None
+    try:
+        with open(source, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        out = os.path.join(_BUILD_DIR, f"{tag}-{digest}.so")
+        if os.path.exists(out):
+            return out
+        # everything below can fail on a read-only install — that must
+        # mean "use the Python fallback", never a crash
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+        os.close(fd)
+        proc = subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, source],
+            capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            return None
+        os.replace(tmp, out)
+        tmp = None
+        return out
+    except Exception:  # noqa: BLE001 — any build failure -> fallback
+        return None
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def load_pageserde() -> Optional[ctypes.CDLL]:
+    """The page codec library, or None when unavailable (no compiler,
+    build failure) — callers fall back to pure Python."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = _build(os.path.join(_HERE, "pageserde.cpp"), "pageserde")
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.pt_compress.restype = ctypes.c_int64
+    lib.pt_compress.argtypes = [u8p, ctypes.c_int64, u8p,
+                                ctypes.c_int64]
+    lib.pt_decompress.restype = ctypes.c_int64
+    lib.pt_decompress.argtypes = [u8p, ctypes.c_int64, u8p,
+                                  ctypes.c_int64]
+    lib.pt_checksum.restype = ctypes.c_uint64
+    lib.pt_checksum.argtypes = [u8p, ctypes.c_int64]
+    lib.pt_compress_bound.restype = ctypes.c_int64
+    lib.pt_compress_bound.argtypes = [ctypes.c_int64]
+    _lib = lib
+    return _lib

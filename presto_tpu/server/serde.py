@@ -1,20 +1,23 @@
 """Batch wire format for the DCN data plane (reference:
-execution/buffer/PagesSerde — LZ4-compressed pages over HTTP; here
-npz-compressed numpy columns + a JSON schema header).
+execution/buffer/PagesSerde — LZ4-compressed, checksummed pages over
+HTTP; our block codec is the C++ `native/pageserde.cpp` with a zlib
+fallback, selected per frame).
 
 Only live rows travel: batches are compacted before serialization, so
 the wire never carries padding lanes.
-"""
+
+Layout: 4-byte big-endian header length, JSON header (column metadata +
+array table), then one codec frame holding every column's raw bytes
+concatenated (data + mask per column, then row_valid)."""
 
 from __future__ import annotations
 
-import io
 import json
-from typing import Tuple
 
 import numpy as np
 
 from presto_tpu.batch import Batch, Column, bucket_capacity
+from presto_tpu.native import codec
 from presto_tpu.types import parse_type
 
 
@@ -24,34 +27,50 @@ def batch_to_bytes(batch: Batch) -> bytes:
     n = batch.num_valid()
     b = batch.compact(bucket_capacity(max(n, 1)), known_valid=n)
     host = jax.device_get(b)
-    header = {
-        "columns": [
-            {"name": name, "type": c.type.display(),
-             "dictionary": list(c.dictionary)
-             if c.dictionary is not None else None}
-            for name, c in host.columns.items()
-        ],
-    }
-    arrays = {}
-    for i, (name, c) in enumerate(host.columns.items()):
-        arrays[f"d{i}"] = np.asarray(c.data)
-        arrays[f"m{i}"] = np.asarray(c.mask)
-    arrays["rv"] = np.asarray(host.row_valid)
-    buf = io.BytesIO()
-    np.savez_compressed(buf, **arrays)
-    payload = buf.getvalue()
-    head = json.dumps(header).encode()
-    return len(head).to_bytes(4, "big") + head + payload
+    parts = []
+    columns = []
+    arrays = []
+    offset = 0
+
+    def add(arr: np.ndarray):
+        nonlocal offset
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        arrays.append({"dtype": arr.dtype.str, "n": int(arr.shape[0]),
+                       "off": offset})
+        parts.append(raw)
+        offset += len(raw)
+
+    for name, c in host.columns.items():
+        columns.append({
+            "name": name, "type": c.type.display(),
+            "dictionary": list(c.dictionary)
+            if c.dictionary is not None else None,
+        })
+        add(np.asarray(c.data))
+        add(np.asarray(c.mask))
+    add(np.asarray(host.row_valid))
+    header = json.dumps({"columns": columns, "arrays": arrays}).encode()
+    frame = codec.encode(b"".join(parts))
+    return len(header).to_bytes(4, "big") + header + frame
 
 
 def batch_from_bytes(data: bytes) -> Batch:
     hlen = int.from_bytes(data[:4], "big")
     header = json.loads(data[4:4 + hlen].decode())
-    npz = np.load(io.BytesIO(data[4 + hlen:]))
+    body = codec.decode(data[4 + hlen:])
+
+    def arr(i: int) -> np.ndarray:
+        meta = header["arrays"][i]
+        dt = np.dtype(meta["dtype"])
+        off = meta["off"]
+        return np.frombuffer(
+            body, dt, count=meta["n"], offset=off).copy()
+
     cols = {}
     for i, meta in enumerate(header["columns"]):
         dic = tuple(meta["dictionary"]) \
             if meta["dictionary"] is not None else None
         cols[meta["name"]] = Column(
-            npz[f"d{i}"], npz[f"m{i}"], parse_type(meta["type"]), dic)
-    return Batch(cols, npz["rv"])
+            arr(2 * i), arr(2 * i + 1), parse_type(meta["type"]), dic)
+    return Batch(cols, arr(2 * len(header["columns"])))
