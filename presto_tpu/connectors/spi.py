@@ -96,6 +96,12 @@ class ConnectorMetadata(abc.ABC):
         presto-main cost/StatsCalculator). None = unknown."""
         return None
 
+    def column_stats(self, handle: TableHandle) -> Dict[str, Any]:
+        """Optional per-column statistics: {column: planner.stats
+        .ColStats} (NDV, null fraction, min/max in physical units).
+        Missing columns fall back to dictionary-derived NDVs."""
+        return {}
+
 
 class ConnectorSplitManager(abc.ABC):
     @abc.abstractmethod

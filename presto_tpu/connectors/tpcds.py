@@ -1199,6 +1199,53 @@ class _TpcdsMetadata(ConnectorMetadata):
     def estimate_row_count(self, handle: TableHandle) -> int:
         return self._gens[handle.schema].rows(handle.table)
 
+    def column_stats(self, handle: TableHandle):
+        """Stats derived from the generation spec itself: fk columns
+        have the target table's cardinality, numeric columns their
+        configured ranges, date fks the sales span."""
+        from presto_tpu.planner.stats import ColStats
+        gen = self._gens[handle.schema]
+        out = {}
+        for c in _columns(handle.table):
+            if c.kind == "pk":
+                cs = ColStats(ndv=gen.rows(handle.table),
+                              null_frac=c.null_frac)
+            elif c.kind == "fk":
+                cs = ColStats(ndv=gen.rows(c.arg), low=1,
+                              high=gen.rows(c.arg),
+                              null_frac=c.null_frac)
+            elif c.kind == "date_fk":
+                cs = ColStats(ndv=_SALES_SK_HI - _SALES_SK_LO + 1,
+                              low=_SALES_SK_LO, high=_SALES_SK_HI,
+                              null_frac=c.null_frac)
+            elif c.kind == "time_fk":
+                cs = ColStats(ndv=86_400, low=0, high=86_399,
+                              null_frac=c.null_frac)
+            elif c.kind == "int":
+                lo, hi = c.arg
+                cs = ColStats(ndv=hi - lo + 1, low=lo, high=hi,
+                              null_frac=c.null_frac)
+            elif c.kind == "money":
+                lo, hi = c.arg
+                cs = ColStats(low=lo, high=hi, null_frac=c.null_frac)
+            else:
+                continue  # dict-derived or derived columns
+            out[c.name] = cs
+        if handle.table == "date_dim":
+            out["d_date_sk"] = ColStats(ndv=_N_DATES, low=_SK_D0,
+                                        high=_SK_D0 + _N_DATES - 1)
+            out["d_year"] = ColStats(ndv=_D1.year - _D0.year + 1,
+                                     low=_D0.year, high=_D1.year)
+            out["d_moy"] = ColStats(ndv=12, low=1, high=12)
+            out["d_dom"] = ColStats(ndv=31, low=1, high=31)
+            out["d_dow"] = ColStats(ndv=7, low=0, high=6)
+            out["d_qoy"] = ColStats(ndv=4, low=1, high=4)
+            out["d_month_seq"] = ColStats(
+                ndv=(_D1.year - _D0.year + 1) * 12,
+                low=(_D0.year - 1900) * 12,
+                high=(_D1.year - 1900) * 12 + 11)
+        return out
+
 
 class _TpcdsSplitManager(ConnectorSplitManager):
     def __init__(self, gens: Dict[str, TpcdsGenerator]):

@@ -465,6 +465,65 @@ class _TpchMetadata(ConnectorMetadata):
             return gen.rows("orders") * 4  # ~4 lines per order
         return gen.rows(handle.table)
 
+    def column_stats(self, handle: TableHandle):
+        """Analytic per-column stats (the generator's value domains are
+        known exactly — the analog of presto-tpch's TpchMetadata
+        statistics tables)."""
+        from presto_tpu.planner.stats import ColStats
+        gen = self._gens[handle.schema]
+        r = gen.rows
+        # date physical units: days since 1970-01-01
+        d92, d98_08 = 8035, 10440       # orderdate span per dbgen
+        t = handle.table
+        if t == "lineitem":
+            return {
+                "orderkey": ColStats(ndv=r("orders")),
+                "partkey": ColStats(ndv=r("part")),
+                "suppkey": ColStats(ndv=r("supplier")),
+                "linenumber": ColStats(ndv=7, low=1, high=7),
+                "quantity": ColStats(ndv=50, low=1, high=50),
+                "extendedprice": ColStats(low=900, high=105000),
+                "discount": ColStats(ndv=11, low=0.0, high=0.1),
+                "tax": ColStats(ndv=9, low=0.0, high=0.08),
+                "shipdate": ColStats(ndv=2527, low=d92 + 1,
+                                     high=d98_08 + 122),
+                "commitdate": ColStats(ndv=2527, low=d92 + 30,
+                                       high=d98_08 + 90),
+                "receiptdate": ColStats(ndv=2527, low=d92 + 2,
+                                        high=d98_08 + 152),
+            }
+        if t == "orders":
+            return {
+                "orderkey": ColStats(ndv=r("orders")),
+                "custkey": ColStats(ndv=r("customer")),
+                "orderdate": ColStats(ndv=2406, low=d92, high=d98_08),
+                "totalprice": ColStats(low=850, high=560000),
+                "shippriority": ColStats(ndv=1, low=0, high=0),
+            }
+        if t == "customer":
+            return {"custkey": ColStats(ndv=r("customer")),
+                    "nationkey": ColStats(ndv=25, low=0, high=24),
+                    "acctbal": ColStats(low=-1000, high=10000)}
+        if t == "supplier":
+            return {"suppkey": ColStats(ndv=r("supplier")),
+                    "nationkey": ColStats(ndv=25, low=0, high=24),
+                    "acctbal": ColStats(low=-1000, high=10000)}
+        if t == "part":
+            return {"partkey": ColStats(ndv=r("part")),
+                    "size": ColStats(ndv=50, low=1, high=50),
+                    "retailprice": ColStats(low=900, high=2100)}
+        if t == "partsupp":
+            return {"partkey": ColStats(ndv=r("part")),
+                    "suppkey": ColStats(ndv=r("supplier")),
+                    "availqty": ColStats(ndv=9999, low=1, high=9999),
+                    "supplycost": ColStats(low=1, high=1000)}
+        if t == "nation":
+            return {"nationkey": ColStats(ndv=25, low=0, high=24),
+                    "regionkey": ColStats(ndv=5, low=0, high=4)}
+        if t == "region":
+            return {"regionkey": ColStats(ndv=5, low=0, high=4)}
+        return {}
+
 
 class _TpchSplitManager(ConnectorSplitManager):
     def __init__(self, gens: Dict[str, TpchGenerator]):

@@ -42,65 +42,6 @@ from presto_tpu.types import DOUBLE, Type
 
 
 # ---------------------------------------------------------------------------
-# Cardinality estimation (reference: presto-main cost/ — the minimal
-# stats the broadcast-vs-partitioned decision needs; no histograms)
-
-_UNKNOWN_ROWS = 1e9  # unknown = assume large -> partitioned join
-
-
-def estimate_rows(node: N.PlanNode, catalogs,
-                  _memo: Optional[Dict[int, float]] = None) -> float:
-    memo = _memo if _memo is not None else {}
-    if id(node) in memo:
-        return memo[id(node)]
-    est = _estimate(node, catalogs, memo)
-    memo[id(node)] = est
-    return est
-
-
-def _estimate(node: N.PlanNode, catalogs, memo) -> float:
-    def src(n):
-        return estimate_rows(n, catalogs, memo)
-
-    if isinstance(node, N.TableScanNode):
-        try:
-            conn = catalogs.connector(node.handle.catalog)
-            n = conn.metadata.estimate_row_count(node.handle)
-        except Exception:
-            n = None
-        return float(n) if n is not None else _UNKNOWN_ROWS
-    if isinstance(node, N.ValuesNode):
-        return float(len(node.rows))
-    if isinstance(node, N.FilterNode):
-        return 0.33 * src(node.source)
-    if isinstance(node, N.AggregationNode):
-        if not node.keys:
-            return 1.0
-        return max(1.0, 0.1 * src(node.source))
-    if isinstance(node, N.DistinctNode):
-        return max(1.0, 0.3 * src(node.source))
-    if isinstance(node, N.JoinNode):
-        l, r = src(node.left), src(node.right)
-        if node.join_type == "cross" or not node.criteria:
-            return l * r
-        return max(l, r)
-    if isinstance(node, N.SemiJoinNode):
-        return src(node.source)
-    if isinstance(node, (N.LimitNode, N.TopNNode)):
-        return min(float(node.n), src(node.source))
-    if isinstance(node, N.EnforceSingleRowNode):
-        return 1.0
-    if isinstance(node, N.UnionNode):
-        return sum(src(x) for x in node.inputs)
-    if isinstance(node, N.GroupIdNode):
-        return len(node.groupings) * src(node.source)
-    if isinstance(node, N.RemoteSourceNode):
-        return _UNKNOWN_ROWS
-    srcs = node.sources()
-    return src(srcs[0]) if srcs else _UNKNOWN_ROWS
-
-
-# ---------------------------------------------------------------------------
 # Partitioning properties
 
 P_SINGLE = "single"
@@ -132,7 +73,8 @@ class _Exchanger:
             "broadcast_join_threshold_rows", 100_000))
         self._memo: Dict[int, Tuple[N.PlanNode, Props]] = {}
         self._shared: set = set()
-        self._est_memo: Dict[int, float] = {}
+        from presto_tpu.planner.stats import StatsEstimator
+        self._estimator = StatsEstimator(catalogs)
 
     def run(self, root: N.OutputNode) -> N.OutputNode:
         self._shared = _shared_nodes(root)
@@ -168,7 +110,7 @@ class _Exchanger:
         return self._exchange(node, "repartition", keys, dicts)
 
     def _est(self, node: N.PlanNode) -> float:
-        return estimate_rows(node, self.catalogs, self._est_memo)
+        return self._estimator.rows(node)
 
     # -- the walk ----------------------------------------------------------
 

@@ -17,8 +17,16 @@ from presto_tpu.planner import nodes as N
 from presto_tpu.types import BOOLEAN
 
 
-def optimize(root: N.PlanNode) -> N.PlanNode:
-    root = _rewrite(root)
+def optimize(root: N.PlanNode, catalogs=None) -> N.PlanNode:
+    """`catalogs` enables the cost-based join-order choice (reference:
+    ReorderJoins + CostCalculatorUsingExchanges); without it ordering
+    falls back to the connectivity heuristic. Estimates are analytic,
+    so distributed nodes re-deriving the plan stay deterministic."""
+    estimator = None
+    if catalogs is not None:
+        from presto_tpu.planner.stats import StatsEstimator
+        estimator = StatsEstimator(catalogs)
+    root = _rewrite(root, estimator)
     _push_scan_constraints(root)
     return root
 
@@ -109,15 +117,16 @@ def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
         for col, d in sorted(doms.items())))
 
 
-def _rewrite(node: N.PlanNode) -> N.PlanNode:
+def _rewrite(node: N.PlanNode, estimator=None) -> N.PlanNode:
     # rewrite children first
     for attr in ("source", "left", "right", "filtering_source"):
         if hasattr(node, attr):
-            setattr(node, attr, _rewrite(getattr(node, attr)))
+            setattr(node, attr,
+                    _rewrite(getattr(node, attr), estimator))
     if isinstance(node, N.UnionNode):
-        node.inputs = [_rewrite(x) for x in node.inputs]
+        node.inputs = [_rewrite(x, estimator) for x in node.inputs]
     if isinstance(node, N.FilterNode):
-        return _rewrite_filter(node)
+        return _rewrite_filter(node, estimator)
     return node
 
 
@@ -153,7 +162,7 @@ def _flatten_cross(node: N.PlanNode, leaves: List[N.PlanNode]) -> bool:
     return False
 
 
-def _rewrite_filter(node: N.FilterNode) -> N.PlanNode:
+def _rewrite_filter(node: N.FilterNode, estimator=None) -> N.PlanNode:
     leaves: List[N.PlanNode] = []
     if not _flatten_cross(node.source, leaves) or len(leaves) < 2:
         return node
@@ -189,40 +198,48 @@ def _rewrite_filter(node: N.FilterNode) -> N.PlanNode:
         else:
             new_leaves.append(leaf)
 
-    # 2. greedy left-deep join tree over the predicate graph
+    # 2. greedy left-deep join tree over the predicate graph,
+    # cost-based when stats are available (reference: ReorderJoins —
+    # at each step take the connected leaf minimizing the estimated
+    # intermediate size; probes accumulate left, builds join right)
     used = [False] * len(new_leaves)
-    order = _initial_leaf(join_preds, leaf_syms, new_leaves)
+    order = _initial_leaf(join_preds, leaf_syms, new_leaves, estimator)
     current = new_leaves[order]
     used[order] = True
     current_syms = set(leaf_syms[order])
     unused_preds = list(join_preds)
-    while not all(used):
-        # find a leaf connected to the current tree
-        best = None
+
+    def criteria_for(i):
+        crit = []
         for (c, l, r) in unused_preds:
-            for i, syms in enumerate(leaf_syms):
-                if used[i]:
-                    continue
-                if (l in current_syms and r in syms) or \
-                        (r in current_syms and l in syms):
-                    best = i
-                    break
-            if best is not None:
-                break
-        if best is None:  # disconnected: true cross join
+            if l in current_syms and r in leaf_syms[i]:
+                crit.append(((l, r), c))
+            elif r in current_syms and l in leaf_syms[i]:
+                crit.append(((r, l), c))
+        return crit
+
+    while not all(used):
+        connected = [i for i in range(len(new_leaves))
+                     if not used[i] and criteria_for(i)]
+        if not connected:  # disconnected: true cross join
             best = next(i for i, u in enumerate(used) if not u)
             criteria: List[Tuple[str, str]] = []
             taken: List[RowExpression] = []
         else:
-            criteria = []
-            taken = []
-            for (c, l, r) in unused_preds:
-                if l in current_syms and r in leaf_syms[best]:
-                    criteria.append((l, r))
-                    taken.append(c)
-                elif r in current_syms and l in leaf_syms[best]:
-                    criteria.append((r, l))
-                    taken.append(c)
+            if estimator is not None and len(connected) > 1:
+                def joined_rows(i):
+                    probe = N.JoinNode(
+                        "inner", current, new_leaves[i],
+                        [p for p, _ in criteria_for(i)],
+                        tuple(current.output)
+                        + tuple(new_leaves[i].output))
+                    return estimator.estimate(probe).rows
+                best = min(connected, key=joined_rows)
+            else:
+                best = connected[0]
+            pairs = criteria_for(best)
+            criteria = [p for p, _ in pairs]
+            taken = [c for _, c in pairs]
         unused_preds = [p for p in unused_preds if p[0] not in
                         [t for t in taken]]
         leaf = new_leaves[best]
@@ -246,11 +263,14 @@ def _rewrite_filter(node: N.FilterNode) -> N.PlanNode:
     return current
 
 
-def _initial_leaf(join_preds, leaf_syms, leaves) -> int:
+def _initial_leaf(join_preds, leaf_syms, leaves, estimator=None) -> int:
     """Start from the largest relation so it stays on the probe side
-    (builds should be the smaller inputs). Heuristic: a leaf that is a
-    bare TableScan of a fact-sized table, detected by connected degree —
-    the most-connected leaf is usually the fact table."""
+    (builds should be the smaller inputs). With stats: the leaf with
+    the most estimated rows; without: the most-connected leaf is
+    usually the fact table."""
+    if estimator is not None:
+        return max(range(len(leaves)),
+                   key=lambda i: estimator.estimate(leaves[i]).rows)
     degree = [0] * len(leaves)
     for (_, l, r) in join_preds:
         for i, syms in enumerate(leaf_syms):

@@ -147,7 +147,7 @@ class LocalRunner:
         except AnalysisError as e:
             raise QueryError(str(e)) from e
         from presto_tpu.planner.optimizer import optimize
-        plan = optimize(plan)
+        plan = optimize(plan, self.catalogs)
         return self._run_plan(plan)
 
     def create_plan(self, sql: str) -> N.OutputNode:
@@ -263,7 +263,7 @@ class LocalRunner:
         except AnalysisError as e:
             raise QueryError(str(e)) from e
         from presto_tpu.planner.optimizer import optimize
-        return self._run_plan(optimize(plan))
+        return self._run_plan(optimize(plan, self.catalogs))
 
     def _create_table_as(self, stmt: T.CreateTableAs
                          ) -> MaterializedResult:
@@ -374,7 +374,7 @@ class LocalRunner:
         plan = plan_statement(inner, self.catalogs, self.session)
         from presto_tpu.planner.local_planner import prune_unused_columns
         from presto_tpu.planner.optimizer import optimize
-        plan = optimize(plan)
+        plan = optimize(plan, self.catalogs)
         prune_unused_columns(plan)
         if stmt.analyze:
             result = self._run_plan(plan, profile=True)

@@ -281,7 +281,7 @@ class MeshRunner(LocalRunner):
     def explain_text(self, sql: str) -> str:
         """Fragmented EXPLAIN (reference: planPrinter's fragment view)."""
         from presto_tpu.planner.optimizer import optimize
-        plan = optimize(self.create_plan(sql))
+        plan = optimize(self.create_plan(sql), self.catalogs)
         prune_unused_columns(plan)
         plan = add_exchanges(plan, self.catalogs, self.session)
         return fragment_plan(plan).text()

@@ -118,9 +118,43 @@ class Coordinator(Node):
             q.done_at = time.monotonic()
 
     def execute(self, sql: str):
-        """Distributed execution: schedule fragments over the workers,
-        run the single-partition fragments locally, return the root
-        result (the DistributedQueryRunner-style entry point)."""
+        """Distributed execution with elastic retry: a failed or dead
+        worker fails the attempt, the membership is re-probed, and the
+        query re-runs on the survivors — splits regenerate identically
+        anywhere, so no state needs recovering (reference:
+        SqlQueryScheduler section retry :667-690 + P7/P8 relocatable
+        splits; a whole-query retry is the single-section case)."""
+        retries = int(self.properties.get("query_retries", 1))
+        workers = list(self.worker_urls)
+        attempt = 0
+        while True:
+            try:
+                return self._execute_attempt(sql, workers)
+            except Exception as e:  # noqa: BLE001 — inspect + retry
+                attempt += 1
+                if attempt > retries:
+                    raise
+                alive = []
+                for url in workers:
+                    try:
+                        st = json.loads(http_get(f"{url}/v1/info",
+                                                 timeout=5))
+                        if st.get("state") == "active":
+                            alive.append(url)
+                    except Exception:  # noqa: BLE001 — dead worker
+                        pass
+                if not alive:
+                    raise
+                if len(alive) == len(workers):
+                    # nothing died — the failure is the query's own
+                    # (analysis error, execution bug): don't mask it
+                    # behind a retry
+                    raise
+                workers = alive
+                continue
+
+    def _execute_attempt(self, sql: str, worker_urls: List[str]):
+        """One scheduling attempt over a fixed worker set."""
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
         )
@@ -129,7 +163,7 @@ class Coordinator(Node):
         )
         runner = LocalRunner(self.catalog, self.schema, self.properties)
         fplan = derive_fragments(runner, sql)
-        if not self.worker_urls and any(
+        if not worker_urls and any(
                 f.partitioning == "distributed"
                 for f in fplan.fragments.values()):
             raise RuntimeError(
@@ -137,32 +171,39 @@ class Coordinator(Node):
                 "coordinator has no workers")
         query_id = uuid.uuid4().hex[:12]
         exchanges = build_http_exchanges(
-            query_id, fplan, self.worker_urls, self.url, self.registry)
+            query_id, fplan, worker_urls, self.url, self.registry)
 
         # dispatch distributed fragments: one task per worker
-        # (reference: SqlStageExecution.scheduleTask -> HttpRemoteTask)
+        # (reference: SqlStageExecution.scheduleTask -> HttpRemoteTask).
+        # The release below MUST cover dispatch failures too — a dead
+        # worker mid-dispatch (the canonical retry trigger) would
+        # otherwise leak the attempt's running tasks and registry state
         remote: List[tuple] = []
-        for fid, fragment in fplan.fragments.items():
-            if fragment.partitioning != "distributed":
-                continue
-            for t, wurl in enumerate(self.worker_urls):
-                task_id = f"{query_id}.{fid}.{t}"
-                spec = {
-                    "task_id": task_id,
-                    "query_id": query_id,
-                    "sql": sql,
-                    "session": {"catalog": self.catalog,
-                                "schema": self.schema,
-                                "properties": self.properties},
-                    "fragment_id": fid,
-                    "task_index": t,
-                    "n_tasks": len(self.worker_urls),
-                    "worker_urls": self.worker_urls,
-                    "coordinator_url": self.url,
-                }
-                http_post(f"{wurl}/v1/task",
-                          json.dumps(spec).encode())
-                remote.append((task_id, wurl))
+        try:
+            for fid, fragment in fplan.fragments.items():
+                if fragment.partitioning != "distributed":
+                    continue
+                for t, wurl in enumerate(worker_urls):
+                    task_id = f"{query_id}.{fid}.{t}"
+                    spec = {
+                        "task_id": task_id,
+                        "query_id": query_id,
+                        "sql": sql,
+                        "session": {"catalog": self.catalog,
+                                    "schema": self.schema,
+                                    "properties": self.properties},
+                        "fragment_id": fid,
+                        "task_index": t,
+                        "n_tasks": len(worker_urls),
+                        "worker_urls": worker_urls,
+                        "coordinator_url": self.url,
+                    }
+                    http_post(f"{wurl}/v1/task",
+                              json.dumps(spec).encode())
+                    remote.append((task_id, wurl))
+        except Exception:
+            self._release_everywhere(query_id, worker_urls)
+            raise
 
         # run single-partition fragments here (root last -> result)
         result = None
@@ -216,18 +257,22 @@ class Coordinator(Node):
             # release this query's resources everywhere: abort surviving
             # remote tasks (on failure they'd otherwise keep running and
             # pushing pages) and drop exchange state on every node
-            self.release_query(query_id)
-            for wurl in self.worker_urls:
-                try:
-                    http_post(f"{wurl}/v1/query/{query_id}/release",
-                              b"", timeout=10)
-                except Exception:  # noqa: BLE001 — best-effort cleanup
-                    pass
+            self._release_everywhere(query_id, worker_urls)
         if failure:
             raise RuntimeError(failure[0])
         return MaterializedResult(result.result_names,
                                   result.result_sink,
                                   result.result_fields)
+
+    def _release_everywhere(self, query_id: str,
+                            worker_urls: List[str]) -> None:
+        self.release_query(query_id)
+        for wurl in worker_urls:
+            try:
+                http_post(f"{wurl}/v1/query/{query_id}/release",
+                          b"", timeout=10)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
 
     @staticmethod
     def _drive_with_failures(pipelines, failure: List[str],

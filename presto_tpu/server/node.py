@@ -383,7 +383,7 @@ def derive_fragments(runner, sql: str):
     )
     from presto_tpu.planner.local_planner import prune_unused_columns
     from presto_tpu.planner.optimizer import optimize
-    plan = optimize(runner.create_plan(sql))
+    plan = optimize(runner.create_plan(sql), runner.catalogs)
     prune_unused_columns(plan)
     plan = add_exchanges(plan, runner.catalogs, runner.session)
     return fragment_plan(plan)
