@@ -468,7 +468,13 @@ class _TpchMetadata(ConnectorMetadata):
     def column_stats(self, handle: TableHandle):
         """Analytic per-column stats (the generator's value domains are
         known exactly — the analog of presto-tpch's TpchMetadata
-        statistics tables)."""
+        statistics tables). The generator never emits NULLs, so every
+        column's null fraction is a known 0."""
+        import dataclasses as _dc
+        return {k: _dc.replace(v, null_frac=0.0)
+                for k, v in self._column_stats_raw(handle).items()}
+
+    def _column_stats_raw(self, handle: TableHandle):
         from presto_tpu.planner.stats import ColStats
         gen = self._gens[handle.schema]
         r = gen.rows

@@ -31,7 +31,7 @@ _COMPARISONS = {"less_than", "less_than_or_equal", "greater_than",
 @dataclasses.dataclass(frozen=True)
 class ColStats:
     ndv: Optional[float] = None
-    null_frac: float = 0.0
+    null_frac: Optional[float] = None  # None = unknown (0.0 = known 0)
     low: Optional[float] = None   # numeric/physical (dates = days)
     high: Optional[float] = None
 
@@ -251,7 +251,10 @@ def _selectivity(pred, inner: PlanStats
             if e.form == "is_null":
                 v = e.args[0]
                 if isinstance(v, InputRef):
-                    return inner.col(v.name).null_frac or 0.05
+                    nf = inner.col(v.name).null_frac
+                    # a KNOWN 0.0 means the column provably has no
+                    # NULLs — don't mistake it for unknown
+                    return nf if nf is not None else 0.05
                 return 0.05
             return _DEFAULT_SELECTIVITY
         if isinstance(e, Call):

@@ -173,13 +173,17 @@ class Coordinator(Node):
         exchanges = build_http_exchanges(
             query_id, fplan, worker_urls, self.url, self.registry)
 
-        # dispatch distributed fragments: one task per worker
-        # (reference: SqlStageExecution.scheduleTask -> HttpRemoteTask).
-        # The release below MUST cover dispatch failures too — a dead
-        # worker mid-dispatch (the canonical retry trigger) would
-        # otherwise leak the attempt's running tasks and registry state
+        # everything from first dispatch to completion runs under one
+        # release guard: a failure at ANY point (dead worker mid-
+        # dispatch, local planning bug, drive failure) must abort the
+        # attempt's remote tasks and drop its exchange state before the
+        # retry loop launches the next attempt
         remote: List[tuple] = []
+        stop = threading.Event()
         try:
+            # dispatch distributed fragments: one task per worker
+            # (reference: SqlStageExecution.scheduleTask ->
+            # HttpRemoteTask)
             for fid, fragment in fplan.fragments.items():
                 if fragment.partitioning != "distributed":
                     continue
@@ -201,62 +205,56 @@ class Coordinator(Node):
                     http_post(f"{wurl}/v1/task",
                               json.dumps(spec).encode())
                     remote.append((task_id, wurl))
-        except Exception:
-            self._release_everywhere(query_id, worker_urls)
-            raise
 
-        # run single-partition fragments here (root last -> result)
-        result = None
-        pipelines: List[list] = []
-        for fid, fragment in fplan.fragments.items():
-            if fragment.partitioning != "single":
-                continue
-            task = TaskContext(index=0, count=1, device=None,
-                               exchanges=exchanges)
-            planner = LocalExecutionPlanner(
-                runner.catalogs, runner.session, task=task)
-            if fid == fplan.root_id:
-                lplan = planner.plan(fragment.root)
-                pipelines.extend(lplan.pipelines)
-                result = lplan
-            else:
-                sinks = [exchanges[e.exchange_id]
-                         for e in fplan.producer_edges(fid)]
-                pipelines.extend(planner.plan_fragment(fragment.root,
-                                                       sinks))
-        assert result is not None
+            # run single-partition fragments here (root last -> result)
+            result = None
+            pipelines: List[list] = []
+            for fid, fragment in fplan.fragments.items():
+                if fragment.partitioning != "single":
+                    continue
+                task = TaskContext(index=0, count=1, device=None,
+                                   exchanges=exchanges)
+                planner = LocalExecutionPlanner(
+                    runner.catalogs, runner.session, task=task)
+                if fid == fplan.root_id:
+                    lplan = planner.plan(fragment.root)
+                    pipelines.extend(lplan.pipelines)
+                    result = lplan
+                else:
+                    sinks = [exchanges[e.exchange_id]
+                             for e in fplan.producer_edges(fid)]
+                    pipelines.extend(
+                        planner.plan_fragment(fragment.root, sinks))
+            assert result is not None
 
-        failure: List[str] = []
-        stop = threading.Event()
+            failure: List[str] = []
 
-        def watch():
-            # failure detection: poll remote task state; a failed task
-            # fails the query (reference: ContinuousTaskStatusFetcher
-            # + RequestErrorTracker)
-            while not stop.is_set():
-                for task_id, wurl in remote:
-                    try:
-                        st = json.loads(http_get(
-                            f"{wurl}/v1/task/{task_id}", timeout=10))
-                    except Exception as e:  # noqa: BLE001
-                        failure.append(f"worker {wurl} unreachable: "
-                                       f"{e}")
-                        return
-                    if st["state"] == "failed":
-                        failure.append(
-                            f"task {task_id} failed: {st['error']}")
-                        return
-                time.sleep(0.2)
+            def watch():
+                # failure detection: poll remote task state; a failed
+                # task fails the query (reference:
+                # ContinuousTaskStatusFetcher + RequestErrorTracker)
+                while not stop.is_set():
+                    for task_id, wurl in remote:
+                        try:
+                            st = json.loads(http_get(
+                                f"{wurl}/v1/task/{task_id}",
+                                timeout=10))
+                        except Exception as e:  # noqa: BLE001
+                            failure.append(
+                                f"worker {wurl} unreachable: {e}")
+                            return
+                        if st["state"] == "failed":
+                            failure.append(
+                                f"task {task_id} failed: "
+                                f"{st['error']}")
+                            return
+                    time.sleep(0.2)
 
-        watcher = threading.Thread(target=watch, daemon=True)
-        watcher.start()
-        try:
+            watcher = threading.Thread(target=watch, daemon=True)
+            watcher.start()
             drivers = self._drive_with_failures(pipelines, failure)
         finally:
             stop.set()
-            # release this query's resources everywhere: abort surviving
-            # remote tasks (on failure they'd otherwise keep running and
-            # pushing pages) and drop exchange state on every node
             self._release_everywhere(query_id, worker_urls)
         if failure:
             raise RuntimeError(failure[0])

@@ -1,0 +1,2 @@
+"""Operational tooling: verifier + benchmark suite (reference modules:
+presto-verifier, presto-benchmark)."""

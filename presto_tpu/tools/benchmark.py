@@ -1,0 +1,91 @@
+"""Benchmark suite: run a query suite on a runner and record per-query
+wall times + rows/s (reference: presto-benchmark BenchmarkSuite.java +
+AbstractSqlBenchmark over LocalQueryRunner; bench.py at the repo root
+remains the driver's single-number headline).
+
+Usage:
+    python -m presto_tpu.tools.benchmark --suite tpch --schema sf0_1 \
+        --runner local --runs 3 --out results.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from typing import List, Optional
+
+from presto_tpu.tools.verifier import _runner_fn, load_suite
+
+
+def run_suite(run, queries, runs: int = 3, warmup: int = 1):
+    results = []
+    for name in sorted(queries):
+        sql = queries[name]
+        try:
+            for _ in range(warmup):
+                run(sql)
+            times = []
+            rows = 0
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                out = run(sql)
+                times.append(time.perf_counter() - t0)
+                rows = len(out)
+            results.append({
+                "query": name, "rows": rows,
+                "best_s": round(min(times), 4),
+                "median_s": round(statistics.median(times), 4),
+            })
+        except Exception as e:  # noqa: BLE001 — per-query record
+            results.append({"query": name,
+                            "error": f"{type(e).__name__}: {e}"})
+    return results
+
+
+def summarize(results) -> dict:
+    times = [r["best_s"] for r in results if "best_s" in r]
+    ok = len(times)
+    geo = 1.0
+    for t in times:
+        geo *= t
+    geo = geo ** (1 / ok) if ok else None
+    return {"queries": len(results), "succeeded": ok,
+            "geomean_best_s": round(geo, 4) if geo else None,
+            "total_best_s": round(sum(times), 3)}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description="Per-query benchmark suite")
+    p.add_argument("--suite", default="tpch", choices=["tpch", "tpcds"])
+    p.add_argument("--runner", default="local")
+    p.add_argument("--catalog", default=None)
+    p.add_argument("--schema", default="tiny")
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--out", default=None)
+    p.add_argument("--queries", default=None,
+                   help="comma-separated subset, e.g. q1,q6,q14")
+    args = p.parse_args(argv)
+    run = _runner_fn(args.runner, args.catalog or args.suite,
+                     args.schema)
+    suite = load_suite(args.suite)
+    if args.queries:
+        want = set(args.queries.split(","))
+        suite = {k: v for k, v in suite.items() if k in want}
+    results = run_suite(run, suite, args.runs, args.warmup)
+    doc = {"suite": args.suite, "schema": args.schema,
+           "runner": args.runner, "results": results,
+           "summary": summarize(results)}
+    text = json.dumps(doc, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

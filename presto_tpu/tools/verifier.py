@@ -1,0 +1,178 @@
+"""Verifier: replay a query suite on a CONTROL and a TEST runner and
+compare order-insensitive row checksums (reference: presto-verifier
+framework/AbstractVerification.java:109-111 + its checksum/ package —
+control vs test clusters; ours compares any two runner configurations,
+e.g. single-process LocalRunner vs the 8-device MeshRunner vs a live
+coordinator URL).
+
+Checksumming mirrors the reference's approach: per-row content hash
+(type-aware canonicalization: floats rounded to a tolerance grid so
+bit-level reassociation differences don't flag; NULL distinct from 0),
+summed wrapping-int64 over rows so ordering doesn't matter, plus the
+row count. A FULLY ordered comparison would punish legitimate
+re-ordering under ties.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_MASK = (1 << 64) - 1
+
+
+def _mix(h: int) -> int:
+    h &= _MASK
+    h ^= h >> 30
+    h = (h * 0xbf58476d1ce4e5b9) & _MASK
+    h ^= h >> 27
+    h = (h * 0x94d049bb133111eb) & _MASK
+    return h ^ (h >> 31)
+
+
+def row_checksum(row: Sequence, float_digits: int = 6) -> int:
+    h = 0x9e3779b97f4a7c15
+    for v in row:
+        if v is None:
+            h = _mix(h ^ 0xdeadbeef)
+        elif isinstance(v, bool):
+            h = _mix(h ^ (2 if v else 3))
+        elif isinstance(v, float):
+            h = _mix(h ^ hash(round(v, float_digits)))
+        elif isinstance(v, int):
+            h = _mix(h ^ (v & _MASK))
+        else:
+            h = _mix(h ^ (hash(str(v)) & _MASK))
+    return h
+
+
+def result_checksum(rows: List[Tuple]) -> Tuple[int, int]:
+    """(order-insensitive checksum, row count)."""
+    total = 0
+    for r in rows:
+        total = (total + row_checksum(r)) & _MASK
+    return total, len(rows)
+
+
+@dataclasses.dataclass
+class Verification:
+    name: str
+    status: str            # match | mismatch | control_error | test_error
+    control_s: float = 0.0
+    test_s: float = 0.0
+    detail: str = ""
+
+
+def verify_queries(control: Callable[[str], List[Tuple]],
+                   test: Callable[[str], List[Tuple]],
+                   queries: Dict[str, str]) -> List[Verification]:
+    out: List[Verification] = []
+    for name in sorted(queries):
+        sql = queries[name]
+        t0 = time.perf_counter()
+        try:
+            crows = control(sql)
+        except Exception as e:  # noqa: BLE001 — recorded per query
+            out.append(Verification(name, "control_error",
+                                    detail=f"{type(e).__name__}: {e}"))
+            continue
+        t1 = time.perf_counter()
+        try:
+            trows = test(sql)
+        except Exception as e:  # noqa: BLE001
+            out.append(Verification(name, "test_error",
+                                    time.perf_counter() - t1, 0.0,
+                                    f"{type(e).__name__}: {e}"))
+            continue
+        t2 = time.perf_counter()
+        csum, ccnt = result_checksum(crows)
+        tsum, tcnt = result_checksum(trows)
+        if (csum, ccnt) == (tsum, tcnt):
+            out.append(Verification(name, "match", t1 - t0, t2 - t1))
+        else:
+            out.append(Verification(
+                name, "mismatch", t1 - t0, t2 - t1,
+                f"control {ccnt} rows sum {csum:x}; "
+                f"test {tcnt} rows sum {tsum:x}"))
+    return out
+
+
+def _runner_fn(spec: str, catalog: str, schema: str
+               ) -> Callable[[str], List[Tuple]]:
+    if spec == "local":
+        from presto_tpu.runner import LocalRunner
+        r = LocalRunner(catalog, schema)
+        return lambda sql: r.execute(sql).rows()
+    if spec == "mesh":
+        from presto_tpu.runner import MeshRunner
+        r = MeshRunner(catalog, schema)
+        return lambda sql: r.execute(sql).rows()
+    if spec.startswith("http"):
+        from presto_tpu.server.coordinator import StatementClient
+        client = StatementClient(spec)
+
+        def run(sql):
+            _, data = client.execute(sql)
+            return [tuple(row) for row in data]
+        return run
+    raise ValueError(f"unknown runner spec {spec!r} "
+                     "(local | mesh | http://coordinator)")
+
+
+def load_suite(name: str) -> Dict[str, str]:
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "tests"))
+    if name == "tpch":
+        from tpch_queries import QUERIES
+        return {f"q{k}": v for k, v in QUERIES.items()}
+    if name == "tpcds":
+        from tpcds_queries import QUERIES
+        return {f"q{k}": v for k, v in QUERIES.items()}
+    raise ValueError(f"unknown suite {name!r}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Replay a query suite on control vs test runners "
+                    "and compare row checksums")
+    p.add_argument("--control", default="local")
+    p.add_argument("--test", default="mesh")
+    p.add_argument("--suite", default="tpch",
+                   choices=["tpch", "tpcds"])
+    p.add_argument("--catalog", default=None)
+    p.add_argument("--schema", default="tiny")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--queries", default=None,
+                   help="comma-separated subset, e.g. q1,q6,q14")
+    args = p.parse_args(argv)
+    catalog = args.catalog or args.suite
+    control = _runner_fn(args.control, catalog, args.schema)
+    test = _runner_fn(args.test, catalog, args.schema)
+    suite = load_suite(args.suite)
+    if args.queries:
+        want = set(args.queries.split(","))
+        suite = {k: v for k, v in suite.items() if k in want}
+    results = verify_queries(control, test, suite)
+    bad = 0
+    for v in results:
+        if args.json:
+            print(json.dumps(dataclasses.asdict(v)))
+        else:
+            line = f"{v.name:>6}  {v.status:<14} " \
+                   f"control {v.control_s:6.2f}s test {v.test_s:6.2f}s"
+            if v.detail:
+                line += f"  {v.detail}"
+            print(line)
+        bad += v.status != "match"
+    print(f"{len(results) - bad}/{len(results)} match", file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
