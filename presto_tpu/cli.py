@@ -14,9 +14,20 @@ import argparse
 import sys
 
 
-def _format_rows(names, rows) -> str:
+def _format_value(v, typ) -> str:
+    if v is None:
+        return "NULL"
+    if typ == "date" and isinstance(v, int):
+        import datetime
+        return (datetime.date(1970, 1, 1)
+                + datetime.timedelta(days=v)).isoformat()
+    return str(v)
+
+
+def _format_rows(names, rows, types=None) -> str:
     cols = [str(n) for n in names]
-    table = [[("NULL" if v is None else str(v)) for v in r]
+    types = types or [None] * len(cols)
+    table = [[_format_value(v, t) for v, t in zip(r, types)]
              for r in rows]
     widths = [len(c) for c in cols]
     for r in table:
@@ -35,10 +46,12 @@ def _run_one(sql: str, args, runner) -> int:
         if args.server:
             from presto_tpu.server.coordinator import StatementClient
             columns, data = StatementClient(args.server).execute(sql)
-            print(_format_rows([c["name"] for c in columns], data))
+            print(_format_rows([c["name"] for c in columns], data,
+                               [c.get("type") for c in columns]))
         else:
             res = runner.execute(sql)
-            print(_format_rows(res.names, res.rows()))
+            print(_format_rows(res.names, res.rows(),
+                               [f.type.name for f in res.fields]))
         return 0
     except Exception as e:  # noqa: BLE001 — console surface
         print(f"error: {e}", file=sys.stderr)

@@ -110,6 +110,7 @@ class LocalRunner:
         from presto_tpu.connectors.memory import (
             BlackholeConnector, MemoryConnector,
         )
+        from presto_tpu.connectors.files import FileConnector
         from presto_tpu.connectors.tpch import TpchConnector
         from presto_tpu.connectors.tpcds import TpcdsConnector
         self.catalogs = CatalogManager()
@@ -117,6 +118,7 @@ class LocalRunner:
         self.catalogs.register("tpcds", TpcdsConnector())
         self.catalogs.register("memory", MemoryConnector())
         self.catalogs.register("blackhole", BlackholeConnector())
+        self.catalogs.register("file", FileConnector())
         self.session = Session(catalog, schema, dict(properties or {}))
 
     def register_connector(self, name: str, connector: Connector):
@@ -434,9 +436,16 @@ class LocalRunner:
             return self._text_result("Schema",
                                      conn.metadata.list_schemas())
         if isinstance(stmt, T.ShowTables):
-            conn = self.catalogs.connector(self.session.catalog)
+            # FROM may name `schema` or `catalog.schema`
+            if stmt.schema and len(stmt.schema) > 2:
+                raise QueryError(
+                    f"invalid schema name "
+                    f"{'.'.join(stmt.schema)}")
+            catalog = stmt.schema[0] if stmt.schema \
+                and len(stmt.schema) == 2 else self.session.catalog
             schema = stmt.schema[-1] if stmt.schema \
                 else self.session.schema
+            conn = self.catalogs.connector(catalog)
             return self._text_result("Table",
                                      conn.metadata.list_tables(schema))
         if isinstance(stmt, T.ShowColumns):

@@ -1,0 +1,3 @@
+"""Storage formats (reference layer LS: presto-parquet / presto-orc).
+parquet.py is a self-contained reader/writer for the flat-schema
+subset the engine scans and writes."""

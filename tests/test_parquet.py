@@ -1,0 +1,165 @@
+"""Parquet storage layer (storage/parquet.py; reference:
+presto-parquet ParquetReader + OrcSelectiveRecordReader's pushdown
+pruning) and the file connector over it. pyarrow is used ONLY to
+verify interoperability with standard writers/readers."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.storage import parquet as pq
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_FILE_ROOT", str(tmp_path))
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", "tiny")
+
+
+def test_roundtrip_own_files(tmp_path):
+    cols = [pq.ParquetColumn("a", pq.T_INT64, optional=False),
+            pq.ParquetColumn("b", pq.T_DOUBLE),
+            pq.ParquetColumn("s", pq.T_BYTE_ARRAY, pq.CONV_UTF8)]
+    n = 500
+    data = {"a": np.arange(n, dtype=np.int64),
+            "b": np.linspace(0, 1, n),
+            "s": [f"v{i % 13}".encode() for i in range(n)]}
+    masks = {"b": np.arange(n) % 5 != 0,
+             "s": np.arange(n) % 7 != 0}
+    path = str(tmp_path / "t.parquet")
+    for codec in (pq.CODEC_UNCOMPRESSED, pq.CODEC_GZIP):
+        pq.write_table(path, cols, data, masks, codec=codec,
+                       row_group_rows=200)
+        info = pq.read_footer(path)
+        assert info.num_rows == n
+        assert len(info.row_groups) == 3
+        vals, mask = [], []
+        for g in info.row_groups:
+            v, m = pq.read_column(path, g, "b")
+            vals.append(v)
+            mask.append(m)
+        m = np.concatenate(mask)
+        assert (m == masks["b"]).all()
+        assert np.allclose(np.concatenate(vals),
+                           data["b"][masks["b"]])
+
+
+def test_row_group_statistics(tmp_path):
+    cols = [pq.ParquetColumn("k", pq.T_INT64, optional=False)]
+    path = str(tmp_path / "s.parquet")
+    pq.write_table(path, cols,
+                   {"k": np.arange(1000, dtype=np.int64)},
+                   row_group_rows=250)
+    info = pq.read_footer(path)
+    assert pq.group_min_max(info.row_groups[0], "k") == (0, 249)
+    assert pq.group_min_max(info.row_groups[3], "k") == (750, 999)
+
+
+def test_pyarrow_reads_our_file(tmp_path):
+    papq = pytest.importorskip("pyarrow.parquet")
+    cols = [pq.ParquetColumn("x", pq.T_INT64, optional=False),
+            pq.ParquetColumn("y", pq.T_BYTE_ARRAY, pq.CONV_UTF8)]
+    n = 100
+    path = str(tmp_path / "ours.parquet")
+    pq.write_table(path, cols, {
+        "x": np.arange(n, dtype=np.int64),
+        "y": [f"s{i}".encode() for i in range(n)],
+    }, {"y": np.arange(n) % 3 != 0}, codec=pq.CODEC_GZIP)
+    t = papq.read_table(path)
+    assert t.column("x").to_pylist() == list(range(n))
+    got = t.column("y").to_pylist()
+    assert got[0] is None and got[1] == "s1"
+
+
+def test_we_read_pyarrow_file(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    papq = pytest.importorskip("pyarrow.parquet")
+    n = 300
+    tbl = pa.table({
+        "x": pa.array(list(range(n)), pa.int64()),
+        "y": pa.array([None if i % 4 == 0 else f"v{i % 11}"
+                       for i in range(n)]),
+    })
+    path = str(tmp_path / "arrow.parquet")
+    # dictionary + gzip: the encodings arrow uses by default
+    papq.write_table(tbl, path, compression="GZIP")
+    info = pq.read_footer(path)
+    x, _ = pq.read_column(path, info.row_groups[0], "x")
+    assert list(x) == list(range(n))
+    y, ym = pq.read_column(path, info.row_groups[0], "y")
+    assert list(y) == [f"v{i % 11}".encode() for i in range(n)
+                       if i % 4 != 0]
+    assert (~ym[::4]).all()
+
+
+def test_ctas_and_query_through_sql(runner):
+    """CTAS into the file catalog writes Parquet; scans read it back
+    with full SQL (joins, aggregation, NULL handling)."""
+    runner.execute(
+        "create table file.default.items as "
+        "select orderkey, partkey, quantity, returnflag, shipdate "
+        "from lineitem")
+    res = runner.execute(
+        "select returnflag, count(*) c, sum(quantity) q "
+        "from file.default.items group by returnflag "
+        "order by returnflag")
+    want = runner.execute(
+        "select returnflag, count(*) c, sum(quantity) q "
+        "from lineitem group by returnflag order by returnflag")
+    assert res.rows() == want.rows()
+    # join parquet back against a generated table
+    res2 = runner.execute(
+        "select count(*) from file.default.items i, orders o "
+        "where i.orderkey = o.orderkey and o.orderdate >= "
+        "date '1995-01-01'")
+    want2 = runner.execute(
+        "select count(*) from lineitem l, orders o "
+        "where l.orderkey = o.orderkey and o.orderdate >= "
+        "date '1995-01-01'")
+    assert res2.rows() == want2.rows()
+
+
+def test_show_and_drop(runner):
+    runner.execute("create table file.default.tiny_nation as "
+                   "select * from nation")
+    assert "tiny_nation" in [
+        r[0] for r in runner.execute(
+            "show tables from file.default").rows()]
+    rows = runner.execute(
+        "select name, regionkey from file.default.tiny_nation "
+        "order by name limit 3").rows()
+    assert rows[0][0] == "ALGERIA"
+    runner.execute("drop table file.default.tiny_nation")
+    assert "tiny_nation" not in [
+        r[0] for r in runner.execute(
+            "show tables from file.default").rows()]
+
+
+def test_row_group_pruning(runner, tmp_path):
+    """A pushed-down range predicate skips row groups whose min/max
+    can't match — verified by counting rows actually materialized."""
+    import os
+    root = os.environ["PRESTO_TPU_FILE_ROOT"]
+    os.makedirs(os.path.join(root, "default"), exist_ok=True)
+    cols = [pq.ParquetColumn("k", pq.T_INT64, optional=False),
+            pq.ParquetColumn("v", pq.T_DOUBLE, optional=False)]
+    n = 4000
+    pq.write_table(os.path.join(root, "default", "pruned.parquet"),
+                   cols,
+                   {"k": np.arange(n, dtype=np.int64),
+                    "v": np.arange(n, dtype=np.float64)},
+                   row_group_rows=1000)
+    res = runner.execute("select count(*), min(k), max(k) "
+                         "from file.default.pruned where k >= 3500")
+    assert res.rows() == [(500, 3500, 3999)]
+    # pruning observable via connector-level scan
+    conn = runner.catalogs.connector("file")
+    from presto_tpu.connectors.spi import Domain, TableHandle, \
+        TupleDomain
+    handle = TableHandle("file", "default", "pruned")
+    splits = conn.split_manager.get_splits(handle, 1)
+    dom = TupleDomain((("k", Domain(low=3500)),))
+    batches = list(conn.page_source.batches(
+        splits[0], ["k"], 1 << 20, dom))
+    total_capacity_rows = sum(int(b.num_valid()) for b in batches)
+    assert total_capacity_rows == 1000  # 3 of 4 groups pruned
