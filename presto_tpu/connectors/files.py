@@ -67,6 +67,22 @@ class _FileCatalog:
         self.root = root
         self._cache: Dict[str, Tuple[float, pq.FileInfo,
                                      Dict[str, tuple]]] = {}
+        # string -> code reverse indexes, alongside the dict cache
+        self._indexes: Dict[Tuple[str, float, str],
+                            Dict[str, int]] = {}
+
+    def index(self, path: str, col: str,
+              dic: tuple) -> Dict[str, int]:
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            mtime = 0.0
+        key = (path, mtime, col)
+        idx = self._indexes.get(key)
+        if idx is None:
+            idx = {v: i for i, v in enumerate(dic)}
+            self._indexes[key] = idx
+        return idx
 
     def path(self, handle: TableHandle) -> str:
         return os.path.join(self.root, handle.schema,
@@ -139,7 +155,7 @@ class _FileSplitManager(ConnectorSplitManager):
                    target_splits: int) -> List[Split]:
         info, _ = self._cat.info(handle)
         n = len(info.row_groups)
-        per = math.ceil(n / max(target_splits, 1))
+        per = max(1, math.ceil(n / max(target_splits, 1)))
         return [Split(handle, (lo, min(lo + per, n)), partition=i)
                 for i, lo in enumerate(range(0, n, per))] \
             or [Split(handle, (0, 0), partition=0)]
@@ -189,7 +205,7 @@ class _FilePageSource(ConnectorPageSource):
                 mask = np.ones(n, bool) if present is None else present
                 if typ.is_string:
                     dic = dicts.get(name, ())
-                    index = {v: i for i, v in enumerate(dic)}
+                    index = self._cat.index(path, name, dic)
                     codes = np.zeros(n, np.int32)
                     codes[mask] = [
                         index[v.decode("utf-8", "replace")]

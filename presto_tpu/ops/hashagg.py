@@ -50,10 +50,18 @@ class AggFunction:
     row's input (already masked) to state contributions; contributions and
     existing states merge with segment reductions described by `reduce`
     (one of sum/min/max per state array).
+
+    A state component may be a VECTOR per group: declare it as
+    (np.dtype, K) in `state_dtypes` and have init() return [rows, K]
+    contributions (e.g. approx_percentile's bucket histogram). Vector
+    components flow through the sort path (2-D segment reductions) and
+    the direct path (one-hot matmul), but are not exposed as
+    intermediate columns — the planner keeps such aggregations on a
+    SINGLE step with co-located groups.
     """
 
     name: str
-    state_dtypes: Tuple[np.dtype, ...]
+    state_dtypes: Tuple  # np.dtype | (np.dtype, K) per component
     reduces: Tuple[str, ...]  # per state array: "sum" | "min" | "max"
     # (value_data, contribute_weight_bool) -> tuple of state arrays
     init: Callable[[Optional[jnp.ndarray], jnp.ndarray], Tuple[jnp.ndarray, ...]]
@@ -64,7 +72,15 @@ class AggFunction:
     intermediate_types: Tuple[Type, ...] = ()
 
 
-def _ident_for(reduce: str, dtype) -> jnp.ndarray:
+def _comp_spec(comp) -> Tuple[np.dtype, Tuple[int, ...]]:
+    """state_dtypes entry -> (dtype, extra per-group shape)."""
+    if isinstance(comp, tuple):
+        return np.dtype(comp[0]), (int(comp[1]),)
+    return np.dtype(comp), ()
+
+
+def _ident_for(reduce: str, comp) -> jnp.ndarray:
+    dtype, _ = _comp_spec(comp)
     if reduce == "sum":
         return jnp.zeros((), dtype)
     info = jnp.iinfo(dtype) if jnp.issubdtype(dtype, jnp.integer) \
@@ -238,6 +254,75 @@ def make_checksum(input_type: Type) -> AggFunction:
                        init, final, BIGINT, (BIGINT,))
 
 
+#: approx_percentile sketch geometry: log-spaced buckets with
+#: per-bucket relative error (GAMMA-1)/(GAMMA+1) ~ 2.9% (the DDSketch
+#: construction; reference: operator/aggregation/
+#: ApproximateDoublePercentileAggregations' qdigest plays this role).
+#: Layout: [0, HALF-2] negatives (most negative first), HALF-1 zero,
+#: [HALF, K-1] positives. Magnitudes cover GAMMA^-(HALF/2) ..
+#: GAMMA^(HALF/2) ~ 3e-6 .. 3e6; values outside clamp to the end
+#: buckets.
+PCTL_BUCKETS = 1024
+_PCTL_GAMMA = 1.06
+_PCTL_HALF = PCTL_BUCKETS // 2
+_PCTL_EXP0 = _PCTL_HALF // 2  # exponent offset: magnitudes cover
+#                               gamma^-256..gamma^+254 ~ 3e-7..2.7e6
+
+
+def _pctl_bucket(value: jnp.ndarray) -> jnp.ndarray:
+    lng = float(np.log(_PCTL_GAMMA))
+    mag = jnp.abs(value.astype(jnp.float64))
+    tiny = mag < 1e-12
+    li = jnp.clip(jnp.round(jnp.log(jnp.maximum(mag, 1e-12)) / lng)
+                  .astype(jnp.int32) + _PCTL_EXP0, 0, _PCTL_HALF - 2)
+    pos = _PCTL_HALF + li
+    neg = _PCTL_HALF - 2 - li
+    b = jnp.where(value >= 0, pos, neg)
+    return jnp.where(tiny, _PCTL_HALF - 1, b).astype(jnp.int32)
+
+
+def _pctl_values() -> np.ndarray:
+    """Representative value per bucket (geometric midpoint)."""
+    mid = 2 * _PCTL_GAMMA / (_PCTL_GAMMA + 1)
+    li = np.arange(_PCTL_HALF - 1)          # 255 exponent slots
+    mags = mid * _PCTL_GAMMA ** (li - _PCTL_EXP0)
+    out = np.zeros(PCTL_BUCKETS)
+    # positives [HALF, 2*HALF-2] ascending; zero at HALF-1;
+    # negatives [0, HALF-2] with the most negative first
+    out[_PCTL_HALF:2 * _PCTL_HALF - 1] = mags
+    out[_PCTL_HALF - 2::-1] = -mags
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def make_approx_percentile(fraction: float) -> AggFunction:
+    """Mergeable log-histogram percentile sketch. State: one int32
+    count vector of PCTL_BUCKETS per group. The per-row contribution
+    is a one-hot bucket row — XLA reduces it without a scatter (sorted
+    path: 2-D segment sum; direct path: one-hot matmul on the MXU)."""
+    K = PCTL_BUCKETS
+
+    def init(value, w):
+        b = _pctl_bucket(value)
+        oh = (b[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :])
+        return ((oh & w[:, None]).astype(np.int32),)
+
+    def final(state):
+        counts = state[0].astype(jnp.float64)   # [G, K]
+        total = counts.sum(axis=1)
+        cdf = jnp.cumsum(counts, axis=1)
+        target = jnp.ceil(fraction * total)
+        target = jnp.maximum(target, 1.0)
+        # first bucket where cdf >= target
+        hit = cdf >= target[:, None]
+        idx = jnp.argmax(hit, axis=1)
+        vals = jnp.asarray(_pctl_values())[idx]
+        return vals, total > 0
+    return AggFunction(f"approx_percentile[{fraction}]",
+                       ((np.int32, K),), ("sum",), init, final,
+                       DOUBLE, ())
+
+
 AGG_FACTORIES = {
     "sum": make_sum,
     "count": make_count,
@@ -264,6 +349,18 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def _full_state(n: int, comp, reduce: str) -> jnp.ndarray:
+    dtype, extra = _comp_spec(comp)
+    return jnp.full((n,) + extra, _ident_for(reduce, comp), dtype)
+
+
+def _gate(w: jnp.ndarray, contrib: jnp.ndarray, ident) -> jnp.ndarray:
+    """where(w, contrib, ident) broadcast over vector components."""
+    if contrib.ndim == 2:
+        return jnp.where(w[:, None], contrib, ident)
+    return jnp.where(w, contrib, ident)
+
+
 def init_state(key_types: Sequence[Type], aggs: Sequence[AggFunction],
                max_groups: int) -> GroupByState:
     keys = [(jnp.zeros(max_groups, t.np_dtype), jnp.zeros(max_groups, bool))
@@ -271,7 +368,7 @@ def init_state(key_types: Sequence[Type], aggs: Sequence[AggFunction],
     states = []
     for a in aggs:
         states.append(tuple(
-            jnp.full(max_groups, _ident_for(r, dt), dt)
+            _full_state(max_groups, dt, r)
             for dt, r in zip(a.state_dtypes, a.reduces)))
     return GroupByState(keys, states, jnp.zeros(max_groups, bool),
                         jnp.asarray(False))
@@ -301,7 +398,8 @@ def agg_step(state: GroupByState,
         if is_merge:
             # inp is a tuple of partial state arrays; weight gates validity
             parts = tuple(
-                jnp.where(w, p, _ident_for(r, dt)).astype(dt)
+                _gate(w, p, _ident_for(r, dt)).astype(
+                    _comp_spec(dt)[0])
                 for p, dt, r in zip(inp, agg.state_dtypes, agg.reduces))
             contribs.append(parts)
         else:
@@ -398,7 +496,7 @@ def direct_init(aggs: Sequence[AggFunction], num_slots: int) -> DirectState:
     states = []
     for a in aggs:
         states.append(tuple(
-            jnp.full(num_slots, _ident_for(r, dt), dt)
+            _full_state(num_slots, dt, r)
             for dt, r in zip(a.state_dtypes, a.reduces)))
     return DirectState(states, jnp.zeros(num_slots, bool))
 
@@ -414,10 +512,22 @@ _ONEHOT_SLOT_LIMIT = 256
 def _slot_reduce(contrib: jnp.ndarray, gid: jnp.ndarray, num_slots: int,
                  reduce: str, dtype) -> jnp.ndarray:
     """Reduce per-row contributions into `num_slots` slots (drop slot
-    `num_slots` discarded). gid is int32 in [0, num_slots]."""
+    `num_slots` discarded). gid is int32 in [0, num_slots]. contrib may
+    be [rows] or [rows, K] (vector state component)."""
     c = contrib.astype(dtype)
     if num_slots <= _ONEHOT_SLOT_LIMIT:
         oh = gid[:, None] == jnp.arange(num_slots, dtype=gid.dtype)[None, :]
+        if c.ndim == 2:
+            if reduce == "sum":
+                # [slots, rows] x [rows, K] matmul — MXU-friendly;
+                # per-batch counts stay exact in f32 (rows < 2^24)
+                return jax.lax.dot_general(
+                    oh.astype(jnp.float32).T, c.astype(jnp.float32),
+                    (((1,), (0,)), ((), ()))).astype(dtype)
+            masked = jnp.where(oh[:, :, None], c[:, None, :],
+                               _ident_for(reduce, dtype))
+            op = jnp.min if reduce == "min" else jnp.max
+            return op(masked, axis=0)
         masked = jnp.where(oh, c[:, None], _ident_for(reduce, dtype))
         if reduce == "sum":
             return jnp.sum(masked, axis=0)
@@ -456,7 +566,8 @@ def direct_step(state: DirectState,
                                          agg_weights, merge):
         if is_merge:
             contrib = tuple(
-                jnp.where(w, p, _ident_for(r, dt)).astype(dt)
+                _gate(w, p, _ident_for(r, dt)).astype(
+                    _comp_spec(dt)[0])
                 for p, dt, r in zip(inp, agg.state_dtypes, agg.reduces))
         else:
             contrib = agg.init(inp, w)

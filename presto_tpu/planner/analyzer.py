@@ -37,8 +37,33 @@ AGG_FUNCTIONS = {
     "var_samp", "var_pop", "variance", "stddev", "stddev_samp",
     "stddev_pop", "count_if", "bool_and", "bool_or", "every",
     "geometric_mean", "checksum", "arbitrary", "any_value",
-    "approx_distinct",
+    "approx_distinct", "approx_percentile",
 }
+
+
+def _agg_arg_and_params(c, an):
+    """Argument expression + static parameters of an aggregate call.
+    approx_percentile(x, p) takes a constant percentile as its second
+    argument; everything else is single-argument."""
+    if c.name == "approx_percentile":
+        if len(c.args) != 2:
+            raise AnalysisError(
+                "approx_percentile takes (value, percentile)")
+        p = fold_constants(an.analyze(c.args[1]))
+        if not isinstance(p, Literal) or p.value is None:
+            raise AnalysisError(
+                "approx_percentile's percentile must be a constant")
+        frac = float(p.value) if not p.type.is_decimal \
+            else p.value / 10 ** p.type.scale
+        if not 0 < frac < 1:
+            raise AnalysisError("percentile must be in (0, 1)")
+        return fold_constants(an.analyze(c.args[0])), (frac,)
+    if len(c.args) != 1:
+        raise AnalysisError(f"{c.name} takes one argument")
+    arg = fold_constants(an.analyze(c.args[0]))
+    if c.name in ("count_if", "bool_and", "bool_or", "every"):
+        arg = _coerce_to(arg, BOOLEAN)
+    return arg, ()
 
 
 class AnalysisError(Exception):
@@ -635,7 +660,8 @@ def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
     if fn in ("count", "count_if", "checksum"):
         return BIGINT
     if fn in ("avg", "var_samp", "var_pop", "variance", "stddev",
-              "stddev_samp", "stddev_pop", "geometric_mean"):
+              "stddev_samp", "stddev_pop", "geometric_mean",
+              "approx_percentile"):
         return DOUBLE
     if fn in ("bool_and", "bool_or", "every"):
         return BOOLEAN
@@ -974,6 +1000,12 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
         if any(c.is_star or not c.args for c in distinct_calls):
             raise AnalysisError("DISTINCT aggregate requires an "
                                 "argument")
+        if any(c.name == "approx_percentile" for c in distinct_calls):
+            # the distinct-planning branches carry only the first
+            # argument — a sketch over DISTINCT values is also not a
+            # meaningful percentile
+            raise AnalysisError(
+                "approx_percentile does not support DISTINCT")
         argkeys = {_ast_key(c.args[0]) for c in distinct_calls}
         if any(not c.distinct for c in calls) or len(argkeys) != 1:
             rp_md, rw_md = _plan_mixed_distinct(keys, calls, rp, ctx, an)
@@ -1011,20 +1043,18 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
             continue
         if c.filter is not None:
             raise AnalysisError("FILTER (WHERE ...) not yet supported")
+        params: tuple = ()
         if c.distinct:
             arg, arg_t, dic = InputRef(dsym, d_t), d_t, d_dic
         elif c.is_star or not c.args:
             arg, arg_t, dic = None, None, None
         else:
-            if len(c.args) != 1:
-                raise AnalysisError(f"{c.name} takes one argument")
-            arg = fold_constants(an.analyze(c.args[0]))
-            if c.name in ("count_if", "bool_and", "bool_or", "every"):
-                arg = _coerce_to(arg, BOOLEAN)
+            arg, params = _agg_arg_and_params(c, an)
             arg_t, dic = arg.type, an.dictionary_of(arg)
         out_t = _agg_output_type(c.name, arg_t)
         sym = ctx.symbols.new(c.name)
-        agg_nodes.append(N.AggCall(sym, c.name, arg, False, out_t))
+        agg_nodes.append(N.AggCall(sym, c.name, arg, False, out_t,
+                                   params=params))
         out_dic = dic if c.name in ("min", "max", "arbitrary",
                                     "any_value") else None
         rewrites[key] = (sym, out_t, out_dic)
@@ -1170,16 +1200,16 @@ def _plan_mixed_distinct(keys, calls, rp: RelationPlan,
             continue
         if c.filter is not None:
             raise AnalysisError("FILTER (WHERE ...) not yet supported")
+        params: tuple = ()
         if c.is_star or not c.args:
             arg, arg_t, dic = None, None, None
         else:
-            arg = fold_constants(an.analyze(c.args[0]))
-            if c.name in ("count_if", "bool_and", "bool_or", "every"):
-                arg = _coerce_to(arg, BOOLEAN)
+            arg, params = _agg_arg_and_params(c, an)
             arg_t, dic = arg.type, an.dictionary_of(arg)
         out_t = _agg_output_type(c.name, arg_t)
         sym = ctx.symbols.new(c.name)
-        plain_aggs.append(N.AggCall(sym, c.name, arg, False, out_t))
+        plain_aggs.append(N.AggCall(sym, c.name, arg, False, out_t,
+                                    params=params))
         out_dic = dic if c.name in ("min", "max", "arbitrary",
                                     "any_value") else None
         agg_fields.append(N.Field(sym, out_t, out_dic))

@@ -222,10 +222,14 @@ class _Exchanger:
         if props.kind == P_SINGLE:
             node.source = src
             return node, SINGLE
+        from presto_tpu.planner.local_planner import NO_SPLIT_AGGS
         key_syms = tuple(s for s, _ in node.keys)
-        if any(a.distinct for a in node.aggregates):
-            # distinct aggs cannot split partial/final: co-locate whole
-            # groups, then run a SINGLE-step aggregation per worker
+        if any(a.distinct or a.function in NO_SPLIT_AGGS
+               for a in node.aggregates):
+            # distinct aggs (and sketch aggs whose state has no
+            # intermediate-column form) cannot split partial/final:
+            # co-locate whole groups, then run a SINGLE-step
+            # aggregation per worker
             if not key_syms:
                 node.source = self._to_single(src, props)
                 return node, SINGLE

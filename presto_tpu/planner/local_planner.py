@@ -275,7 +275,7 @@ class LocalExecutionPlanner:
     @staticmethod
     def _make_agg(a: N.AggCall, arg_ce: Optional[CompiledExpr]):
         t = a.input_type or (arg_ce.type if arg_ce else None)
-        return agg_function_for(a.function, t, a.output_type)
+        return agg_function_for(a.function, t, a.output_type, a.params)
 
     def _visit_JoinNode(self, node: N.JoinNode, pipe: List):
         if node.join_type == "cross":
@@ -444,11 +444,20 @@ DOUBLE_INPUT_AGGS = frozenset({
 _VARIANCE_CANON = {"variance": "var_samp", "stddev_samp": "stddev"}
 
 
+#: aggregates whose state has no intermediate column representation —
+#: the planner co-locates whole groups (like DISTINCT aggs) instead of
+#: splitting partial/final across an exchange
+NO_SPLIT_AGGS = {"approx_percentile"}
+
+
 def agg_function_for(name: str, input_type: Optional[Type],
-                     output_type: Optional[Type]) -> hashagg.AggFunction:
+                     output_type: Optional[Type],
+                     params: tuple = ()) -> hashagg.AggFunction:
     """Resolve an aggregate name + argument type to its state machine.
     Shared by local planning and the AddExchanges partial/final split
     (both sides must construct bit-identical state layouts)."""
+    if name == "approx_percentile":
+        return hashagg.make_approx_percentile(params[0])
     if name == "count":
         return hashagg.make_count(input_type)
     if name == "sum":

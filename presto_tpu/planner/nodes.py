@@ -85,6 +85,8 @@ class AggCall:
     # there — the operator merges <out>__s{i} state columns instead) so
     # the state layout matches the partial side exactly
     input_type: Optional[Type] = None
+    # static call parameters (e.g. approx_percentile's fraction)
+    params: Tuple = ()
 
 
 @dataclasses.dataclass

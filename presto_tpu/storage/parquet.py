@@ -406,6 +406,13 @@ def read_column(path: str, group: RowGroupInfo, name: str
         if present is not None:
             masks.append(present)
         seen += nvals
+    if not values_parts:  # zero-row row group (empty CTAS, pyarrow)
+        empty = [] if col.ptype == T_BYTE_ARRAY \
+            else np.zeros(0, {T_BOOLEAN: np.bool_, T_INT32: np.int32,
+                              T_INT64: np.int64, T_FLOAT: np.float32,
+                              T_DOUBLE: np.float64}.get(col.ptype,
+                                                        np.float64))
+        return empty, (np.zeros(0, bool) if col.optional else None)
     if isinstance(values_parts[0], list):
         values: Any = [v for part in values_parts for v in part]
     else:

@@ -1,0 +1,92 @@
+"""approx_percentile: DDSketch-style log-histogram sketch as a
+vector-state aggregate (reference: operator/aggregation/
+ApproximateDoublePercentileAggregations backed by qdigest; ours is the
+DDSketch construction with ~3% per-bucket relative error)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", "tiny")
+
+
+@pytest.fixture(scope="module")
+def lineitem(runner):
+    return runner.catalogs.connector("tpch").table_pandas(
+        "tiny", "lineitem")
+
+
+TOL = 0.07
+
+
+def test_global_percentiles(runner, lineitem):
+    for p in (0.1, 0.5, 0.9, 0.99):
+        got = runner.execute(
+            f"select approx_percentile(extendedprice, {p}) "
+            "from lineitem").rows()[0][0]
+        exact = float(np.percentile(lineitem["extendedprice"],
+                                    p * 100))
+        assert abs(got - exact) <= TOL * abs(exact), (p, got, exact)
+
+
+def test_grouped_percentiles(runner, lineitem):
+    rows = runner.execute(
+        "select returnflag, approx_percentile(quantity, 0.5) p "
+        "from lineitem group by returnflag order by returnflag").rows()
+    for rf, p in rows:
+        exact = float(np.percentile(
+            lineitem[lineitem.returnflag == rf]["quantity"], 50))
+        assert abs(p - exact) <= TOL * max(abs(exact), 1.0)
+
+
+def test_negative_and_zero_values(runner):
+    rows = runner.execute(
+        "select approx_percentile(v, 0.5) from (values (-100.0), "
+        "(-10.0), (0.0), (10.0), (100.0)) as t(v)").rows()
+    assert abs(rows[0][0]) < 0.5  # median is the zero bucket
+    lo = runner.execute(
+        "select approx_percentile(v, 0.1) from (values (-100.0), "
+        "(-10.0), (0.0), (10.0), (100.0)) as t(v)").rows()[0][0]
+    assert abs(lo - (-100.0)) <= TOL * 100
+
+
+def test_mixed_with_other_aggregates(runner, lineitem):
+    rows = runner.execute(
+        "select count(*), approx_percentile(quantity, 0.9), "
+        "sum(quantity) from lineitem").rows()
+    n, p90, total = rows[0]
+    assert n == len(lineitem)
+    assert total == lineitem["quantity"].sum()
+    exact = float(np.percentile(lineitem["quantity"], 90))
+    assert abs(p90 - exact) <= TOL * exact
+
+
+def test_percentile_validation(runner):
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises(QueryError, match="percentile"):
+        runner.execute(
+            "select approx_percentile(quantity, 1.5) from lineitem")
+    with pytest.raises(QueryError, match="constant"):
+        runner.execute(
+            "select approx_percentile(quantity, quantity) "
+            "from lineitem")
+
+
+def test_distributed_colocated(lineitem):
+    """On the mesh the sketch cannot split partial/final (its state
+    has no column form) — groups co-locate and each worker runs a
+    SINGLE-step aggregation; results must match local execution."""
+    from presto_tpu.runner import MeshRunner
+    r = MeshRunner("tpch", "tiny")
+    rows = r.execute(
+        "select returnflag, approx_percentile(extendedprice, 0.5) p "
+        "from lineitem group by returnflag order by returnflag").rows()
+    from presto_tpu.runner import LocalRunner
+    local = LocalRunner("tpch", "tiny").execute(
+        "select returnflag, approx_percentile(extendedprice, 0.5) p "
+        "from lineitem group by returnflag order by returnflag").rows()
+    assert [(rf, round(p, 6)) for rf, p in rows] \
+        == [(rf, round(p, 6)) for rf, p in local]
