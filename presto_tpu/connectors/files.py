@@ -67,21 +67,25 @@ class _FileCatalog:
         self.root = root
         self._cache: Dict[str, Tuple[float, pq.FileInfo,
                                      Dict[str, tuple]]] = {}
-        # string -> code reverse indexes, alongside the dict cache
-        self._indexes: Dict[Tuple[str, float, str],
-                            Dict[str, int]] = {}
+        # string -> code reverse indexes, one entry per path replaced
+        # wholesale on rewrite (keyed by the mtime of the CACHED
+        # dictionaries — never re-stat here, or a concurrent rewrite
+        # could bind a fresh mtime to stale dictionaries)
+        self._indexes: Dict[str, Tuple[float,
+                                       Dict[str, Dict[str, int]]]] = {}
 
     def index(self, path: str, col: str,
               dic: tuple) -> Dict[str, int]:
-        try:
-            mtime = os.stat(path).st_mtime
-        except OSError:
-            mtime = 0.0
-        key = (path, mtime, col)
-        idx = self._indexes.get(key)
+        cached = self._cache.get(path)
+        mtime = cached[0] if cached is not None else 0.0
+        hit = self._indexes.get(path)
+        if hit is None or hit[0] != mtime:
+            hit = (mtime, {})
+            self._indexes[path] = hit
+        idx = hit[1].get(col)
         if idx is None:
             idx = {v: i for i, v in enumerate(dic)}
-            self._indexes[key] = idx
+            hit[1][col] = idx
         return idx
 
     def path(self, handle: TableHandle) -> str:

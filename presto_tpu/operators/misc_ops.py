@@ -192,6 +192,82 @@ class AssignUniqueIdOperatorFactory(OperatorFactory):
             self.symbol, self.start, self.stride)
 
 
+class UnnestOperator(Operator):
+    """Static-length UNNEST replication (reference:
+    operator/unnest/UnnestOperator.java — ours unrolls fixed-size
+    ARRAY constructors): replica i of each input batch selects every
+    array's i-th element column; arrays shorter than the longest pad
+    NULL; ordinality is the constant i+1. String element columns are
+    re-encoded onto the output field's union dictionary so one output
+    code space covers all replicas."""
+
+    def __init__(self, ctx: OperatorContext,
+                 items: Sequence[Tuple[str, List[str]]],
+                 ordinality_symbol: Optional[str],
+                 out_dicts: Dict[str, Optional[tuple]]):
+        super().__init__(ctx)
+        self.items = list(items)
+        self.ordinality_symbol = ordinality_symbol
+        self.out_dicts = out_dicts
+        self.depth = max(len(syms) for _, syms in items)
+        self._pending: List[Batch] = []
+        self._finishing = False
+
+    def needs_input(self) -> bool:
+        return not self._pending and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        from presto_tpu.batch import remap_column
+        from presto_tpu.types import BIGINT
+        self._count_in(batch)
+        cap = batch.capacity
+        for i in range(self.depth):
+            cols = dict(batch.columns)
+            for out_sym, elem_syms in self.items:
+                if i < len(elem_syms):
+                    col = batch.columns[elem_syms[i]]
+                    target = self.out_dicts.get(out_sym)
+                    if target is not None \
+                            and col.dictionary != target:
+                        col = remap_column(col, target)
+                else:  # zip padding: NULL element
+                    ref = batch.columns[elem_syms[0]]
+                    col = Column(ref.data, jnp.zeros(cap, bool),
+                                 ref.type,
+                                 self.out_dicts.get(out_sym))
+                cols[out_sym] = col
+            if self.ordinality_symbol is not None:
+                cols[self.ordinality_symbol] = Column(
+                    jnp.full(cap, i + 1, jnp.int64),
+                    jnp.ones(cap, bool), BIGINT, None)
+            self._pending.append(Batch(cols, batch.row_valid))
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._pending:
+            return None
+        return self._count_out(self._pending.pop(0))
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._pending
+
+
+class UnnestOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, items, ordinality_symbol,
+                 out_dicts):
+        super().__init__(operator_id, "unnest")
+        self.items = items
+        self.ordinality_symbol = ordinality_symbol
+        self.out_dicts = out_dicts
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return UnnestOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.items, self.ordinality_symbol, self.out_dicts)
+
+
 class GroupIdOperator(Operator):
     """GROUPING SETS replication (reference: GroupIdOperator.java): each
     input batch is emitted once per grouping set with the key columns

@@ -283,9 +283,11 @@ def _pctl_bucket(value: jnp.ndarray) -> jnp.ndarray:
 
 def _pctl_values() -> np.ndarray:
     """Representative value per bucket (geometric midpoint)."""
-    mid = 2 * _PCTL_GAMMA / (_PCTL_GAMMA + 1)
-    li = np.arange(_PCTL_HALF - 1)          # 255 exponent slots
-    mags = mid * _PCTL_GAMMA ** (li - _PCTL_EXP0)
+    # round()-based bucket indexing covers gamma^(i-1/2)..gamma^(i+1/2)
+    # per bucket, whose geometric midpoint is gamma^i itself (no
+    # DDSketch 2g/(g+1) factor — that is for ceil-based indexing)
+    li = np.arange(_PCTL_HALF - 1)          # exponent slots
+    mags = _PCTL_GAMMA ** (li.astype(np.float64) - _PCTL_EXP0)
     out = np.zeros(PCTL_BUCKETS)
     # positives [HALF, 2*HALF-2] ascending; zero at HALF-1;
     # negatives [0, HALF-2] with the most negative first

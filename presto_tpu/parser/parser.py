@@ -434,7 +434,8 @@ class _Parser:
         elif self.cur.kind in ("ident", "qident"):
             alias = self.ident()
         if alias and self.at_op("(")\
-                and isinstance(rel, (T.SubqueryRelation, T.Table)):
+                and isinstance(rel, (T.SubqueryRelation, T.Table,
+                                     T.Unnest)):
             self.expect_op("(")
             col_aliases = [self.ident()]
             while self.accept_op(","):
@@ -445,6 +446,25 @@ class _Parser:
         return rel
 
     def relation_primary(self) -> T.Node:
+        if self._at_ident("unnest") and self.toks[self.i + 1].kind \
+                == "op" and self.toks[self.i + 1].value == "(":
+            self.advance()
+            self.expect_op("(")
+            args = [self.expr()]
+            while self.accept_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            ordinality = False
+            if self.at_kw("with"):
+                # WITH ORDINALITY (contextual second word)
+                save = self.i
+                self.advance()
+                if self._at_ident("ordinality"):
+                    self.advance()
+                    ordinality = True
+                else:
+                    self.i = save
+            return T.Unnest(args, ordinality)
         if self.accept_op("("):
             # subquery or parenthesized join
             if self.at_kw("select", "with", "values"):
@@ -579,6 +599,18 @@ class _Parser:
 
     def primary(self) -> T.Node:
         t = self.cur
+        if t.kind == "ident" and t.value.lower() == "array" \
+                and self.toks[self.i + 1].kind == "op" \
+                and self.toks[self.i + 1].value == "[":
+            self.advance()
+            self.expect_op("[")
+            items: List[T.Node] = []
+            if not self.accept_op("]"):
+                items.append(self.expr())
+                while self.accept_op(","):
+                    items.append(self.expr())
+                self.expect_op("]")
+            return T.ArrayConstructor(items)
         if t.kind == "number":
             self.advance()
             return T.NumberLit(t.value)

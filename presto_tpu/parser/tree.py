@@ -230,6 +230,18 @@ class QuerySpec(Node):
 
 
 @dataclasses.dataclass
+class ArrayConstructor(Node):
+    items: List[Node]
+
+
+@dataclasses.dataclass
+class Unnest(Node):
+    """UNNEST(expr, ...) [WITH ORDINALITY] as a FROM relation."""
+    args: List[Node]
+    ordinality: bool = False
+
+
+@dataclasses.dataclass
 class ValuesRelation(Node):
     rows: List[List[Node]]
 

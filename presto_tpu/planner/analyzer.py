@@ -1316,6 +1316,11 @@ def _plan_relation(rel: T.Node, ctx: PlannerContext,
                    outer: Optional[Scope]) -> RelationPlan:
     if isinstance(rel, T.Table):
         return _plan_table(rel, ctx, outer)
+    un, un_alias, un_cols = _unwrap_unnest(rel)
+    if un is not None:
+        # standalone UNNEST (aliased or not); the alias names only the
+        # unnested columns, unlike a subquery alias
+        return _plan_unnest(un, None, ctx, outer, un_alias, un_cols)
     if isinstance(rel, T.AliasedRelation):
         inner = _plan_relation(rel.relation, ctx, outer)
         fields = []
@@ -1335,7 +1340,103 @@ def _plan_relation(rel: T.Node, ctx: PlannerContext,
         return RelationPlan(rp.node, Scope(fields, outer))
     if isinstance(rel, T.Join):
         return _plan_join(rel, ctx, outer)
+    if isinstance(rel, T.Unnest):
+        return _plan_unnest(rel, None, ctx, outer, None, None)
     raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+
+def _unwrap_unnest(rel):
+    """(unnest, alias, column_aliases) when `rel` is an UNNEST relation
+    (possibly aliased), else (None, None, None)."""
+    if isinstance(rel, T.Unnest):
+        return rel, None, None
+    if isinstance(rel, T.AliasedRelation) \
+            and isinstance(rel.relation, T.Unnest):
+        return rel.relation, rel.alias, rel.column_aliases
+    return None, None, None
+
+
+def _plan_unnest(un: T.Unnest, source: Optional[RelationPlan],
+                 ctx: PlannerContext, outer: Optional[Scope],
+                 alias: Optional[str],
+                 col_aliases: Optional[List[str]]) -> RelationPlan:
+    """UNNEST(ARRAY[...], ...) — lateral over `source` (the left side
+    of the enclosing cross join; element expressions may reference its
+    columns) or standalone over a one-row relation. Static array
+    lengths make this pure replication (UnnestNode); zip semantics pad
+    shorter arrays with NULL."""
+    standalone = source is None
+    if standalone:
+        # a single synthetic row to replicate; its column stays out of
+        # the visible scope (SELECT * shows only unnested columns)
+        source, _ = _plan_values(
+            T.ValuesRelation([[T.NumberLit("0")]]), ctx)
+    an = _Analyzer(source.scope, ctx)
+    arrays: List[List[RowExpression]] = []
+    for a in un.args:
+        if not isinstance(a, T.ArrayConstructor):
+            raise AnalysisError(
+                "UNNEST supports ARRAY[...] constructors")
+        if not a.items:
+            raise AnalysisError("cannot UNNEST an empty array")
+        elems = [fold_constants(an.analyze(e)) for e in a.items]
+        t = UNKNOWN
+        for e in elems:
+            st = common_super_type(t, e.type)
+            if st is None:
+                raise AnalysisError(
+                    "UNNEST array element types are incompatible")
+            t = st
+        if t == UNKNOWN:
+            raise AnalysisError("cannot UNNEST an all-NULL array")
+        elems = [e if e.type == t else _coerce_to(e, t)
+                 for e in elems]
+        arrays.append(elems)
+
+    src_fields = tuple(source.node.output)
+    assigns = [(f.symbol, InputRef(f.symbol, f.type))
+               for f in src_fields]
+    proj_fields = list(src_fields)
+    items: List[Tuple[str, List[str]]] = []
+    new_fields: List[N.Field] = []
+    for j, elems in enumerate(arrays):
+        t = elems[0].type
+        union_dict = None
+        if t.is_string:
+            vals: set = set()
+            for e in elems:
+                vals |= set(an.dictionary_of(e) or ())
+            union_dict = tuple(sorted(vals))
+        elem_syms = []
+        for i, e in enumerate(elems):
+            s = ctx.symbols.new(f"unnest_elem")
+            assigns.append((s, e))
+            proj_fields.append(N.Field(s, e.type,
+                                       an.dictionary_of(e)))
+            elem_syms.append(s)
+        out_sym = ctx.symbols.new("unnest")
+        items.append((out_sym, elem_syms))
+        new_fields.append(N.Field(out_sym, t, union_dict))
+    ord_sym = None
+    if un.ordinality:
+        ord_sym = ctx.symbols.new("ordinality")
+        new_fields.append(N.Field(ord_sym, BIGINT, None))
+    proj = N.ProjectNode(source.node, assigns, tuple(proj_fields))
+    out_fields = src_fields + tuple(new_fields)
+    node = N.UnnestNode(proj, items, ord_sym, out_fields)
+
+    n_named = len(arrays) + (1 if un.ordinality else 0)
+    if col_aliases is not None and len(col_aliases) != n_named:
+        raise AnalysisError(
+            f"UNNEST alias needs {n_named} column names")
+    names = col_aliases or (
+        [f"col{j + 1}" for j in range(len(arrays))]
+        + (["ordinality"] if un.ordinality else []))
+    fields = [] if standalone else list(source.scope.fields)
+    for f, name in zip(new_fields, names):
+        fields.append(ScopeField(alias, name, f.symbol, f.type,
+                                 f.dictionary))
+    return RelationPlan(node, Scope(fields, outer))
 
 
 def _plan_table(rel: T.Table, ctx: PlannerContext,
@@ -1375,6 +1476,13 @@ def _split_conjuncts(e: T.Node) -> List[T.Node]:
 def _plan_join(rel: T.Join, ctx: PlannerContext,
                outer: Optional[Scope]) -> RelationPlan:
     left = _plan_relation(rel.left, ctx, outer)
+    un, un_alias, un_cols = _unwrap_unnest(rel.right)
+    if un is not None:
+        # lateral: element expressions see the left relation's columns
+        if rel.join_type != "cross" or rel.on is not None or rel.using:
+            raise AnalysisError(
+                "UNNEST joins must be CROSS JOIN (comma) form")
+        return _plan_unnest(un, left, ctx, outer, un_alias, un_cols)
     right = _plan_relation(rel.right, ctx, outer)
     combined = Scope(left.scope.fields + right.scope.fields, outer)
     out_fields = tuple(N.Field(f.symbol, f.type, f.dictionary)

@@ -405,6 +405,14 @@ class LocalExecutionPlanner:
             self._next_id(), node.symbol,
             start=self.task.index, stride=self.task.count))
 
+    def _visit_UnnestNode(self, node: N.UnnestNode, pipe: List):
+        self._visit(node.source, pipe)
+        out_dicts = {s: node.field(s).dictionary
+                     for s, _ in node.items}
+        pipe.append(misc_ops.UnnestOperatorFactory(
+            self._next_id(), node.items, node.ordinality_symbol,
+            out_dicts))
+
     def _visit_GroupIdNode(self, node: N.GroupIdNode, pipe: List):
         self._visit(node.source, pipe)
         pipe.append(misc_ops.GroupIdOperatorFactory(
@@ -609,6 +617,12 @@ def _child_demand(node: N.PlanNode, demand: set
     if isinstance(node, N.GroupIdNode):
         drop = {node.gid_symbol} | {s for s, _ in node.grouping_outputs}
         return [(node.source, (demand - drop) | set(node.all_keys))]
+    if isinstance(node, N.UnnestNode):
+        drop = {s for s, _ in node.items}
+        if node.ordinality_symbol:
+            drop.add(node.ordinality_symbol)
+        elem = {e for _, syms in node.items for e in syms}
+        return [(node.source, (demand - drop) | elem)]
     if isinstance(node, N.UnionNode):
         out = []
         for inp, m in zip(node.inputs, node.symbol_maps):
@@ -671,6 +685,11 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
         node.output = narrowed(
             set(node.all_keys) | {node.gid_symbol}
             | {s for s, _ in node.grouping_outputs})
+    elif isinstance(node, N.UnnestNode):
+        keep = {s for s, _ in node.items}
+        if node.ordinality_symbol:
+            keep.add(node.ordinality_symbol)
+        node.output = narrowed(keep)
     elif isinstance(node, N.UnionNode):
         node.output = narrowed()
         keep_syms = {f.symbol for f in node.output}

@@ -215,6 +215,24 @@ class AssignUniqueIdNode(PlanNode):
 
 
 @dataclasses.dataclass
+class UnnestNode(PlanNode):
+    """UNNEST over ARRAY[...] constructors (reference:
+    operator/unnest/UnnestOperator.java + plan/UnnestNode). Arrays are
+    syntactically fixed-length, so unnesting is static replication:
+    replica i of each input row selects every array's i-th element
+    column (pre-projected below this node); shorter arrays pad NULL
+    (zip semantics), plus an optional 1-based ordinality column."""
+    source: PlanNode
+    # per unnested array: (output symbol, element symbol per slot)
+    items: List[Tuple[str, List[str]]]
+    ordinality_symbol: Optional[str]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
 class GroupIdNode(PlanNode):
     """Replicates its input once per grouping set, NULLing the key
     columns excluded from each set and appending a literal group-id

@@ -183,6 +183,11 @@ class StatsEstimator:
         return PlanStats(len(node.groupings) * inner.rows,
                          dict(inner.columns))
 
+    def _est_UnnestNode(self, node: N.UnnestNode) -> PlanStats:
+        inner = self.estimate(node.source)
+        depth = max(len(s) for _, s in node.items)
+        return PlanStats(depth * inner.rows, dict(inner.columns))
+
     def _est_UnionNode(self, node: N.UnionNode) -> PlanStats:
         return PlanStats(sum(self.rows(x) for x in node.inputs))
 
