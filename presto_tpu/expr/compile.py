@@ -439,12 +439,8 @@ class _Compiler:
                 return d >= boundary, m
             return CompiledExpr(f_range, BOOLEAN)
         if a.dictionary is not None and len(a.dictionary) == 1:
-            flipped = {"less_than": "greater_than",
-                       "greater_than": "less_than",
-                       "less_than_or_equal": "greater_than_or_equal",
-                       "greater_than_or_equal": "less_than_or_equal",
-                       "equal": "equal", "not_equal": "not_equal"}[name]
-            return self._string_comparison(flipped, b, a)
+            from presto_tpu.expr.ir import FLIP_COMPARISON
+            return self._string_comparison(FLIP_COMPARISON[name], b, a)
         if a.dictionary is not None and a.dictionary == b.dictionary:
             fa, fb = a.fn, b.fn
 

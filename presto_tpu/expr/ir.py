@@ -48,6 +48,19 @@ class Call(RowExpression):
         return self.args
 
 
+#: comparison call name under operand swap: a OP b == b FLIP[OP] a —
+#: the ONE copy every rewrite that normalizes literal-first
+#: comparisons uses
+FLIP_COMPARISON = {
+    "less_than": "greater_than",
+    "greater_than": "less_than",
+    "less_than_or_equal": "greater_than_or_equal",
+    "greater_than_or_equal": "less_than_or_equal",
+    "equal": "equal",
+    "not_equal": "not_equal",
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class SpecialForm(RowExpression):
     """Non-function forms with their own evaluation/null rules

@@ -200,6 +200,32 @@ class _Exchanger:
         node.source = self._ensure_hashed(src, props, keys, None)
         return node, Props(P_HASH, keys, (None,) * len(keys))
 
+    def _rw_TopNRowNumberNode(self, node):
+        src, props = self._rw(node.source)
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        keys0 = tuple(node.partition_by)
+        if keys0 and props.kind == P_HASH and props.keys == keys0 \
+                and props.dicts == (None,) * len(keys0):
+            # already partitioned on the keys — no exchange will be
+            # inserted, so a partial copy would just rank twice
+            node.source = src
+            return node, props
+        # partial pre-filter on every worker: a row's global rank is
+        # >= its local rank, so local rank <= N keeps a superset
+        partial = N.TopNRowNumberNode(
+            src, list(node.partition_by), list(node.order_by),
+            list(node.descending), list(node.nulls_first),
+            node.function, node.row_number_symbol, node.max_rank,
+            tuple(node.output))
+        if not node.partition_by:
+            node.source = self._exchange(partial, "gather")
+            return node, SINGLE
+        keys = tuple(node.partition_by)
+        node.source = self._ensure_hashed(partial, props, keys, None)
+        return node, Props(P_HASH, keys, (None,) * len(keys))
+
     def _rw_UnionNode(self, node):
         rewritten = [self._rw(x) for x in node.inputs]
         if all(p.kind == P_SINGLE for _, p in rewritten):

@@ -347,6 +347,35 @@ class LocalExecutionPlanner:
             self._next_id(), bridge, [node.source_key], node.negate,
             build_keys=[node.filtering_key], key_dicts=key_dicts))
 
+    def _visit_TopNRowNumberNode(self, node: N.TopNRowNumberNode,
+                                 pipe: List):
+        """Window (single rank call) + fused rank <= N filter."""
+        from presto_tpu.expr.ir import Call, Literal
+        from presto_tpu.operators.window_ops import WindowOperatorFactory
+        from presto_tpu.ops.window import WindowCallSpec
+        from presto_tpu.types import BIGINT, BOOLEAN
+        self._visit(node.source, pipe)
+        pipe.append(WindowOperatorFactory(
+            self._next_id(), node.partition_by, node.order_by,
+            node.descending, node.nulls_first,
+            [WindowCallSpec(node.row_number_symbol, node.function,
+                            None, "FULL", BIGINT, None, 1)]))
+        schema = {f.symbol: ColumnSchema(f.symbol, f.type, f.dictionary)
+                  for f in node.source.output}
+        schema[node.row_number_symbol] = ColumnSchema(
+            node.row_number_symbol, BIGINT, None)
+        pred = compile_expression(
+            Call("less_than_or_equal",
+                 (InputRef(node.row_number_symbol, BIGINT),
+                  Literal(node.max_rank, BIGINT)), BOOLEAN), schema)
+        projections = [
+            (f.symbol, compile_expression(
+                InputRef(f.symbol, f.type), schema))
+            for f in node.output]
+        pipe.append(FilterProjectOperatorFactory(
+            self._next_id(), pred, projections,
+            _schema_dicts(schema)))
+
     def _visit_WindowNode(self, node: N.WindowNode, pipe: List):
         from presto_tpu.operators.window_ops import WindowOperatorFactory
         from presto_tpu.ops.window import WindowCallSpec
@@ -606,6 +635,10 @@ def _child_demand(node: N.PlanNode, demand: set
             | set(node.partition_by) | set(node.order_by) \
             | {c.argument for c in node.calls if c.argument}
         return [(node.source, child)]
+    if isinstance(node, N.TopNRowNumberNode):
+        child = (demand - {node.row_number_symbol}) \
+            | set(node.partition_by) | set(node.order_by)
+        return [(node.source, child)]
     if isinstance(node, N.DistinctNode):
         # DISTINCT is defined over exactly its output columns
         return [(node.source, {f.symbol for f in node.output})]
@@ -679,6 +712,9 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
         node.output = narrowed(
             set(node.partition_by) | set(node.order_by)
             | {c.argument for c in node.calls if c.argument})
+    elif isinstance(node, N.TopNRowNumberNode):
+        node.output = narrowed(
+            set(node.partition_by) | set(node.order_by))
     elif isinstance(node, N.AssignUniqueIdNode):
         node.output = narrowed({node.symbol})
     elif isinstance(node, N.GroupIdNode):

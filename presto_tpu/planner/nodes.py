@@ -262,6 +262,28 @@ class WindowCall:
 
 
 @dataclasses.dataclass
+class TopNRowNumberNode(PlanNode):
+    """Filter(rank-family window <= N) fused (reference:
+    TopNRowNumberOperator + the PushdownFilterIntoWindow family of
+    rules). The win is distributed: a PARTIAL copy runs on every worker
+    before the exchange — a row's global rank is >= its local rank, so
+    pre-filtering local rank <= N is a safe row reduction — and the
+    FINAL copy recomputes exact ranks on co-located partitions."""
+    source: PlanNode
+    partition_by: List[str]
+    order_by: List[str]
+    descending: List[bool]
+    nulls_first: List[bool]
+    function: str            # row_number | rank | dense_rank
+    row_number_symbol: str
+    max_rank: int
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
 class WindowNode(PlanNode):
     """OVER(...) evaluation appending one column per call (reference:
     sql/planner/plan/WindowNode + WindowOperator.java:62). Partition and

@@ -84,17 +84,14 @@ def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
                 note(v.name, "in", [i.value for i in items])
             continue
         if isinstance(c, Call) and len(c.args) == 2:
+            from presto_tpu.expr.ir import FLIP_COMPARISON
             a, b = c.args
             if isinstance(b, InputRef) and not isinstance(a, InputRef):
                 a, b = b, a
-                flip = {"less_than": "greater_than",
-                        "less_than_or_equal": "greater_than_or_equal",
-                        "greater_than": "less_than",
-                        "greater_than_or_equal": "less_than_or_equal",
-                        "equal": "equal"}
-                if c.name not in flip:
+                if c.name not in FLIP_COMPARISON \
+                        or c.name == "not_equal":
                     continue
-                name = flip[c.name]
+                name = FLIP_COMPARISON[c.name]
             else:
                 name = c.name
             if not (isinstance(a, InputRef) and isinstance(b, Literal)
@@ -126,8 +123,95 @@ def _rewrite(node: N.PlanNode, estimator=None) -> N.PlanNode:
     if isinstance(node, N.UnionNode):
         node.inputs = [_rewrite(x, estimator) for x in node.inputs]
     if isinstance(node, N.FilterNode):
+        fused = _fuse_topn_row_number(node)
+        if fused is not None:
+            return fused
         return _rewrite_filter(node, estimator)
     return node
+
+
+_RANK_FUNCTIONS = ("row_number", "rank", "dense_rank")
+
+
+def _rank_bound(conj: RowExpression,
+                rn_sym: str) -> Optional[Tuple[int, bool]]:
+    """(N, subsumed) such that `conj` implies rank <= N; `subsumed`
+    means the TopN cut fully enforces the conjunct (pure upper bound,
+    in either literal position) so no residual filter is needed."""
+    from presto_tpu.expr.ir import FLIP_COMPARISON, Literal
+    if not (isinstance(conj, Call) and len(conj.args) == 2):
+        return None
+    a, b = conj.args
+    name = conj.name
+    if isinstance(b, InputRef) and isinstance(a, Literal):
+        a, b = b, a
+        name = FLIP_COMPARISON.get(name)
+    if not (isinstance(a, InputRef) and a.name == rn_sym
+            and isinstance(b, Literal)
+            and isinstance(b.value, int)):
+        return None
+    if name == "less_than_or_equal":
+        return b.value, True
+    if name == "less_than":
+        return b.value - 1, True
+    if name == "equal":
+        return b.value, False
+    return None
+
+
+def _fuse_topn_row_number(node: N.FilterNode) -> Optional[N.PlanNode]:
+    """Filter(Window[single rank-family call]) with a rank <= N
+    conjunct -> TopNRowNumberNode (+ residual Filter), peeling one
+    rename-only Project (the subquery-projection shape). Reference:
+    PushdownFilterIntoWindow / TopNRowNumberOperator."""
+    win = node.source
+    proj: Optional[N.ProjectNode] = None
+    rename_to_src: Dict[str, str] = {}
+    if isinstance(win, N.ProjectNode) \
+            and all(isinstance(e, InputRef)
+                    for _, e in win.assignments):
+        proj = win
+        rename_to_src = {s: e.name for s, e in win.assignments}
+        win = win.source
+    if not (isinstance(win, N.WindowNode) and len(win.calls) == 1):
+        return None
+    call = win.calls[0]
+    if call.function not in _RANK_FUNCTIONS or not win.order_by:
+        return None
+    rn = call.out_symbol
+    # the predicate sees the (possibly renamed) rank symbol
+    rn_outs = {rn} if proj is None else {
+        o for o, src in rename_to_src.items() if src == rn}
+    conjs = _split_conjuncts(node.predicate)
+    bound = None
+    residual: List[RowExpression] = []
+    for c in conjs:
+        hit = None
+        for rn_out in rn_outs:
+            hit = _rank_bound(c, rn_out)
+            if hit is not None:
+                break
+        if hit is not None:
+            b, subsumed = hit
+            bound = b if bound is None else min(bound, b)
+            if not subsumed:
+                residual.append(c)  # e.g. rank = N still filters
+        else:
+            residual.append(c)
+    if bound is None or bound > 100_000 or bound < 1:
+        return None
+    topn = N.TopNRowNumberNode(
+        win.source, list(win.partition_by), list(win.order_by),
+        list(win.descending), list(win.nulls_first), call.function,
+        rn, bound, tuple(win.output))
+    inner: N.PlanNode = topn
+    if proj is not None:
+        proj.source = topn
+        inner = proj
+    if residual:
+        return N.FilterNode(inner, _combine_conjuncts(residual),
+                            node.output)
+    return inner
 
 
 def _split_conjuncts(e: RowExpression) -> List[RowExpression]:

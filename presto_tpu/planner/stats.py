@@ -183,6 +183,15 @@ class StatsEstimator:
         return PlanStats(len(node.groupings) * inner.rows,
                          dict(inner.columns))
 
+    def _est_TopNRowNumberNode(self, node) -> PlanStats:
+        inner = self.estimate(node.source)
+        parts = 1.0
+        for p in node.partition_by:
+            nd = inner.col(p).ndv
+            parts *= nd if nd else 100.0
+        rows = min(inner.rows, node.max_rank * parts)
+        return PlanStats(max(1.0, rows), dict(inner.columns))
+
     def _est_UnnestNode(self, node: N.UnnestNode) -> PlanStats:
         inner = self.estimate(node.source)
         depth = max(len(s) for _, s in node.items)
