@@ -81,25 +81,33 @@ def edge_key_dicts(edge) -> List:
             for k in edge.partition_keys]
 
 
+DEFAULT_HOST_SPOOL_BYTES = 8 << 30
+
+
 class MeshExchange:
     """One exchange edge: N producer tasks -> M consumer task queues.
 
     Grouped (bucket-wise) execution: with `lifespans` G > 1 the hash
     space is split W x G (reference: execution/Lifespan.java:26 driver
     groups); rows for the CURRENT lifespan queue on their consumer's
-    device, rows for later lifespans spill to HOST memory (the TPU
-    analog of Presto's disk spill — HBM is the scarce tier, host RAM
-    is the big one) and return to the device when advance_lifespan()
-    starts their bucket. Producers that themselves run bucket-wise
-    signal done once per lifespan; `producer_finishes` sets how many
-    signals complete one producer."""
+    device, rows for later lifespans spill DOWN the memory tiers —
+    first to host RAM (the scarce tier is HBM), and past
+    `host_spool_bytes` of host batches to DISK as compressed pages
+    through the native codec (reference: spiller/
+    FileSingleStreamSpiller.java:56 + GenericPartitioningSpiller —
+    their partitioned spill is our per-lifespan bucketing). Batches
+    return to the device when advance_lifespan() starts their bucket;
+    spill files are deleted as they are read back. Producers that
+    themselves run bucket-wise signal done once per lifespan;
+    `producer_finishes` sets how many signals complete one producer."""
 
     def __init__(self, exchange_id: int, scheme: str,
                  partition_keys: Sequence[str],
                  hash_dicts, key_dictionaries,
                  mesh, n_producers: int, n_consumers: int,
                  lifespans: int = 1, producer_finishes: int = 1,
-                 pool=None):
+                 pool=None,
+                 host_spool_bytes: int = DEFAULT_HOST_SPOOL_BYTES):
         self.exchange_id = exchange_id
         self.scheme = scheme
         self.partition_keys = list(partition_keys)
@@ -127,6 +135,12 @@ class MeshExchange:
         self._template: Optional[Batch] = None
         self._rr = 0
         self._remaps = build_remap_tables(hash_dicts, key_dictionaries)
+        # host/disk spool accounting
+        self._host_spool_bytes = host_spool_bytes
+        self._host_bytes = 0
+        self._spill_dir: Optional[str] = None
+        self._spill_seq = 0
+        self.spilled_pages = 0  # observability + tests
 
     # -- memory accounting -------------------------------------------------
 
@@ -193,16 +207,68 @@ class MeshExchange:
         return self.current_lifespan + 1 < self.lifespans
 
     def advance_lifespan(self) -> None:
-        """Reload the next bucket's host-spooled batches onto their
-        consumer devices."""
+        """Reload the next bucket's spooled batches (host RAM or spill
+        files) onto their consumer devices."""
+        import os
         self.current_lifespan += 1
         g = self.current_lifespan
         for c, dq in enumerate(self._spooled.pop(g, [])):
+            dev = self.devices[c] if c < len(self.devices) \
+                else self.devices[0]
             while dq:
-                host_batch = dq.popleft()
-                self._enqueue(c, jax.device_put(
-                    host_batch, self.devices[c]
-                    if c < len(self.devices) else self.devices[0]))
+                tier, payload, nbytes = dq.popleft()
+                if tier == "disk":
+                    from presto_tpu.server.serde import batch_from_bytes
+                    with open(payload, "rb") as f:
+                        host_batch = batch_from_bytes(f.read())
+                    os.unlink(payload)
+                else:
+                    self._host_bytes -= nbytes
+                    host_batch = payload
+                self._enqueue(c, jax.device_put(host_batch, dev))
+        if self.current_lifespan + 1 >= self.lifespans:
+            self._drop_spill_dir()
+
+    def _drop_spill_dir(self) -> None:
+        if self._spill_dir is not None:
+            import shutil
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            self._spill_dir = None
+
+    def close(self) -> None:
+        """Release every spooled resource — called when the query ends
+        for ANY reason (error paths included), so spill files never
+        outlive their query."""
+        self._spooled = {}
+        self._host_bytes = 0
+        self._drop_spill_dir()
+
+    def _spool(self, g: int, consumer: int, part: Batch,
+               known_valid: int) -> None:
+        """Park a later bucket's batch on the host tier, or on disk
+        once host spool passes its budget. Sizes come from shape
+        metadata — no device sync to decide the tier, and the caller
+        already compacted `part` so serialization skips re-compaction."""
+        import os
+        import tempfile
+        from presto_tpu.execution.memory import batch_bytes
+        nbytes = batch_bytes(part)
+        if self._host_bytes + nbytes <= self._host_spool_bytes:
+            self._host_bytes += nbytes
+            self._spooled[g][consumer].append(
+                ("mem", jax.device_get(part), nbytes))
+            return
+        from presto_tpu.server.serde import batch_to_bytes
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(
+                prefix=f"presto-tpu-spill-{self.exchange_id}-")
+        path = os.path.join(self._spill_dir,
+                            f"{g}-{consumer}-{self._spill_seq}.page")
+        self._spill_seq += 1
+        with open(path, "wb") as f:
+            f.write(batch_to_bytes(part, assume_compact=True))
+        self.spilled_pages += 1
+        self._spooled[g][consumer].append(("disk", path, nbytes))
 
     def _key_hash(self, batch: Batch):
         return partition_key_hash(batch, self.partition_keys,
@@ -228,8 +294,7 @@ class MeshExchange:
                 if n == 0:
                     continue
                 part = part.compact(bucket_capacity(n), known_valid=n)
-                self._spooled[g][consumer].append(
-                    jax.device_get(part))
+                self._spool(g, consumer, part, n)
 
     def _route_lifespan(self, consumer: int, batch: Batch) -> None:
         if self.lifespans == 1:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
+from presto_tpu.operators import exchange_ops
 from presto_tpu.operators.exchange_ops import MeshExchange, edge_key_dicts
 from presto_tpu.parallel.mesh import make_mesh
 from presto_tpu.planner import nodes as N
@@ -155,7 +156,10 @@ class MeshRunner(LocalRunner):
                 n_consumers=self._task_count(consumer),
                 lifespans=lifespans_of[edge.consumer],
                 producer_finishes=lifespans_of[edge.producer],
-                pool=pool)
+                pool=pool,
+                host_spool_bytes=int(session.properties.get(
+                    "host_spool_bytes",
+                    exchange_ops.DEFAULT_HOST_SPOOL_BYTES)))
 
         dctx = DriverContext(profile=profile, memory=pool)
         result = None
@@ -199,10 +203,17 @@ class MeshRunner(LocalRunner):
 
         t0 = _time.perf_counter()
         stat_snaps: List[List] = []
-        self._drive_phased(fplan, all_drivers, instance_drivers,
-                           remaining_lifespans, exchanges,
-                           spawn_fragment,
-                           stat_snaps if profile else None)
+        try:
+            self._drive_phased(fplan, all_drivers, instance_drivers,
+                               remaining_lifespans, exchanges,
+                               spawn_fragment,
+                               stat_snaps if profile else None)
+        finally:
+            # spill files must never outlive the query, error or not
+            self._last_spilled_pages = sum(
+                x.spilled_pages for x in exchanges.values())
+            for x in exchanges.values():
+                x.close()
         if profile:
             self._last_profile = self._render_operator_stats(
                 stat_snaps, _time.perf_counter() - t0, pool)

@@ -21,11 +21,16 @@ from presto_tpu.native import codec
 from presto_tpu.types import parse_type
 
 
-def batch_to_bytes(batch: Batch) -> bytes:
+def batch_to_bytes(batch: Batch, assume_compact: bool = False) -> bytes:
     import jax
-    # compact: ship live rows only
-    n = batch.num_valid()
-    b = batch.compact(bucket_capacity(max(n, 1)), known_valid=n)
+    if assume_compact:
+        # caller already packed live rows into a prefix (e.g. the
+        # spill path) — skip the num_valid sync + second compact
+        b = batch
+    else:
+        # compact: ship live rows only
+        n = batch.num_valid()
+        b = batch.compact(bucket_capacity(max(n, 1)), known_valid=n)
     host = jax.device_get(b)
     parts = []
     columns = []
