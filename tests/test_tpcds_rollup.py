@@ -1,0 +1,94 @@
+"""Canonical ROLLUP-based TPC-DS query shapes (q27, q67 spine) —
+sqlite has no ROLLUP, so the oracle runs the equivalent UNION ALL
+expansion over the same generated rows."""
+
+import sqlite3
+
+import pytest
+
+from test_tpch_suite import assert_rows_equal, normalize
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpcds", "tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    conn = runner.catalogs.connector("tpcds")
+    db = sqlite3.connect(":memory:")
+    for t in ["store_sales", "date_dim", "item", "store",
+              "customer_demographics"]:
+        conn.table_pandas("tiny", t).to_sql(t, db, index=False)
+    return db
+
+
+def test_q27_shape(runner, oracle):
+    """q27: demographic item averages with s_state rollup."""
+    got = runner.execute("""
+        select i_item_id, s_state, grouping(s_state) g_state,
+               avg(ss_quantity) agg1, avg(ss_list_price) agg2
+        from store_sales, customer_demographics, date_dim, store, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+          and cd_gender = 'M' and d_year = 2000
+        group by rollup(i_item_id, s_state)
+        order by i_item_id, s_state
+        limit 100""").rows()
+    base = """
+        from store_sales, customer_demographics, date_dim, store, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+          and cd_gender = 'M' and d_year = 2000"""
+    exp = [tuple(r) for r in oracle.execute(f"""
+        select * from (
+          select i_item_id, s_state, 0 g, avg(ss_quantity) a1,
+                 avg(ss_list_price) a2 {base}
+          group by i_item_id, s_state
+          union all
+          select i_item_id, null, 1, avg(ss_quantity),
+                 avg(ss_list_price) {base} group by i_item_id
+          union all
+          select null, null, 3, avg(ss_quantity),
+                 avg(ss_list_price) {base})
+        order by i_item_id nulls last, s_state nulls last limit 100""").fetchall()]
+    assert_rows_equal(
+        normalize(got, ["varchar", "varchar", "bigint", "double",
+                        "double"]), exp, "q27", False)
+
+
+def test_q67_shape(runner, oracle):
+    """q67 spine: category/class/brand rollup of sales totals."""
+    got = runner.execute("""
+        select i_category, i_class, i_brand,
+               sum(ss_ext_sales_price) s
+        from store_sales, date_dim, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and d_moy = 11
+        group by rollup(i_category, i_class, i_brand)
+        order by i_category, i_class, i_brand
+        limit 100""").rows()
+    base = """
+        from store_sales, date_dim, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and d_moy = 11"""
+    exp = [tuple(r) for r in oracle.execute(f"""
+        select * from (
+          select i_category, i_class, i_brand,
+                 sum(ss_ext_sales_price) {base}
+          group by i_category, i_class, i_brand
+          union all
+          select i_category, i_class, null,
+                 sum(ss_ext_sales_price) {base}
+          group by i_category, i_class
+          union all
+          select i_category, null, null,
+                 sum(ss_ext_sales_price) {base} group by i_category
+          union all
+          select null, null, null, sum(ss_ext_sales_price) {base})
+        order by i_category nulls last, i_class nulls last, i_brand nulls last limit 100""").fetchall()]
+    assert_rows_equal(
+        normalize(got, ["varchar"] * 3 + ["double"]), exp, "q67",
+        False)
