@@ -1,0 +1,195 @@
+"""PEP 249 (DB-API 2.0) driver — the Python-idiomatic analog of the
+reference's JDBC driver (presto-jdbc PrestoDriver.java:35), speaking
+the same queued/executing client protocol as the CLI.
+
+    import presto_tpu.dbapi as dbapi
+    conn = dbapi.connect("http://coordinator:8080")     # remote
+    conn = dbapi.connect(catalog="tpch", schema="tiny")  # in-process
+    cur = conn.cursor()
+    cur.execute("select * from nation")
+    print(cur.fetchall())
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, List, Optional, Sequence, Tuple
+
+apilevel = "2.0"
+threadsafety = 1          # threads may share the module
+paramstyle = "qmark"
+
+
+class Error(Exception):
+    pass
+
+
+class ProgrammingError(Error):
+    pass
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+        self._rows: Optional[List[Tuple]] = None
+        self._pos = 0
+        self.description = None
+        self.rowcount = -1
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, sql: str,
+                parameters: Optional[Sequence[Any]] = None) -> "Cursor":
+        if parameters is not None:
+            sql = _bind(sql, parameters)
+        columns, rows = self._conn._run(sql)
+        self._rows = rows
+        self._pos = 0
+        self.rowcount = len(rows)
+        self.description = [
+            (name, typ, None, None, None, None, None)
+            for name, typ in columns]
+        return self
+
+    def executemany(self, sql: str,
+                    seq_of_parameters: Sequence[Sequence[Any]]) -> None:
+        for p in seq_of_parameters:
+            self.execute(sql, p)
+
+    # -- fetching ----------------------------------------------------------
+
+    def _check(self) -> List[Tuple]:
+        if self._rows is None:
+            raise ProgrammingError("no query has been executed")
+        return self._rows
+
+    def fetchone(self) -> Optional[Tuple]:
+        rows = self._check()
+        if self._pos >= len(rows):
+            return None
+        row = rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[Tuple]:
+        rows = self._check()
+        n = size or self.arraysize
+        out = rows[self._pos:self._pos + n]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> List[Tuple]:
+        rows = self._check()
+        out = rows[self._pos:]
+        self._pos = len(rows)
+        return out
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+    def close(self) -> None:
+        self._rows = None
+
+    def setinputsizes(self, sizes) -> None:  # noqa: D401 — PEP 249
+        pass
+
+    def setoutputsize(self, size, column=None) -> None:
+        pass
+
+
+class Connection:
+    def __init__(self, server: Optional[str] = None,
+                 catalog: str = "tpch", schema: str = "tiny"):
+        self._server = server
+        self._client = None
+        self._runner = None
+        if server is not None:
+            from presto_tpu.server.coordinator import StatementClient
+            self._client = StatementClient(server)
+        else:
+            from presto_tpu.runner import LocalRunner
+            self._runner = LocalRunner(catalog, schema)
+
+    def _run(self, sql: str):
+        """-> ([(name, type_name)], rows) with DATE decoded."""
+        try:
+            if self._client is not None:
+                columns, data = self._client.execute(sql)
+                names = [(c["name"], c.get("type", "")) for c in columns]
+                types = [c.get("type", "") for c in columns]
+                rows = [tuple(_decode(v, t) for v, t in zip(r, types))
+                        for r in data]
+                return names, rows
+            res = self._runner.execute(sql)
+            names = [(n, f.type.name)
+                     for n, f in zip(res.names, res.fields)]
+            types = [f.type.name for f in res.fields]
+            rows = [tuple(_decode(v, t) for v, t in zip(r, types))
+                    for r in res.rows()]
+            return names, rows
+        except Error:
+            raise
+        except Exception as e:  # noqa: BLE001 — PEP 249 error surface
+            raise Error(str(e)) from e
+
+    def cursor(self) -> Cursor:
+        return Cursor(self)
+
+    def commit(self) -> None:
+        pass  # autocommit engine
+
+    def rollback(self) -> None:
+        raise Error("transactions are not supported")
+
+    def close(self) -> None:
+        self._client = None
+        self._runner = None
+
+
+def _decode(v, type_name: str):
+    if v is None:
+        return None
+    if type_name == "date" and isinstance(v, int):
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=v)
+    return v
+
+
+def _bind(sql: str, parameters: Sequence[Any]) -> str:
+    """qmark substitution with SQL-literal encoding (the engine has no
+    server-side prepared statements yet)."""
+    parts = sql.split("?")
+    if len(parts) - 1 != len(parameters):
+        raise ProgrammingError(
+            f"statement has {len(parts) - 1} placeholders, "
+            f"{len(parameters)} parameters given")
+    out = [parts[0]]
+    for p, tail in zip(parameters, parts[1:]):
+        out.append(_literal(p))
+        out.append(tail)
+    return "".join(out)
+
+
+def _literal(p) -> str:
+    if p is None:
+        return "NULL"
+    if isinstance(p, bool):
+        return "true" if p else "false"
+    if isinstance(p, (int, float)):
+        return repr(p)
+    if isinstance(p, datetime.date):
+        return f"date '{p.isoformat()}'"
+    if isinstance(p, str):
+        return "'" + p.replace("'", "''") + "'"
+    raise ProgrammingError(f"cannot bind parameter of type "
+                           f"{type(p).__name__}")
+
+
+def connect(server: Optional[str] = None, catalog: str = "tpch",
+            schema: str = "tiny") -> Connection:
+    return Connection(server, catalog, schema)

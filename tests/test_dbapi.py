@@ -1,0 +1,81 @@
+"""PEP 249 driver (reference analog: presto-jdbc)."""
+
+import datetime
+
+import pytest
+
+import presto_tpu.dbapi as dbapi
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return dbapi.connect(catalog="tpch", schema="tiny")
+
+
+def test_fetch_variants(conn):
+    cur = conn.cursor()
+    cur.execute("select nationkey, name from nation order by nationkey")
+    assert cur.rowcount == 25
+    assert [d[0] for d in cur.description] == ["nationkey", "name"]
+    assert cur.fetchone() == (0, "ALGERIA")
+    assert cur.fetchmany(2) == [(1, "ARGENTINA"), (2, "BRAZIL")]
+    rest = cur.fetchall()
+    assert len(rest) == 22
+    assert cur.fetchone() is None
+
+
+def test_iteration_and_params(conn):
+    cur = conn.cursor()
+    cur.execute("select name from nation where nationkey < ? "
+                "and name <> ? order by name", (3, "BRAZIL"))
+    assert [r[0] for r in cur] == ["ALGERIA", "ARGENTINA"]
+
+
+def test_date_decoding(conn):
+    cur = conn.cursor()
+    cur.execute("select min(orderdate) from orders")
+    (d,) = cur.fetchone()
+    assert isinstance(d, datetime.date)
+    assert d == datetime.date(1992, 1, 1)
+
+
+def test_date_parameter(conn):
+    cur = conn.cursor()
+    cur.execute("select count(*) from orders where orderdate < ?",
+                (datetime.date(1995, 1, 1),))
+    n = cur.fetchone()[0]
+    cur.execute("select count(*) from orders")
+    total = cur.fetchone()[0]
+    assert 0 < n < total
+
+
+def test_errors(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.Error):
+        cur.execute("select * from no_such_table")
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ?", ())
+    fresh = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        fresh.fetchall()
+
+
+def test_string_escaping(conn):
+    cur = conn.cursor()
+    cur.execute("select ?", ("O'Brien",))
+    assert cur.fetchone() == ("O'Brien",)
+
+
+def test_remote_connection():
+    """The same driver over the client protocol against a live
+    coordinator (no workers needed for a values query)."""
+    from presto_tpu.server.coordinator import Coordinator
+    coord = Coordinator([], "tpch", "tiny")
+    coord.start()
+    try:
+        cur = dbapi.connect(coord.url).cursor()
+        cur.execute("select 1 + 1 two")
+        assert cur.fetchall() == [(2,)]
+        assert cur.description[0][0] == "two"
+    finally:
+        coord.stop()

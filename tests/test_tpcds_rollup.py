@@ -28,7 +28,7 @@ def oracle(runner):
 def test_q27_shape(runner, oracle):
     """q27: demographic item averages with s_state rollup."""
     got = runner.execute("""
-        select i_item_id, s_state, grouping(s_state) g_state,
+        select i_item_id, s_state, grouping(i_item_id, s_state) g,
                avg(ss_quantity) agg1, avg(ss_list_price) agg2
         from store_sales, customer_demographics, date_dim, store, item
         where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
@@ -36,7 +36,7 @@ def test_q27_shape(runner, oracle):
           and cd_gender = 'M' and d_year = 2000
         group by rollup(i_item_id, s_state)
         order by i_item_id, s_state
-        limit 100""").rows()
+        limit 200""").rows()
     base = """
         from store_sales, customer_demographics, date_dim, store, item
         where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
@@ -53,7 +53,7 @@ def test_q27_shape(runner, oracle):
           union all
           select null, null, 3, avg(ss_quantity),
                  avg(ss_list_price) {base})
-        order by i_item_id nulls last, s_state nulls last limit 100""").fetchall()]
+        order by i_item_id nulls last, s_state nulls last limit 200""").fetchall()]
     assert_rows_equal(
         normalize(got, ["varchar", "varchar", "bigint", "double",
                         "double"]), exp, "q27", False)
