@@ -18,9 +18,8 @@ def _format_value(v, typ) -> str:
     if v is None:
         return "NULL"
     if typ == "date" and isinstance(v, int):
-        import datetime
-        return (datetime.date(1970, 1, 1)
-                + datetime.timedelta(days=v)).isoformat()
+        from presto_tpu.expr.dates import days_to_date
+        return days_to_date(v).isoformat()
     return str(v)
 
 

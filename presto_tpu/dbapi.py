@@ -75,7 +75,7 @@ class Cursor:
 
     def fetchmany(self, size: Optional[int] = None) -> List[Tuple]:
         rows = self._check()
-        n = size or self.arraysize
+        n = self.arraysize if size is None else size
         out = rows[self._pos:self._pos + n]
         self._pos += len(out)
         return out
@@ -105,16 +105,25 @@ class Cursor:
 
 class Connection:
     def __init__(self, server: Optional[str] = None,
-                 catalog: str = "tpch", schema: str = "tiny"):
+                 catalog: Optional[str] = None,
+                 schema: Optional[str] = None):
         self._server = server
         self._client = None
         self._runner = None
         if server is not None:
+            if catalog is not None or schema is not None:
+                # the client protocol carries no session context yet;
+                # silently running against the coordinator's defaults
+                # would be a wrong-catalog footgun
+                raise Error(
+                    "catalog/schema cannot be set on a remote "
+                    "connection — the coordinator's session applies")
             from presto_tpu.server.coordinator import StatementClient
             self._client = StatementClient(server)
         else:
             from presto_tpu.runner import LocalRunner
-            self._runner = LocalRunner(catalog, schema)
+            self._runner = LocalRunner(catalog or "tpch",
+                                       schema or "tiny")
 
     def _run(self, sql: str):
         """-> ([(name, type_name)], rows) with DATE decoded."""
@@ -156,14 +165,45 @@ def _decode(v, type_name: str):
     if v is None:
         return None
     if type_name == "date" and isinstance(v, int):
-        return datetime.date(1970, 1, 1) + datetime.timedelta(days=v)
+        from presto_tpu.expr.dates import days_to_date
+        return days_to_date(v)
     return v
+
+
+def _split_placeholders(sql: str) -> List[str]:
+    """Split on '?' placeholders OUTSIDE string literals ('?' inside
+    '...' is literal text; '' escapes a quote)."""
+    parts: List[str] = []
+    buf: List[str] = []
+    in_string = False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_string:
+            buf.append(ch)
+            if ch == "'":
+                if i + 1 < len(sql) and sql[i + 1] == "'":
+                    buf.append("'")
+                    i += 1
+                else:
+                    in_string = False
+        elif ch == "'":
+            in_string = True
+            buf.append(ch)
+        elif ch == "?":
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    parts.append("".join(buf))
+    return parts
 
 
 def _bind(sql: str, parameters: Sequence[Any]) -> str:
     """qmark substitution with SQL-literal encoding (the engine has no
     server-side prepared statements yet)."""
-    parts = sql.split("?")
+    parts = _split_placeholders(sql)
     if len(parts) - 1 != len(parameters):
         raise ProgrammingError(
             f"statement has {len(parts) - 1} placeholders, "
@@ -190,6 +230,7 @@ def _literal(p) -> str:
                            f"{type(p).__name__}")
 
 
-def connect(server: Optional[str] = None, catalog: str = "tpch",
-            schema: str = "tiny") -> Connection:
+def connect(server: Optional[str] = None,
+            catalog: Optional[str] = None,
+            schema: Optional[str] = None) -> Connection:
     return Connection(server, catalog, schema)

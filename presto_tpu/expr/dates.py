@@ -19,6 +19,12 @@ def date_to_days(d: datetime.date) -> int:
     return d.toordinal() - EPOCH
 
 
+def days_to_date(days: int) -> datetime.date:
+    """Physical epoch-days value -> datetime.date (the one wire-format
+    decoder — CLI and DB-API both route through here)."""
+    return datetime.date.fromordinal(days + EPOCH)
+
+
 def parse_date_literal(text: str) -> int:
     return date_to_days(datetime.date.fromisoformat(text.strip()))
 

@@ -461,9 +461,16 @@ class LocalRunner:
                 names, [b],
                 tuple(N.Field(n, VARCHAR) for n in names))
         if isinstance(stmt, T.ShowSession):
-            rows = sorted(self.session.properties.items())
-            return self._text_result(
-                "Property", [f"{k}={v}" for k, v in rows])
+            from presto_tpu.session_properties import (
+                SESSION_PROPERTIES, effective,
+            )
+            rows = []
+            for k, v in sorted(effective(
+                    self.session.properties).items()):
+                p = SESSION_PROPERTIES.get(k)
+                desc = f"  -- {p.description}" if p else ""
+                rows.append(f"{k}={v}{desc}")
+            return self._text_result("Property", rows)
         raise QueryError("unsupported SHOW")
 
     def _set_session(self, stmt: T.SetSession) -> MaterializedResult:
@@ -475,7 +482,12 @@ class LocalRunner:
         e = an.analyze(stmt.value)
         if not isinstance(e, Literal):
             raise QueryError("SET SESSION value must be a constant")
-        self.session.properties[stmt.name] = e.value
+        from presto_tpu.session_properties import validate_set
+        try:
+            value = validate_set(stmt.name, e.value)
+        except ValueError as err:
+            raise QueryError(str(err)) from None
+        self.session.properties[stmt.name] = value
         return self._text_result("result", ["SET SESSION"])
 
     def _text_result(self, name: str, lines: List[str]

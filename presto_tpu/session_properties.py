@@ -1,0 +1,111 @@
+"""Session property registry (reference: SystemSessionProperties.java
+— the typed, defaulted, per-query flag system behind SET SESSION and
+client session headers; its 110 keys gate every engine experiment).
+
+Each property declares a type, default, and description; SET SESSION
+validates the name and coerces the value, and SHOW SESSION lists every
+known property with its effective value — unknown keys are rejected at
+SET time rather than silently ignored at read time (the reference's
+strict-config discipline)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyDef:
+    name: str
+    type_name: str                 # bigint | boolean | varchar
+    default: Any
+    description: str
+    validate: Optional[Callable[[Any], Optional[str]]] = None
+
+
+def _positive(v) -> Optional[str]:
+    return None if v > 0 else "must be positive"
+
+
+def _non_negative(v) -> Optional[str]:
+    return None if v >= 0 else "must be >= 0"
+
+
+def _power_of_two(v) -> Optional[str]:
+    if v > 0 and (v & (v - 1)) == 0:
+        return None
+    return "must be a power of two"
+
+
+SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
+    PropertyDef(
+        "batch_rows", "bigint", 65536,
+        "Rows per scan batch (power of two; larger batches amortize "
+        "dispatch, smaller ones bound HBM)", _power_of_two),
+    PropertyDef(
+        "max_groups", "bigint", 4096,
+        "Initial group-by table capacity; overflow retries the query "
+        "with 4x (reference: MultiChannelGroupByHash rehash)",
+        _positive),
+    PropertyDef(
+        "broadcast_join_threshold_rows", "bigint", 100_000,
+        "Estimated build rows at or below which a join broadcasts "
+        "instead of repartitioning (reference: join-distribution "
+        "choice in AddExchanges)", _non_negative),
+    PropertyDef(
+        "hbm_budget_bytes", "bigint", None,
+        "Per-query device memory budget; exceeding it fails locally "
+        "or triggers bucket-wise execution on a mesh (reference: "
+        "query_max_memory_per_node)", _positive),
+    PropertyDef(
+        "lifespans", "bigint", 1,
+        "Grouped (bucket-wise) execution split of the hash space "
+        "(reference: Lifespan driver groups)", _positive),
+    PropertyDef(
+        "host_spool_bytes", "bigint", 8 << 30,
+        "Host-RAM budget for spooled lifespan buckets before they "
+        "spill to disk (reference: spiller thresholds)",
+        _non_negative),
+    PropertyDef(
+        "query_retries", "bigint", 1,
+        "Distributed-query retry budget after worker failures "
+        "(reference: per-section retries, max_stage_retries)",
+        _non_negative),
+    PropertyDef(
+        "target_splits", "bigint", 4,
+        "Scan splits requested per table (parallel scan fan-out; "
+        "reference: initial-splits-per-node)", _positive),
+]}
+
+
+def validate_set(name: str, value: Any) -> Any:
+    """SET SESSION gate: known name, coercible type, valid value.
+    Dotted names (catalog.key) are connector-private and pass through
+    unvalidated (reference: per-connector session properties)."""
+    if "." in name:
+        return value
+    p = SESSION_PROPERTIES.get(name)
+    if p is None:
+        known = ", ".join(sorted(SESSION_PROPERTIES))
+        raise ValueError(
+            f"unknown session property {name!r} (known: {known})")
+    if p.type_name == "bigint":
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise ValueError(f"{name} expects an integer")
+    elif p.type_name == "boolean" and not isinstance(value, bool):
+        raise ValueError(f"{name} expects a boolean")
+    if p.validate is not None and value is not None:
+        err = p.validate(value)
+        if err:
+            raise ValueError(f"{name}: {err}")
+    return value
+
+
+def effective(properties: Dict[str, Any]) -> Dict[str, Any]:
+    """Every known property with its session-or-default value, plus
+    any extra keys the session carries (connector-private settings)."""
+    out = {name: properties.get(name, p.default)
+           for name, p in SESSION_PROPERTIES.items()}
+    for k, v in properties.items():
+        out.setdefault(k, v)
+    return out

@@ -66,6 +66,26 @@ def test_string_escaping(conn):
     assert cur.fetchone() == ("O'Brien",)
 
 
+def test_placeholder_inside_literal(conn):
+    cur = conn.cursor()
+    cur.execute("select '?', ?", (7,))
+    assert cur.fetchone() == ("?", 7)
+    cur.execute("select 'it''s ?', ?", (1,))
+    assert cur.fetchone() == ("it's ?", 1)
+
+
+def test_fetchmany_zero(conn):
+    cur = conn.cursor()
+    cur.execute("select nationkey from nation")
+    assert cur.fetchmany(0) == []
+    assert cur.fetchmany(1) == [(0,)]
+
+
+def test_remote_rejects_catalog_args():
+    with pytest.raises(dbapi.Error, match="remote"):
+        dbapi.connect("http://localhost:1", catalog="tpch")
+
+
 def test_remote_connection():
     """The same driver over the client protocol against a live
     coordinator (no workers needed for a values query)."""

@@ -1,0 +1,51 @@
+"""Session property registry (reference: SystemSessionProperties —
+typed, defaulted, validated per-query flags)."""
+
+import pytest
+
+from presto_tpu.session_properties import (
+    SESSION_PROPERTIES, effective, validate_set,
+)
+
+
+def test_known_properties_validate():
+    assert validate_set("batch_rows", 1 << 16) == 1 << 16
+    assert validate_set("lifespans", 4) == 4
+    assert validate_set("query_retries", 0) == 0
+
+
+def test_unknown_rejected():
+    with pytest.raises(ValueError, match="unknown session property"):
+        validate_set("no_such", 1)
+
+
+def test_type_and_range_checks():
+    with pytest.raises(ValueError, match="integer"):
+        validate_set("batch_rows", "big")
+    with pytest.raises(ValueError, match="integer"):
+        validate_set("batch_rows", True)
+    with pytest.raises(ValueError, match="power of two"):
+        validate_set("batch_rows", 1000)
+    with pytest.raises(ValueError, match="positive"):
+        validate_set("lifespans", 0)
+
+
+def test_effective_fills_defaults():
+    eff = effective({"lifespans": 8, "my_connector_knob": "x"})
+    assert eff["lifespans"] == 8
+    assert eff["batch_rows"] == SESSION_PROPERTIES["batch_rows"].default
+    assert eff["my_connector_knob"] == "x"
+
+
+def test_engine_round_trip():
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.runner.local import QueryError
+    r = LocalRunner("tpch", "tiny")
+    r.execute("set session max_groups = 1024")
+    assert r.session.properties["max_groups"] == 1024
+    with pytest.raises(QueryError, match="unknown session property"):
+        r.execute("set session nope = 1")
+    listing = "\n".join(
+        row[0] for row in r.execute("show session").rows())
+    for name in SESSION_PROPERTIES:
+        assert name in listing
