@@ -1,0 +1,181 @@
+"""System connector: engine state as queryable tables (reference: the
+system connector `connector/system/` — system.runtime.nodes/queries —
+and the jmx connector's introspection role).
+
+Schemas:
+  system.runtime.nodes    — node id, uri, state (single local node or
+                            the coordinator's worker membership)
+  system.runtime.queries  — the runner's query history (id, state,
+                            rows, elapsed)
+  system.metadata.catalogs — registered catalogs
+  system.metadata.tables   — every (catalog, schema, table)
+
+Tables materialize a host-side SNAPSHOT when the planner fetches the
+schema (string dictionaries are plan-time static), and the scan serves
+that same snapshot — a query observing the engine must not observe
+itself mid-flight."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+from presto_tpu.batch import Batch
+from presto_tpu.connectors.spi import (
+    Connector, ConnectorMetadata, ConnectorPageSource,
+    ConnectorSplitManager, Split, TableHandle, TupleDomain,
+)
+from presto_tpu.schema import ColumnSchema, RelationSchema
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+
+#: table -> (column, type) list; all VARCHAR dictionaries derive from
+#: the snapshot rows
+_TABLES: Dict[str, List] = {
+    "runtime.nodes": [("node_id", VARCHAR), ("http_uri", VARCHAR),
+                      ("state", VARCHAR)],
+    "runtime.queries": [("query_id", BIGINT), ("state", VARCHAR),
+                        ("query", VARCHAR), ("output_rows", BIGINT),
+                        ("elapsed_ms", DOUBLE)],
+    "metadata.catalogs": [("catalog_name", VARCHAR)],
+    "metadata.tables": [("table_catalog", VARCHAR),
+                        ("table_schema", VARCHAR),
+                        ("table_name", VARCHAR)],
+}
+
+
+class SystemConnector(Connector):
+    """`snapshot_fns` supplies each table's rows on demand; the runner
+    wires its own state in at registration."""
+
+    name = "system"
+
+    def __init__(self, snapshot_fns: Dict[str, Callable[[], List[tuple]]]):
+        self._fns = snapshot_fns
+        self._snapshots: Dict[str, List[tuple]] = {}
+        self._metadata = _SystemMetadata(self)
+        self._splits = _SystemSplitManager()
+        self._source = _SystemPageSource(self)
+
+    def _key(self, handle: TableHandle) -> str:
+        return f"{handle.schema}.{handle.table}"
+
+    def snapshot(self, handle: TableHandle,
+                 refresh: bool) -> List[tuple]:
+        key = self._key(handle)
+        if key not in _TABLES:
+            raise KeyError(handle.table)
+        if refresh or key not in self._snapshots:
+            self._snapshots[key] = list(self._fns[key]())
+        return self._snapshots[key]
+
+    @property
+    def metadata(self):
+        return self._metadata
+
+    @property
+    def split_manager(self):
+        return self._splits
+
+    @property
+    def page_source(self):
+        return self._source
+
+
+class _SystemMetadata(ConnectorMetadata):
+    def __init__(self, conn: SystemConnector):
+        self._conn = conn
+
+    def list_schemas(self) -> List[str]:
+        return sorted({k.split(".")[0] for k in _TABLES})
+
+    def list_tables(self, schema: str) -> List[str]:
+        return sorted(k.split(".")[1] for k in _TABLES
+                      if k.startswith(schema + "."))
+
+    def get_table_schema(self, handle: TableHandle) -> RelationSchema:
+        key = self._conn._key(handle)
+        if key not in _TABLES:
+            raise KeyError(handle.table)
+        # schema fetch = snapshot point: dictionaries are built from
+        # the rows this query will scan
+        rows = self._conn.snapshot(handle, refresh=True)
+        cols = []
+        for i, (name, typ) in enumerate(_TABLES[key]):
+            dic = None
+            if typ.is_string:
+                dic = tuple(sorted({r[i] for r in rows
+                                    if r[i] is not None}))
+            cols.append(ColumnSchema(name, typ, dic))
+        return RelationSchema.of(*cols)
+
+    def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
+        try:
+            return len(self._conn.snapshot(handle, refresh=False))
+        except KeyError:
+            return None
+
+
+class _SystemSplitManager(ConnectorSplitManager):
+    def get_splits(self, handle: TableHandle,
+                   target_splits: int) -> List[Split]:
+        return [Split(handle, None, partition=0)]
+
+
+class _SystemPageSource(ConnectorPageSource):
+    def __init__(self, conn: SystemConnector):
+        self._conn = conn
+
+    def batches(self, split: Split, columns: Sequence[str],
+                batch_rows: int,
+                constraint: Optional[TupleDomain] = None
+                ) -> Iterator[Batch]:
+        key = self._conn._key(split.table)
+        rows = self._conn.snapshot(split.table, refresh=False)
+        names = [n for n, _ in _TABLES[key]]
+        types = dict(_TABLES[key])
+        idx = {n: i for i, n in enumerate(names)}
+        data = {c: ([r[idx[c]] for r in rows], types[c])
+                for c in columns}
+        yield Batch.from_pydict(data)
+
+
+def runner_system_connector(runner) -> SystemConnector:
+    """The LocalRunner-backed instance: single local node, the
+    runner's query history, and its catalog manager."""
+
+    def nodes():
+        return [("local-0", "local://in-process", "active")]
+
+    def queries():
+        # ids are the runner's monotonic sequence, stable across the
+        # history cap trimming old entries
+        return [(q["id"], q["state"], q["sql"], q["rows"],
+                 q["elapsed_ms"])
+                for q in runner.query_history]
+
+    def catalogs():
+        return [(c,) for c in runner.catalogs.catalogs()]
+
+    def tables():
+        out = []
+        for cat in runner.catalogs.catalogs():
+            if cat == "system":
+                for key in _TABLES:
+                    s, t = key.split(".")
+                    out.append((cat, s, t))
+                continue
+            conn = runner.catalogs.connector(cat)
+            try:
+                for schema in conn.metadata.list_schemas():
+                    for t in conn.metadata.list_tables(schema):
+                        out.append((cat, schema, t))
+            except Exception:  # noqa: BLE001 — best-effort listing
+                continue
+        return out
+
+    return SystemConnector({
+        "runtime.nodes": nodes,
+        "runtime.queries": queries,
+        "metadata.catalogs": catalogs,
+        "metadata.tables": tables,
+    })

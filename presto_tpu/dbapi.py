@@ -171,30 +171,49 @@ def _decode(v, type_name: str):
 
 
 def _split_placeholders(sql: str) -> List[str]:
-    """Split on '?' placeholders OUTSIDE string literals ('?' inside
-    '...' is literal text; '' escapes a quote)."""
+    """Split on '?' placeholders OUTSIDE string literals ('' escapes),
+    double-quoted identifiers, -- line comments, and block comments —
+    the same lexical contexts the engine's lexer treats as opaque."""
     parts: List[str] = []
     buf: List[str] = []
-    in_string = False
     i = 0
-    while i < len(sql):
+    n = len(sql)
+    while i < n:
         ch = sql[i]
-        if in_string:
+        if ch in ("'", '"'):
+            quote = ch
             buf.append(ch)
-            if ch == "'":
-                if i + 1 < len(sql) and sql[i + 1] == "'":
-                    buf.append("'")
+            i += 1
+            while i < n:
+                buf.append(sql[i])
+                if sql[i] == quote:
+                    if quote == "'" and i + 1 < n \
+                            and sql[i + 1] == "'":
+                        buf.append("'")
+                        i += 2
+                        continue
                     i += 1
-                else:
-                    in_string = False
-        elif ch == "'":
-            in_string = True
-            buf.append(ch)
-        elif ch == "?":
+                    break
+                i += 1
+            continue
+        if ch == "-" and sql[i:i + 2] == "--":
+            end = sql.find("\n", i)
+            end = n if end == -1 else end
+            buf.append(sql[i:end])
+            i = end
+            continue
+        if ch == "/" and sql[i:i + 2] == "/*":
+            end = sql.find("*/", i)
+            end = n if end == -1 else end + 2
+            buf.append(sql[i:end])
+            i = end
+            continue
+        if ch == "?":
             parts.append("".join(buf))
             buf = []
-        else:
-            buf.append(ch)
+            i += 1
+            continue
+        buf.append(ch)
         i += 1
     parts.append("".join(buf))
     return parts

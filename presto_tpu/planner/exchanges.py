@@ -69,8 +69,9 @@ def add_exchanges(root: N.OutputNode, catalogs, session) -> N.OutputNode:
 class _Exchanger:
     def __init__(self, catalogs, session):
         self.catalogs = catalogs
-        self.threshold = int(session.properties.get(
-            "broadcast_join_threshold_rows", 100_000))
+        from presto_tpu.session_properties import get_property
+        self.threshold = int(get_property(
+            session.properties, "broadcast_join_threshold_rows"))
         self._memo: Dict[int, Tuple[N.PlanNode, Props]] = {}
         self._shared: set = set()
         from presto_tpu.planner.stats import StatsEstimator

@@ -33,6 +33,7 @@ from presto_tpu.operators.sort_ops import (
 from presto_tpu.ops import hashagg
 from presto_tpu.planner import nodes as N
 from presto_tpu.schema import ColumnSchema
+from presto_tpu.session_properties import get_property
 from presto_tpu.types import DOUBLE, Type
 from presto_tpu.expr.ir import SpecialForm
 
@@ -166,10 +167,10 @@ class LocalExecutionPlanner:
         symbols = list(node.assignments.keys())
         columns = [node.assignments[s] for s in symbols]
         rename = dict(zip(columns, symbols))
-        batch_rows = int(self.session.properties.get(
-            "batch_rows", DEFAULT_BATCH_ROWS))
-        target_splits = int(self.session.properties.get(
-            "target_splits", 4))
+        batch_rows = int(get_property(self.session.properties,
+                                      "batch_rows"))
+        target_splits = int(get_property(self.session.properties,
+                                         "target_splits"))
         handle = node.handle
         task = self.task
         constraint = node.constraint
@@ -267,7 +268,8 @@ class LocalExecutionPlanner:
                 arg_ce = compile_expression(arg, schema)
             fn = self._make_agg(a, arg_ce)
             specs.append(AggSpec(a.out_symbol, fn, arg_ce))
-        max_groups = int(self.session.properties.get("max_groups", 4096))
+        max_groups = int(get_property(self.session.properties,
+                                      "max_groups"))
         pipe.append(AggregationOperatorFactory(
             self._next_id(), key_names, key_exprs, specs, node.step,
             max_groups, input_dicts=_schema_dicts(schema)))

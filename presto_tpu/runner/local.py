@@ -119,6 +119,10 @@ class LocalRunner:
         self.catalogs.register("memory", MemoryConnector())
         self.catalogs.register("blackhole", BlackholeConnector())
         self.catalogs.register("file", FileConnector())
+        # engine state as tables (system.runtime / system.metadata)
+        from presto_tpu.connectors.system import runner_system_connector
+        self.query_history: List[Dict[str, Any]] = []
+        self.catalogs.register("system", runner_system_connector(self))
         self.session = Session(catalog, schema, dict(properties or {}))
 
     def register_connector(self, name: str, connector: Connector):
@@ -144,13 +148,30 @@ class LocalRunner:
         if not isinstance(stmt, T.Query):
             raise QueryError(
                 f"unsupported statement {type(stmt).__name__}")
+        import time as _time
+        self._query_seq = getattr(self, "_query_seq", -1) + 1
+        entry = {"id": self._query_seq, "sql": sql.strip(),
+                 "state": "RUNNING", "rows": 0, "elapsed_ms": 0.0}
+        self.query_history.append(entry)
+        del self.query_history[:-1000]  # bounded history
+        t0 = _time.perf_counter()
         try:
-            plan = plan_statement(stmt, self.catalogs, self.session)
-        except AnalysisError as e:
-            raise QueryError(str(e)) from e
-        from presto_tpu.planner.optimizer import optimize
-        plan = optimize(plan, self.catalogs)
-        return self._run_plan(plan)
+            try:
+                plan = plan_statement(stmt, self.catalogs, self.session)
+            except AnalysisError as e:
+                raise QueryError(str(e)) from e
+            from presto_tpu.planner.optimizer import optimize
+            plan = optimize(plan, self.catalogs)
+            result = self._run_plan(plan)
+            entry["state"] = "FINISHED"
+            entry["rows"] = result.row_count
+            return result
+        except Exception:
+            entry["state"] = "FAILED"
+            raise
+        finally:
+            entry["elapsed_ms"] = round(
+                (_time.perf_counter() - t0) * 1000, 3)
 
     def create_plan(self, sql: str) -> N.OutputNode:
         stmt = parse_statement(sql)
@@ -168,7 +189,9 @@ class LocalRunner:
             planner = LocalExecutionPlanner(self.catalogs, session)
             lplan = planner.plan(plan)
             t0 = _time.perf_counter()
-            budget = session.properties.get("hbm_budget_bytes")
+            from presto_tpu.session_properties import get_property
+            budget = get_property(session.properties,
+                                  "hbm_budget_bytes")
             pool = MemoryPool(int(budget) if budget else None)
             from presto_tpu.execution.memory import MemoryLimitExceeded
             try:

@@ -27,6 +27,7 @@ from presto_tpu.planner.exchanges import (
 from presto_tpu.planner.local_planner import (
     LocalExecutionPlanner, TaskContext, prune_unused_columns,
 )
+from presto_tpu.session_properties import get_property
 from presto_tpu.runner.local import (
     LocalRunner, MaterializedResult, QueryError,
 )
@@ -79,7 +80,7 @@ class MeshRunner(LocalRunner):
                 # retries (ids restart per planner deterministically);
                 # the @instance suffix is not
                 oom_op = e.tag.split("@")[0]
-                cur = int(session.properties.get("lifespans", 1))
+                cur = int(get_property(session.properties, "lifespans"))
                 if prev_oom is not None:
                     p_op, p_g, p_req = prev_oom
                     if p_op == oom_op and cur > p_g \
@@ -136,9 +137,9 @@ class MeshRunner(LocalRunner):
         from presto_tpu.operators.base import DriverContext
         from presto_tpu.operators.driver import Driver
 
-        budget = session.properties.get("hbm_budget_bytes")
+        budget = get_property(session.properties, "hbm_budget_bytes")
         pool = MemoryPool(int(budget) if budget else None)
-        G = int(session.properties.get("lifespans", 1))
+        G = int(get_property(session.properties, "lifespans"))
         lifespans_of = {
             fid: (G if G > 1
                   and self._grouped_eligible(fplan, frag) else 1)
@@ -157,9 +158,8 @@ class MeshRunner(LocalRunner):
                 lifespans=lifespans_of[edge.consumer],
                 producer_finishes=lifespans_of[edge.producer],
                 pool=pool,
-                host_spool_bytes=int(session.properties.get(
-                    "host_spool_bytes",
-                    exchange_ops.DEFAULT_HOST_SPOOL_BYTES)))
+                host_spool_bytes=int(get_property(
+                    session.properties, "host_spool_bytes")))
 
         dctx = DriverContext(profile=profile, memory=pool)
         result = None

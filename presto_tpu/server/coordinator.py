@@ -124,7 +124,9 @@ class Coordinator(Node):
         anywhere, so no state needs recovering (reference:
         SqlQueryScheduler section retry :667-690 + P7/P8 relocatable
         splits; a whole-query retry is the single-section case)."""
-        retries = int(self.properties.get("query_retries", 1))
+        from presto_tpu.session_properties import get_property
+        retries = int(get_property(self.properties,
+                                   "query_retries"))
         workers = list(self.worker_urls)
         attempt = 0
         while True:

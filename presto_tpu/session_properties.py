@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, Optional
 
+from presto_tpu.batch import DEFAULT_BATCH_ROWS
+
 
 @dataclasses.dataclass(frozen=True)
 class PropertyDef:
@@ -39,7 +41,7 @@ def _power_of_two(v) -> Optional[str]:
 
 SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
     PropertyDef(
-        "batch_rows", "bigint", 65536,
+        "batch_rows", "bigint", DEFAULT_BATCH_ROWS,
         "Rows per scan batch (power of two; larger batches amortize "
         "dispatch, smaller ones bound HBM)", _power_of_two),
     PropertyDef(
@@ -80,8 +82,9 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
 
 def validate_set(name: str, value: Any) -> Any:
     """SET SESSION gate: known name, coercible type, valid value.
-    Dotted names (catalog.key) are connector-private and pass through
-    unvalidated (reference: per-connector session properties)."""
+    NULL resets to the property's default; dotted names (catalog.key)
+    are connector-private and pass through unvalidated (reference:
+    per-connector session properties)."""
     if "." in name:
         return value
     p = SESSION_PROPERTIES.get(name)
@@ -89,16 +92,26 @@ def validate_set(name: str, value: Any) -> Any:
         known = ", ".join(sorted(SESSION_PROPERTIES))
         raise ValueError(
             f"unknown session property {name!r} (known: {known})")
+    if value is None:
+        return p.default
     if p.type_name == "bigint":
         if isinstance(value, bool) or not isinstance(value, int):
             raise ValueError(f"{name} expects an integer")
     elif p.type_name == "boolean" and not isinstance(value, bool):
         raise ValueError(f"{name} expects a boolean")
-    if p.validate is not None and value is not None:
+    if p.validate is not None:
         err = p.validate(value)
         if err:
             raise ValueError(f"{name}: {err}")
     return value
+
+
+def get_property(properties: Dict[str, Any], name: str) -> Any:
+    """The ONE effective-value accessor: session override or the
+    registry default — every engine consumer reads through here so
+    SHOW SESSION can never diverge from behavior."""
+    p = SESSION_PROPERTIES[name]
+    return properties.get(name, p.default)
 
 
 def effective(properties: Dict[str, Any]) -> Dict[str, Any]:
