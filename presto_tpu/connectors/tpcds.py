@@ -220,6 +220,24 @@ _M1 = np.uint64(0xbf58476d1ce4e5b9)
 _M2 = np.uint64(0x94d049bb133111eb)
 
 
+def _native_datagen():
+    """The C++ hash kernel, or None (pure-numpy fallback — both paths
+    are bit-identical; tests assert it)."""
+    from presto_tpu.native import load_datagen
+    return load_datagen()
+
+
+_U64P = None  # ctypes.POINTER(c_uint64), bound once on first use
+
+
+def _u64p():
+    global _U64P
+    if _U64P is None:
+        import ctypes
+        _U64P = ctypes.POINTER(ctypes.c_uint64)
+    return _U64P
+
+
 def _mix64(x: np.ndarray) -> np.ndarray:
     """splitmix64 finalizer — the per-(table, column, row) counter hash
     everything is generated from."""
@@ -751,6 +769,15 @@ class TpcdsGenerator:
     def _h(self, tag: str, idx: np.ndarray) -> np.ndarray:
         salt = np.uint64(self.seed * 0x9e3779b9
                          + zlib.crc32(tag.encode()))
+        lib = _native_datagen()
+        if lib is not None and len(idx):
+            u64p = _u64p()
+            src = np.ascontiguousarray(idx, np.uint64)
+            out = np.empty(len(src), np.uint64)
+            lib.pt_gen_hash_idx(
+                src.ctypes.data_as(u64p), len(src), int(salt),
+                out.ctypes.data_as(u64p))
+            return out
         with np.errstate(over="ignore"):
             return _mix64(idx.astype(np.uint64)
                           + salt * np.uint64(0x632be59bd9b4e019))

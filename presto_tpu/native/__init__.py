@@ -21,6 +21,8 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
+_datagen: Optional[ctypes.CDLL] = None
+_datagen_tried = False
 
 
 def _build(source: str, tag: str) -> Optional[str]:
@@ -57,6 +59,28 @@ def _build(source: str, tag: str) -> Optional[str]:
                 os.unlink(tmp)
             except OSError:
                 pass
+
+
+def load_datagen() -> Optional[ctypes.CDLL]:
+    """The data-generation kernel library, or None (fallback: numpy)."""
+    global _datagen, _datagen_tried
+    if _datagen_tried:
+        return _datagen
+    _datagen_tried = True
+    path = _build(os.path.join(_HERE, "datagen.cpp"), "datagen")
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    u64 = ctypes.c_uint64
+    i64 = ctypes.c_int64
+    u64p = ctypes.POINTER(u64)
+    lib.pt_gen_hash_idx.restype = None
+    lib.pt_gen_hash_idx.argtypes = [u64p, i64, u64, u64p]
+    _datagen = lib
+    return _datagen
 
 
 def load_pageserde() -> Optional[ctypes.CDLL]:

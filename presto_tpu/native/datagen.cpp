@@ -1,0 +1,40 @@
+// Native data-generation kernel for the TPC-DS connector (reference
+// role: the dsdgen C tool behind presto-tpcds; our generator is
+// counter-hash-based and this is its hot inner loop in C++).
+//
+// Bit-identical to the numpy path in connectors/tpcds.py: splitmix64
+// finalizer over (row index + salt * GOLDEN), fused into one pass
+// instead of numpy's temporary-array pipeline. Every generated column
+// routes through pt_gen_hash_idx.
+//
+// C ABI (ctypes):
+//   pt_gen_hash_idx(idx_u64, n, salt, out_u64)
+
+#include <cstdint>
+
+namespace {
+
+const uint64_t GOLDEN = 0x632be59bd9b4e019ull;
+
+inline uint64_t mix64(uint64_t x) {
+    x ^= x >> 30;
+    x *= 0xbf58476d1ce4e5b9ull;
+    x ^= x >> 27;
+    x *= 0x94d049bb133111ebull;
+    x ^= x >> 31;
+    return x;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_gen_hash_idx(const uint64_t* idx, int64_t n, uint64_t salt,
+                     uint64_t* out) {
+    uint64_t base = salt * GOLDEN;
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = mix64(idx[i] + base);
+    }
+}
+
+}  // extern "C"

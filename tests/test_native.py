@@ -75,6 +75,30 @@ def test_malformed_native_block_rejected():
             codec.decode(frame)
 
 
+def test_datagen_kernel_builds():
+    from presto_tpu.native import load_datagen
+    assert load_datagen() is not None
+
+
+def test_datagen_bit_identical_to_numpy(monkeypatch):
+    """The C++ hash kernel must reproduce the numpy pipeline exactly —
+    TPC-DS data is defined by these bits (relocatable splits, oracle
+    comparisons)."""
+    import numpy as np
+    import presto_tpu.connectors.tpcds as tp
+    import presto_tpu.native as native_mod
+    g = tp.TpcdsGenerator(1.0)
+    idx = np.arange(10_000, dtype=np.uint64)
+    scattered = g._h("seed", idx) % np.uint64(10_000)  # arbitrary idx
+    for probe in (idx, scattered):
+        native = g._h("store_sales.x", probe)
+        monkeypatch.setattr(native_mod, "_datagen", None)
+        monkeypatch.setattr(native_mod, "_datagen_tried", True)
+        fallback = g._h("store_sales.x", probe)
+        monkeypatch.undo()
+        assert (native == fallback).all()
+
+
 def test_zlib_fallback_roundtrip(monkeypatch):
     import presto_tpu.native as native_mod
     monkeypatch.setattr(native_mod, "_lib", None)
