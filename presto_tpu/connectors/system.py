@@ -148,10 +148,22 @@ def runner_system_connector(runner) -> SystemConnector:
 
     def queries():
         # ids are the runner's monotonic sequence, stable across the
-        # history cap trimming old entries
-        return [(q["id"], q["state"], q["sql"], q["rows"],
-                 q["elapsed_ms"])
-                for q in runner.query_history]
+        # history cap trimming old entries; row counts resolve lazily
+        # from the (weakly held) result — -1 once it is gone
+        out = []
+        for q in runner.query_history:
+            rows = q["rows"]
+            if rows is None:
+                ref = q.get("_result")
+                res = ref() if ref is not None else None
+                if res is not None:
+                    rows = q["rows"] = res.row_count
+                    q.pop("_result", None)
+                else:
+                    rows = -1
+            out.append((q["id"], q["state"], q["sql"], rows,
+                        q["elapsed_ms"]))
+        return out
 
     def catalogs():
         return [(c,) for c in runner.catalogs.catalogs()]

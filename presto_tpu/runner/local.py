@@ -164,7 +164,12 @@ class LocalRunner:
             plan = optimize(plan, self.catalogs)
             result = self._run_plan(plan)
             entry["state"] = "FINISHED"
-            entry["rows"] = result.row_count
+            # row count resolves lazily when system.runtime.queries is
+            # read — counting here would put device syncs on the timed
+            # hot path of every query
+            import weakref
+            entry["rows"] = None
+            entry["_result"] = weakref.ref(result)
             return result
         except Exception:
             entry["state"] = "FAILED"

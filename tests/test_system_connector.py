@@ -28,17 +28,22 @@ def test_tables_listing(runner):
 
 
 def test_query_history(runner):
-    runner.execute("select count(*) from nation")
+    held = runner.execute("select count(*) from nation")
+    runner.execute("select count(*) from region")  # result discarded
     with pytest.raises(Exception):
         runner.execute("select * from nope")
     rows = runner.execute(
         "select query_id, state, output_rows, query "
         "from system.runtime.queries order by query_id").rows()
+    # row counts resolve lazily from weakly-held results: alive -> the
+    # count (no sync on the producing query's timed path), gone -> -1
     assert rows[0][1] == "FINISHED" and rows[0][2] == 1
-    assert rows[1][1] == "FAILED"
+    assert rows[1][1] == "FINISHED" and rows[1][2] == -1
+    assert rows[2][1] == "FAILED"
     # the observing query sees itself mid-flight
     assert rows[-1][1] == "RUNNING"
     assert "system.runtime.queries" in rows[-1][3]
+    del held
 
 
 def test_nodes(runner):
