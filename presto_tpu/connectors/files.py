@@ -232,6 +232,27 @@ def _cap(n: int) -> int:
     return bucket_capacity(max(n, 1))
 
 
+def _read_full(path: str, g: pq.RowGroupInfo,
+               col: pq.ParquetColumn):
+    """One row group's column as FULL-length host values + mask (the
+    parquet reader returns present values compacted): strings as
+    list[bytes] with b'' at nulls, numerics as zero-filled arrays —
+    exactly the layouts pq.write_table stages."""
+    typ = _engine_type(col)
+    vals, present = pq.read_column(path, g, col.name)
+    n = g.num_rows
+    mask = np.ones(n, bool) if present is None else present
+    if typ.is_string:
+        full: list = [b""] * n
+        it = iter(vals)
+        for i in np.flatnonzero(mask):
+            full[i] = next(it)
+        return full, mask
+    out = np.zeros(n, typ.np_dtype)
+    out[mask] = np.asarray(vals).astype(typ.np_dtype)
+    return out, mask
+
+
 class _FilePageSink(ConnectorPageSink):
     """Buffers appended batches host-side; finish() writes one Parquet
     file (the TableFinishOperator commit point — the file appears
@@ -241,6 +262,8 @@ class _FilePageSink(ConnectorPageSink):
         self._cat = cat
         self._pending: Dict[Tuple[str, str],
                             Tuple[RelationSchema, List[Batch]]] = {}
+        # INSERT rewrites: existing rows staged host-side per table
+        self._base: Dict[Tuple[str, str], Tuple[Dict, Dict]] = {}
 
     def create_table(self, handle: TableHandle,
                      schema: RelationSchema) -> None:
@@ -256,19 +279,41 @@ class _FilePageSink(ConnectorPageSink):
     def append(self, handle: TableHandle, batch: Batch) -> None:
         key = (handle.schema, handle.table)
         if key not in self._pending:
-            raise KeyError(f"table {handle} not open for writes")
+            # INSERT into an existing table: files are immutable, so
+            # the commit REWRITES the file with old + new rows (the
+            # reference's transactional write-then-swap, collapsed).
+            # Existing rows stage HOST-side straight from the parquet
+            # pages — copying untouched rows must not round-trip the
+            # device or re-encode strings through dictionaries
+            schema = _FileMetadata(self._cat).get_table_schema(handle)
+            info, _ = self._cat.info(handle)
+            path = self._cat.path(handle)
+            base: Dict[str, list] = {c.name: [] for c in info.columns}
+            base_masks: Dict[str, list] = {c.name: []
+                                           for c in info.columns}
+            for g in info.row_groups:
+                for col in info.columns:
+                    full, mask = _read_full(path, g, col)
+                    base[col.name].append(full)
+                    base_masks[col.name].append(mask)
+            self._pending[key] = (schema, [])
+            self._base[key] = (base, base_masks)
         self._pending[key][1].append(batch)
 
     def finish(self, handle: TableHandle) -> None:
         import jax
         key = (handle.schema, handle.table)
         schema, batches = self._pending.pop(key)
+        base, base_masks = self._base.pop(key, ({}, {}))
         cols: List[pq.ParquetColumn] = []
         for c in schema.columns:
             ptype, conv = _TYPE_TO_PQ[c.type.name]
             cols.append(pq.ParquetColumn(c.name, ptype, conv))
-        data: Dict[str, list] = {c.name: [] for c in schema.columns}
-        masks: Dict[str, list] = {c.name: [] for c in schema.columns}
+        data: Dict[str, list] = {c.name: list(base.get(c.name, ()))
+                                 for c in schema.columns}
+        masks: Dict[str, list] = {
+            c.name: list(base_masks.get(c.name, ()))
+            for c in schema.columns}
         total = 0
         for b in batches:
             host = jax.device_get(b)
@@ -302,12 +347,20 @@ class _FilePageSink(ConnectorPageSink):
         pq.write_table(tmp, cols, flat_data, flat_masks,
                        row_group_rows=1 << 20)
         os.replace(tmp, path)
+        # commit point: evict cached footers/dictionaries/indexes for
+        # the replaced file — mtime alone can miss a same-tick rewrite
+        # on coarse-granularity filesystems
+        self._cat._cache.pop(path, None)
+        self._cat._indexes.pop(path, None)
 
     def drop_table(self, handle: TableHandle) -> None:
+        path = self._cat.path(handle)
         try:
-            os.unlink(self._cat.path(handle))
+            os.unlink(path)
         except FileNotFoundError:
             raise KeyError(f"table {handle} does not exist") from None
+        self._cat._cache.pop(path, None)
+        self._cat._indexes.pop(path, None)
 
 
 class FileConnector(Connector):

@@ -156,11 +156,11 @@ def runner_system_connector(runner) -> SystemConnector:
             if rows is None:
                 ref = q.get("_result")
                 res = ref() if ref is not None else None
-                if res is not None:
-                    rows = q["rows"] = res.row_count
-                    q.pop("_result", None)
-                else:
-                    rows = -1
+                # either way the answer is now final: cache it and
+                # drop the ref so later snapshots do no work
+                rows = q["rows"] = res.row_count \
+                    if res is not None else -1
+                q.pop("_result", None)
             out.append((q["id"], q["state"], q["sql"], rows,
                         q["elapsed_ms"]))
         return out

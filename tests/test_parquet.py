@@ -135,6 +135,22 @@ def test_show_and_drop(runner):
             "show tables from file.default").rows()]
 
 
+def test_insert_into_existing(runner):
+    """INSERT INTO an existing parquet table rewrites the file with
+    old + new rows (immutable files, transactional swap)."""
+    runner.execute("create table file.default.nat2 as "
+                   "select nationkey, name from nation "
+                   "where nationkey < 3")
+    runner.execute("insert into file.default.nat2 "
+                   "select nationkey, name from nation "
+                   "where nationkey >= 23")
+    rows = runner.execute("select nationkey, name from "
+                          "file.default.nat2 order by nationkey").rows()
+    assert [r[0] for r in rows] == [0, 1, 2, 23, 24]
+    assert rows[-1][1] == "UNITED STATES"
+    runner.execute("drop table file.default.nat2")
+
+
 def test_row_group_pruning(runner, tmp_path):
     """A pushed-down range predicate skips row groups whose min/max
     can't match — verified by counting rows actually materialized."""
