@@ -74,6 +74,12 @@ class _FileCatalog:
         self._indexes: Dict[str, Tuple[float,
                                        Dict[str, Dict[str, int]]]] = {}
 
+    def evict(self, path: str) -> None:
+        """Commit-point invalidation for a rewritten/removed file —
+        mtime alone can miss a same-tick rewrite."""
+        self._cache.pop(path, None)
+        self._indexes.pop(path, None)
+
     def index(self, path: str, col: str,
               dic: tuple) -> Dict[str, int]:
         cached = self._cache.get(path)
@@ -347,11 +353,7 @@ class _FilePageSink(ConnectorPageSink):
         pq.write_table(tmp, cols, flat_data, flat_masks,
                        row_group_rows=1 << 20)
         os.replace(tmp, path)
-        # commit point: evict cached footers/dictionaries/indexes for
-        # the replaced file — mtime alone can miss a same-tick rewrite
-        # on coarse-granularity filesystems
-        self._cat._cache.pop(path, None)
-        self._cat._indexes.pop(path, None)
+        self._cat.evict(path)
 
     def drop_table(self, handle: TableHandle) -> None:
         path = self._cat.path(handle)
@@ -359,8 +361,7 @@ class _FilePageSink(ConnectorPageSink):
             os.unlink(path)
         except FileNotFoundError:
             raise KeyError(f"table {handle} does not exist") from None
-        self._cat._cache.pop(path, None)
-        self._cat._indexes.pop(path, None)
+        self._cat.evict(path)
 
 
 class FileConnector(Connector):

@@ -79,7 +79,8 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
     (same rule as the filter/project cache)."""
     aggs = tuple(s.function for s in specs)
     exprs = list(key_exprs) + [s.input for s in specs
-                               if s.input is not None]
+                               if s.input is not None] \
+        + [s.mask for s in specs if s.mask is not None]
     key = None
     if all(e.ir is not None for e in exprs):
         try:
@@ -87,6 +88,7 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                    tuple((ke.ir, ke.dictionary) for ke in key_exprs),
                    tuple((s.out_name if mode == "final" else None,
                           s.input.ir if s.input is not None else None,
+                          s.mask.ir if s.mask is not None else None,
                           s.function) for s in specs))
             cached = _AGG_STEP_CACHE.get(key)
             if cached is not None:
@@ -113,16 +115,21 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                 agg_inputs.append(parts)
                 agg_weights.append(batch.row_valid)
                 merge.append(True)
-            elif s.input is None:
+                continue
+            if s.input is None:
                 agg_inputs.append(None)
-                agg_weights.append(batch.row_valid)
-                merge.append(False)
+                w = batch.row_valid
             else:
                 d, m = s.input.fn(env)
                 agg_inputs.append(jnp.broadcast_to(d, (cap,)))
-                agg_weights.append(batch.row_valid
-                                   & jnp.broadcast_to(m, (cap,)))
-                merge.append(False)
+                w = batch.row_valid & jnp.broadcast_to(m, (cap,))
+            if s.mask is not None:
+                # FILTER (WHERE ...): NULL counts as excluded; groups
+                # still form from row_valid — only contributions gate
+                fd, fm = s.mask.fn(env)
+                w = w & jnp.broadcast_to(fd & fm, (cap,))
+            agg_weights.append(w)
+            merge.append(False)
         if domains is not None:
             return hashagg.direct_step(
                 state, batch.row_valid, key_cols, domains, agg_inputs,

@@ -779,6 +779,9 @@ def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
             if c.distinct:
                 raise AnalysisError(
                     f"DISTINCT is not supported in window {name}")
+            if c.filter is not None:
+                raise AnalysisError(
+                    "FILTER is not supported on window functions")
             if name not in WINDOW_FUNCTIONS and \
                     name not in AGG_FUNCTIONS:
                 raise AnalysisError(f"unknown window function {name}")
@@ -1006,6 +1009,9 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
             # meaningful percentile
             raise AnalysisError(
                 "approx_percentile does not support DISTINCT")
+        if any(c.filter is not None for c in distinct_calls):
+            raise AnalysisError(
+                "FILTER with DISTINCT aggregates is not supported")
         argkeys = {_ast_key(c.args[0]) for c in distinct_calls}
         if any(not c.distinct for c in calls) or len(argkeys) != 1:
             rp_md, rw_md = _plan_mixed_distinct(keys, calls, rp, ctx, an)
@@ -1041,8 +1047,13 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
         key = _ast_key(c)
         if key in rewrites:
             continue
+        filt = None
         if c.filter is not None:
-            raise AnalysisError("FILTER (WHERE ...) not yet supported")
+            if c.distinct:
+                raise AnalysisError(
+                    "FILTER with DISTINCT aggregates is not supported")
+            filt = _coerce_to(fold_constants(an.analyze(c.filter)),
+                              BOOLEAN)
         params: tuple = ()
         if c.distinct:
             arg, arg_t, dic = InputRef(dsym, d_t), d_t, d_dic
@@ -1054,7 +1065,7 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
         out_t = _agg_output_type(c.name, arg_t)
         sym = ctx.symbols.new(c.name)
         agg_nodes.append(N.AggCall(sym, c.name, arg, False, out_t,
-                                   params=params))
+                                   params=params, filter=filt))
         out_dic = dic if c.name in ("min", "max", "arbitrary",
                                     "any_value") else None
         rewrites[key] = (sym, out_t, out_dic)
@@ -1198,8 +1209,10 @@ def _plan_mixed_distinct(keys, calls, rp: RelationPlan,
     for c in calls:
         if c.distinct or _ast_key(c) in rewrites:
             continue
+        filt = None
         if c.filter is not None:
-            raise AnalysisError("FILTER (WHERE ...) not yet supported")
+            filt = _coerce_to(fold_constants(an.analyze(c.filter)),
+                              BOOLEAN)
         params: tuple = ()
         if c.is_star or not c.args:
             arg, arg_t, dic = None, None, None
@@ -1209,7 +1222,7 @@ def _plan_mixed_distinct(keys, calls, rp: RelationPlan,
         out_t = _agg_output_type(c.name, arg_t)
         sym = ctx.symbols.new(c.name)
         plain_aggs.append(N.AggCall(sym, c.name, arg, False, out_t,
-                                    params=params))
+                                    params=params, filter=filt))
         out_dic = dic if c.name in ("min", "max", "arbitrary",
                                     "any_value") else None
         agg_fields.append(N.Field(sym, out_t, out_dic))
@@ -2011,9 +2024,12 @@ def _try_scalar_decorrelation(q: T.Query, rp: RelationPlan,
             continue
         arg = fold_constants(an2.analyze(c.args[0])) \
             if (c.args and not c.is_star) else None
+        filt = _coerce_to(fold_constants(an2.analyze(c.filter)),
+                          BOOLEAN) if c.filter is not None else None
         out_t = _agg_output_type(c.name, arg.type if arg else None)
         sym = ctx.symbols.new(c.name)
-        agg_nodes.append(N.AggCall(sym, c.name, arg, False, out_t))
+        agg_nodes.append(N.AggCall(sym, c.name, arg, False, out_t,
+                                   filter=filt))
         rewrites[key] = (sym, out_t, None)
     inner_keys = [p[1] for p in corr]
     key_exprs = []

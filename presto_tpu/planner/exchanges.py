@@ -293,9 +293,11 @@ class _Exchanger:
         state_fields: List[N.Field] = []
         for a in node.aggregates:
             eff_in = self._effective_input_type(a)
+            # the FILTER gates contributions at the PARTIAL step; the
+            # FINAL step merges already-filtered states
             partial_calls.append(N.AggCall(
                 a.out_symbol, a.function, a.argument, False,
-                a.output_type, eff_in))
+                a.output_type, eff_in, filter=a.filter))
             final_calls.append(N.AggCall(
                 a.out_symbol, a.function, None, False,
                 a.output_type, eff_in))

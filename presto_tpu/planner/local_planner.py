@@ -266,8 +266,10 @@ class LocalExecutionPlanner:
                         and arg.type.is_decimal:
                     arg = SpecialForm("cast", (arg,), DOUBLE)
                 arg_ce = compile_expression(arg, schema)
+            mask_ce = compile_expression(a.filter, schema) \
+                if a.filter is not None else None
             fn = self._make_agg(a, arg_ce)
-            specs.append(AggSpec(a.out_symbol, fn, arg_ce))
+            specs.append(AggSpec(a.out_symbol, fn, arg_ce, mask_ce))
         max_groups = int(get_property(self.session.properties,
                                       "max_groups"))
         pipe.append(AggregationOperatorFactory(
@@ -612,8 +614,11 @@ def _child_demand(node: N.PlanNode, demand: set
         for _, e in node.keys:
             _refs(e, child)
         for a in node.aggregates:
-            if a.out_symbol in demand and a.argument is not None:
-                _refs(a.argument, child)
+            if a.out_symbol in demand:
+                if a.argument is not None:
+                    _refs(a.argument, child)
+                if a.filter is not None:
+                    _refs(a.filter, child)
         return [(node.source, child)]
     if isinstance(node, N.JoinNode):
         extra: set = set()

@@ -87,6 +87,9 @@ class AggCall:
     input_type: Optional[Type] = None
     # static call parameters (e.g. approx_percentile's fraction)
     params: Tuple = ()
+    # FILTER (WHERE ...) predicate gating contributions; applied at
+    # the PARTIAL step only under a distributed split
+    filter: Optional[RowExpression] = None
 
 
 @dataclasses.dataclass
