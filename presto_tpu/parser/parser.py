@@ -111,6 +111,9 @@ class _Parser:
             name = ".".join(self.qualified_name())
             self.expect_op("=")
             return T.SetSession(name, self.expr())
+        if self.accept_kw("reset"):
+            self.expect_kw("session")
+            return T.ResetSession(".".join(self.qualified_name()))
         if self.accept_kw("create"):
             self.expect_kw("table")
             if_not = False

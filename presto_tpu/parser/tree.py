@@ -310,6 +310,11 @@ class SetSession(Node):
 
 
 @dataclasses.dataclass
+class ResetSession(Node):
+    name: str
+
+
+@dataclasses.dataclass
 class CreateTableAs(Node):
     name: Tuple[str, ...]
     query: Query

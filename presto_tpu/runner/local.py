@@ -139,6 +139,17 @@ class LocalRunner:
             return self._show(stmt)
         if isinstance(stmt, T.SetSession):
             return self._set_session(stmt)
+        if isinstance(stmt, T.ResetSession):
+            # back to the registry default (reference: RESET SESSION);
+            # unknown names reject like SET would — a typo must not
+            # silently leave the real override in place
+            from presto_tpu.session_properties import SESSION_PROPERTIES
+            if "." not in stmt.name \
+                    and stmt.name not in SESSION_PROPERTIES:
+                raise QueryError(
+                    f"unknown session property {stmt.name!r}")
+            self.session.properties.pop(stmt.name, None)
+            return self._text_result("result", ["RESET SESSION"])
         if isinstance(stmt, T.CreateTableAs):
             return self._create_table_as(stmt)
         if isinstance(stmt, T.InsertInto):

@@ -37,6 +37,18 @@ def test_effective_fills_defaults():
     assert eff["my_connector_knob"] == "x"
 
 
+def test_reset_session():
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    r.execute("set session lifespans = 8")
+    assert r.session.properties["lifespans"] == 8
+    r.execute("reset session lifespans")
+    assert "lifespans" not in r.session.properties
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises(QueryError, match="unknown session property"):
+        r.execute("reset session lifespan")  # typo must not no-op
+
+
 def test_engine_round_trip():
     from presto_tpu.runner import LocalRunner
     from presto_tpu.runner.local import QueryError
