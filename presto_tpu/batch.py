@@ -36,6 +36,20 @@ MIN_CAPACITY = 16
 DEFAULT_BATCH_ROWS = 64 * 1024
 
 
+def quantized_capacity(n: int) -> int:
+    """Power-of-FOUR capacity ladder with a 4096 floor.
+
+    Exchange waves and their outputs land on this ladder instead of
+    the exact power-of-two bucket: every distinct capacity is a fresh
+    XLA compile of the shard_map collective (and of each downstream
+    kernel it feeds) at ~2s apiece, so a handful of coarse steps beats
+    exact sizing — at a bounded <=4x padding cost."""
+    cap = 4096
+    while cap < n:
+        cap *= 4
+    return cap
+
+
 def bucket_capacity(n: int) -> int:
     """Round up to a power of two (>= MIN_CAPACITY) to bound recompiles."""
     cap = MIN_CAPACITY

@@ -1,0 +1,89 @@
+"""Dynamic filtering (reference: operator/DynamicFilterSourceOperator
++ the dynamic-filter planner rules under sql/planner/iterative/rule/
+and DynamicFilterService).
+
+TPU-native shape: the join BUILD operator keeps running per-key
+min/max as DEVICE scalars (two tiny fused reductions per batch, no
+host sync) and publishes them to a per-plan registry at build finish.
+Probe-side TABLE SCANS in the same fragment consult the registry per
+batch and narrow `row_valid` with one fused compare — rows outside the
+build side's key range never reach the exchange/probe at all. Because
+a probe operator blocks on its bridge, the driver never pulls the
+probe-side scan before the build finishes, so the bounds are always
+ready by the time scan batches flow (no wait protocol needed).
+
+Scope mirrors where this is sound and local: INNER equi-joins whose
+probe key traces through filters/identity projections to a scan column
+in the SAME fragment — in mesh plans that is exactly the broadcast
+(star-schema) join, the reference's headline dynamic-filter case.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.batch import Batch
+
+
+class DynamicFilterRegistry:
+    """Per-plan handoff: df_id -> (min, max) device scalars."""
+
+    def __init__(self):
+        self._bounds: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        self._seq = 0
+
+    def new_id(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def publish(self, df_id: int, mn, mx) -> None:
+        self._bounds[df_id] = (mn, mx)
+
+    def get(self, df_id: int):
+        return self._bounds.get(df_id)
+
+
+def _ident(dtype):
+    info = jnp.iinfo(dtype) if jnp.issubdtype(dtype, jnp.integer) \
+        else jnp.finfo(dtype)
+    return info
+
+
+@jax.jit
+def bounds_step(state, data, mask):
+    """Fold one batch's column into running (min, max) IN THE KEY'S OWN
+    DTYPE — no float widening, so int64 key domains stay exact.
+    NULL/dead rows contribute identity; NaN keys are masked out (they
+    can never satisfy an equi-join here, and one NaN would otherwise
+    poison the bounds into pruning EVERY probe row)."""
+    mn, mx = state
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        mask = mask & ~jnp.isnan(data)
+    info = _ident(data.dtype)
+    mn = jnp.minimum(mn, jnp.min(jnp.where(mask, data,
+                                           jnp.asarray(info.max,
+                                                       data.dtype))))
+    mx = jnp.maximum(mx, jnp.max(jnp.where(mask, data,
+                                           jnp.asarray(info.min,
+                                                       data.dtype))))
+    return mn, mx
+
+
+def bounds_init(dtype):
+    info = _ident(dtype)
+    return (jnp.asarray(info.max, dtype), jnp.asarray(info.min, dtype))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def apply_bounds(batch: Batch, col: str, mn, mx) -> Batch:
+    """Narrow row_valid to rows whose key can possibly match the build
+    side (inner-join semantics: NULL keys never match, so they drop
+    too)."""
+    c = batch.columns[col]
+    keep = (c.data >= mn.astype(c.data.dtype)) \
+        & (c.data <= mx.astype(c.data.dtype)) & c.mask
+    return Batch(batch.columns, batch.row_valid & keep)

@@ -5,15 +5,21 @@ One pool per query bounds what materializing operators (sort, window,
 join builds, spools, exchange buffers) may pin in device memory.
 Reservations are HOST-side estimates from array byte sizes — exact for
 our fixed-capacity batches — so the hot path never syncs the device.
-On exhaustion the pool raises MemoryLimitExceeded; the MeshRunner
-reacts by re-running bucket-wise (grouped execution, the Lifespan
-analog — execution/Lifespan.java:26), trading one pass for G smaller
-ones instead of dying like a plain OOM would.
+
+On pressure the pool first REVOKES: operators with spillable state
+(join builds, buffered aggregation partials) register a revoke
+callback, and a reserve() that would exceed the budget asks the
+largest holders to move state to host RAM before failing (reference:
+execution/MemoryRevokingScheduler.java:48 driving
+HashBuilderOperator's SPILLING_INPUT state machine). Only when
+revocation cannot free enough does MemoryLimitExceeded escalate — at
+which point the MeshRunner re-runs bucket-wise (grouped execution, the
+Lifespan analog — execution/Lifespan.java:26).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from presto_tpu.batch import Batch
 
@@ -45,14 +51,46 @@ class MemoryPool:
         self.peak = 0
         self._by_tag: Dict[str, int] = {}
         self.peak_by_tag: Dict[str, int] = {}
+        #: tag -> () -> bytes freed; registered by spillable operators
+        self._revocables: Dict[str, Callable[[], int]] = {}
+        self.revocations = 0
+
+    def register_revocable(self, tag: str,
+                           spill: Callable[[], int]) -> None:
+        self._revocables[tag] = spill
+
+    def unregister_revocable(self, tag: str) -> None:
+        self._revocables.pop(tag, None)
+
+    def _revoke(self, needed: int, requesting: str) -> None:
+        """Ask spillable holders (largest first) to move state off the
+        device until `needed` more bytes fit. The REQUESTING operator
+        is revoked last — its callback then runs re-entrantly inside
+        its own reserve(), which the operators' spill paths handle, but
+        another holder's memory should free first. Callbacks free their
+        own reservations; they must not reserve re-entrantly."""
+        order = sorted(self._revocables,
+                       key=lambda t: (t == requesting,
+                                      -self._by_tag.get(t, 0)))
+        for tag in order:
+            if self.reserved + needed <= self.budget:
+                return
+            spill = self._revocables.get(tag)
+            if spill is None:
+                continue
+            if spill() > 0:
+                self.revocations += 1
 
     def reserve(self, tag: str, nbytes: int) -> None:
         if nbytes <= 0:
             return
         if self.budget is not None \
                 and self.reserved + nbytes > self.budget:
-            raise MemoryLimitExceeded(tag, nbytes, self.reserved,
-                                      self.budget)
+            if self._revocables:
+                self._revoke(nbytes, tag)
+            if self.reserved + nbytes > self.budget:
+                raise MemoryLimitExceeded(tag, nbytes, self.reserved,
+                                          self.budget)
         self.reserved += nbytes
         self._by_tag[tag] = self._by_tag.get(tag, 0) + nbytes
         self.peak = max(self.peak, self.reserved)

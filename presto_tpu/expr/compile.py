@@ -368,6 +368,16 @@ class _Compiler:
                     h = h_i if h is None else _combine_hash(h, h_i)
                 return h, jnp.asarray(True)
             return CompiledExpr(f_hash, BIGINT)
+        if name in ("is_nan", "is_finite", "is_infinite"):
+            (a,) = args
+            test = {"is_nan": jnp.isnan, "is_finite": jnp.isfinite,
+                    "is_infinite": jnp.isinf}[name]
+
+            def f_ieee(env):
+                d, m = a.fn(env)
+                return test(d.astype(jnp.float64)), m
+            from presto_tpu.types import BOOLEAN as _B
+            return CompiledExpr(f_ieee, _B)
         raise ExpressionCompileError(f"unknown scalar function {name!r}")
 
     def _comparison(self, name: str, e: Call, args) -> CompiledExpr:

@@ -46,11 +46,14 @@ class AggSpec:
     mask: Optional[CompiledExpr] = None  # FILTER (WHERE ...) — later
 
 
-# One compiled fold step per (shapes, agg specs). AggFunction instances
-# are frozen dataclasses -> hashable static args; the factories are
-# lru_cached so the same spec hits the jit cache across queries.
-_jit_step = jax.jit(hashagg.agg_step, static_argnums=(5, 6))
-_jit_direct_step = jax.jit(hashagg.direct_step, static_argnums=(3, 6, 7))
+# AggFunction instances are frozen dataclasses -> hashable static
+# args; the factories are lru_cached so the same spec hits the jit
+# cache across queries.
+#: log-depth tree merge of buffered per-batch partials (sort path)
+_jit_merge = jax.jit(hashagg.merge_partials, static_argnums=(1, 2))
+#: buffered partials per merge round: each merge sorts FANIN x P rows,
+#: so the per-input-row sort cost stays ~(1 + 1/FANIN + ...) ~ 1.15x
+_MERGE_FANIN = 8
 
 #: Whole-step kernel cache keyed by the expression IRs + agg layout so a
 #: re-executed (or structurally identical) query reuses the compiled XLA
@@ -97,8 +100,7 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
         except TypeError:
             key = None
 
-    @jax.jit
-    def kernel(state, batch: Batch):
+    def _batch_parts(batch: Batch):
         env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
         cap = batch.capacity
         key_cols = []
@@ -130,13 +132,25 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                 w = w & jnp.broadcast_to(fd & fm, (cap,))
             agg_weights.append(w)
             merge.append(False)
-        if domains is not None:
+        return key_cols, agg_inputs, agg_weights, tuple(merge)
+
+    if domains is not None:
+        @jax.jit
+        def kernel(state, batch: Batch):
+            key_cols, agg_inputs, agg_weights, merge = _batch_parts(batch)
             return hashagg.direct_step(
                 state, batch.row_valid, key_cols, domains, agg_inputs,
-                agg_weights, aggs, tuple(merge))
-        return hashagg.agg_step(state, batch.row_valid, key_cols,
-                                agg_inputs, agg_weights, aggs,
-                                tuple(merge))
+                agg_weights, aggs, merge)
+    else:
+        # sort path: expression eval + per-batch compaction fused into
+        # ONE dispatch; out_cap is static so one Python kernel serves
+        # every max_groups retry size
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def kernel(out_cap: int, batch: Batch):
+            key_cols, agg_inputs, agg_weights, merge = _batch_parts(batch)
+            return hashagg.batch_aggregate(
+                batch.row_valid, key_cols, agg_inputs, agg_weights,
+                aggs, out_cap, merge)
 
     if key is not None:
         _AGG_STEP_CACHE[key] = kernel
@@ -219,9 +233,16 @@ class AggregationOperator(Operator):
             self._state = hashagg.direct_init(
                 [s.function for s in self.specs], slots)
         else:
-            self._state = hashagg.init_state(
-                [k.type for k in key_exprs],
-                [s.function for s in self.specs], max_groups)
+            # sort path: per-batch compacted partials sized by the
+            # BATCH (distinct <= rows), tree-merged level-wise with
+            # capacities growing toward max_groups — no running
+            # max_groups state re-sorted every batch, and no FANIN
+            # giant buffers for high-cardinality aggregations
+            self._state = None
+            self._cap = bucket_capacity(max_groups)
+            self._levels: Dict[int, list] = {}
+            self._host_spill: list = []
+            self.ctx.register_revocable(self._revoke)
         self._finishing = False
         self._emitted = False
 
@@ -232,23 +253,141 @@ class AggregationOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
-        # ONE dispatch per batch: expression eval + fold are fused, and
-        # no per-batch overflow sync — the flag accumulates on device
-        # (state.overflow) and is checked ONCE at get_output. A blocking
+        # ONE dispatch per batch: expression eval + grouping are fused,
+        # and no per-batch overflow sync — the flag accumulates on
+        # device and is checked ONCE at get_output. A blocking
         # device->host read per batch costs a full roundtrip (~190ms on
         # a remote TPU tunnel) and serializes the pipeline.
-        self._state = self._kernel(self._state, batch)
+        if self._domains is not None:
+            self._state = self._kernel(self._state, batch)
+            return
+        c0 = min(self._cap, bucket_capacity(batch.capacity))
+        self._push(self._kernel(c0, batch))
+
+    # -- sort-path partial management ---------------------------------
+
+    @staticmethod
+    def _state_bytes(st) -> int:
+        return sum(x.dtype.itemsize * x.size
+                   for x in jax.tree_util.tree_leaves(st))
+
+    @staticmethod
+    def _state_cap(st) -> int:
+        return st.valid.shape[0]
+
+    def _merge_cap(self, states) -> int:
+        # distinct(union) <= sum of live rows <= sum of caps, so this
+        # capacity can only flag overflow when max_groups truly
+        # overflows
+        return min(self._cap, bucket_capacity(
+            sum(self._state_cap(s) for s in states)))
+
+    def _push(self, st) -> None:
+        """Buffer a partial, keyed by CAPACITY: merges then always see
+        FANIN equal-shaped states, so the jit specialization count is
+        bounded by the handful of power-of-two caps — not by the
+        combinatorics of mixed-cap tuples."""
+        pool_reserve = self.ctx.driver_context.memory is not None
+        if pool_reserve:
+            self.ctx.driver_context.memory.reserve(
+                self.ctx.tag, self._state_bytes(st))
+        cap = self._state_cap(st)
+        buf = self._levels.setdefault(cap, [])
+        buf.append(st)
+        if len(buf) >= _MERGE_FANIN:
+            aggs = tuple(s.function for s in self.specs)
+            merged = _jit_merge(tuple(buf), aggs, self._merge_cap(buf))
+            if pool_reserve:
+                self.ctx.driver_context.memory.free(
+                    self.ctx.tag,
+                    sum(self._state_bytes(s) for s in buf))
+            self._levels[cap] = []
+            self._push(merged)
+
+    def _merge_mixed(self, states):
+        """Merge leftover states of assorted caps with a bounded set of
+        kernel shapes: same-cap groups first (padded to FANIN with
+        empty states so each cap has ONE specialization), then a
+        pairwise ladder across ascending caps."""
+        aggs = tuple(s.function for s in self.specs)
+        key_types = [k.type for k in self.key_exprs]
+        by_cap: Dict[int, list] = {}
+        for s in states:
+            by_cap.setdefault(self._state_cap(s), []).append(s)
+        level: list = []
+        for cap in sorted(by_cap):
+            group = by_cap[cap]
+            if len(group) == 1:
+                level.append(group[0])
+                continue
+            while len(group) < _MERGE_FANIN:
+                group.append(hashagg.init_state(key_types, aggs, cap))
+            level.append(_jit_merge(tuple(group), aggs,
+                                    self._merge_cap(group)))
+        level.sort(key=self._state_cap)
+        while len(level) > 1:
+            a, b = level.pop(0), level.pop(0)
+            m = _jit_merge((a, b), aggs, self._merge_cap((a, b)))
+            level.append(m)
+            level.sort(key=self._state_cap)
+        return level[0]
+
+    def _revoke(self) -> int:
+        """Pool callback: park every buffered partial in host RAM."""
+        states = [s for buf in self._levels.values() for s in buf]
+        if not states:
+            return 0
+        freed = sum(self._state_bytes(s) for s in states)
+        for s in states:
+            self._host_spill.append(jax.device_get(s))
+            self.ctx.count_spill(1, self._state_bytes(s))
+        self._levels = {}
+        pool = self.ctx.driver_context.memory
+        if pool is not None:
+            pool.free_all(self.ctx.tag)
+        return freed
+
+    def _final_state(self):
+        aggs = tuple(s.function for s in self.specs)
+        key_types = [k.type for k in self.key_exprs]
+        states = [s for buf in self._levels.values() for s in buf]
+        self._levels = {}
+        if self._host_spill:
+            # spilled run: restore + merge host-resident partials one
+            # same-cap FANIN group at a time, keeping only one merge
+            # group on device at once
+            for s in states:
+                self._host_spill.append(jax.device_get(s))
+            work = sorted(self._host_spill, key=self._state_cap)
+            self._host_spill = []
+            while len(work) > _MERGE_FANIN:
+                group = [jax.device_put(s) for s in work[:_MERGE_FANIN]]
+                merged = _jit_merge(tuple(group), aggs,
+                                    self._merge_cap(group))
+                work = work[_MERGE_FANIN:]
+                work.append(jax.device_get(merged))
+                work.sort(key=self._state_cap)
+            if not work:
+                return hashagg.init_state(key_types, aggs, self._cap)
+            return self._merge_mixed([jax.device_put(s) for s in work])
+        if not states:
+            return hashagg.init_state(key_types, aggs, self._cap)
+        if len(states) > 1:
+            return self._merge_mixed(states)
+        return states[0]
 
     def get_output(self) -> Optional[Batch]:
         if not self._finishing or self._emitted:
             return None
-        if self._domains is None and \
-                bool(np.asarray(self._state.overflow)):
-            # groups were dropped — the query must re-run with a larger
-            # table (reference analog: MultiChannelGroupByHash rehash :87,
-            # except the retry is at query level to keep the hot loop
-            # sync-free)
-            raise GroupLimitExceeded(self.max_groups * 4)
+        if self._domains is None:
+            self._state = self._final_state()
+            self.ctx.unregister_revocable()
+            if bool(np.asarray(self._state.overflow)):
+                # groups were dropped — the query must re-run with a
+                # larger table (reference analog: MultiChannelGroupByHash
+                # rehash :87, except the retry is at query level to keep
+                # the hot loop sync-free)
+                raise GroupLimitExceeded(self.max_groups * 4)
         self._emitted = True
         key_types = tuple(k.type for k in self.key_exprs)
         key_dicts = tuple(k.dictionary for k in self.key_exprs)
@@ -267,6 +406,16 @@ class AggregationOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing and self._emitted
+
+    def close(self) -> None:
+        # drop device references so retired lifespan instances release
+        # their HBM
+        self._state = None
+        if self._domains is None:
+            self.ctx.unregister_revocable()
+            self.ctx.release_all()
+            self._levels = {}
+            self._host_spill = []
 
 
 class AggregationOperatorFactory(OperatorFactory):

@@ -26,6 +26,9 @@ class OperatorStats:
     output_batches: int = 0
     output_rows: int = 0
     busy_seconds: float = 0.0
+    #: operator-state spill (memory revocation) counters
+    spilled_batches: int = 0
+    spilled_bytes: int = 0
     input_rows_dev: Any = None
     output_rows_dev: Any = None
 
@@ -52,6 +55,33 @@ class DriverContext:
     #: profiled execution (EXPLAIN ANALYZE): count rows per operator and
     #: time each output with a device barrier
     profile: bool = False
+    #: sync-free error protocol: operators append (read_flag, make_exc)
+    #: pairs; the drive loop fetches every flag in ONE host sync after
+    #: all drivers finish and raises the first tripped one. Keeps
+    #: per-batch hot paths free of device->host reads (the join
+    #: capacity / group limit pattern).
+    deferred_checks: List[Any] = dataclasses.field(default_factory=list)
+
+
+def run_deferred_checks(dctx: "DriverContext") -> None:
+    """Fetch every deferred device flag in ONE host sync and raise the
+    first tripped error (called by drive loops after all drivers
+    finish, before results are trusted)."""
+    flags, excs = [], []
+    for check in dctx.deferred_checks:
+        flag, make_exc = check()
+        if flag is not None:
+            flags.append(flag)
+            excs.append(make_exc)
+    if not flags:
+        return
+    import jax
+    # device_get, not stack: task flags may live on different devices
+    # of a mesh; one gather call still fetches them together
+    tripped = jax.device_get(flags)
+    for hit, make_exc in zip(tripped, excs):
+        if bool(hit):
+            raise make_exc()
 
 
 class OperatorContext:
@@ -79,6 +109,24 @@ class OperatorContext:
         pool = self.driver_context.memory
         if pool is not None:
             pool.free_all(self.tag)
+
+    # -- spill (memory revocation) helpers ----------------------------
+
+    def register_revocable(self, spill) -> None:
+        """Expose this operator's spill callback to the pool. `spill`
+        returns bytes freed (and must free its own reservations)."""
+        pool = self.driver_context.memory
+        if pool is not None:
+            pool.register_revocable(self.tag, spill)
+
+    def unregister_revocable(self) -> None:
+        pool = self.driver_context.memory
+        if pool is not None:
+            pool.unregister_revocable(self.tag)
+
+    def count_spill(self, batches: int, nbytes: int) -> None:
+        self.stats.spilled_batches += batches
+        self.stats.spilled_bytes += nbytes
 
 
 class Operator(abc.ABC):

@@ -68,12 +68,19 @@ class ValuesOperatorFactory(OperatorFactory):
 
 class TableScanOperator(SourceOperator):
     """Pulls batches from a connector page source (reference:
-    TableScanOperator.java:43; splits arrive via the factory)."""
+    TableScanOperator.java:43; splits arrive via the factory).
+
+    `df_specs` [(column, df_id, registry)] wires dynamic filtering:
+    once the corresponding join build has published its key bounds,
+    every scanned batch narrows row_valid with one fused compare — the
+    probe operator's bridge-block guarantees the bounds exist before
+    this scan is ever pulled (see execution/dynamic_filters.py)."""
 
     def __init__(self, ctx: OperatorContext,
-                 batch_iter: Iterator[Batch]):
+                 batch_iter: Iterator[Batch], df_specs=None):
         super().__init__(ctx)
         self._iter = batch_iter
+        self._df_specs = df_specs or []
         self._finished = False
 
     def get_output(self) -> Optional[Batch]:
@@ -84,6 +91,13 @@ class TableScanOperator(SourceOperator):
         except StopIteration:
             self._finished = True
             return None
+        for col, df_id, reg in self._df_specs:
+            bounds = reg.get(df_id)
+            if bounds is not None:
+                from presto_tpu.execution.dynamic_filters import (
+                    apply_bounds,
+                )
+                b = apply_bounds(b, col, bounds[0], bounds[1])
         # (live-row counts stay device-side; EXPLAIN ANALYZE
         #  materializes them once at drain)
         return self._count_out(b)
@@ -97,14 +111,16 @@ class TableScanOperator(SourceOperator):
 
 class TableScanOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, name: str,
-                 batch_iter_factory: Callable[[], Iterator[Batch]]):
+                 batch_iter_factory: Callable[[], Iterator[Batch]],
+                 df_specs=None):
         super().__init__(operator_id, name)
         self._factory = batch_iter_factory
+        self._df_specs = df_specs
 
     def create(self, driver_context: DriverContext) -> Operator:
         return TableScanOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self._factory())
+            self._factory(), self._df_specs)
 
 
 #: jit-kernel LRU cache keyed by the (hashable) expression IR so re-running

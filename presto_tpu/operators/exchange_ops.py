@@ -225,6 +225,10 @@ class MeshExchange:
                 else:
                     self._host_bytes -= nbytes
                     host_batch = payload
+                # pad on the HOST to the quantized capacity ladder:
+                # exact tiny buckets would each compile fresh kernels
+                # downstream; numpy padding costs nothing
+                host_batch = _host_pad_quantized(host_batch)
                 self._enqueue(c, jax.device_put(host_batch, dev))
         if self.current_lifespan + 1 >= self.lifespans:
             self._drop_spill_dir()
@@ -369,6 +373,7 @@ class MeshExchange:
         return jax.device_put(b, self.devices[producer])
 
     def _try_wave(self) -> None:
+        from presto_tpu.batch import quantized_capacity
         while True:
             have = [bool(p) for p in self._pending]
             if all(h or d for h, d in zip(have, self._done)):
@@ -376,7 +381,8 @@ class MeshExchange:
                     return  # nothing left to flush
             else:
                 return  # wait for slower producers
-            cap = max(p[0].capacity for p in self._pending if p)
+            cap = quantized_capacity(
+                max(p[0].capacity for p in self._pending if p))
             wave = []
             for i, p in enumerate(self._pending):
                 wave.append(p.popleft() if p
@@ -386,6 +392,24 @@ class MeshExchange:
                                     key_remaps=self._remaps)
             for c, b in enumerate(outs):
                 self._route_lifespan(c, b)
+
+
+def _host_pad_quantized(batch: Batch) -> Batch:
+    """Numpy-pad a HOST-side batch up to the quantized capacity ladder
+    (see batch.quantized_capacity) before it returns to the device."""
+    import numpy as _np
+    from presto_tpu.batch import quantized_capacity
+    cap = quantized_capacity(batch.capacity)
+    if cap == batch.capacity:
+        return batch
+    pad = cap - batch.capacity
+    cols = {}
+    for n, c in batch.columns.items():
+        cols[n] = Column(
+            _np.pad(_np.asarray(c.data), (0, pad)),
+            _np.pad(_np.asarray(c.mask), (0, pad)), c.type,
+            c.dictionary)
+    return Batch(cols, _np.pad(_np.asarray(batch.row_valid), (0, pad)))
 
 
 class ExchangeSinkOperator(Operator):

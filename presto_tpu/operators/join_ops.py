@@ -18,18 +18,107 @@ from presto_tpu.batch import Batch, bucket_capacity, remap_column
 from presto_tpu.operators.base import (
     DriverContext, Operator, OperatorContext, OperatorFactory,
 )
+from presto_tpu.ops import common as ops_common
 from presto_tpu.ops import join as join_ops
 
 
+class JoinCapacityExceeded(Exception):
+    """A probe batch's true join output exceeded the optimistic output
+    capacity (probe capacity x expansion factor). Detected ON DEVICE and
+    surfaced once per query via DriverContext.deferred_checks; the
+    runner retries with the suggested larger factor — the sync-free
+    sibling of GroupLimitExceeded."""
+
+    def __init__(self, suggested: int):
+        super().__init__(
+            f"join output overflowed; retry with expansion factor "
+            f"{suggested}")
+        self.suggested = suggested
+
+
+#: hash partitions for spilled join builds. Uses hash bits 32+ so the
+#: split is independent of the shuffle (h % n_consumers) and lifespan
+#: ((h // n) % G) bucketing — sharing low bits would collapse every
+#: row of a task into one spill part.
+SPILL_PARTS = 8
+
+
+def _spill_part_of(h, n_parts: int):
+    return jnp.mod(h >> 32, n_parts)
+
+
+class SpilledBuild:
+    """Build side partitioned by key hash and parked in host RAM
+    (reference: spiller/GenericPartitioningSpiller.java:47). The probe
+    operator asks for one partition's BuildTable at a time, so device
+    residency is ~1/n_parts of the build side."""
+
+    def __init__(self, n_parts: int, key_names: Tuple[str, ...],
+                 schema_cols, host_parts, key_dicts=None):
+        self.n_parts = n_parts
+        self.key_names = key_names
+        self.schema_cols = schema_cols
+        self.key_dicts = key_dicts
+        self.host_parts = host_parts  # part -> [host-side Batch]
+
+    def build_part(self, p: int) -> join_ops.BuildTable:
+        import jax
+        batches = [jax.device_put(b) for b in self.host_parts[p]]
+        if batches:
+            cap = bucket_capacity(sum(b.capacity for b in batches))
+            merged = Batch.concat(batches, cap)
+        else:
+            # empty part still needs the unified dictionaries so its
+            # (all-masked) probe outputs concat with other parts'
+            from presto_tpu.batch import empty_batch
+            merged = _remap_keys(empty_batch(self.schema_cols),
+                                 self.key_names, self.key_dicts)
+        return join_ops.build(merged, self.key_names)
+
+
+def spill_batch_to_host(b: Batch, part_dev, parts_out: List[list],
+                        ctx) -> None:
+    """Move one device batch to host RAM, split by partition id — ONE
+    device->host transfer for the whole batch, then numpy slicing (no
+    per-part device syncs, no shape-specialized compaction kernels:
+    the spill path must not trigger a jit compile storm)."""
+    import jax
+    from presto_tpu.batch import Column
+    from presto_tpu.execution.memory import batch_bytes
+    host, hpart = jax.device_get((b, part_dev))
+    live = np.asarray(host.row_valid)
+    for p in range(len(parts_out)):
+        sel = live & (hpart == p)
+        n = int(sel.sum())
+        if n == 0:
+            continue
+        cap = bucket_capacity(n)
+        cols = {}
+        for name, c in host.columns.items():
+            d = np.zeros(cap, dtype=np.asarray(c.data).dtype)
+            m = np.zeros(cap, dtype=bool)
+            d[:n] = np.asarray(c.data)[sel]
+            m[:n] = np.asarray(c.mask)[sel]
+            cols[name] = Column(d, m, c.type, c.dictionary)
+        rv = np.zeros(cap, dtype=bool)
+        rv[:n] = True
+        sub = Batch(cols, rv)
+        parts_out[p].append(sub)
+        ctx.count_spill(1, batch_bytes(sub))
+
+
 class JoinBridge:
-    """Shared build-side handoff (reference: LookupSourceFactory)."""
+    """Shared build-side handoff (reference: LookupSourceFactory).
+    Exactly one of `table` (in-memory) or `spilled` (partitioned,
+    host-resident) is set once the build finishes."""
 
     def __init__(self):
         self.table: Optional[join_ops.BuildTable] = None
+        self.spilled: Optional[SpilledBuild] = None
 
     @property
     def ready(self) -> bool:
-        return self.table is not None
+        return self.table is not None or self.spilled is not None
 
 
 class HashBuildOperator(Operator):
@@ -44,24 +133,82 @@ class HashBuildOperator(Operator):
     def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
                  key_names: Tuple[str, ...],
                  key_dicts: Optional[List[Optional[tuple]]] = None,
-                 schema_cols: Optional[Sequence[tuple]] = None):
+                 schema_cols: Optional[Sequence[tuple]] = None,
+                 spillable: bool = False,
+                 df_publish: Optional[List[tuple]] = None):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
         self.key_dicts = key_dicts
         self.schema_cols = schema_cols
         self._batches: List[Batch] = []
+        self._spill = None  # part -> [host Batch] once revoked
+        self._total = None
         self._finished = False
+        #: dynamic filtering: [(key_name, df_id, registry)] — running
+        #: min/max per named key, published at finish
+        self._df_publish = df_publish or []
+        self._df_state: dict = {}
+        if spillable:
+            self.ctx.register_revocable(self._revoke)
 
     def needs_input(self) -> bool:
         return not self._finished
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        batch = _remap_keys(batch, self.key_names, self.key_dicts)
+        for key, df_id, _reg in self._df_publish:
+            from presto_tpu.execution import dynamic_filters as df
+            c = batch.columns[key]
+            st = self._df_state.get(df_id)
+            if st is None:
+                st = df.bounds_init(c.data.dtype)
+            self._df_state[df_id] = df.bounds_step(
+                st, c.data, c.mask & batch.row_valid)
+        if self._spill is not None:
+            # once revoked, later input goes straight to host partitions
+            self._spill_batches([batch])
+            return
         self.ctx.reserve_batch(batch)  # held until close: the built
         # table the bridge exposes is the same order of magnitude
-        self._batches.append(_remap_keys(batch, self.key_names,
-                                         self.key_dicts))
+        self._batches.append(batch)
+        # running live-row total, prefetched: the async d2h copy is in
+        # flight while later batches stream, so finish()'s one blocking
+        # read usually finds the bytes already on the host instead of
+        # paying a full tunnel roundtrip
+        t = jnp.sum(batch.row_valid)
+        self._total = t if self._total is None else self._total + t
+        try:
+            self._total.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+    # -- spill (memory revocation) ------------------------------------
+
+    def _revoke(self) -> int:
+        """Pool callback: move buffered build batches to host RAM,
+        hash-partitioned (reference: HashBuilderOperator.java:159-179
+        SPILLING_INPUT). Runs on the slow path only — the per-part
+        compaction syncs are irrelevant next to freeing HBM."""
+        if self._finished or not self._batches:
+            return 0
+        from presto_tpu.execution.memory import batch_bytes
+        freed = sum(batch_bytes(b) for b in self._batches)
+        self._spill_batches(self._batches)
+        self._batches = []
+        self._total = None
+        self.ctx.release_all()
+        return freed
+
+    def _spill_batches(self, batches: List[Batch]) -> None:
+        if self._spill is None:
+            self._spill = [[] for _ in range(SPILL_PARTS)]
+        for b in batches:
+            keys = [b.columns[k].astuple() for k in self.key_names]
+            part = _spill_part_of(ops_common.row_hash(keys),
+                                  SPILL_PARTS)
+            spill_batch_to_host(b, part, self._spill, self.ctx)
 
     def get_output(self) -> Optional[Batch]:
         return None
@@ -70,8 +217,32 @@ class HashBuildOperator(Operator):
         if self._finished:
             return
         self._finished = True
+        self.ctx.unregister_revocable()
+        for key, df_id, reg in self._df_publish:
+            if df_id in self._df_state:
+                mn, mx = self._df_state[df_id]
+                reg.publish(df_id, mn, mx)
+            else:
+                # empty build side: publish the impossible range so
+                # inner-join probe scans prune everything
+                from presto_tpu.execution import dynamic_filters as df
+                col = dict(
+                    (n, t) for n, t, _ in (self.schema_cols or []))
+                if key in col:
+                    mn, mx = df.bounds_init(col[key].np_dtype)
+                    reg.publish(df_id, mn, mx)
+        if self._spill is not None:
+            if self._batches:  # revoked mid-stream leftovers
+                self._spill_batches(self._batches)
+                self._batches = []
+                self.ctx.release_all()
+            self.bridge.spilled = SpilledBuild(
+                SPILL_PARTS, self.key_names, self.schema_cols,
+                self._spill, self.key_dicts)
+            return
         # one device->host sync for the whole build side (not per batch)
-        total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
+        total = int(np.asarray(self._total)) if self._total is not None \
+            else 0
         cap = bucket_capacity(max(total, 1))
         if self._batches:
             merged = Batch.concat(self._batches, cap, live_rows=total)
@@ -92,71 +263,168 @@ class HashBuildOperator(Operator):
     def close(self) -> None:
         # drop the build table so a closed lifespan instance releases
         # its REAL HBM, not just its pool ledger entry
+        self.ctx.unregister_revocable()
         self._batches = []
+        self._spill = None
         self.bridge.table = None
+        self.bridge.spilled = None
 
 
 class LookupJoinOperator(Operator):
     """Probe side (reference: LookupJoinOperator.java:53, processProbe:392).
 
-    Per probe batch: candidate runs via two searchsorted calls, a host
-    sync for the total match count (picks the output capacity bucket),
-    then one expand kernel."""
+    Per probe batch: ONE fused dispatch (candidate runs + expansion) and
+    ZERO host syncs. The output capacity is probe capacity x
+    `expansion_factor` (1 is exact for every FK->PK join, where each
+    probe row matches at most one build row); the kernel's on-device
+    overflow flag accumulates across batches and is fetched once per
+    query by the drive loop — tripping it retries the query with a 4x
+    factor via JoinCapacityExceeded."""
 
     def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
                  key_names: Tuple[str, ...], join_type: str,
                  probe_output: Sequence[str], build_output: Sequence[str],
                  build_rename: Optional[dict] = None,
                  build_keys: Optional[Tuple[str, ...]] = None,
-                 key_dicts: Optional[List[Optional[tuple]]] = None):
+                 key_dicts: Optional[List[Optional[tuple]]] = None,
+                 expansion_factor: int = 1):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
         self.build_keys = build_keys  # None -> kernel defaults
         self.key_dicts = key_dicts
         self.join_type = join_type
-        self.probe_output = list(probe_output)
-        self.build_output = list(build_output)
+        self.probe_output = tuple(probe_output)
+        self.build_output = tuple(build_output)
         self.build_rename = build_rename or {}
-        self._pending: Optional[Batch] = None
+        self.expansion_factor = max(1, int(expansion_factor))
+        self._overflow = None
+        # two-slot output queue: a probed batch is emitted one driver
+        # PASS after its dispatch, so its live-count d2h copy (started
+        # at dispatch) genuinely overlaps the next batch's probe
+        # instead of blocking microseconds later in the same pass
+        self._pending: List = []
         self._finishing = False
+        # spilled-build probe state: current partition's table, the
+        # host-buffered probe rows of later partitions, and the replay
+        # cursor through them
+        self._cur_table = None
+        self._cur_part = -1
+        self._probe_bufs = None
+        ctx.driver_context.deferred_checks.append(self._deferred_check)
+
+    def _deferred_check(self):
+        """(flag_array | None, exception factory) for the drive loop's
+        single end-of-query fetch."""
+        if self._overflow is None:
+            return None, None
+        return self._overflow, \
+            lambda: JoinCapacityExceeded(self.expansion_factor * 4)
 
     def is_blocked(self):
         return False if self.bridge.ready else "waiting for join build"
 
     def needs_input(self) -> bool:
-        return self.bridge.ready and self._pending is None \
+        return self.bridge.ready and len(self._pending) < 2 \
             and not self._finishing
+
+    #: outputs at or under this capacity skip the count/compact round
+    COMPACT_FLOOR = 8192
+
+    def _probe(self, table, batch: Batch) -> Batch:
+        cap = bucket_capacity(batch.capacity * self.expansion_factor)
+        out, ovf, total = join_ops.probe_join(
+            table, batch, self.key_names, cap, self.join_type,
+            self.probe_output, self.build_output,
+            self.build_keys if self.build_keys is not None
+            else self.key_names)
+        self._overflow = ovf if self._overflow is None \
+            else self._overflow | ovf
+        if self.build_rename:
+            out = out.rename(self.build_rename)
+        if out.capacity > self.COMPACT_FLOOR:
+            # selective joins emit few rows into a fat capacity; left
+            # uncompacted that padding would ride every downstream
+            # exchange/pad/spool. The live count's d2h copy starts NOW
+            # (async) and is consumed one driver round later in
+            # get_output — the hot loop never blocks on a fresh fetch.
+            try:
+                total.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            return out, total
+        return out, None
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
         batch = _remap_keys(batch, self.key_names, self.key_dicts)
-        table = self.bridge.table
-        lo, hi, counts, pkv = join_ops.probe_counts(
-            table, batch, self.key_names)
-        emit = np.asarray(counts)
-        if self.join_type == "left":
-            rv = np.asarray(batch.row_valid)
-            emit = np.where(rv & (emit == 0), 1, emit * rv)
-        total = int(emit.sum())
-        cap = bucket_capacity(max(total, 1))
-        out = join_ops.expand(
-            table, batch, self.key_names, lo, hi, counts, pkv, cap,
-            self.join_type, probe_output=self.probe_output,
-            build_output=self.build_output, build_keys=self.build_keys)
-        if self.build_rename:
-            out = out.rename(self.build_rename)
-        self._pending = out
+        if self.bridge.table is not None:
+            self._pending.append(self._probe(self.bridge.table, batch))
+            return
+        # spilled build: probe the resident partition now, park the
+        # rest of the batch's rows on the host per partition
+        import jax
+        sp = self.bridge.spilled
+        if self._probe_bufs is None:
+            self._probe_bufs = [[] for _ in range(sp.n_parts)]
+            self._cur_part = 0
+            self._cur_table = sp.build_part(0)
+        keys = [batch.columns[k].astuple() for k in self.key_names]
+        part = _spill_part_of(ops_common.row_hash(keys), sp.n_parts)
+        later = [[] for _ in range(sp.n_parts)]
+        spill_batch_to_host(Batch(batch.columns,
+                                  batch.row_valid & (part != 0)),
+                            part, later, self.ctx)
+        for p in range(1, sp.n_parts):
+            self._probe_bufs[p].extend(later[p])
+        self._pending.append(self._probe(
+            self._cur_table, batch.filter(part == 0)))
+
+    def _emit(self, pending) -> Batch:
+        out, total = pending
+        if total is not None:
+            # the async copy has been in flight since add_input; this
+            # read is normally a cache hit, not a fresh roundtrip
+            n = int(np.asarray(total))
+            # floor keeps the compiled-shape set small (tiny outputs
+            # all land on one bucket)
+            cap = max(1024, bucket_capacity(max(n, 1)))
+            if cap < out.capacity:
+                out = out.compact(cap, known_valid=n)
+        return out
 
     def get_output(self) -> Optional[Batch]:
-        out, self._pending = self._pending, None
-        return self._count_out(out)
+        # emit the HEAD only once a second batch is queued behind it
+        # (or input ended): by then its count fetch has overlapped a
+        # full probe dispatch
+        if self._pending and (len(self._pending) > 1
+                              or self._finishing):
+            return self._count_out(self._emit(self._pending.pop(0)))
+        if self._pending or not self._finishing \
+                or self._probe_bufs is None:
+            return None
+        # drain the parked partitions: restore one probe batch per call
+        import jax
+        sp = self.bridge.spilled
+        while self._cur_part < sp.n_parts:
+            if self._probe_bufs[self._cur_part]:
+                host = self._probe_bufs[self._cur_part].pop(0)
+                out = self._probe(self._cur_table, jax.device_put(host))
+                return self._count_out(self._emit(out))
+            if self._cur_part + 1 >= sp.n_parts:
+                break
+            self._cur_part += 1
+            self._cur_table = sp.build_part(self._cur_part)
+        self._probe_bufs = None  # fully drained
+        self._cur_table = None
+        return None
 
     def finish(self) -> None:
         self._finishing = True
 
     def is_finished(self) -> bool:
-        return self._finishing and self._pending is None
+        return self._finishing and not self._pending \
+            and self._probe_bufs is None
 
 
 class SemiJoinOperator(Operator):
@@ -218,18 +486,22 @@ class HashBuildOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, bridge: JoinBridge,
                  key_names: Sequence[str],
                  key_dicts: Optional[List[Optional[tuple]]] = None,
-                 schema_cols: Optional[Sequence[tuple]] = None):
+                 schema_cols: Optional[Sequence[tuple]] = None,
+                 spillable: bool = False,
+                 df_publish: Optional[List[tuple]] = None):
         super().__init__(operator_id, "hash_build")
         self.bridge = bridge
         self.key_names = tuple(key_names)
         self.key_dicts = key_dicts
         self.schema_cols = schema_cols
+        self.spillable = spillable
+        self.df_publish = df_publish
 
     def create(self, driver_context: DriverContext) -> Operator:
         return HashBuildOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.bridge, self.key_names, self.key_dicts,
-            self.schema_cols)
+            self.schema_cols, self.spillable, self.df_publish)
 
 
 class LookupJoinOperatorFactory(OperatorFactory):
@@ -238,7 +510,8 @@ class LookupJoinOperatorFactory(OperatorFactory):
                  probe_output: Sequence[str], build_output: Sequence[str],
                  build_rename: Optional[dict] = None,
                  build_keys: Optional[Sequence[str]] = None,
-                 key_dicts: Optional[List[Optional[tuple]]] = None):
+                 key_dicts: Optional[List[Optional[tuple]]] = None,
+                 expansion_factor: int = 1):
         super().__init__(operator_id, f"lookup_join({join_type})")
         self.bridge = bridge
         self.key_names = tuple(key_names)
@@ -248,13 +521,14 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.probe_output = probe_output
         self.build_output = build_output
         self.build_rename = build_rename
+        self.expansion_factor = expansion_factor
 
     def create(self, driver_context: DriverContext) -> Operator:
         return LookupJoinOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.bridge, self.key_names, self.join_type,
             self.probe_output, self.build_output, self.build_rename,
-            self.build_keys, self.key_dicts)
+            self.build_keys, self.key_dicts, self.expansion_factor)
 
 
 class SemiJoinOperatorFactory(OperatorFactory):

@@ -376,6 +376,109 @@ def init_state(key_types: Sequence[Type], aggs: Sequence[AggFunction],
                         jnp.asarray(False))
 
 
+def _sorted_reduce(sarr: jnp.ndarray, gid: jnp.ndarray, out_cap: int,
+                   reduce: str) -> jnp.ndarray:
+    """Reduce a contribution array ALREADY SORTED by ascending group id
+    into `out_cap` packed slots (dead rows carry gid == out_cap).
+
+    Integer sums use cumsum + two searchsorted gathers of size out_cap —
+    measured ~5x cheaper than the scatter-lowered segment_sum on TPU
+    and exact under wrapping arithmetic. Floats keep segment_sum: a
+    cumsum-difference would leak one group's NaN into every later
+    group's total. min/max stay segment ops (sorted hint)."""
+    if reduce == "sum" and sarr.ndim == 1 \
+            and jnp.issubdtype(sarr.dtype, jnp.integer):
+        cs = jnp.cumsum(sarr)
+        slots = jnp.arange(out_cap)
+        starts = jnp.searchsorted(gid, slots, side="left")
+        ends = jnp.searchsorted(gid, slots, side="right")
+        hi = cs[jnp.maximum(ends - 1, 0)]
+        lo = jnp.where(starts > 0, cs[jnp.maximum(starts - 1, 0)], 0)
+        return jnp.where(ends > starts, hi - lo,
+                         jnp.zeros((), sarr.dtype))
+    if reduce == "sum":
+        red = jax.ops.segment_sum(sarr, gid, num_segments=out_cap + 1,
+                                  indices_are_sorted=True)
+    elif reduce == "min":
+        red = jax.ops.segment_min(sarr, gid, num_segments=out_cap + 1,
+                                  indices_are_sorted=True)
+    else:
+        red = jax.ops.segment_max(sarr, gid, num_segments=out_cap + 1,
+                                  indices_are_sorted=True)
+    return red[:out_cap]
+
+
+def _group_reduce(keys: Sequence[CVal], valid: jnp.ndarray,
+                  contribs: Sequence[Tuple[jnp.ndarray, ...]],
+                  aggs: Sequence[AggFunction],
+                  out_cap: int) -> GroupByState:
+    """The sort-based grouping core: ONE variadic `lax.sort` carries the
+    key columns and every 1-D contribution through the sorting network
+    together (no argsort, no per-array gathers — the TPU killer of the
+    old formulation), then boundary detection assigns PACKED group ids
+    and each contribution is segment-reduced into `out_cap` slots.
+    Vector (2-D) contributions ride via one sorted row-index payload.
+
+    Groups beyond out_cap are dropped and the overflow flag set (the
+    caller's retry protocol). Output groups land packed in key order."""
+    flat1d: List[jnp.ndarray] = []
+    have_2d = any(arr.ndim == 2 for st in contribs for arr in st)
+    for st in contribs:
+        for arr in st:
+            if arr.ndim == 1:
+                flat1d.append(arr)
+    n = valid.shape[0]
+    extra = [jnp.arange(n)] if have_2d else []
+    skeys, svalid, spay = common.sort_rows(
+        keys, valid=valid, payloads=flat1d + extra)
+    if keys:
+        bnd = common.boundaries(skeys, svalid)
+    else:
+        # global aggregation: a single group holds every valid row
+        bnd = jnp.zeros_like(svalid).at[0].set(True)
+    gid = jnp.cumsum(bnd) - 1
+    num_groups = jnp.sum(bnd)
+    # invalid rows -> overflow segment out_cap (sliced away)
+    gid = jnp.where(svalid, jnp.minimum(gid, out_cap), out_cap)
+
+    perm2 = spay[len(flat1d)] if have_2d else None
+    new_states: List[Tuple[jnp.ndarray, ...]] = []
+    it = iter(spay)
+    for st, agg in zip(contribs, aggs):
+        reduced = []
+        for arr, r in zip(st, agg.reduces):
+            sarr = next(it) if arr.ndim == 1 else arr[perm2]
+            reduced.append(_sorted_reduce(sarr, gid, out_cap, r))
+        new_states.append(tuple(reduced))
+
+    # representative key row per packed group: gid is ascending, so the
+    # first row of group g is a binary search, not a segment_min
+    slots = jnp.arange(out_cap)
+    first_row = jnp.clip(jnp.searchsorted(gid, slots, side="left"),
+                         0, n - 1)
+    new_valid = slots < num_groups
+    new_keys = [(d[first_row], m[first_row] & new_valid)
+                for d, m in skeys]
+    return GroupByState(new_keys, new_states, new_valid,
+                        num_groups > out_cap)
+
+
+def _make_contribs(aggs, agg_inputs, agg_weights, merge):
+    contribs: List[Tuple[jnp.ndarray, ...]] = []
+    for agg, inp, w, is_merge in zip(aggs, agg_inputs, agg_weights,
+                                     merge):
+        if is_merge:
+            # inp is a tuple of partial state arrays; weight gates
+            # validity
+            parts = tuple(
+                _gate(w, p, _ident_for(r, dt)).astype(_comp_spec(dt)[0])
+                for p, dt, r in zip(inp, agg.state_dtypes, agg.reduces))
+            contribs.append(parts)
+        else:
+            contribs.append(agg.init(inp, w))
+    return contribs
+
+
 def agg_step(state: GroupByState,
              row_valid: jnp.ndarray,
              key_cols: Sequence[CVal],
@@ -390,24 +493,17 @@ def agg_step(state: GroupByState,
     evaluated input column (or None for count(*)), `agg_weights[i]` is the
     per-row contribute mask (row_valid & not-null). When `merge[i]` is
     True the i-th "input" is a tuple of partial state arrays to merge
-    instead of raw values (final aggregation after a shuffle)."""
+    instead of raw values (final aggregation after a shuffle).
+
+    NOTE: folding a LARGE state through every batch re-sorts it each
+    step; the operator uses batch_aggregate + merge_partials instead
+    (per-batch compaction, log-depth merges). agg_step remains the
+    semantic reference and the path for small accumulators."""
     max_groups = state.valid.shape[0]
     merge = merge or [False] * len(aggs)
+    contribs = _make_contribs(aggs, agg_inputs, agg_weights, merge)
 
-    # 1. contributions for the incoming rows
-    contribs: List[Tuple[jnp.ndarray, ...]] = []
-    for agg, inp, w, is_merge in zip(aggs, agg_inputs, agg_weights, merge):
-        if is_merge:
-            # inp is a tuple of partial state arrays; weight gates validity
-            parts = tuple(
-                _gate(w, p, _ident_for(r, dt)).astype(
-                    _comp_spec(dt)[0])
-                for p, dt, r in zip(inp, agg.state_dtypes, agg.reduces))
-            contribs.append(parts)
-        else:
-            contribs.append(agg.init(inp, w))
-
-    # 2. concat state rows + input rows
+    # concat state rows + input rows, then one grouped reduction
     all_keys = [
         (jnp.concatenate([sk[0], kc[0].astype(sk[0].dtype)]),
          jnp.concatenate([sk[1], kc[1]]))
@@ -419,55 +515,52 @@ def agg_step(state: GroupByState,
         all_states.append(tuple(
             jnp.concatenate([s, c.astype(s.dtype)])
             for s, c in zip(st, cb)))
+    out = _group_reduce(all_keys, all_valid, all_states, aggs,
+                        max_groups)
+    return GroupByState(out.keys, out.states, out.valid,
+                        state.overflow | out.overflow)
 
-    # 3. sort by keys (invalid rows last), detect boundaries, segment ids
-    perm = common.lex_order(all_keys, valid=all_valid)
-    sorted_keys = common.take(all_keys, perm)
-    sorted_valid = all_valid[perm]
-    if all_keys:
-        bnd = common.boundaries(sorted_keys, sorted_valid)
-    else:
-        # global aggregation: a single group holds every valid row
-        bnd = jnp.zeros_like(sorted_valid).at[0].set(True)
-    gid = jnp.cumsum(bnd) - 1
-    num_groups = jnp.sum(bnd)
-    # invalid rows -> overflow segment max_groups (sliced away)
-    gid = jnp.where(sorted_valid, jnp.minimum(gid, max_groups), max_groups)
 
-    # 4. segment-reduce each state array
-    new_states = []
-    for st, agg in zip(all_states, aggs):
-        reduced = []
-        for arr, r in zip(st, agg.reduces):
-            sarr = arr[perm]
-            if r == "sum":
-                red = jax.ops.segment_sum(sarr, gid,
-                                          num_segments=max_groups + 1,
-                                          indices_are_sorted=True)
-            elif r == "min":
-                red = jax.ops.segment_min(sarr, gid,
-                                          num_segments=max_groups + 1,
-                                          indices_are_sorted=True)
-            else:
-                red = jax.ops.segment_max(sarr, gid,
-                                          num_segments=max_groups + 1,
-                                          indices_are_sorted=True)
-            reduced.append(red[:max_groups])
-        new_states.append(tuple(reduced))
+def batch_aggregate(row_valid: jnp.ndarray,
+                    key_cols: Sequence[CVal],
+                    agg_inputs: Sequence[Optional[jnp.ndarray]],
+                    agg_weights: Sequence[jnp.ndarray],
+                    aggs: Sequence[AggFunction],
+                    out_cap: int,
+                    merge: Sequence[bool] | None = None) -> GroupByState:
+    """Compact ONE batch to its distinct groups (<= out_cap slots) —
+    no running state in the hot loop. The operator buffers these
+    per-batch partials and tree-merges them with merge_partials, so a
+    million-group aggregation never re-sorts a million-row state per
+    batch (the old fold's failure mode on Q3/Q18-class queries)."""
+    merge = merge or [False] * len(aggs)
+    contribs = _make_contribs(aggs, agg_inputs, agg_weights, merge)
+    return _group_reduce(key_cols, row_valid, contribs, aggs, out_cap)
 
-    # 5. representative key row per group (first row of each segment)
-    row_idx = jnp.arange(sorted_valid.shape[0])
-    first_row = jax.ops.segment_min(
-        jnp.where(bnd, row_idx, sorted_valid.shape[0]), gid,
-        num_segments=max_groups + 1, indices_are_sorted=True)[:max_groups]
-    first_row = jnp.minimum(first_row, sorted_valid.shape[0] - 1)
-    new_keys = [(d[first_row], m[first_row] & True) for d, m in sorted_keys]
-    slot = jnp.arange(max_groups)
-    new_valid = slot < num_groups
-    new_keys = [(d, m & new_valid) for d, m in new_keys]
 
-    return GroupByState(new_keys, new_states, new_valid,
-                        state.overflow | (num_groups > max_groups))
+def merge_partials(states: Sequence[GroupByState],
+                   aggs: Sequence[AggFunction],
+                   out_cap: int) -> GroupByState:
+    """Regroup several compacted partial states into one (log-depth
+    tree merge; the reference analog is merging InMemoryHashAggregation
+    builders across spill generations). Output capacity `out_cap`;
+    overflow flags OR through."""
+    keys = [
+        (jnp.concatenate([s.keys[i][0] for s in states]),
+         jnp.concatenate([s.keys[i][1] for s in states]))
+        for i in range(len(states[0].keys))
+    ]
+    valid = jnp.concatenate([s.valid for s in states])
+    contribs = []
+    for ai in range(len(aggs)):
+        contribs.append(tuple(
+            jnp.concatenate([s.states[ai][ci] for s in states])
+            for ci in range(len(states[0].states[ai]))))
+    out = _group_reduce(keys, valid, contribs, aggs, out_cap)
+    ovf = out.overflow
+    for s in states:
+        ovf = ovf | s.overflow
+    return GroupByState(out.keys, out.states, out.valid, ovf)
 
 
 # ---------------------------------------------------------------------------

@@ -32,24 +32,28 @@ CVal = Tuple[jnp.ndarray, jnp.ndarray]
 
 @dataclasses.dataclass
 class BuildTable:
-    """Sorted-by-hash build side, ready for probing. A pytree."""
+    """Sorted-by-hash build side, ready for probing. A pytree.
+    `batch` rows are IN sorted-hash order (the variadic build sort
+    carries every column as payload), so a probe candidate at sorted
+    slot s reads batch row s directly — no index indirection."""
     sorted_hash: jnp.ndarray          # [n] int64, invalid rows at +inf end
-    sorted_row: jnp.ndarray           # [n] original row index
     valid_count: jnp.ndarray          # scalar: live build rows
-    batch: Batch                      # original (compacted) build rows
+    batch: Batch                      # build rows, sorted by key hash
 
 
 jax.tree_util.register_pytree_node(
     BuildTable,
-    lambda t: ((t.sorted_hash, t.sorted_row, t.valid_count,
-                t.batch), None),
+    lambda t: ((t.sorted_hash, t.valid_count, t.batch), None),
     lambda _, c: BuildTable(*c),
 )
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def build(batch: Batch, key_names: Tuple[str, ...]) -> BuildTable:
-    """Index the build side: hash keys, sort rows by hash.
+    """Index the build side: hash keys, sort ROWS by hash in one
+    variadic sort (columns ride as payloads — no argsort + per-column
+    gather). Probe-time candidate gathers then read nearly-contiguous
+    sorted rows instead of chasing a permutation.
 
     Rows with any NULL key never match an equi-join; they are pushed to
     the end by giving them the maximum hash and marking them invalid.
@@ -60,15 +64,22 @@ def build(batch: Batch, key_names: Tuple[str, ...]) -> BuildTable:
         valid = valid & m
     h = common.row_hash(keys)
     h = jnp.where(valid, h, jnp.iinfo(jnp.int64).max)
-    order = jnp.argsort(h, stable=True)
+    payloads = [batch.row_valid]
+    for n in batch.names:
+        payloads.extend(batch.columns[n].astuple())
+    out = jax.lax.sort((h,) + tuple(payloads), num_keys=1,
+                       is_stable=True)
     # (identical keys need not be adjacent within a hash run: expand()
-    #  scans the whole run and verifies actual keys per candidate,
-    #  gathering them from batch via sorted_row)
+    #  scans the whole run and verifies actual keys per candidate)
+    cols = {}
+    for i, n in enumerate(batch.names):
+        c = batch.columns[n]
+        cols[n] = Column(out[2 + 2 * i], out[3 + 2 * i], c.type,
+                         c.dictionary)
     return BuildTable(
-        sorted_hash=h[order],
-        sorted_row=order,
+        sorted_hash=out[0],
         valid_count=jnp.sum(valid),
-        batch=batch,
+        batch=Batch(cols, out[1]),
     )
 
 
@@ -111,22 +122,51 @@ def expand(table: BuildTable, probe: Batch, key_names,
     if build_keys is not None:
         assert len(build_keys) == len(key_names), \
             "probe/build key lists must have equal length"
-    return _expand(table, probe, tuple(key_names), lo, hi, counts,
-                   probe_key_valid, out_capacity, join_type,
-                   tuple(probe_output if probe_output is not None
-                         else probe.names),
-                   tuple(build_output if build_output is not None
-                         else table.batch.names),
-                   probe_prefix, build_prefix,
-                   tuple(build_keys) if build_keys is not None
-                   else tuple(key_names))
+    out, _ = _expand(table, probe, tuple(key_names), lo, hi, counts,
+                     probe_key_valid, out_capacity, join_type,
+                     tuple(probe_output if probe_output is not None
+                           else probe.names),
+                     tuple(build_output if build_output is not None
+                           else table.batch.names),
+                     probe_prefix, build_prefix,
+                     tuple(build_keys) if build_keys is not None
+                     else tuple(key_names))
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+def probe_join(table: BuildTable, probe: Batch,
+               key_names: Tuple[str, ...], out_capacity: int,
+               join_type: str, probe_output: Tuple[str, ...],
+               build_output: Tuple[str, ...],
+               build_keys: Tuple[str, ...]
+               ) -> Tuple[Batch, jnp.ndarray, jnp.ndarray]:
+    """Fused probe: candidate runs + expansion in ONE dispatch, with NO
+    host sync — the output capacity is chosen by the CALLER (typically
+    probe capacity x an expansion factor). Returns (output batch,
+    overflow flag, live output rows), all on device:
+
+    - `overflow` records whether the true output exceeded out_capacity;
+      the operator accumulates it across batches and the runner checks
+      ONCE per query, retrying with a larger factor (the same sync-free
+      protocol as GroupLimitExceeded — reference analog:
+      LookupJoinOperator.java:392's per-page yield loop, minus the
+      pointer-chased page builder).
+    - the live-row count backs the operator's one-round-delayed
+      output compaction (its d2h copy starts immediately, so the read
+      a driver round later is normally a cache hit)."""
+    lo, hi, counts, pkv = probe_counts(table, probe, key_names)
+    out, overflow = _expand(table, probe, key_names, lo, hi, counts,
+                            pkv, out_capacity, join_type, probe_output,
+                            build_output, "", "", build_keys)
+    return out, overflow, jnp.sum(out.row_valid)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 7, 8, 9, 10, 11, 12, 13))
 def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
             probe_key_valid, out_capacity: int, join_type: str,
             probe_output, build_output, probe_prefix, build_prefix,
-            build_keys) -> Batch:
+            build_keys) -> Tuple[Batch, jnp.ndarray]:
     left_join = join_type == "left"
     # per-probe emitted rows: matches, or 1 unmatched row for LEFT
     emit = counts
@@ -143,8 +183,9 @@ def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
     k = slots - cum[pid]                      # k-th emission of that row
     slot_live = slots < total
     is_match = slot_live & (k < counts[pid])
-    bslot = jnp.clip(lo[pid] + k, 0, table.sorted_hash.shape[0] - 1)
-    brow = table.sorted_row[bslot]
+    # build rows are stored in sorted-hash order: the candidate slot IS
+    # the row index (near-contiguous gathers within each hash run)
+    brow = jnp.clip(lo[pid] + k, 0, table.sorted_hash.shape[0] - 1)
 
     # verify actual keys (hash collisions -> mask out)
     verified = is_match
@@ -177,17 +218,20 @@ def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
         bmask = c.mask[brow] & verified  # NULL build side on unmatched
         cols[build_prefix + name] = Column(c.data[brow], bmask, c.type,
                                            c.dictionary)
-    return Batch(cols, live)
+    return Batch(cols, live), total > out_capacity
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def semi_mark(table: BuildTable, probe: Batch, key_names: Tuple[str, ...],
               build_keys: Optional[Tuple[str, ...]] = None):
-    """For each probe row: does any build row share its key? Verified
-    exactly by scanning the (short) candidate run with a bounded loop of
-    gathers — runs are capped via MAX_RUN; longer runs fall back to
-    hash-equality (duplicates in build make long runs of identical keys,
-    for which hash equality IS key equality modulo collisions)."""
+    """For each probe row: does any build row share its key? EXACT for
+    every run length (reference: HashSemiJoinOperator is always exact):
+    the first UNROLL candidates are verified with straight-line gathers
+    (covers almost all runs — duplicates in a semi build are rare), and
+    any still-unresolved longer runs are scanned to their true end by an
+    on-device `lax.while_loop` — no host sync, no hash-equality
+    shortcut, so engineered 64-bit hash collisions cannot produce a
+    false IN/EXISTS match."""
     build_keys = build_keys or key_names
     assert len(build_keys) == len(key_names), \
         "probe/build key lists must have equal length"
@@ -198,18 +242,33 @@ def semi_mark(table: BuildTable, probe: Batch, key_names: Tuple[str, ...],
     h = common.row_hash(keys)
     lo = jnp.searchsorted(table.sorted_hash, h, side="left")
     hi = jnp.searchsorted(table.sorted_hash, h, side="right")
-    MAX_RUN = 4
-    found = jnp.zeros_like(valid)
-    for i in range(MAX_RUN):
-        slot = jnp.clip(lo + i, 0, table.sorted_hash.shape[0] - 1)
+    bcols = [table.batch.columns[bn].astuple() for bn in build_keys]
+    nbuild = table.sorted_hash.shape[0]
+
+    def check_at(i, found):
+        """found |= (probe key == build key at run offset i)."""
+        brow = jnp.clip(lo + i, 0, nbuild - 1)
         in_run = (lo + i) < hi
-        brow = table.sorted_row[slot]
-        same = in_run
-        for (pd, pm), bn in zip(keys, build_keys):
-            bd, bm = table.batch.columns[bn].astuple()
+        same = in_run & valid
+        for (pd, pm), (bd, bm) in zip(keys, bcols):
             same = same & (pd == bd[brow]) & pm & bm[brow]
-        found = found | same
-    # long runs: treat hash-run membership as a match (collision risk
-    # bounded by 64-bit hash; exact for duplicate-heavy build keys)
-    found = found | ((hi - lo) > MAX_RUN)
+        return found | same
+
+    UNROLL = 4
+    found = jnp.zeros_like(valid)
+    for i in range(UNROLL):
+        found = check_at(i, found)
+
+    def cond(state):
+        i, found = state
+        # a row still needs scanning while its run extends past i and
+        # no match has been confirmed yet
+        return jnp.any(((lo + i) < hi) & valid & ~found)
+
+    def body(state):
+        i, found = state
+        return i + 1, check_at(i, found)
+
+    _, found = jax.lax.while_loop(
+        cond, body, (jnp.asarray(UNROLL, jnp.int32), found))
     return found & valid, valid

@@ -23,13 +23,28 @@ from presto_tpu.ops import common
 def sort_batch(batch: Batch, key_names: Tuple[str, ...],
                descending: Tuple[bool, ...],
                nulls_first: Tuple[bool, ...]) -> Batch:
-    """Reorder rows into key order, invalid rows compacted to the end."""
+    """Reorder rows into key order, invalid rows compacted to the end.
+
+    ONE variadic sort HLO carries every column (data + mask) through
+    the sorting network — no argsort permutation, no per-column random
+    gathers (each ~0.8s/1M rows on TPU)."""
     keys = [batch.columns[k].astuple() for k in key_names]
-    perm = common.lex_order(keys, list(descending), list(nulls_first),
-                            valid=batch.row_valid)
-    cols = {n: Column(c.data[perm], c.mask[perm], c.type, c.dictionary)
-            for n, c in batch.columns.items()}
-    return Batch(cols, batch.row_valid[perm])
+    other = [n for n in batch.names if n not in key_names]
+    payloads: list = []
+    for n in other:
+        payloads.extend(batch.columns[n].astuple())
+    skeys, svalid, spay = common.sort_rows(
+        keys, list(descending), list(nulls_first),
+        valid=batch.row_valid, payloads=payloads)
+    cols = {}
+    for name, (d, m) in zip(key_names, skeys):
+        c = batch.columns[name]
+        cols[name] = Column(d, m, c.type, c.dictionary)
+    for i, name in enumerate(other):
+        c = batch.columns[name]
+        cols[name] = Column(spay[2 * i], spay[2 * i + 1], c.type,
+                            c.dictionary)
+    return Batch({n: cols[n] for n in batch.names}, svalid)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
@@ -78,7 +93,10 @@ def distinct_state(schema_cols, capacity: int) -> Batch:
 @jax.jit
 def distinct_step(state: Batch, batch: Batch) -> Batch:
     """Fold step for SELECT DISTINCT / set-union dedup: re-group
-    state ++ batch by all columns, keep one representative per group."""
+    state ++ batch by all columns, keep one representative per group
+    (hashagg._group_reduce with zero aggregates — one variadic sort,
+    packed representatives, no argsort/gather chains)."""
+    from presto_tpu.ops import hashagg
     cap = state.capacity
     names = state.names
     merged_cols = {}
@@ -89,18 +107,9 @@ def distinct_step(state: Batch, batch: Batch) -> Batch:
             jnp.concatenate([sc.mask, bc.mask]), sc.type, sc.dictionary)
     valid = jnp.concatenate([state.row_valid, batch.row_valid])
     keys = [merged_cols[n].astuple() for n in names]
-    perm = common.lex_order(keys, valid=valid)
-    sorted_keys = common.take(keys, perm)
-    sorted_valid = valid[perm]
-    bnd = common.boundaries(sorted_keys, sorted_valid)
-    # compact representatives to the front before slicing to cap —
-    # duplicate runs would otherwise push later groups past the slice
-    pack = jnp.argsort(~bnd, stable=True)
-    live = bnd[pack]
+    gr = hashagg._group_reduce(keys, valid, [], [], cap)
     cols = {}
-    for name in names:
+    for name, (d, m) in zip(names, gr.keys):
         sc = merged_cols[name]
-        d = sc.data[perm][pack][:cap]
-        m = sc.mask[perm][pack][:cap] & live[:cap]
         cols[name] = Column(d, m, sc.type, sc.dictionary)
-    return Batch(cols, live[:cap])
+    return Batch(cols, gr.valid)

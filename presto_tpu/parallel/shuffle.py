@@ -254,7 +254,10 @@ def wave_repartition(mesh: Mesh, batches, key_names,
     """
     w = len(batches)
     assert w == mesh.shape[axis]
-    cap = max(b.capacity for b in batches)
+    from presto_tpu.batch import quantized_capacity
+    # quantized wave capacity: the whole shard_map program recompiles
+    # per distinct shape, so waves ride a coarse capacity ladder
+    cap = quantized_capacity(max(b.capacity for b in batches))
     batches = [b if b.capacity == cap else b.compact(cap)
                for b in batches]
     names = batches[0].names
@@ -286,10 +289,12 @@ def wave_repartition(mesh: Mesh, batches, key_names,
     out_datas, out_masks, out_valid, counts = fn(
         g_valid, tuple(key_datas), tuple(key_masks), g_datas, g_masks)
 
+    from presto_tpu.batch import quantized_capacity
     counts = np.asarray(counts)  # ONE host sync per wave
     out = []
     for c in range(w):
-        cap2 = bucket_capacity(max(int(counts[c]), 1))
+        shard_len = _shard(out_valid, c).shape[0]
+        cap2 = min(quantized_capacity(int(counts[c])), shard_len)
         cols = {}
         for n, gd, gm in zip(names, out_datas, out_masks):
             col = tmpl.columns[n]

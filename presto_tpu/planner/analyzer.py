@@ -681,7 +681,8 @@ def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
 
 #: ranking / positional window functions (aggregates also allowed OVER)
 WINDOW_FUNCTIONS = {"rank", "dense_rank", "row_number", "lag", "lead",
-                    "first_value", "last_value"}
+                    "first_value", "last_value", "ntile",
+                    "percent_rank", "cume_dist", "nth_value"}
 
 
 def _collect_window_calls(node, out: List[T.FunctionCall]):
@@ -702,20 +703,47 @@ def _collect_window_calls(node, out: List[T.FunctionCall]):
                         _collect_window_calls(x, out)
 
 
-def _window_frame_mode(w: T.WindowSpec) -> str:
-    """Map a frame clause to the kernel's mode (ops/window.py);
-    reference: WindowFrame defaults in SqlBase.g4 / StatementAnalyzer."""
-    from presto_tpu.ops import window as wk
+def _parse_frame_bound(s: str, is_start: bool):
+    """Parser bound string -> kernel encoding ("u" | "c" | signed
+    offset; PRECEDING is negative)."""
+    if s == "unbounded preceding":
+        if not is_start:
+            raise AnalysisError(
+                "frame end cannot be UNBOUNDED PRECEDING")
+        return "u"
+    if s == "unbounded following":
+        if is_start:
+            raise AnalysisError(
+                "frame start cannot be UNBOUNDED FOLLOWING")
+        return "u"
+    if s == "current row":
+        return "c"
+    n_str, _, kind = s.rpartition(" ")
+    try:
+        n = float(n_str)
+        n = int(n) if n == int(n) else n
+    except ValueError:
+        raise AnalysisError(f"invalid frame bound {s!r}") from None
+    if n < 0:
+        raise AnalysisError("frame offset must be non-negative")
+    return -n if kind == "preceding" else n
+
+
+def _window_frame(w: T.WindowSpec):
+    """Frame clause -> (mode, start, end) for the kernel (reference:
+    WindowFrame defaults in SqlBase.g4 / StatementAnalyzer: RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW when ORDER BY is present)."""
     if not w.order_by:
-        return wk.FULL
+        return ("rows", "u", "u")
     if w.frame is None:
-        return wk.RANGE_RUNNING  # SQL default with ORDER BY
+        return ("range", "u", "c")
     ftype, start, end = w.frame
-    if start == "unbounded preceding" and end == "unbounded following":
-        return wk.FULL
-    if start == "unbounded preceding" and end == "current row":
-        return wk.ROWS_RUNNING if ftype == "rows" else wk.RANGE_RUNNING
-    raise AnalysisError(f"unsupported window frame {w.frame}")
+    fs = _parse_frame_bound(start, True)
+    fe = _parse_frame_bound(end, False)
+    if ftype == "rows":
+        if any(isinstance(b, float) for b in (fs, fe)):
+            raise AnalysisError("ROWS frame offsets must be integers")
+    return (ftype, fs, fe)
 
 
 def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
@@ -765,12 +793,32 @@ def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
             desc.append(d)
             nf.append(item.nulls_first if item.nulls_first is not None
                       else d)
-        frame = _window_frame_mode(w)
+        fmode, fstart, fend = _window_frame(w)
 
         def field_of(sym: str) -> N.Field:
             # proj_fields grows as to_symbol projects helper columns —
             # resolve at call time, not from a snapshot
             return next(f for f in proj_fields if f.symbol == sym)
+
+        if fmode == "range" and (isinstance(fstart, (int, float))
+                                 or isinstance(fend, (int, float))):
+            # value-based RANGE offsets: SQL requires exactly one
+            # numeric/date order key
+            if len(order_syms) != 1:
+                raise AnalysisError(
+                    "RANGE with an offset requires exactly one ORDER "
+                    "BY key")
+            okt = field_of(order_syms[0])
+            if okt.dictionary is not None or okt.type.is_string:
+                raise AnalysisError(
+                    "RANGE offsets require a numeric or date ORDER BY "
+                    "key")
+
+        def const_arg(ast, what: str):
+            e = fold_constants(an.analyze(ast))
+            if not isinstance(e, Literal):
+                raise AnalysisError(f"{what} must be a constant")
+            return e.value
 
         wcalls: List[N.WindowCall] = []
         call_fields: List[N.Field] = []
@@ -779,39 +827,83 @@ def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
             if c.distinct:
                 raise AnalysisError(
                     f"DISTINCT is not supported in window {name}")
-            if c.filter is not None:
-                raise AnalysisError(
-                    "FILTER is not supported on window functions")
             if name not in WINDOW_FUNCTIONS and \
                     name not in AGG_FUNCTIONS:
                 raise AnalysisError(f"unknown window function {name}")
             offset = 1
             arg_sym = None
-            if name in ("rank", "dense_rank", "row_number"):
+            filter_sym = None
+            default = None
+            is_agg = name in ("sum", "avg", "count", "min", "max")
+            if c.filter is not None:
+                if not is_agg:
+                    raise AnalysisError(
+                        "FILTER is only supported on aggregate window "
+                        "functions")
+                filter_sym = to_symbol(c.filter, "wfilter")
+            if name in ("rank", "dense_rank", "row_number",
+                        "percent_rank", "cume_dist"):
                 if c.args:
                     raise AnalysisError(f"{name}() takes no arguments")
-                out_type: Type = BIGINT
-                cframe = frame
-            elif name in ("lag", "lead", "first_value", "last_value"):
+                out_type: Type = DOUBLE \
+                    if name in ("percent_rank", "cume_dist") else BIGINT
+                if name != "row_number" and not w.order_by:
+                    raise AnalysisError(f"{name} requires ORDER BY")
+            elif name == "ntile":
+                if len(c.args) != 1:
+                    raise AnalysisError("ntile(n) takes one argument")
+                n_val = const_arg(c.args[0], "ntile bucket count")
+                if not isinstance(n_val, int) or n_val <= 0:
+                    raise AnalysisError(
+                        "ntile bucket count must be a positive integer")
+                offset = n_val
+                out_type = BIGINT
+            elif name in ("lag", "lead", "first_value", "last_value",
+                          "nth_value"):
                 if not c.args:
                     raise AnalysisError(f"{name} requires an argument")
                 if not w.order_by:
                     raise AnalysisError(f"{name} requires ORDER BY")
                 arg_sym = to_symbol(c.args[0], name)
-                if name in ("lag", "lead") and len(c.args) > 2:
-                    raise AnalysisError(
-                        f"{name} default-value argument is not "
-                        "supported yet (use coalesce around the call)")
-                if name in ("lag", "lead") and len(c.args) > 1:
-                    off = fold_constants(an.analyze(c.args[1]))
-                    if not isinstance(off, Literal):
+                if name == "nth_value":
+                    if len(c.args) != 2:
                         raise AnalysisError(
-                            f"{name} offset must be a constant")
-                    offset = int(off.value)
+                            "nth_value(x, n) takes two arguments")
+                    n_val = const_arg(c.args[1], "nth_value position")
+                    if not isinstance(n_val, int) or n_val <= 0:
+                        raise AnalysisError(
+                            "nth_value position must be a positive "
+                            "integer")
+                    offset = n_val
+                if name in ("lag", "lead") and len(c.args) > 1:
+                    offset = const_arg(c.args[1], f"{name} offset")
+                    if not isinstance(offset, int):
+                        raise AnalysisError(
+                            f"{name} offset must be an integer")
+                if name in ("lag", "lead") and len(c.args) > 2:
+                    default = const_arg(c.args[2],
+                                        f"{name} default value")
+                    af = field_of(arg_sym)
+                    if default is not None:
+                        if af.dictionary is not None:
+                            if not isinstance(default, str):
+                                raise AnalysisError(
+                                    f"{name} default must be a string "
+                                    "for a varchar argument")
+                        elif isinstance(default, str):
+                            raise AnalysisError(
+                                f"{name} default type does not match "
+                                "the argument")
+                        elif isinstance(default, float) \
+                                and af.type.np_dtype.kind in "iu":
+                            if default != int(default):
+                                raise AnalysisError(
+                                    f"{name} default must be integral "
+                                    "for an integer argument")
+                            default = int(default)
                 out_type = field_of(arg_sym).type
-                cframe = frame
             else:  # aggregate OVER
-                if name not in ("sum", "avg", "count", "min", "max"):
+                if not is_agg:
                     raise AnalysisError(
                         f"{name} is not supported as a window function")
                 if c.is_star or not c.args:
@@ -827,12 +919,18 @@ def _plan_windows(calls: List[T.FunctionCall], rp: RelationPlan,
                     arg_sym = to_symbol(a_ast, name)
                     arg_type = field_of(arg_sym).type
                 out_type = _agg_output_type(name, arg_type)
-                cframe = frame
             sym = ctx.symbols.new(name)
             dic = field_of(arg_sym).dictionary \
                 if arg_sym and out_type.is_string else None
-            wcalls.append(N.WindowCall(sym, name, arg_sym, cframe,
-                                       out_type, offset))
+            if isinstance(default, str) and dic is not None \
+                    and default not in dic:
+                # the output dictionary grows to hold the default;
+                # input codes stay valid under suffix extension
+                dic = tuple(dic) + (default,)
+            wcalls.append(N.WindowCall(
+                sym, name, arg_sym, fmode, out_type, offset,
+                frame_start=fstart, frame_end=fend, filter=filter_sym,
+                default=default))
             call_fields.append(N.Field(sym, out_type, dic))
             out_rewrites[_ast_key(c)] = (sym, out_type, dic)
 
@@ -2414,6 +2512,14 @@ class _Analyzer:
             return Call("date_trunc", tuple(args), DATE)
         if name == "hash_code":
             return Call("hash_code", tuple(args), BIGINT)
+        if name in ("nan", "infinity") and not args:
+            # zero-arg IEEE constants (reference: MathFunctions.java)
+            return Literal(float("nan") if name == "nan"
+                           else float("inf"), DOUBLE)
+        if name == "is_nan":
+            return Call("is_nan", tuple(args), BOOLEAN)
+        if name in ("is_finite", "is_infinite"):
+            return Call(name, tuple(args), BOOLEAN)
         raise AnalysisError(f"unknown function {name!r}")
 
     def _an_InSubquery(self, a):

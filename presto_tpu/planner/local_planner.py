@@ -77,6 +77,31 @@ def _schema_of(node: N.PlanNode) -> Dict[str, ColumnSchema]:
             for f in node.output}
 
 
+def _trace_scan_column(node: N.PlanNode, symbol: str, shared=frozenset()):
+    """Follow `symbol` down through filters and identity projections to
+    the TableScanNode that produces it; None when anything else (a
+    join, aggregation, or exchange boundary) intervenes, or when any
+    node on the path is SHARED (a spooled subtree also feeds other
+    consumers — a join-specific filter there would corrupt them)."""
+    from presto_tpu.expr.ir import InputRef
+    while True:
+        if id(node) in shared:
+            return None
+        if isinstance(node, N.TableScanNode):
+            return (node, symbol) if symbol in node.assignments else None
+        if isinstance(node, N.FilterNode):
+            node = node.source
+            continue
+        if isinstance(node, N.ProjectNode):
+            expr = dict(node.assignments).get(symbol)
+            if isinstance(expr, InputRef):
+                symbol = expr.name
+                node = node.source
+                continue
+            return None
+        return None
+
+
 class LocalExecutionPlanner:
     def __init__(self, catalog_manager, session,
                  task: Optional[TaskContext] = None):
@@ -87,6 +112,13 @@ class LocalExecutionPlanner:
         self._op_id = 0
         self._shared: set = set()
         self._spools: Dict[int, misc_ops.Spool] = {}
+        # dynamic filtering: per-plan registry + scan-node -> [(scan
+        # symbol, df_id)] wiring discovered while visiting inner joins
+        from presto_tpu.execution.dynamic_filters import (
+            DynamicFilterRegistry,
+        )
+        self._df_registry = DynamicFilterRegistry()
+        self._df_scans: Dict[int, List] = {}
 
     def _next_id(self) -> int:
         self._op_id += 1
@@ -191,7 +223,8 @@ class LocalExecutionPlanner:
                         b = _jax.device_put(b, task.device)
                     yield b
         pipe.append(TableScanOperatorFactory(
-            self._next_id(), f"scan:{handle.table}", batch_iter))
+            self._next_id(), f"scan:{handle.table}", batch_iter,
+            df_specs=self._df_scans.get(id(node))))
 
     def _visit_RemoteSourceNode(self, node, pipe: List):
         from presto_tpu.operators.exchange_ops import (
@@ -272,9 +305,27 @@ class LocalExecutionPlanner:
             specs.append(AggSpec(a.out_symbol, fn, arg_ce, mask_ce))
         max_groups = int(get_property(self.session.properties,
                                       "max_groups"))
+        # stats-driven sizing (reference: the planner's NDV-based
+        # memory planning): a group-by whose estimated cardinality
+        # exceeds the session default starts with a big-enough table
+        # instead of paying log4(groups/default) whole-query retries
+        est = self._estimated_groups(node)
+        if est is not None and est * 2 > max_groups:
+            max_groups = min(int(est * 2), 1 << 26)
         pipe.append(AggregationOperatorFactory(
             self._next_id(), key_names, key_exprs, specs, node.step,
             max_groups, input_dicts=_schema_dicts(schema)))
+
+    def _estimated_groups(self, node: N.AggregationNode):
+        """Estimated distinct groups, or None when unknowable."""
+        try:
+            from presto_tpu.planner.stats import (
+                StatsEstimator, UNKNOWN_ROWS,
+            )
+            est = StatsEstimator(self.catalogs).estimate(node).rows
+        except Exception:
+            return None
+        return est if est < UNKNOWN_ROWS * 0.99 else None
 
     @staticmethod
     def _make_agg(a: N.AggCall, arg_ce: Optional[CompiledExpr]):
@@ -304,13 +355,18 @@ class LocalExecutionPlanner:
                 jt = "left"
             bridge = JoinBridge()
             key_dicts = _unified_key_dicts(probe, build, criteria)
+            df_publish = self._plan_dynamic_filters(
+                probe, build, criteria) if jt == "inner" else None
             build_pipe = []
             self._visit(build, build_pipe)
             build_pipe.append(HashBuildOperatorFactory(
                 self._next_id(), bridge, [r for _, r in criteria],
                 key_dicts,
                 schema_cols=[(f.symbol, f.type, f.dictionary)
-                             for f in build.output]))
+                             for f in build.output],
+                spillable=bool(get_property(self.session.properties,
+                                            "spill_enabled")),
+                df_publish=df_publish))
             self._pipelines.append(build_pipe)
             self._visit(probe, pipe)
             pipe.append(LookupJoinOperatorFactory(
@@ -319,7 +375,9 @@ class LocalExecutionPlanner:
                 probe_output=[f.symbol for f in probe.output],
                 build_output=[f.symbol for f in build.output],
                 build_keys=[r for _, r in criteria],
-                key_dicts=key_dicts))
+                key_dicts=key_dicts,
+                expansion_factor=int(get_property(
+                    self.session.properties, "join_expansion_factor"))))
         else:
             raise LocalPlanningError(
                 f"{node.join_type} join not supported yet")
@@ -333,6 +391,30 @@ class LocalExecutionPlanner:
             pipe.append(FilterProjectOperatorFactory(
                 self._next_id(), pred, projections,
                 _schema_dicts(schema)))
+
+    def _plan_dynamic_filters(self, probe, build, criteria):
+        """For an INNER join, wire build-key min/max bounds to probe-
+        side scans in THIS fragment (reference: the dynamic-filter
+        planner rules; mesh plans hit this exactly on broadcast/star
+        joins, where the scan and join are co-fragment)."""
+        if not bool(get_property(self.session.properties,
+                                 "dynamic_filtering")):
+            return None
+        build_fields = {f.symbol: f for f in build.output}
+        publish = []
+        for l, r in criteria:
+            bf = build_fields.get(r)
+            if bf is None or bf.dictionary is not None:
+                continue  # numeric/date keys only
+            traced = _trace_scan_column(probe, l, self._shared)
+            if traced is None:
+                continue
+            scan_node, scan_sym = traced
+            df_id = self._df_registry.new_id()
+            publish.append((r, df_id, self._df_registry))
+            self._df_scans.setdefault(id(scan_node), []).append(
+                (scan_sym, df_id, self._df_registry))
+        return publish or None
 
     def _visit_SemiJoinNode(self, node: N.SemiJoinNode, pipe: List):
         bridge = JoinBridge()
@@ -385,15 +467,23 @@ class LocalExecutionPlanner:
         from presto_tpu.ops.window import WindowCallSpec
         self._visit(node.source, pipe)
         src_schema = _schema_of(node.source)
+        out_fields = {f.symbol: f for f in node.output}
         calls = []
         for c in node.calls:
             out_dict = None
+            default = c.default
             if c.argument is not None and c.output_type is not None \
                     and c.output_type.is_string:
-                out_dict = src_schema[c.argument].dictionary
+                # the call's OUTPUT field carries the (possibly
+                # default-extended) dictionary the analyzer chose
+                out_dict = out_fields[c.out_symbol].dictionary
+                if isinstance(default, str) and out_dict is not None:
+                    default = out_dict.index(default)
             calls.append(WindowCallSpec(
                 c.out_symbol, c.function, c.argument, c.frame,
-                c.output_type, out_dict, c.offset))
+                c.output_type, out_dict, c.offset,
+                fstart=c.frame_start, fend=c.frame_end,
+                filter_arg=c.filter, default=default))
         pipe.append(WindowOperatorFactory(
             self._next_id(), node.partition_by, node.order_by,
             node.descending, node.nulls_first, calls))
@@ -640,7 +730,8 @@ def _child_demand(node: N.PlanNode, demand: set
     if isinstance(node, N.WindowNode):
         child = (demand - {c.out_symbol for c in node.calls}) \
             | set(node.partition_by) | set(node.order_by) \
-            | {c.argument for c in node.calls if c.argument}
+            | {c.argument for c in node.calls if c.argument} \
+            | {c.filter for c in node.calls if c.filter}
         return [(node.source, child)]
     if isinstance(node, N.TopNRowNumberNode):
         child = (demand - {node.row_number_symbol}) \

@@ -259,9 +259,13 @@ class WindowCall:
     out_symbol: str
     function: str                  # rank|dense_rank|row_number|lag|...
     argument: Optional[str]        # source symbol (pre-projected)
-    frame: str                     # ops.window FULL/ROWS/RANGE mode
+    frame: str                     # ops.window mode ("rows"/"range"/legacy)
     output_type: Optional[Type] = None
-    offset: int = 1                # lag/lead distance
+    offset: int = 1                # lag/lead distance; ntile/nth_value N
+    frame_start: object = "u"      # "u" | "c" | signed offset
+    frame_end: object = "c"
+    filter: Optional[str] = None   # FILTER (WHERE ...) bool symbol
+    default: object = None         # lag/lead constant default
 
 
 @dataclasses.dataclass

@@ -126,8 +126,54 @@ def _rewrite(node: N.PlanNode, estimator=None) -> N.PlanNode:
         fused = _fuse_topn_row_number(node)
         if fused is not None:
             return fused
+        pushed = _push_filter_through_join(node, estimator)
+        if pushed is not None:
+            return pushed
         return _rewrite_filter(node, estimator)
     return node
+
+
+def _push_filter_through_join(node: N.FilterNode,
+                              estimator=None) -> Optional[N.PlanNode]:
+    """Filter over an explicit JOIN: push single-side conjuncts below
+    the join (reference: PredicatePushDown.java's visitJoin). Inner
+    joins push to both inputs; LEFT joins only to the preserved (left)
+    input — filtering the nullable side above vs below an outer join
+    differs. The pushed filters re-enter _rewrite so they keep sinking
+    through nested joins and onto scan constraints."""
+    src = node.source
+    if not isinstance(src, N.JoinNode) \
+            or src.join_type not in ("inner", "left"):
+        return None
+    left_syms = {f.symbol for f in src.left.output}
+    right_syms = {f.symbol for f in src.right.output}
+    push_left: List[RowExpression] = []
+    push_right: List[RowExpression] = []
+    remaining: List[RowExpression] = []
+    for c in _split_conjuncts(node.predicate):
+        refs = _refs(c)
+        if refs and refs <= left_syms:
+            push_left.append(c)
+        elif refs and refs <= right_syms and src.join_type == "inner":
+            push_right.append(c)
+        else:
+            remaining.append(c)
+    if not push_left and not push_right:
+        return None
+    if push_left:
+        src.left = _rewrite(
+            N.FilterNode(src.left, _combine_conjuncts(push_left),
+                         tuple(src.left.output)), estimator)
+    if push_right:
+        src.right = _rewrite(
+            N.FilterNode(src.right, _combine_conjuncts(push_right),
+                         tuple(src.right.output)), estimator)
+    if remaining:
+        return N.FilterNode(src, _combine_conjuncts(remaining),
+                            node.output)
+    keep = {f.symbol for f in node.output}
+    src.output = tuple(f for f in src.output if f.symbol in keep)
+    return src
 
 
 _RANK_FUNCTIONS = ("row_number", "rank", "dense_rank")

@@ -199,6 +199,7 @@ class LocalRunner:
                   profile: bool = False) -> MaterializedResult:
         from presto_tpu.execution.memory import MemoryPool
         from presto_tpu.operators.aggregation import GroupLimitExceeded
+        from presto_tpu.operators.join_ops import JoinCapacityExceeded
         import time as _time
         session = self.session
         while True:
@@ -228,6 +229,17 @@ class LocalRunner:
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "max_groups": e.suggested})
+                continue
+            except JoinCapacityExceeded as e:
+                # a join emitted more rows than probe capacity x factor
+                # (many-to-many expansion): re-run with the larger factor
+                if e.suggested > 1 << 10:
+                    raise QueryError(
+                        "join expansion exceeds supported factor") from e
+                session = dataclasses.replace(
+                    session, properties={
+                        **session.properties,
+                        "join_expansion_factor": e.suggested})
                 continue
             if profile:
                 # snapshot the stats TEXT now and drop the driver refs:
@@ -281,6 +293,11 @@ class LocalRunner:
                     f"query made no progress for {max_idle_s:.0f}s "
                     "(deadlock?)")
             _time.sleep(0.002)
+        # sync-free error protocol: ONE host fetch for every deferred
+        # device flag (join capacity overflow etc.), after all drivers
+        # finished but before results are trusted
+        from presto_tpu.operators.base import run_deferred_checks
+        run_deferred_checks(dctx)
         for d in drivers:
             d.close()
         return drivers
@@ -453,12 +470,16 @@ class LocalRunner:
                 busy_total += s.busy_seconds
                 mem = peaks.get(tag, 0)
                 mem_s = f"  peak mem: {mem / 1e6:.1f}MB" if mem else ""
+                spill_s = (f"  spilled: {s.spilled_batches} batches/"
+                           f"{s.spilled_bytes / 1e6:.1f}MB"
+                           if s.spilled_batches else "")
                 lines.append(
                     f"  {name} [id={op_id}]  "
                     f"rows: {s.input_rows:,} -> {s.output_rows:,}  "
                     f"batches: {s.input_batches} -> "
                     f"{s.output_batches}  "
-                    f"busy: {s.busy_seconds * 1e3:.1f}ms{mem_s}")
+                    f"busy: {s.busy_seconds * 1e3:.1f}ms{mem_s}"
+                    f"{spill_s}")
         lines.append(f"wall: {wall * 1e3:.1f}ms, "
                      f"operator busy sum: {busy_total * 1e3:.1f}ms")
         if pool is not None and pool.peak:

@@ -48,6 +48,7 @@ class MeshRunner(LocalRunner):
                   profile: bool = False) -> MaterializedResult:
         from presto_tpu.execution.memory import MemoryLimitExceeded
         from presto_tpu.operators.aggregation import GroupLimitExceeded
+        from presto_tpu.operators.join_ops import JoinCapacityExceeded
         prune_unused_columns(plan)
         plan = add_exchanges(plan, self.catalogs, self.session)
         fplan = fragment_plan(plan)
@@ -65,6 +66,14 @@ class MeshRunner(LocalRunner):
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "max_groups": e.suggested})
+            except JoinCapacityExceeded as e:
+                if e.suggested > 1 << 10:
+                    raise QueryError(
+                        "join expansion exceeds supported factor") from e
+                session = dataclasses.replace(
+                    session, properties={
+                        **session.properties,
+                        "join_expansion_factor": e.suggested})
             except MemoryLimitExceeded as e:
                 # grouped (bucket-wise) execution retry: split the hash
                 # space into lifespans so only 1/G of each shuffled
@@ -208,6 +217,8 @@ class MeshRunner(LocalRunner):
                                remaining_lifespans, exchanges,
                                spawn_fragment,
                                stat_snaps if profile else None)
+            from presto_tpu.operators.base import run_deferred_checks
+            run_deferred_checks(dctx)
         finally:
             # spill files must never outlive the query, error or not
             self._last_spilled_pages = sum(

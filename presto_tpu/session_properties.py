@@ -50,6 +50,22 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "with 4x (reference: MultiChannelGroupByHash rehash)",
         _positive),
     PropertyDef(
+        "dynamic_filtering", "boolean", True,
+        "Inner-join build-side key bounds prune probe-side scans in "
+        "the same fragment (reference: enable-dynamic-filtering)"),
+    PropertyDef(
+        "spill_enabled", "boolean", True,
+        "Allow memory revocation: join builds and buffered aggregation "
+        "partials spill to host RAM under HBM pressure instead of "
+        "failing or retrying bucket-wise (reference: "
+        "experimental.spill-enabled)"),
+    PropertyDef(
+        "join_expansion_factor", "bigint", 1,
+        "Join output capacity as a multiple of probe batch capacity "
+        "(1 is exact for FK->PK joins); on-device overflow detection "
+        "retries the query with 4x (sync-free, like max_groups)",
+        _positive),
+    PropertyDef(
         "broadcast_join_threshold_rows", "bigint", 100_000,
         "Estimated build rows at or below which a join broadcasts "
         "instead of repartitioning (reference: join-distribution "

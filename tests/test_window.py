@@ -94,6 +94,84 @@ WINDOW_QUERIES = {
                                     order by acctbal desc) rn
           from customer) t
         where rn <= 2 order by nationkey, rn""",
+    # -- general frames + round-3 function additions ------------------
+    "rows_between_sliding": """
+        select orderkey, linenumber, quantity,
+               sum(quantity) over (partition by orderkey
+                                   order by linenumber
+                                   rows between 2 preceding
+                                            and 1 following) s,
+               min(quantity) over (partition by orderkey
+                                   order by linenumber
+                                   rows between 2 preceding
+                                            and 1 following) lo,
+               max(quantity) over (partition by orderkey
+                                   order by linenumber
+                                   rows between 1 preceding
+                                            and 2 following) hi,
+               count(*) over (partition by orderkey
+                              order by linenumber
+                              rows between 1 following
+                                       and 2 following) c
+        from lineitem where orderkey < 200
+        order by orderkey, linenumber""",
+    "rows_current_to_unbounded": """
+        select orderkey, linenumber, quantity,
+               sum(quantity) over (partition by orderkey
+                                   order by linenumber
+                                   rows between current row
+                                            and unbounded following) s
+        from lineitem where orderkey < 200
+        order by orderkey, linenumber""",
+    "range_value_offsets": """
+        select nationkey, acctbal,
+               count(*) over (partition by nationkey
+                              order by acctbal
+                              range between 100 preceding
+                                        and 100 following) near,
+               sum(acctbal) over (partition by nationkey
+                                  order by acctbal
+                                  range between 500 preceding
+                                           and current row) s
+        from customer where nationkey < 4
+        order by nationkey, acctbal""",
+    "ntile_percent_cume": """
+        select nationkey, acctbal,
+               ntile(4) over (partition by nationkey
+                              order by acctbal) nt,
+               percent_rank() over (partition by nationkey
+                                    order by acctbal) pr,
+               cume_dist() over (partition by nationkey
+                                 order by acctbal) cd
+        from customer where nationkey < 4
+        order by nationkey, acctbal""",
+    "nth_value_frames": """
+        select orderkey, linenumber, quantity,
+               nth_value(quantity, 2) over (partition by orderkey
+                                            order by linenumber) nv,
+               last_value(quantity) over (partition by orderkey
+                                          order by linenumber
+                                          rows between current row
+                                               and unbounded following
+                                          ) lv
+        from lineitem where orderkey < 150
+        order by orderkey, linenumber""",
+    "lag_lead_default": """
+        select orderkey, linenumber, quantity,
+               lag(quantity, 1, -1.0) over (partition by orderkey
+                                            order by linenumber) pq,
+               lead(quantity, 2, -7.0) over (partition by orderkey
+                                             order by linenumber) nq
+        from lineitem where orderkey < 150
+        order by orderkey, linenumber""",
+    "window_filter_clause": """
+        select orderkey, linenumber, quantity,
+               sum(quantity) filter (where linenumber > 1)
+                   over (partition by orderkey order by linenumber) s,
+               count(*) filter (where quantity > 25)
+                   over (partition by orderkey) c
+        from lineitem where orderkey < 200
+        order by orderkey, linenumber""",
 }
 
 
@@ -123,3 +201,57 @@ def test_window_on_mesh(name, oracle):  # noqa: F811
     exp = [tuple(r) for r in oracle.execute(to_sqlite(sql)).fetchall()]
     assert_rows_equal(got, exp, name, ordered=True)
     jax.clear_caches()
+
+
+def test_window_float_sum_nan_isolation(runner):  # noqa: F811
+    """A NaN must poison ONLY the frames that contain it — the framed
+    float sum cannot be a bare cumsum difference (x - NaN = NaN would
+    leak into every later frame)."""
+    import math
+    runner.execute("drop table if exists memory.default.wnan")
+    runner.execute(
+        "create table memory.default.wnan as select "
+        "orderkey k, cast(orderkey as double) v from orders "
+        "where orderkey < 40")
+    runner.execute(
+        "insert into memory.default.wnan values (0, nan())")
+    rows = runner.execute("""
+        select k, sum(v) over (order by k
+                               rows between 1 preceding
+                                        and current row) s
+        from memory.default.wnan order by k""").rows()
+    assert math.isnan(rows[0][1])        # the NaN row itself
+    assert math.isnan(rows[1][1])        # frame includes the NaN row
+    for k, s in rows[2:]:
+        assert not math.isnan(s), (k, s)
+        assert s == 2 * k - 1, (k, s)
+    runner.execute("drop table memory.default.wnan")
+
+
+def test_lag_default_string_and_type_checks(runner):  # noqa: F811
+    """String defaults ride the dictionary (extending it when new);
+    mismatched default types are rejected at analysis."""
+    rows = runner.execute("""
+        select nationkey,
+               lag(name, 1, 'FIRST!') over (order by nationkey) p
+        from nation where nationkey < 3 order by nationkey""").rows()
+    assert rows[0][1] == "FIRST!"
+    assert rows[1][1] == "ALGERIA"
+    from presto_tpu.runner import QueryError
+    import pytest as _pytest
+    with _pytest.raises(QueryError, match="default"):
+        runner.execute("select lag(name, 1, 7) over (order by "
+                       "nationkey) from nation")
+    with _pytest.raises(QueryError, match="integral"):
+        runner.execute("select lag(nationkey, 1, 1.5) over (order by "
+                       "nationkey) from nation")
+
+
+def test_fractional_rows_frame_rejected(runner):  # noqa: F811
+    from presto_tpu.runner import QueryError
+    import pytest as _pytest
+    with _pytest.raises(QueryError, match="integers"):
+        runner.execute("""
+            select sum(acctbal) over (order by custkey
+                rows between 1.5 preceding and current row)
+            from customer""")
