@@ -302,7 +302,9 @@ class _Compiler:
         if name == "like":
             return self._like(e, args)
         if name in _STRING_TO_STRING or name in _STRING_TO_INT \
-                or name in _STRING_TO_BOOL:
+                or name in _STRING_TO_BOOL \
+                or name in _STRING_TO_STRING_NULL \
+                or name in _STRING_TO_INT_NULL:
             return self._string_fn(name, e, args)
         if name == "concat":
             return self._concat(e, args)
@@ -368,6 +370,43 @@ class _Compiler:
                     h = h_i if h is None else _combine_hash(h, h_i)
                 return h, jnp.asarray(True)
             return CompiledExpr(f_hash, BIGINT)
+        if name in ("second", "minute", "hour", "millisecond"):
+            a = args[0]
+            div, mod = {"millisecond": (1, 1000),
+                        "second": (1000, 60),
+                        "minute": (60_000, 60),
+                        "hour": (3_600_000, 24)}[name]
+
+            def f_time(env, div=div, mod=mod):
+                d, m = a.fn(env)
+                return (d.astype(jnp.int64) // div) % mod, m
+            return CompiledExpr(f_time, BIGINT)
+        if name in ("date_add", "date_diff"):
+            return self._date_arith(name, e, args)
+        if name == "last_day_of_month":
+            a = args[0]
+
+            def f_ldom(env):
+                d, m = a.fn(env)
+                return D.last_day_of_month(d), m
+            from presto_tpu.types import DATE as _DATE
+            return CompiledExpr(f_ldom, _DATE)
+        if name == "from_unixtime":
+            a = args[0]
+
+            def f_fut(env):
+                d, m = a.fn(env)
+                return jnp.round(d.astype(jnp.float64) * 1000.0) \
+                    .astype(jnp.int64), m
+            from presto_tpu.types import TIMESTAMP as _TS
+            return CompiledExpr(f_fut, _TS)
+        if name == "to_unixtime":
+            a = args[0]
+
+            def f_tut(env):
+                d, m = a.fn(env)
+                return d.astype(jnp.float64) / 1000.0, m
+            return CompiledExpr(f_tut, DOUBLE)
         if name in ("is_nan", "is_finite", "is_infinite"):
             (a,) = args
             test = {"is_nan": jnp.isnan, "is_finite": jnp.isfinite,
@@ -379,6 +418,81 @@ class _Compiler:
             from presto_tpu.types import BOOLEAN as _B
             return CompiledExpr(f_ieee, _B)
         raise ExpressionCompileError(f"unknown scalar function {name!r}")
+
+    def _date_arith(self, name: str, e: Call, args) -> CompiledExpr:
+        """date_add(unit, n, x) / date_diff(unit, a, b) over DATE
+        (days) or TIMESTAMP (ms) physical values (reference:
+        DateTimeFunctions.java dateAdd/dateDiff; month-family units
+        clamp the day of month)."""
+        unit_lit = e.args[0]
+        if not isinstance(unit_lit, Literal):
+            raise ExpressionCompileError(f"{name} unit must be a "
+                                         "literal")
+        unit = str(unit_lit.value).lower()
+        is_ts = e.args[1 if name == "date_diff" else 2].type.name \
+            == "timestamp"
+        a1, a2 = args[1], args[2]
+
+        DAY_MS = 86_400_000
+        if name == "date_add":
+            if unit in _MONTH_UNITS:
+                k = _MONTH_UNITS[unit]
+
+                def f(env):
+                    nd, nm = a1.fn(env)
+                    xd, xm = a2.fn(env)
+                    if is_ts:
+                        days = jnp.floor_divide(xd, DAY_MS)
+                        tod = xd - days * DAY_MS
+                        out = D.add_months(days, nd * k) * DAY_MS + tod
+                    else:
+                        out = D.add_months(xd, nd * k)
+                    return out, nm & xm
+            else:
+                units = _MS_UNITS if is_ts else _DAY_UNITS
+                if unit not in units:
+                    raise ExpressionCompileError(
+                        f"date_add unit {unit!r} unsupported for "
+                        f"{'timestamp' if is_ts else 'date'}")
+                mult = units[unit]
+
+                def f(env):
+                    nd, nm = a1.fn(env)
+                    xd, xm = a2.fn(env)
+                    return xd + nd * mult, nm & xm
+            return CompiledExpr(f, e.type)
+
+        # date_diff(unit, a, b) = b - a in unit, truncated toward zero
+        if unit in _MONTH_UNITS:
+            k = _MONTH_UNITS[unit]
+
+            def f(env):
+                ad, am = a1.fn(env)
+                bd, bm = a2.fn(env)
+                if is_ts:
+                    a_days = jnp.floor_divide(ad, DAY_MS)
+                    b_days = jnp.floor_divide(bd, DAY_MS)
+                    months = D.months_between(
+                        a_days, b_days,
+                        a_tie=ad - a_days * DAY_MS,
+                        b_tie=bd - b_days * DAY_MS)
+                else:
+                    months = D.months_between(ad, bd)
+                return jnp.trunc(months / k).astype(jnp.int64), am & bm
+        else:
+            units = _MS_UNITS if is_ts else _DAY_UNITS
+            if unit not in units:
+                raise ExpressionCompileError(
+                    f"date_diff unit {unit!r} unsupported for "
+                    f"{'timestamp' if is_ts else 'date'}")
+            mult = units[unit]
+
+            def f(env):
+                ad, am = a1.fn(env)
+                bd, bm = a2.fn(env)
+                return jnp.trunc((bd - ad) / mult).astype(jnp.int64), \
+                    am & bm
+        return CompiledExpr(f, BIGINT)
 
     def _comparison(self, name: str, e: Call, args) -> CompiledExpr:
         a, b = args
@@ -506,6 +620,47 @@ class _Compiler:
             fn = col.fn
             return CompiledExpr(
                 lambda env: _apply_lookup(fn, tbl, env), BOOLEAN)
+        if name in _STRING_TO_INT_NULL:
+            impl = _STRING_TO_INT_NULL[name]
+            mapped = [impl(v, *lit_args) for v in dic]
+            vals = np.array([0 if v is None else v for v in mapped]
+                            or [0], np.int64)
+            nulls = np.array([v is None for v in mapped] or [True],
+                             bool)
+            tbl = jnp.asarray(vals)
+            ntbl = jnp.asarray(nulls)
+            fn = col.fn
+
+            def f_int_nullable(env):
+                d, m = fn(env)
+                idx = jnp.clip(d.astype(jnp.int32), 0,
+                               tbl.shape[0] - 1)
+                return tbl[idx], m & ~ntbl[idx]
+            return CompiledExpr(f_int_nullable, BIGINT)
+        if name in _STRING_TO_STRING_NULL:
+            # functions that can yield SQL NULL per dictionary value
+            # (regexp no-match, bad JSON path, out-of-range part): a
+            # null table rides next to the code remap and narrows the
+            # result mask
+            impl = _STRING_TO_STRING_NULL[name]
+            mapped = [impl(v, *lit_args) for v in dic]
+            new_dic = tuple(sorted({m for m in mapped
+                                    if m is not None}))
+            index = {v: i for i, v in enumerate(new_dic)}
+            remap = np.array([0 if v is None else index[v]
+                              for v in mapped] or [0], np.int32)
+            nulls = np.array([v is None for v in mapped] or [True],
+                             bool)
+            tbl = jnp.asarray(remap)
+            ntbl = jnp.asarray(nulls)
+            fn = col.fn
+
+            def f_nullable(env):
+                d, m = fn(env)
+                idx = jnp.clip(d.astype(jnp.int32), 0,
+                               tbl.shape[0] - 1)
+                return tbl[idx], m & ~ntbl[idx]
+            return CompiledExpr(f_nullable, VARCHAR, new_dic)
         impl = _STRING_TO_STRING[name]
         mapped = [impl(v, *lit_args) for v in dic]
         new_dic = tuple(sorted(set(mapped)))
@@ -890,6 +1045,22 @@ _MATH_FNS = {
     "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
     "atan2": jnp.arctan2,
     "mod": jnp.mod,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "log": lambda b, x: jnp.log(x) / jnp.log(b),
+    "truncate": lambda x, d=None: jnp.trunc(x) if d is None
+    else jnp.trunc(x * 10.0 ** d) / 10.0 ** d,
+    "width_bucket": lambda x, lo, hi, n: jnp.clip(
+        jnp.floor((x - lo) / jnp.maximum(hi - lo, 1e-300) * n) + 1,
+        0, n + 1).astype(jnp.int64),
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_not": jnp.bitwise_not,
+    "bitwise_left_shift": jnp.left_shift,
+    "bitwise_right_shift": jnp.right_shift,
+    "cot": lambda x: 1.0 / jnp.tan(x),
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
 }
 
 _DATE_EXTRACT = {
@@ -899,7 +1070,19 @@ _DATE_EXTRACT = {
     "quarter": D.extract_quarter,
     "day_of_week": D.extract_dow,
     "day_of_year": D.extract_doy,
+    "week": D.extract_week,
+    "week_of_year": D.extract_week,
+    "day_of_month": D.extract_day,
+    "year_of_week": D.extract_year_of_week,
 }
+
+#: date_add/date_diff unit multipliers on the DATE (days) axis
+_DAY_UNITS = {"day": 1, "week": 7}
+#: ... and on the TIMESTAMP (milliseconds) axis
+_MS_UNITS = {"millisecond": 1, "second": 1000, "minute": 60_000,
+             "hour": 3_600_000, "day": 86_400_000,
+             "week": 7 * 86_400_000}
+_MONTH_UNITS = {"month": 1, "quarter": 3, "year": 12}
 
 def _pad(v: str, n, pad: str, left: bool) -> str:
     """Presto lpad/rpad: truncate to n when longer; multi-character pad
@@ -927,6 +1110,132 @@ def _substr(v: str, start, length=None) -> str:
     return v[idx:idx + int(length)]
 
 
+def _presto_replacement(repl: str) -> str:
+    """Presto regexp_replace replacement -> Python re.sub template:
+    $N group refs become \\N, \\$ is a literal dollar, bare $ stays a
+    dollar, and literal backslashes are escaped."""
+    out = []
+    i = 0
+    n = len(repl)
+    while i < n:
+        c = repl[i]
+        if c == "\\" and i + 1 < n and repl[i + 1] in "$\\":
+            out.append("\\\\" if repl[i + 1] == "\\" else "$")
+            i += 2
+        elif c == "$" and i + 1 < n and repl[i + 1].isdigit():
+            j = i + 1
+            while j < n and repl[j].isdigit():
+                j += 1
+            out.append("\\" + repl[i + 1:j])
+            i = j
+        elif c == "\\":
+            out.append("\\\\")
+            i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _json_path_get(doc: str, path: str):
+    """Minimal JSONPath for json_extract_scalar: $, $.k, $.a.b, $[i],
+    $.a[i].b ... (reference: JsonFunctions' scalar subset)."""
+    import json as _json
+    try:
+        cur = _json.loads(doc)
+    except Exception:  # noqa: BLE001 — malformed JSON -> NULL
+        return None
+    if not path.startswith("$"):
+        return None
+    i = 1
+    n = len(path)
+    while i < n:
+        if path[i] == ".":
+            j = i + 1
+            while j < n and path[j] not in ".[":
+                j += 1
+            key = path[i + 1:j]
+            if not isinstance(cur, dict) or key not in cur:
+                return None
+            cur = cur[key]
+            i = j
+        elif path[i] == "[":
+            j = path.index("]", i)
+            try:
+                idx = int(path[i + 1:j])
+            except ValueError:
+                return None
+            if not isinstance(cur, list) or not (
+                    -len(cur) <= idx < len(cur)):
+                return None
+            cur = cur[idx]
+            i = j + 1
+        else:
+            return None
+    return cur
+
+
+def _json_extract_scalar(doc: str, path: str):
+    v = _json_path_get(doc, path)
+    if v is None or isinstance(v, (dict, list)):
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v == int(v):
+        return str(v)
+    return str(v)
+
+
+def _regexp_extract(v: str, pattern: str, group: int = 0):
+    import re as _re
+    m = _re.search(pattern, v)
+    if m is None:
+        return None
+    try:
+        return m.group(int(group)) or ""
+    except IndexError:
+        return None
+
+
+def _split_part(v: str, delim: str, index: int):
+    if not delim:
+        return None
+    parts = v.split(delim)
+    i = int(index)
+    if i < 1 or i > len(parts):
+        return None
+    return parts[i - 1]
+
+
+def _url_part(v: str, part: str):
+    from urllib.parse import urlparse
+    try:
+        u = urlparse(v)
+    except Exception:  # noqa: BLE001
+        return None
+    got = {"host": u.hostname, "protocol": u.scheme, "path": u.path,
+           "query": u.query, "fragment": u.fragment}[part]
+    return got if got else ("" if part in ("path", "query", "fragment")
+                            else None)
+
+
+#: string -> string-or-NULL functions (a null table rides next to the
+#: dictionary remap so no-match/out-of-range yields SQL NULL)
+_STRING_TO_STRING_NULL = {
+    "regexp_extract": _regexp_extract,
+    "json_extract_scalar": _json_extract_scalar,
+    "json_extract": lambda doc, path: (
+        None if (r := _json_path_get(doc, path)) is None
+        else __import__("json").dumps(r)),
+    "split_part": _split_part,
+    "url_extract_host": lambda v: _url_part(v, "host"),
+    "url_extract_protocol": lambda v: _url_part(v, "protocol"),
+    "url_extract_path": lambda v: _url_part(v, "path"),
+    "url_extract_query": lambda v: _url_part(v, "query"),
+    "url_extract_fragment": lambda v: _url_part(v, "fragment"),
+}
+
+
 _STRING_TO_STRING = {
     "substr": _substr,
     "upper": lambda v: v.upper(),
@@ -936,22 +1245,89 @@ _STRING_TO_STRING = {
     "rtrim": lambda v: v.rstrip(),
     "reverse": lambda v: v[::-1],
     "concat_lit": lambda v, suffix: v + suffix,
+    "regexp_replace": lambda v, pat, repl="": __import__("re").sub(
+        pat, _presto_replacement(repl), v),
+    "translate": lambda v, frm, to: v.translate(
+        {ord(f): (to[i] if i < len(to) else None)
+         for i, f in enumerate(frm)}),
+    "normalize": lambda v: __import__("unicodedata").normalize(
+        "NFC", v),
     "replace": lambda v, find, repl="": v.replace(find, repl),
     "lpad": lambda v, n, pad=" ": _pad(v, n, pad, left=True),
     "rpad": lambda v, n, pad=" ": _pad(v, n, pad, left=False),
 }
 
+def _levenshtein(a: str, b: str) -> int:
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _from_base(v: str, radix: int):
+    try:
+        return int(v, int(radix))
+    except ValueError:
+        return None  # deviation: Presto raises; we yield SQL NULL
+
+
+def _json_array_length(doc: str):
+    import json as _json
+    try:
+        arr = _json.loads(doc)
+    except Exception:  # noqa: BLE001
+        return None
+    return len(arr) if isinstance(arr, list) else None
+
+
 _STRING_TO_INT = {
     "length": lambda v: len(v),
     "strpos": lambda v, sub: v.find(sub) + 1,
     "codepoint": lambda v: ord(v[0]) if v else 0,
+    "levenshtein_distance": lambda v, other: _levenshtein(v, other),
+    "bit_length": lambda v: len(v.encode()) * 8,
+    "octet_length": lambda v: len(v.encode()),
+    "crc32": lambda v: __import__("zlib").crc32(v.encode()),
+}
+
+#: string -> bigint-or-NULL (invalid input yields SQL NULL; where
+#: Presto raises instead, the deviation is documented on the impl)
+_STRING_TO_INT_NULL = {
+    "json_array_length": _json_array_length,
+    "from_base": _from_base,
+    # deviation: Presto raises on unequal lengths; we yield NULL
+    "hamming_distance": lambda v, other: sum(
+        x != y for x, y in zip(v, other)) if len(v) == len(other)
+        else None,
 }
 
 _STRING_TO_BOOL = {
     "starts_with": lambda v, prefix: v.startswith(prefix),
     "ends_with": lambda v, suffix: v.endswith(suffix),
     "contains_str": lambda v, sub: sub in v,
+    "regexp_like": lambda v, pat: __import__("re").search(
+        pat, v) is not None,
+    "is_json_scalar": lambda v: (lambda r: not isinstance(
+        r, (dict, list)))(_json_try(v)) if _json_try(v) is not _JSONERR
+        else False,
 }
+
+
+_JSONERR = object()
+
+
+def _json_try(v: str):
+    import json as _json
+    try:
+        return _json.loads(v)
+    except Exception:  # noqa: BLE001
+        return _JSONERR
 
 
 def fold_constants(expr: RowExpression) -> RowExpression:

@@ -83,3 +83,76 @@ def days_from_civil(y, m, d):
     doy = (153 * mp + 2) // 5 + d - 1
     doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
     return era * 146097 + doe - 719468
+
+
+def _is_leap(y):
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+
+
+def days_in_month(y, m):
+    base = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30,
+                        31], jnp.int64)[m - 1]
+    return jnp.where((m == 2) & _is_leap(y), 29, base)
+
+
+def add_months(days, n):
+    """DATE + n months, day-of-month clamped to the target month's
+    length (Presto date_add('month', ...) semantics)."""
+    y, m, d = civil_from_days(days)
+    total = y * 12 + (m - 1) + jnp.asarray(n, jnp.int64)
+    y2 = jnp.floor_divide(total, 12)
+    m2 = total - y2 * 12 + 1
+    d2 = jnp.minimum(d, days_in_month(y2, m2))
+    return days_from_civil(y2, m2, d2)
+
+
+def last_day_of_month(days):
+    y, m, _ = civil_from_days(days)
+    return days_from_civil(y, m, days_in_month(y, m))
+
+
+def extract_day_of_month(days):
+    return extract_day(days)
+
+
+def _iso_week_parts(days):
+    """(iso_year, iso_week): ISO-8601 week containing this date (the
+    week of its Thursday)."""
+    z = days.astype(jnp.int64)
+    thursday = z - (extract_dow(z) - 1) + 3
+    y = civil_from_days(thursday)[0]
+    jan1 = days_from_civil(y, 1, 1)
+    week = (thursday - jan1) // 7 + 1
+    return y, week
+
+
+def extract_week(days):
+    return _iso_week_parts(days)[1]
+
+
+def extract_year_of_week(days):
+    return _iso_week_parts(days)[0]
+
+
+def months_between(a, b, a_tie=None, b_tie=None):
+    """Truncating month difference b - a (Presto date_diff('month')).
+
+    Day-of-month comparisons CLAMP to the target month's length (Jan 31
+    -> Feb 29 counts as one full month); `a_tie`/`b_tie` are optional
+    same-unit tie-breakers (time of day for timestamps) that decide the
+    partial-month test when the clamped days are equal."""
+    ya, ma, da = civil_from_days(a)
+    yb, mb, db = civil_from_days(b)
+    months = (yb * 12 + mb) - (ya * 12 + ma)
+    if a_tie is None:
+        a_tie = jnp.zeros_like(da)
+        b_tie = jnp.zeros_like(db)
+    # forward: not a full month if b's (clamped) day falls short of a's
+    da_c = jnp.minimum(da, days_in_month(yb, mb))
+    short_fwd = (db < da_c) | ((db == da_c) & (b_tie < a_tie))
+    months = months - jnp.where((months > 0) & short_fwd, 1, 0)
+    # backward symmetric
+    db_c = jnp.minimum(db, days_in_month(ya, ma))
+    short_bwd = (da < db_c) | ((da == db_c) & (a_tie < b_tie))
+    months = months + jnp.where((months < 0) & short_bwd, 1, 0)
+    return months

@@ -379,10 +379,18 @@ class AggregationOperator(Operator):
     def get_output(self) -> Optional[Batch]:
         if not self._finishing or self._emitted:
             return None
+        live = None
         if self._domains is None:
             self._state = self._final_state()
             self.ctx.unregister_revocable()
-            if bool(np.asarray(self._state.overflow)):
+            # ONE host fetch serves both the overflow check and the
+            # live-group count (the count drives output compaction —
+            # a stats-overshot state capacity must not ride downstream
+            # as a huge mostly-dead batch)
+            overflow, live = jax.device_get(
+                (self._state.overflow,
+                 jnp.sum(self._state.valid)))
+            if bool(overflow):
                 # groups were dropped — the query must re-run with a
                 # larger table (reference analog: MultiChannelGroupByHash
                 # rehash :87, except the retry is at query level to keep
@@ -397,6 +405,12 @@ class AggregationOperator(Operator):
             self.mode, tuple(self.key_names), key_types, key_dicts,
             self._domains, names, aggs)
         out = fin(self._state)
+        if live is not None:
+            from presto_tpu.batch import quantized_capacity
+            cap = quantized_capacity(int(live))
+            if cap < out.capacity:
+                # groups are already packed at the front of the state
+                out = out.compact(cap, known_valid=int(live))
         # (global aggregation over zero rows already yields one live row:
         #  the kernel's global path pins group 0, so count(*) = 0 works)
         return self._count_out(out)

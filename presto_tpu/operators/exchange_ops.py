@@ -450,13 +450,18 @@ class ExchangeSinkOperator(Operator):
 
 class ExchangeSourceOperator(Operator):
     """Head of a consumer task's pipeline (reference:
-    ExchangeOperator.java:35 pulling from ExchangeClient)."""
+    ExchangeOperator.java:35 pulling from ExchangeClient).
+
+    `device`, when set, pins popped batches to this subtask's chip —
+    DCN pages deserialize on the default device, and a mesh-per-worker
+    subtask must not mix devices inside its jitted operators."""
 
     def __init__(self, ctx: OperatorContext, exchange: MeshExchange,
-                 consumer: int):
+                 consumer: int, device=None):
         super().__init__(ctx)
         self.exchange = exchange
         self.consumer = consumer
+        self.device = device
 
     def needs_input(self) -> bool:
         return False
@@ -472,6 +477,8 @@ class ExchangeSourceOperator(Operator):
 
     def get_output(self) -> Optional[Batch]:
         b = self.exchange.pop(self.consumer)
+        if b is not None and self.device is not None:
+            b = jax.device_put(b, self.device)
         return self._count_out(b) if b is not None else None
 
     def finish(self) -> None:
@@ -497,12 +504,13 @@ class ExchangeSinkOperatorFactory(OperatorFactory):
 
 class ExchangeSourceOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, exchange: MeshExchange,
-                 consumer: int):
+                 consumer: int, device=None):
         super().__init__(operator_id, "exchange_source")
         self.exchange = exchange
         self.consumer = consumer
+        self.device = device
 
     def create(self, driver_context: DriverContext) -> Operator:
         return ExchangeSourceOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self.exchange, self.consumer)
+            self.exchange, self.consumer, self.device)

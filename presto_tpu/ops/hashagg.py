@@ -325,6 +325,61 @@ def make_approx_percentile(fraction: float) -> AggFunction:
                        DOUBLE, ())
 
 
+@functools.lru_cache(maxsize=None)
+def make_moments(kind: str) -> AggFunction:
+    """skewness / kurtosis via sum-mergeable raw moments
+    (n, s1, s2, s3, s4) — reference:
+    operator/aggregation/CentralMomentsAggregation (Presto returns
+    sample skewness and EXCESS sample kurtosis)."""
+    def init(value, w):
+        v = jnp.where(w, value, 0).astype(np.float64)
+        return (w.astype(np.int64), v, v * v, v ** 3, v ** 4)
+
+    def final(state):
+        n_i, s1, s2, s3, s4 = state
+        n = jnp.maximum(n_i, 1).astype(np.float64)
+        m = s1 / n
+        m2 = s2 / n - m * m                       # population variance
+        m3 = s3 / n - 3 * m * s2 / n + 2 * m ** 3
+        m4 = s4 / n - 4 * m * s3 / n + 6 * m * m * s2 / n - 3 * m ** 4
+        if kind == "skewness":
+            # Presto: sqrt(n) * m3 / m2^1.5 with sample correction
+            denom = jnp.maximum(m2, 1e-300) ** 1.5
+            g1 = m3 / denom
+            v = jnp.sqrt(n * (n - 1)) / jnp.maximum(n - 2, 1) * g1
+            mask = n_i > 2
+        else:  # kurtosis (excess, sample-corrected)
+            denom = jnp.maximum(m2 * m2, 1e-300)
+            g2 = m4 / denom - 3.0
+            v = ((n - 1) / jnp.maximum((n - 2) * (n - 3), 1)
+                 * ((n + 1) * g2 + 6))
+            mask = n_i > 3
+        return v, mask
+    return AggFunction(kind, (np.dtype(np.int64),) + (np.dtype(
+        np.float64),) * 4, ("sum",) * 5, init, final, DOUBLE,
+        (BIGINT,) + (DOUBLE,) * 4)
+
+
+@functools.lru_cache(maxsize=None)
+def make_entropy() -> AggFunction:
+    """entropy(c): Shannon entropy (log2) of the count distribution —
+    states (sum_c, sum_c_log_c) are sum-mergeable (reference:
+    aggregation/EntropyAggregation)."""
+    def init(value, w):
+        v = jnp.where(w, jnp.maximum(value, 0), 0).astype(np.float64)
+        clogc = jnp.where(v > 0, v * jnp.log(v), 0.0)
+        return (v, clogc)
+
+    def final(state):
+        total, sclogc = state
+        t = jnp.maximum(total, 1e-300)
+        ent = (jnp.log(t) - sclogc / t) / np.log(2.0)
+        return jnp.maximum(ent, 0.0), total > 0
+    return AggFunction("entropy", (np.dtype(np.float64),) * 2,
+                       ("sum", "sum"), init, final, DOUBLE,
+                       (DOUBLE, DOUBLE))
+
+
 AGG_FACTORIES = {
     "sum": make_sum,
     "count": make_count,

@@ -37,7 +37,8 @@ AGG_FUNCTIONS = {
     "var_samp", "var_pop", "variance", "stddev", "stddev_samp",
     "stddev_pop", "count_if", "bool_and", "bool_or", "every",
     "geometric_mean", "checksum", "arbitrary", "any_value",
-    "approx_distinct", "approx_percentile",
+    "approx_distinct", "approx_percentile", "skewness", "kurtosis",
+    "entropy",
 }
 
 
@@ -661,7 +662,7 @@ def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
         return BIGINT
     if fn in ("avg", "var_samp", "var_pop", "variance", "stddev",
               "stddev_samp", "stddev_pop", "geometric_mean",
-              "approx_percentile"):
+              "approx_percentile", "skewness", "kurtosis", "entropy"):
         return DOUBLE
     if fn in ("bool_and", "bool_or", "every"):
         return BOOLEAN
@@ -2488,8 +2489,69 @@ class _Analyzer:
             return Call(n, tuple(args), args[0].type if
                         args[0].type.is_integer else DOUBLE)
         if name in ("sqrt", "cbrt", "exp", "ln", "log2", "log10", "sin",
-                    "cos", "tan", "asin", "acos", "atan"):
+                    "cos", "tan", "asin", "acos", "atan", "sinh",
+                    "cosh", "tanh", "degrees", "radians", "cot",
+                    "log1p", "expm1"):
             return Call(name, tuple(args), DOUBLE)
+        if name == "log" and len(args) == 2:
+            return Call("log", tuple(args), DOUBLE)
+        if name == "truncate":
+            return Call("truncate", tuple(args), DOUBLE)
+        if name == "width_bucket":
+            return Call("width_bucket", tuple(args), BIGINT)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_not", "bitwise_left_shift",
+                    "bitwise_right_shift"):
+            return Call(name, tuple(args), BIGINT)
+        if name == "pi" and not args:
+            import math as _math
+            return Literal(_math.pi, DOUBLE)
+        if name == "e" and not args:
+            import math as _math
+            return Literal(_math.e, DOUBLE)
+        if name in ("regexp_like", "is_json_scalar"):
+            return Call(name, tuple(args), BOOLEAN)
+        if name in ("regexp_extract", "regexp_replace",
+                    "json_extract_scalar", "json_extract",
+                    "split_part", "translate", "normalize",
+                    "url_extract_host", "url_extract_protocol",
+                    "url_extract_path", "url_extract_query",
+                    "url_extract_fragment"):
+            return Call(name, tuple(args), VARCHAR)
+        if name in ("levenshtein_distance", "hamming_distance",
+                    "from_base", "json_array_length", "bit_length",
+                    "octet_length", "crc32"):
+            return Call(name, tuple(args), BIGINT)
+        if name in ("week", "week_of_year", "day_of_month",
+                    "year_of_week"):
+            return Call(name, tuple(args), BIGINT)
+        if name in ("second", "minute", "hour", "millisecond"):
+            return Call(name, tuple(args), BIGINT)
+        if name == "typeof":
+            if len(args) != 1:
+                raise AnalysisError("typeof takes one argument")
+            return Literal(args[0].type.display(), VARCHAR)
+        if name == "substring":
+            return Call("substr", tuple(args), VARCHAR)
+        if name in ("char_length", "character_length"):
+            return Call("length", tuple(args), BIGINT)
+        if name == "last_day_of_month":
+            return Call(name, tuple(args), DATE)
+        if name == "date_add":
+            if len(args) != 3:
+                raise AnalysisError("date_add(unit, n, x) takes three "
+                                    "arguments")
+            return Call("date_add", tuple(args), args[2].type)
+        if name == "date_diff":
+            if len(args) != 3:
+                raise AnalysisError("date_diff(unit, a, b) takes "
+                                    "three arguments")
+            return Call("date_diff", tuple(args), BIGINT)
+        if name == "from_unixtime":
+            from presto_tpu.types import TIMESTAMP as _TS
+            return Call("from_unixtime", tuple(args), _TS)
+        if name == "to_unixtime":
+            return Call("to_unixtime", tuple(args), DOUBLE)
         if name in ("power", "pow", "atan2", "mod"):
             n = "power" if name == "pow" else name
             if n == "mod" and all(a.type.is_integer for a in args):

@@ -232,7 +232,8 @@ class LocalExecutionPlanner:
         )
         exchange = self.task.exchanges[node.exchange_id]
         pipe.append(ExchangeSourceOperatorFactory(
-            self._next_id(), exchange, self.task.index))
+            self._next_id(), exchange, self.task.index,
+            device=self.task.device))
 
     def _visit_ValuesNode(self, node: N.ValuesNode, pipe: List):
         data = {}
@@ -309,9 +310,15 @@ class LocalExecutionPlanner:
         # memory planning): a group-by whose estimated cardinality
         # exceeds the session default starts with a big-enough table
         # instead of paying log4(groups/default) whole-query retries
+        # cap: overshooting here inflates every merge/finalize shape
+        # (compile time + memory); a genuine overflow still retries 4x.
+        # NEVER below the session value — the overflow-retry protocol
+        # bumps the session property, and clamping under it would
+        # livelock the retry at a too-small size
         est = self._estimated_groups(node)
-        if est is not None and est * 2 > max_groups:
-            max_groups = min(int(est * 2), 1 << 26)
+        if est is not None:
+            max_groups = max(max_groups,
+                             min(int(est * 2), 1 << 22))
         pipe.append(AggregationOperatorFactory(
             self._next_id(), key_names, key_exprs, specs, node.step,
             max_groups, input_dicts=_schema_dicts(schema)))
@@ -609,6 +616,10 @@ def agg_function_for(name: str, input_type: Optional[Type],
         return hashagg.make_geometric_mean()
     if name == "checksum":
         return hashagg.make_checksum(input_type)
+    if name in ("skewness", "kurtosis"):
+        return hashagg.make_moments(name)
+    if name == "entropy":
+        return hashagg.make_entropy()
     raise LocalPlanningError(f"unknown aggregate {name}")
 
 

@@ -24,6 +24,33 @@ from presto_tpu.server.node import (
 )
 
 
+class TaskFailed(RuntimeError):
+    """A remote task failed; carries the structured retry hint when
+    the failure is one of the engine's sync-free overflow errors."""
+
+    def __init__(self, message: str, kind: Optional[str] = None,
+                 suggested: Optional[int] = None):
+        super().__init__(message)
+        self.kind = kind
+        self.suggested = suggested
+
+
+def _retry_hint(e: Exception):
+    """(property_name, suggested) when the error asks for a re-run
+    with a raised setting; (None, None) otherwise."""
+    from presto_tpu.operators.aggregation import GroupLimitExceeded
+    from presto_tpu.operators.join_ops import JoinCapacityExceeded
+    if isinstance(e, JoinCapacityExceeded):
+        return "join_expansion_factor", e.suggested
+    if isinstance(e, GroupLimitExceeded):
+        return "max_groups", e.suggested
+    if isinstance(e, TaskFailed) and e.kind == "join_capacity":
+        return "join_expansion_factor", e.suggested
+    if isinstance(e, TaskFailed) and e.kind == "group_limit":
+        return "max_groups", e.suggested
+    return None, None
+
+
 class _Query:
     def __init__(self, sql: str):
         self.id = uuid.uuid4().hex[:16]
@@ -35,17 +62,34 @@ class _Query:
         self.done_at: Optional[float] = None  # set at terminal state
 
 
+#: result rows per client page (reference: the target-result-size
+#: paging of ExecutingStatementResource)
+PAGE_ROWS = 4096
+
+
 class Coordinator(Node):
+    """`max_concurrent_queries` / `max_queued_queries` give minimal
+    resource-group admission control (reference:
+    execution/resourceGroups/InternalResourceGroup +
+    DispatchManager.java:167): queries past the concurrency cap wait
+    QUEUED; past the queue cap they fail immediately."""
+
     def __init__(self, worker_urls: List[str],
                  catalog: str = "tpch", schema: str = "tiny",
                  properties: Optional[dict] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_concurrent_queries: int = 4,
+                 max_queued_queries: int = 100):
         super().__init__(host, port)
         self.worker_urls = list(worker_urls)
         self.catalog = catalog
         self.schema = schema
         self.properties = dict(properties or {})
         self.queries: Dict[str, _Query] = {}
+        self._admission = threading.Semaphore(max_concurrent_queries)
+        self._queued = 0
+        self._queue_cap = max_queued_queries
+        self._admission_lock = threading.Lock()
 
     # -- health / membership (reference: failureDetector/
     # HeartbeatFailureDetector pinging discovered nodes) ---------------
@@ -63,8 +107,26 @@ class Coordinator(Node):
         if path == "/v1/statement":
             self._prune_queries()
             q = _Query(body.decode())
+            # admission control, decided synchronously AT SUBMIT so
+            # the queue accounting can't race the worker thread: take
+            # a concurrency slot if one is free, else count as queued
+            # (rejecting past the queue bound)
+            has_slot = self._admission.acquire(blocking=False)
+            if not has_slot:
+                with self._admission_lock:
+                    if self._queued >= self._queue_cap:
+                        q.state = "FAILED"
+                        q.error = "query queue is full"
+                        q.done_at = time.monotonic()
+                        self.queries[q.id] = q
+                        return json.dumps({
+                            "id": q.id,
+                            "nextUri": f"{self.url}/v1/statement/"
+                                       f"executing/{q.id}/0"}).encode()
+                    self._queued += 1
             self.queries[q.id] = q
-            threading.Thread(target=self._run_query, args=(q,),
+            threading.Thread(target=self._run_query,
+                             args=(q, has_slot),
                              daemon=True).start()
             return json.dumps({
                 "id": q.id,
@@ -75,17 +137,31 @@ class Coordinator(Node):
 
     def handle_get(self, path: str) -> bytes:
         if path.startswith("/v1/statement/executing/"):
-            qid = path.split("/")[4]
+            parts = path.split("/")
+            qid = parts[4]
+            token = int(parts[5]) if len(parts) > 5 else 0
             q = self.queries[qid]
             out = {"id": q.id, "stats": {"state": q.state}}
-            if q.state == "FINISHED":
+            # columns surface as soon as planning determines them —
+            # before FINISHED (reference: ExecutingStatementResource
+            # emits columns with the first response that knows them)
+            if q.columns is not None:
                 out["columns"] = q.columns
-                out["data"] = q.data
+            if q.state == "FINISHED":
+                # real paging: each nextUri token serves PAGE_ROWS
+                # rows; the tail page omits nextUri (protocol end)
+                lo = token * PAGE_ROWS
+                hi = lo + PAGE_ROWS
+                out["data"] = q.data[lo:hi]
+                if hi < len(q.data):
+                    out["nextUri"] = \
+                        f"{self.url}/v1/statement/executing/" \
+                        f"{qid}/{token + 1}"
             elif q.state == "FAILED":
                 out["error"] = {"message": q.error}
             else:
                 out["nextUri"] = f"{self.url}/v1/statement/executing/" \
-                                 f"{qid}/0"
+                                 f"{qid}/{token}"
             return json.dumps(out).encode()
         return super().handle_get(path)
 
@@ -102,9 +178,18 @@ class Coordinator(Node):
                     and now - q.done_at > ttl_s]:
             self.queries.pop(qid, None)
 
-    def _run_query(self, q: _Query) -> None:
+    def _run_query(self, q: _Query, has_slot: bool = True) -> None:
+        # admission: wait for a concurrency slot (QUEUED state is
+        # client-visible while waiting)
+        if not has_slot:
+            self._admission.acquire()
+            with self._admission_lock:
+                self._queued -= 1
+        q.state = "RUNNING"
         try:
-            result = self.execute(q.sql)
+            result = self.execute(
+                q.sql, on_columns=lambda cols: setattr(
+                    q, "columns", cols))
             q.columns = [
                 {"name": n, "type": f.type.display()}
                 for n, f in zip(result.names, result.fields)]
@@ -116,23 +201,38 @@ class Coordinator(Node):
             q.state = "FAILED"
         finally:
             q.done_at = time.monotonic()
+            self._admission.release()
 
-    def execute(self, sql: str):
+    def execute(self, sql: str, on_columns=None):
         """Distributed execution with elastic retry: a failed or dead
         worker fails the attempt, the membership is re-probed, and the
         query re-runs on the survivors — splits regenerate identically
         anywhere, so no state needs recovering (reference:
         SqlQueryScheduler section retry :667-690 + P7/P8 relocatable
-        splits; a whole-query retry is the single-section case)."""
+        splits; a whole-query retry is the single-section case).
+        `on_columns` fires once the output schema is known (before any
+        result rows exist — the client protocol's early-columns)."""
         from presto_tpu.session_properties import get_property
         retries = int(get_property(self.properties,
                                    "query_retries"))
         workers = list(self.worker_urls)
+        props = dict(self.properties)
         attempt = 0
+        bumps = 0
         while True:
             try:
-                return self._execute_attempt(sql, workers)
+                return self._execute_attempt(sql, workers, props,
+                                             on_columns=on_columns)
             except Exception as e:  # noqa: BLE001 — inspect + retry
+                # sync-free overflow protocol: re-run the WHOLE query
+                # with the suggested setting (any fragment may have
+                # raised it, local or remote) — not a failure retry
+                prop, suggested = _retry_hint(e)
+                if prop is not None and bumps < 8:
+                    bumps += 1
+                    props[prop] = max(suggested,
+                                      props.get(prop, 0) or 0)
+                    continue
                 attempt += 1
                 if attempt > retries:
                     raise
@@ -155,7 +255,22 @@ class Coordinator(Node):
                 workers = alive
                 continue
 
-    def _execute_attempt(self, sql: str, worker_urls: List[str]):
+    def _worker_devices(self, worker_urls: List[str]) -> List[int]:
+        """Per-worker device counts (mesh-per-worker: a worker's tasks
+        expand to one subtask per device)."""
+        ks = []
+        for url in worker_urls:
+            try:
+                info = json.loads(http_get(f"{url}/v1/info",
+                                           timeout=10))
+                ks.append(max(1, int(info.get("devices", 1))))
+            except Exception:  # noqa: BLE001 — treat as single-device
+                ks.append(1)
+        return ks
+
+    def _execute_attempt(self, sql: str, worker_urls: List[str],
+                         properties: Optional[dict] = None,
+                         on_columns=None):
         """One scheduling attempt over a fixed worker set."""
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
@@ -163,7 +278,9 @@ class Coordinator(Node):
         from presto_tpu.runner.local import (
             LocalRunner, MaterializedResult,
         )
-        runner = LocalRunner(self.catalog, self.schema, self.properties)
+        properties = dict(self.properties if properties is None
+                          else properties)
+        runner = LocalRunner(self.catalog, self.schema, properties)
         fplan = derive_fragments(runner, sql)
         if not worker_urls and any(
                 f.partitioning == "distributed"
@@ -172,8 +289,31 @@ class Coordinator(Node):
                 "query requires distributed fragments but the "
                 "coordinator has no workers")
         query_id = uuid.uuid4().hex[:12]
+        # global consumer-task space: one slot per (worker, device);
+        # row routing is h % total so a key lands on one chip of one
+        # worker — the DCN tier addresses devices directly
+        ks = self._worker_devices(worker_urls)
+        offsets = [0]
+        for k in ks:
+            offsets.append(offsets[-1] + k)
+        total_tasks = max(offsets[-1], 1)
+        distributed_urls: List[str] = []
+        for url, k in zip(worker_urls, ks):
+            distributed_urls.extend([url] * k)
+        consumer_urls_by_edge = {}
+        n_producers_by_edge = {}
+        for xid, edge in fplan.edges.items():
+            consumer = fplan.fragments[edge.consumer]
+            producer = fplan.fragments[edge.producer]
+            consumer_urls_by_edge[xid] = [self.url] \
+                if consumer.partitioning == "single" \
+                else list(distributed_urls)
+            n_producers_by_edge[xid] = 1 \
+                if producer.partitioning == "single" else total_tasks
         exchanges = build_http_exchanges(
-            query_id, fplan, worker_urls, self.url, self.registry)
+            query_id, fplan, consumer_urls_by_edge, worker_urls,
+            self.url, self.registry,
+            n_producers_by_edge=n_producers_by_edge)
 
         # everything from first dispatch to completion runs under one
         # release guard: a failure at ANY point (dead worker mid-
@@ -189,19 +329,23 @@ class Coordinator(Node):
             for fid, fragment in fplan.fragments.items():
                 if fragment.partitioning != "distributed":
                     continue
-                for t, wurl in enumerate(worker_urls):
-                    task_id = f"{query_id}.{fid}.{t}"
+                for w, wurl in enumerate(worker_urls):
+                    task_id = f"{query_id}.{fid}.{w}"
                     spec = {
                         "task_id": task_id,
                         "query_id": query_id,
                         "sql": sql,
                         "session": {"catalog": self.catalog,
                                     "schema": self.schema,
-                                    "properties": self.properties},
+                                    "properties": properties},
                         "fragment_id": fid,
-                        "task_index": t,
-                        "n_tasks": len(worker_urls),
+                        "task_index": offsets[w],
+                        "local_base": offsets[w],
+                        "local_count": ks[w],
+                        "n_tasks": total_tasks,
                         "worker_urls": worker_urls,
+                        "consumer_urls_by_edge": consumer_urls_by_edge,
+                        "n_producers_by_edge": n_producers_by_edge,
                         "coordinator_url": self.url,
                     }
                     http_post(f"{wurl}/v1/task",
@@ -228,8 +372,13 @@ class Coordinator(Node):
                     pipelines.extend(
                         planner.plan_fragment(fragment.root, sinks))
             assert result is not None
+            if on_columns is not None:
+                on_columns([
+                    {"name": n, "type": f.type.display()}
+                    for n, f in zip(result.result_names,
+                                    result.result_fields)])
 
-            failure: List[str] = []
+            failure: List[TaskFailed] = []
 
             def watch():
                 # failure detection: poll remote task state; a failed
@@ -242,13 +391,15 @@ class Coordinator(Node):
                                 f"{wurl}/v1/task/{task_id}",
                                 timeout=10))
                         except Exception as e:  # noqa: BLE001
-                            failure.append(
-                                f"worker {wurl} unreachable: {e}")
+                            failure.append(TaskFailed(
+                                f"worker {wurl} unreachable: {e}"))
                             return
                         if st["state"] == "failed":
-                            failure.append(
+                            failure.append(TaskFailed(
                                 f"task {task_id} failed: "
-                                f"{st['error']}")
+                                f"{st['error']}",
+                                kind=st.get("error_kind"),
+                                suggested=st.get("suggested")))
                             return
                     time.sleep(0.2)
 
@@ -259,7 +410,7 @@ class Coordinator(Node):
             stop.set()
             self._release_everywhere(query_id, worker_urls)
         if failure:
-            raise RuntimeError(failure[0])
+            raise failure[0]
         return MaterializedResult(result.result_names,
                                   result.result_sink,
                                   result.result_fields)
@@ -285,7 +436,7 @@ class Coordinator(Node):
         idle_since = None
         while True:
             if failure:
-                raise RuntimeError(failure[0])
+                raise failure[0]
             all_done = True
             progress = False
             for d in drivers:
@@ -293,6 +444,10 @@ class Coordinator(Node):
                     all_done = False
                     progress = d.process() or progress
             if all_done:
+                from presto_tpu.operators.base import (
+                    run_deferred_checks,
+                )
+                run_deferred_checks(dctx)
                 return drivers
             if progress:
                 idle_since = None
@@ -321,13 +476,24 @@ class StatementClient:
         resp = json.loads(http_post(f"{self.server}/v1/statement",
                                     sql.encode()))
         deadline = time.time() + timeout
+        next_uri = resp["nextUri"]
+        columns = None
+        data: list = []
         while True:
-            state = json.loads(http_get(resp["nextUri"]))
+            state = json.loads(http_get(next_uri))
             s = state["stats"]["state"]
-            if s == "FINISHED":
-                return state["columns"], state["data"]
+            if "columns" in state and columns is None:
+                columns = state["columns"]
             if s == "FAILED":
                 raise RuntimeError(state["error"]["message"])
+            if s == "FINISHED":
+                data.extend(state.get("data", []))
+                nxt = state.get("nextUri")
+                if nxt is None:
+                    return columns, data
+                next_uri = nxt
+                continue
+            next_uri = state["nextUri"]
             if time.time() > deadline:
                 raise TimeoutError(f"query {resp['id']} timed out")
             time.sleep(0.1)

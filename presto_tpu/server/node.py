@@ -195,6 +195,13 @@ class TaskState:
     def __init__(self):
         self.state = "running"
         self.error: Optional[str] = None
+        #: structured retry protocol: the engine's sync-free overflow
+        #: errors (join capacity / group limit) are not failures — the
+        #: COORDINATOR must re-run the whole query with the suggested
+        #: setting, so they travel as (kind, suggested) over the status
+        #: RPC instead of opaque text
+        self.error_kind: Optional[str] = None
+        self.suggested: Optional[int] = None
         self.cancel = threading.Event()
         self.done_at: Optional[float] = None  # set at terminal state
 
@@ -242,10 +249,22 @@ class NodeHandler(BaseHTTPRequestHandler):
 
 class Node:
     """Shared HTTP node: exchange receipt + task RPC. The coordinator
-    subclass adds the client protocol."""
+    subclass adds the client protocol.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    `n_devices` > 1 turns the worker into a MESH-PER-WORKER node (the
+    reference's one-worker-per-host shape mapped to TPU: one process
+    per host/slice, the chips inside it device-parallel): each
+    dispatched fragment task expands into one subtask per local device
+    and the exchange consumer space is GLOBAL over
+    sum(worker devices) — DCN pages route straight to (worker, device)
+    by key hash, ICI-local work stays on its chip (reference seam:
+    presto-spark's scheduling-outside/operators-inside split,
+    PrestoSparkTaskExecutorFactory.java:121)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 n_devices: int = 1):
         self.registry = ExchangeRegistry()
+        self.n_devices = max(1, int(n_devices))
         self.tasks: Dict[str, TaskState] = {}
         handler = type("BoundHandler", (NodeHandler,), {"node": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
@@ -264,7 +283,8 @@ class Node:
 
     def handle_get(self, path: str) -> bytes:
         if path == "/v1/info":
-            return json.dumps({"state": "active"}).encode()
+            return json.dumps({"state": "active",
+                               "devices": self.n_devices}).encode()
         if path == "/v1/tasks":
             # observability + test support (reference: /v1/task listing)
             return json.dumps({
@@ -273,8 +293,9 @@ class Node:
         if path.startswith("/v1/task/"):
             tid = path.rsplit("/", 1)[1]
             t = self.tasks[tid]
-            return json.dumps({"state": t.state,
-                               "error": t.error}).encode()
+            return json.dumps({"state": t.state, "error": t.error,
+                               "error_kind": t.error_kind,
+                               "suggested": t.suggested}).encode()
         raise KeyError(path)
 
     def handle_post(self, path: str, body: bytes) -> bytes:
@@ -338,6 +359,18 @@ class Node:
             if state.cancel.is_set():
                 state.state = "aborted"
             else:
+                from presto_tpu.operators.aggregation import (
+                    GroupLimitExceeded,
+                )
+                from presto_tpu.operators.join_ops import (
+                    JoinCapacityExceeded,
+                )
+                if isinstance(e, JoinCapacityExceeded):
+                    state.error_kind = "join_capacity"
+                    state.suggested = e.suggested
+                elif isinstance(e, GroupLimitExceeded):
+                    state.error_kind = "group_limit"
+                    state.suggested = e.suggested
                 state.state = "failed"
                 state.error = f"{type(e).__name__}: {e}\n" \
                               f"{traceback.format_exc(limit=8)}"
@@ -348,7 +381,9 @@ class Node:
                          cancel: Optional[threading.Event] = None
                          ) -> None:
         """Re-derive the fragment plan from SQL (deterministic) and run
-        this node's task of fragment `fragment_id`."""
+        this node's task(s) of fragment `fragment_id` — one subtask per
+        local device when the spec carries `local_count` > 1 (mesh-per-
+        worker), all driven in one round-robin loop."""
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
         )
@@ -360,16 +395,33 @@ class Node:
         fid = spec["fragment_id"]
         fragment = fplan.fragments[fid]
         exchanges = build_http_exchanges(
-            spec["query_id"], fplan, spec["worker_urls"],
-            spec["coordinator_url"], self.registry)
-        task = TaskContext(index=spec["task_index"],
-                           count=spec["n_tasks"], device=None,
-                           exchanges=exchanges)
-        planner = LocalExecutionPlanner(runner.catalogs, runner.session,
-                                        task=task)
-        sinks = [exchanges[e.exchange_id]
-                 for e in fplan.producer_edges(fid)]
-        pipelines = planner.plan_fragment(fragment.root, sinks)
+            spec["query_id"], fplan,
+            spec.get("consumer_urls_by_edge"), spec["worker_urls"],
+            spec["coordinator_url"], self.registry,
+            n_producers_by_edge=spec.get("n_producers_by_edge"))
+        k = int(spec.get("local_count", 1))
+        base = int(spec.get("local_base", spec.get("task_index", 0)))
+        devices = [None] * k
+        if k > 1:
+            import jax
+            devs = jax.devices()
+            if len(devs) < k:
+                raise RuntimeError(
+                    f"task wants {k} local devices, node has "
+                    f"{len(devs)}")
+            devices = list(devs[:k])
+        pipelines = []
+        sinks_edges = fplan.producer_edges(fid)
+        for local in range(k):
+            task = TaskContext(index=base + local,
+                               count=spec["n_tasks"],
+                               device=devices[local],
+                               exchanges=exchanges)
+            planner = LocalExecutionPlanner(
+                runner.catalogs, runner.session, task=task)
+            sinks = [exchanges[e.exchange_id] for e in sinks_edges]
+            pipelines.extend(
+                planner.plan_fragment(fragment.root, sinks))
         LocalRunner.drive_pipelines(
             pipelines,
             cancel=cancel.is_set if cancel is not None else None)
@@ -390,20 +442,35 @@ def derive_fragments(runner, sql: str):
 
 
 def build_http_exchanges(query_id: str, fplan,
+                         consumer_urls_by_edge,
                          worker_urls: List[str],
                          coordinator_url: str,
-                         registry: ExchangeRegistry) -> Dict[int,
-                                                             HttpExchange]:
-    """One HttpExchange per edge; consumer URL table depends on the
-    consumer fragment's distribution (single -> coordinator)."""
+                         registry: ExchangeRegistry,
+                         n_producers_by_edge=None) -> Dict[int,
+                                                           HttpExchange]:
+    """One HttpExchange per edge. The coordinator pre-computes a
+    GLOBAL consumer URL table per edge (one slot per consumer TASK —
+    a mesh-per-worker node's url appears once per device) plus the
+    global producer count, and ships both in the task spec so every
+    node agrees; when absent (legacy/single-device callers) the table
+    degenerates to one slot per worker."""
     out: Dict[int, HttpExchange] = {}
     for xid, edge in fplan.edges.items():
         consumer = fplan.fragments[edge.consumer]
         producer = fplan.fragments[edge.producer]
-        consumer_urls = [coordinator_url] \
-            if consumer.partitioning == "single" else list(worker_urls)
-        n_producers = 1 if producer.partitioning == "single" \
-            else len(worker_urls)
+        if consumer_urls_by_edge is not None:
+            consumer_urls = consumer_urls_by_edge[
+                str(xid) if str(xid) in consumer_urls_by_edge else xid]
+        else:
+            consumer_urls = [coordinator_url] \
+                if consumer.partitioning == "single" \
+                else list(worker_urls)
+        if n_producers_by_edge is not None:
+            n_producers = n_producers_by_edge[
+                str(xid) if str(xid) in n_producers_by_edge else xid]
+        else:
+            n_producers = 1 if producer.partitioning == "single" \
+                else len(worker_urls)
         out[xid] = HttpExchange(
             f"{query_id}:{xid}", edge.scheme, edge.partition_keys,
             edge.hash_dicts, edge_key_dicts(edge), consumer_urls,
@@ -418,8 +485,16 @@ def worker_main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--devices", default="1",
+                   help="local device count for mesh-per-worker "
+                        "('auto' = jax.local_device_count())")
     args = p.parse_args()
-    node = Node(args.host, args.port)
+    if args.devices == "auto":
+        import jax
+        n_devices = jax.local_device_count()
+    else:
+        n_devices = int(args.devices)
+    node = Node(args.host, args.port, n_devices=n_devices)
     node.start()
     print(json.dumps({"url": node.url}), flush=True)
     try:

@@ -181,3 +181,73 @@ def test_cli_local():
         timeout=300)
     assert out.returncode == 0, out.stderr
     assert "two" in out.stdout and "2" in out.stdout
+
+
+def test_result_paging_and_early_columns(cluster):
+    """Round-3 client protocol: results page at PAGE_ROWS per nextUri
+    token (not one giant buffer) and columns surface before the data
+    pages finish (reference: ExecutingStatementResource paging)."""
+    import json as _json
+    from presto_tpu.server.node import http_get, http_post
+    # lineitem (5,990 rows) crosses the 4096-row page boundary
+    resp = _json.loads(http_post(f"{cluster.url}/v1/statement",
+                                 b"select orderkey from lineitem"))
+    pages = 0
+    got_rows = 0
+    next_uri = resp["nextUri"]
+    saw_columns_with_next = False
+    while next_uri is not None:
+        st = _json.loads(http_get(next_uri))
+        if st["stats"]["state"] == "FINISHED":
+            got_rows += len(st.get("data", []))
+            pages += 1 if st.get("data") else 0
+            if "columns" in st and st.get("nextUri"):
+                saw_columns_with_next = True
+        next_uri = st.get("nextUri")
+        if st["stats"]["state"] == "FAILED":
+            raise AssertionError(st["error"])
+    assert got_rows == 5990
+    assert pages >= 2           # really paged
+    assert saw_columns_with_next  # columns arrive before the last page
+    from presto_tpu.server.coordinator import StatementClient
+    cols, data = StatementClient(cluster.url).execute(
+        "select orderkey from lineitem")
+    assert len(data) == 5990
+    assert cols[0]["name"] == "orderkey"
+
+
+def test_admission_queue(cluster):
+    """Queries beyond the concurrency cap report QUEUED before
+    RUNNING; the queue cap rejects floods."""
+    from presto_tpu.server.coordinator import Coordinator
+    # a tiny dedicated coordinator so caps are deterministic
+    c = Coordinator(cluster.worker_urls, "tpch", "tiny",
+                    max_concurrent_queries=1, max_queued_queries=2)
+    c.start()
+    try:
+        import json as _json
+        from presto_tpu.server.node import http_get, http_post
+        resps = [
+            _json.loads(http_post(
+                f"{c.url}/v1/statement",
+                b"select count(*) from lineitem")) for _ in range(3)]
+        # the 4th submission exceeds max_queued and fails fast
+        r4 = _json.loads(http_post(f"{c.url}/v1/statement",
+                                   b"select 1"))
+        st4 = _json.loads(http_get(r4["nextUri"]))
+        states = set()
+        import time as _t
+        deadline = _t.time() + 300
+        while _t.time() < deadline:
+            sts = [_json.loads(http_get(r["nextUri"]))
+                   for r in resps]
+            states |= {s["stats"]["state"] for s in sts}
+            if all(s["stats"]["state"] == "FINISHED" for s in sts):
+                break
+            _t.sleep(0.2)
+        assert all(_json.loads(http_get(r["nextUri"]))
+                   ["stats"]["state"] == "FINISHED" for r in resps)
+        assert st4["stats"]["state"] == "FAILED"
+        assert "queue" in st4["error"]["message"]
+    finally:
+        c.stop()

@@ -1,0 +1,188 @@
+"""Per-function oracle tests for the round-3 scalar breadth push
+(reference surface: presto-main operator/scalar/* — MathFunctions,
+StringFunctions, JsonFunctions, UrlFunctions, DateTimeFunctions).
+Each case is one SQL expression against a Python-computed expected
+value, end to end through parse -> analyze -> compile -> device."""
+
+import math
+
+import pytest
+
+from presto_tpu.runner import LocalRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner("tpch", "tiny")
+
+
+def one(runner, expr):
+    return runner.execute(f"select {expr} as v").rows()[0][0]
+
+
+def _days(y, m, d):
+    import datetime
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+CASES = [
+    # math
+    ("degrees(pi())", 180.0),
+    ("radians(180.0)", math.pi),
+    ("sinh(1.0)", math.sinh(1.0)),
+    ("cosh(1.0)", math.cosh(1.0)),
+    ("tanh(1.0)", math.tanh(1.0)),
+    ("cot(1.0)", 1 / math.tan(1.0)),
+    ("log(2.0, 8.0)", 3.0),
+    ("log1p(1.0)", math.log(2.0)),
+    ("expm1(0.0)", 0.0),
+    ("truncate(3.79)", 3.0),
+    ("truncate(-3.79)", -3.0),
+    ("truncate(3.14159, 2)", 3.14),
+    ("width_bucket(5.0, 0.0, 10.0, 4)", 3),
+    ("width_bucket(-1.0, 0.0, 10.0, 4)", 0),
+    ("e()", math.e),
+    # bitwise
+    ("bitwise_and(12, 10)", 8),
+    ("bitwise_or(12, 10)", 14),
+    ("bitwise_xor(12, 10)", 6),
+    ("bitwise_not(0)", -1),
+    ("bitwise_left_shift(1, 4)", 16),
+    ("bitwise_right_shift(16, 3)", 2),
+    # ieee
+    ("is_nan(nan())", True),
+    ("is_finite(1.0)", True),
+    ("is_infinite(infinity())", True),
+    ("is_nan(1.0)", False),
+    # regexp
+    ("regexp_like('hello world', 'w.rld')", True),
+    ("regexp_like('hello', '^x')", False),
+    ("regexp_extract('ab12cd', '[0-9]+')", "12"),
+    ("regexp_extract('ab12cd34', '([a-z]+)([0-9]+)', 2)", "12"),
+    ("regexp_extract('abc', '[0-9]+')", None),
+    ("regexp_replace('a1b2', '[0-9]', '_')", "a_b_"),
+    # json
+    ("json_extract_scalar('{\"a\": {\"b\": 7}}', '$.a.b')", "7"),
+    ("json_extract_scalar('{\"a\": [1, 2, 3]}', '$.a[1]')", "2"),
+    ("json_extract_scalar('{\"a\": \"x\"}', '$.a')", "x"),
+    ("json_extract_scalar('{\"a\": 1}', '$.missing')", None),
+    ("json_extract_scalar('not json', '$.a')", None),
+    ("json_extract('{\"a\": [1, 2]}', '$.a')", "[1, 2]"),
+    ("json_array_length('[1, 2, 3]')", 3),
+    ("is_json_scalar('7')", True),
+    ("is_json_scalar('[1]')", False),
+    # strings
+    ("split_part('a,b,c', ',', 2)", "b"),
+    ("split_part('a,b,c', ',', 9)", None),
+    ("translate('abcd', 'ac', 'xy')", "xbyd"),
+    ("levenshtein_distance('kitten', 'sitting')", 3),
+    ("hamming_distance('abcd', 'abxd')", 1),
+    ("from_base('ff', 16)", 255),
+    ("bit_length('ab')", 16),
+    ("octet_length('ab')", 2),
+    ("crc32('presto')", __import__("zlib").crc32(b"presto")),
+    # urls
+    ("url_extract_host('https://example.com:8080/p?q=1#f')",
+     "example.com"),
+    ("url_extract_protocol('https://example.com/p')", "https"),
+    ("url_extract_path('https://example.com/a/b')", "/a/b"),
+    ("url_extract_query('https://example.com/p?q=1&r=2')", "q=1&r=2"),
+    ("url_extract_fragment('https://example.com/p#frag')", "frag"),
+    # datetime
+    ("week(date '2024-01-04')", 1),
+    ("day_of_month(date '2024-02-29')", 29),
+    ("year_of_week(date '2021-01-01')", 2020),
+    # DATE surfaces as epoch days in rows() (CLI/DB-API decode it)
+    ("last_day_of_month(date '2024-02-05')", _days(2024, 2, 29)),
+    ("date_add('day', 10, date '2024-01-01')", _days(2024, 1, 11)),
+    ("date_add('week', 2, date '2024-01-01')", _days(2024, 1, 15)),
+    ("date_add('month', 1, date '2024-01-31')", _days(2024, 2, 29)),
+    ("date_add('year', -1, date '2024-02-29')", _days(2023, 2, 28)),
+    ("date_diff('day', date '2024-01-01', date '2024-03-01')", 60),
+    ("date_diff('week', date '2024-01-01', date '2024-01-20')", 2),
+    ("date_diff('month', date '2024-01-31', date '2024-03-30')", 1),
+    ("date_diff('month', date '2024-01-15', date '2024-03-15')", 2),
+    ("date_diff('year', date '2020-06-01', date '2024-05-01')", 3),
+    ("to_unixtime(from_unixtime(1700000000.0))", 1700000000.0),
+    # review-fix regressions
+    ("regexp_replace('ab', 'b', 'cost: $')", "acost: $"),
+    ("regexp_replace('ab12', '([a-z]+)([0-9]+)', '$2-$1')", "12-ab"),
+    ("date_diff('month', date '2024-01-31', date '2024-02-29')", 1),
+    ("date_diff('month', date '2024-01-15', date '2024-02-14')", 0),
+    ("date_add('month', 1, from_unixtime(1705315800.0))",
+     1705315800.0 * 0 + (1705315800 + 31 * 86400) * 1000),
+    ("date_diff('month', from_unixtime(1705320000.0), "
+     "from_unixtime(1707998400.0))", 1),
+    ("date_diff('month', from_unixtime(1705320000.0), "
+     "from_unixtime(1707994800.0))", 0),
+    ("json_array_length('{\"a\": 1}')", None),
+    ("from_base('zz', 10)", None),
+    ("hamming_distance('ab', 'abc')", None),
+]
+
+
+@pytest.mark.parametrize("expr,expected",
+                         CASES, ids=[c[0][:40] for c in CASES])
+def test_scalar_function(runner, expr, expected):
+    got = one(runner, expr)
+    if isinstance(expected, float):
+        assert got == pytest.approx(expected, rel=1e-12), expr
+    else:
+        assert got == expected, expr
+
+
+def test_function_count_minimum():
+    """The analyzer must register >= 150 distinct function names
+    (VERDICT r2 next-steps #7 sets the bar)."""
+    import os
+    import re
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(os.path.join(
+        here, "presto_tpu/planner/analyzer.py")).read()
+    names = set()
+    for m in re.finditer(r'name in \(([^)]+)\)', src):
+        names |= set(re.findall(r'"([a-z_0-9]+)"', m.group(1)))
+    for m in re.finditer(r'name == "([a-z_0-9]+)"', src):
+        names.add(m.group(1))
+    # aggregates + window functions register elsewhere
+    from presto_tpu.planner import analyzer as A
+    names |= set(getattr(A, "AGG_FUNCTIONS", ()))
+    names |= set(getattr(A, "WINDOW_FUNCTIONS", ()))
+    assert len(names) >= 150, (len(names), sorted(names))
+
+
+def test_moment_and_entropy_aggregates(runner):
+    """skewness/kurtosis/entropy vs scipy-free Python oracles over a
+    real column."""
+    rows = runner.execute(
+        "select skewness(acctbal), kurtosis(acctbal), "
+        "entropy(nationkey + 1) from customer").rows()[0]
+    import numpy as np
+    conn = runner.catalogs.connector("tpch")
+    df = conn.table_pandas("tiny", "customer")
+    x = df.acctbal.to_numpy()
+    n = len(x)
+    m = x.mean()
+    m2 = ((x - m) ** 2).mean()
+    m3 = ((x - m) ** 3).mean()
+    m4 = ((x - m) ** 4).mean()
+    skew = (n * (n - 1)) ** 0.5 / (n - 2) * m3 / m2 ** 1.5
+    g2 = m4 / m2 ** 2 - 3
+    kurt = (n - 1) / ((n - 2) * (n - 3)) * ((n + 1) * g2 + 6)
+    c = (df.nationkey + 1).to_numpy().astype(float)
+    t = c.sum()
+    ent = (np.log(t) - (c * np.log(c)).sum() / t) / np.log(2)
+    assert rows[0] == pytest.approx(skew, rel=1e-9)
+    assert rows[1] == pytest.approx(kurt, rel=1e-9)
+    assert rows[2] == pytest.approx(ent, rel=1e-9)
+
+
+def test_time_extracts_and_aliases(runner):
+    ts = "from_unixtime(1700000000.0)"  # 2023-11-14 22:13:20 UTC
+    assert one(runner, f"hour({ts})") == 22
+    assert one(runner, f"minute({ts})") == 13
+    assert one(runner, f"second({ts})") == 20
+    assert one(runner, f"millisecond({ts})") == 0
+    assert one(runner, "typeof(1.0)") == "double"
+    assert one(runner, "substring('hello', 2, 3)") == "ell"
+    assert one(runner, "char_length('abc')") == 3

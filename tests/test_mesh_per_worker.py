@@ -1,0 +1,113 @@
+"""Mesh-per-worker composition: 2 worker PROCESSES x 4 (virtual)
+devices each, one coordinator (reference deployment shape: one worker
+per host, the chips inside it device-parallel; the exchange consumer
+space is GLOBAL over sum(worker devices) so DCN pages address a
+specific (worker, device) by key hash — VERDICT r2 missing #5 /
+SURVEY §2.4).
+
+The workers run with XLA_FLAGS=--xla_force_host_platform_device_count=4
+and announce devices=4; the coordinator expands each fragment task into
+4 device subtasks per worker (8 global tasks) and routes rows by
+h % 8."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+
+def _spawn_worker(env, devices: int):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.server.node", "--port", "0",
+         "--devices", str(devices)],
+        cwd="/root/repo", env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    url = json.loads(proc.stdout.readline())["url"]
+    return proc, url
+
+
+@pytest.fixture(scope="module")
+def mesh_cluster():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    workers = []
+    urls = []
+    for _ in range(2):
+        proc, url = _spawn_worker(env, devices=4)
+        urls.append(url)
+        workers.append(proc)
+    from presto_tpu.server.coordinator import Coordinator
+    coord = Coordinator(urls, "tpch", "tiny",
+                        {"broadcast_join_threshold_rows": 500})
+    coord.start()
+    coord.check_workers()
+    yield coord
+    coord.stop()
+    for w in workers:
+        w.send_signal(signal.SIGTERM)
+    for w in workers:
+        try:
+            w.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            w.kill()
+
+
+@pytest.fixture(scope="module")
+def local_rows():
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+
+    def run(sql):
+        return r.execute(sql).rows()
+    return run
+
+
+def _assert_rows(got, want):
+    assert len(got) == len(want), f"{len(got)} != {len(want)}"
+    for g, w in zip(got, want):
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float):
+                assert abs(gv - wv) < 1e-6 * max(abs(wv), 1), (g, w)
+            else:
+                assert gv == wv, (g, w)
+
+
+def test_workers_announce_devices(mesh_cluster):
+    assert mesh_cluster._worker_devices(
+        mesh_cluster.worker_urls) == [4, 4]
+
+
+def test_q1_partial_final_over_8_global_tasks(mesh_cluster,
+                                              local_rows):
+    sys.path.insert(0, "/root/repo/tests")
+    from tpch_queries import QUERIES
+    _assert_rows(mesh_cluster.execute(QUERIES[1]).rows(),
+                 local_rows(QUERIES[1]))
+
+
+def test_repartitioned_join_across_worker_devices(mesh_cluster,
+                                                  local_rows):
+    # force the repartition path (no broadcast): same keys must meet
+    # on the same (worker, device)
+    sql = ("select o.orderpriority, count(*) c, sum(l.quantity) q "
+           "from orders o join lineitem l on l.orderkey = o.orderkey "
+           "group by o.orderpriority order by o.orderpriority")
+    _assert_rows(mesh_cluster.execute(sql).rows(), local_rows(sql))
+
+
+def test_broadcast_join_and_topn(mesh_cluster, local_rows):
+    sql = ("select n.name, count(*) c from customer c "
+           "join nation n on c.nationkey = n.nationkey "
+           "group by n.name order by c desc, n.name limit 5")
+    _assert_rows(mesh_cluster.execute(sql).rows(), local_rows(sql))
+
+
+def test_semi_join_and_order_by(mesh_cluster, local_rows):
+    sql = ("select custkey, acctbal from customer "
+           "where custkey in (select custkey from orders "
+           "                  where totalprice > 250000) "
+           "order by acctbal desc, custkey limit 10")
+    _assert_rows(mesh_cluster.execute(sql).rows(), local_rows(sql))

@@ -19,17 +19,27 @@ from test_tpch_suite import (
 from test_tpch_suite import oracle, runner  # noqa: F401 (fixtures)
 
 
+_CLEAR_EVERY = 6
+_counter = {"n": 0}
+
+
 @pytest.fixture(autouse=True)
 def _clear_jit_caches():
     """The CPU backend segfaults inside XLA compilation after many
     hundreds of multi-device executables accumulate in one process
-    (reproduced: full suite crashes around the 11th query; every subset
-    passes). Dropping compiled programs between queries keeps the
-    per-process executable count bounded. TPU backends don't exhibit
-    this; the workaround is test-only."""
+    (reproduced pre-round-3: full suite crashed around the 11th query;
+    every subset passes). Dropping compiled programs bounds the
+    per-process executable count — but clearing after EVERY query made
+    each test recompile the whole engine (~1 min apiece). The
+    round-3 quantized capacity ladder cut executables per query by an
+    order of magnitude, so a periodic clear keeps the bound with 6x
+    fewer recompiles. TPU backends don't exhibit the crash; the
+    workaround is test-only."""
     yield
-    import jax
-    jax.clear_caches()
+    _counter["n"] += 1
+    if _counter["n"] % _CLEAR_EVERY == 0:
+        import jax
+        jax.clear_caches()
 
 
 @pytest.fixture(scope="module")
