@@ -1050,9 +1050,16 @@ _MATH_FNS = {
     "log": lambda b, x: jnp.log(x) / jnp.log(b),
     "truncate": lambda x, d=None: jnp.trunc(x) if d is None
     else jnp.trunc(x * 10.0 ** d) / 10.0 ** d,
-    "width_bucket": lambda x, lo, hi, n: jnp.clip(
-        jnp.floor((x - lo) / jnp.maximum(hi - lo, 1e-300) * n) + 1,
-        0, n + 1).astype(jnp.int64),
+    # ascending OR descending bounds (reference: MathFunctions
+    # widthBucket supports bound1 > bound2)
+    "width_bucket": lambda x, lo, hi, n: jnp.where(
+        hi >= lo,
+        jnp.clip(jnp.floor((x - lo)
+                           / jnp.where(hi != lo, hi - lo, 1.0) * n)
+                 + 1, 0, n + 1),
+        jnp.clip(jnp.floor((lo - x)
+                           / jnp.where(hi != lo, lo - hi, 1.0) * n)
+                 + 1, 0, n + 1)).astype(jnp.int64),
     "bitwise_and": jnp.bitwise_and,
     "bitwise_or": jnp.bitwise_or,
     "bitwise_xor": jnp.bitwise_xor,
@@ -1181,8 +1188,6 @@ def _json_extract_scalar(doc: str, path: str):
         return None
     if isinstance(v, bool):
         return "true" if v else "false"
-    if isinstance(v, float) and v == int(v):
-        return str(v)
     return str(v)
 
 
@@ -1192,9 +1197,11 @@ def _regexp_extract(v: str, pattern: str, group: int = 0):
     if m is None:
         return None
     try:
-        return m.group(int(group)) or ""
+        g = m.group(int(group))
     except IndexError:
         return None
+    # a group that did not participate in the match is SQL NULL
+    return g
 
 
 def _split_part(v: str, delim: str, index: int):
@@ -1252,6 +1259,7 @@ _STRING_TO_STRING = {
          for i, f in enumerate(frm)}),
     "normalize": lambda v: __import__("unicodedata").normalize(
         "NFC", v),
+    "split_join": lambda v, d, sep: sep.join(v.split(d)),
     "replace": lambda v, find, repl="": v.replace(find, repl),
     "lpad": lambda v, n, pad=" ": _pad(v, n, pad, left=True),
     "rpad": lambda v, n, pad=" ": _pad(v, n, pad, left=False),
@@ -1291,6 +1299,7 @@ _STRING_TO_INT = {
     "strpos": lambda v, sub: v.find(sub) + 1,
     "codepoint": lambda v: ord(v[0]) if v else 0,
     "levenshtein_distance": lambda v, other: _levenshtein(v, other),
+    "split_count": lambda v, d: len(v.split(d)),
     "bit_length": lambda v: len(v.encode()) * 8,
     "octet_length": lambda v: len(v.encode()),
     "crc32": lambda v: __import__("zlib").crc32(v.encode()),

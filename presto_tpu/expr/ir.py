@@ -62,6 +62,23 @@ FLIP_COMPARISON = {
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayValue(RowExpression):
+    """ANALYSIS-TIME-ONLY fixed-width array value: element expressions
+    plus an optional dynamic length expression (None = the static
+    element count). Every consumer (subscript, cardinality, contains,
+    UNNEST, ...) lowers it to scalar IR during analysis — it never
+    reaches the expression compiler, which keeps the device
+    representation fully static-shape (the TPU answer to ragged
+    arrays; reference: common/type/ArrayType's offsets+child block)."""
+    elements: tuple
+    length: Optional["RowExpression"]
+    type: "Type"
+    #: provenance for consumer rewrites, e.g. ("split", s, delim) lets
+    #: array_join lower to one host-side string function
+    origin: Optional[tuple] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class SpecialForm(RowExpression):
     """Non-function forms with their own evaluation/null rules
     (reference: spi SpecialFormExpression.Form): AND OR NOT IF COALESCE

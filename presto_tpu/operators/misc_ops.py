@@ -202,14 +202,14 @@ class UnnestOperator(Operator):
     code space covers all replicas."""
 
     def __init__(self, ctx: OperatorContext,
-                 items: Sequence[Tuple[str, List[str]]],
+                 items: Sequence[Tuple[str, List[str], Optional[str]]],
                  ordinality_symbol: Optional[str],
                  out_dicts: Dict[str, Optional[tuple]]):
         super().__init__(ctx)
         self.items = list(items)
         self.ordinality_symbol = ordinality_symbol
         self.out_dicts = out_dicts
-        self.depth = max(len(syms) for _, syms in items)
+        self.depth = max(len(syms) for _, syms, _ in items)
         self._pending: List[Batch] = []
         self._finishing = False
 
@@ -223,24 +223,44 @@ class UnnestOperator(Operator):
         cap = batch.capacity
         for i in range(self.depth):
             cols = dict(batch.columns)
-            for out_sym, elem_syms in self.items:
+            row_keep = None
+            for out_sym, elem_syms, len_sym in self.items:
+                # dynamic length (split etc.): element i exists for a
+                # row iff i < its true length; static arrays use the
+                # slot count
+                if len_sym is not None:
+                    lcol = batch.columns[len_sym]
+                    in_arr = lcol.mask & (lcol.data > i)
+                else:
+                    in_arr = None  # statically in range (or padding)
                 if i < len(elem_syms):
                     col = batch.columns[elem_syms[i]]
                     target = self.out_dicts.get(out_sym)
                     if target is not None \
                             and col.dictionary != target:
                         col = remap_column(col, target)
+                    if in_arr is not None:
+                        col = Column(col.data, col.mask & in_arr,
+                                     col.type, col.dictionary)
+                    item_has = in_arr if in_arr is not None else \
+                        jnp.ones(cap, bool)
                 else:  # zip padding: NULL element
                     ref = batch.columns[elem_syms[0]]
                     col = Column(ref.data, jnp.zeros(cap, bool),
                                  ref.type,
                                  self.out_dicts.get(out_sym))
+                    item_has = jnp.zeros(cap, bool) \
+                        if in_arr is None else in_arr
                 cols[out_sym] = col
+                row_keep = item_has if row_keep is None \
+                    else (row_keep | item_has)
             if self.ordinality_symbol is not None:
                 cols[self.ordinality_symbol] = Column(
                     jnp.full(cap, i + 1, jnp.int64),
                     jnp.ones(cap, bool), BIGINT, None)
-            self._pending.append(Batch(cols, batch.row_valid))
+            rv = batch.row_valid if row_keep is None \
+                else batch.row_valid & row_keep
+            self._pending.append(Batch(cols, rv))
 
     def get_output(self) -> Optional[Batch]:
         if not self._pending:

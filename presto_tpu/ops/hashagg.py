@@ -343,10 +343,10 @@ def make_moments(kind: str) -> AggFunction:
         m3 = s3 / n - 3 * m * s2 / n + 2 * m ** 3
         m4 = s4 / n - 4 * m * s3 / n + 6 * m * m * s2 / n - 3 * m ** 4
         if kind == "skewness":
-            # Presto: sqrt(n) * m3 / m2^1.5 with sample correction
+            # Presto CentralMomentsAggregation: g1 = m3 / m2^1.5,
+            # UNcorrected (kurtosis below IS sample-corrected)
             denom = jnp.maximum(m2, 1e-300) ** 1.5
-            g1 = m3 / denom
-            v = jnp.sqrt(n * (n - 1)) / jnp.maximum(n - 2, 1) * g1
+            v = m3 / denom
             mask = n_i > 2
         else:  # kurtosis (excess, sample-corrected)
             denom = jnp.maximum(m2 * m2, 1e-300)

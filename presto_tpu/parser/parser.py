@@ -172,7 +172,7 @@ class _Parser:
         if self.accept_kw("session"):
             return T.ShowSession()
         if self.accept_kw("functions"):
-            return T.ShowSession()  # placeholder listing
+            return T.ShowFunctions()
         raise ParseError(f"unsupported SHOW at {self.cur.pos}")
 
     # -- queries -----------------------------------------------------------
@@ -598,7 +598,14 @@ class _Parser:
             return T.UnaryOp("-", self.unary())
         if self.accept_op("+"):
             return self.unary()
-        return self.primary()
+        e = self.primary()
+        # postfix subscript: expr[index] (array element access)
+        while self.cur.kind == "op" and self.cur.value == "[":
+            self.advance()
+            idx = self.expr()
+            self.expect_op("]")
+            e = T.Subscript(e, idx)
+        return e
 
     def primary(self) -> T.Node:
         t = self.cur

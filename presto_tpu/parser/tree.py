@@ -230,6 +230,12 @@ class QuerySpec(Node):
 
 
 @dataclasses.dataclass
+class Subscript(Node):
+    base: "Node" = None
+    index: "Node" = None
+
+
+@dataclasses.dataclass
 class ArrayConstructor(Node):
     items: List[Node]
 
@@ -300,6 +306,11 @@ class ShowColumns(Node):
 
 @dataclasses.dataclass
 class ShowSession(Node):
+    pass
+
+
+@dataclasses.dataclass
+class ShowFunctions(Node):
     pass
 
 

@@ -524,6 +524,12 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
     item_key_to_symbol: Dict[tuple, str] = {}
     for item in select_items:
         e = fold_constants(an.analyze(item.expr))
+        from presto_tpu.expr.ir import ArrayValue
+        if isinstance(e, ArrayValue):
+            raise AnalysisError(
+                "array values cannot be projected as columns yet — "
+                "consume them with element_at/cardinality/contains/"
+                "array_join or UNNEST")
         name = item.alias or _derive_name(item.expr)
         sym = ctx.symbols.new(name)
         assignments.append((sym, e))
@@ -1483,33 +1489,28 @@ def _plan_unnest(un: T.Unnest, source: Optional[RelationPlan],
         # the visible scope (SELECT * shows only unnested columns)
         source, _ = _plan_values(
             T.ValuesRelation([[T.NumberLit("0")]]), ctx)
+    from presto_tpu.expr.ir import ArrayValue
     an = _Analyzer(source.scope, ctx)
     arrays: List[List[RowExpression]] = []
+    lengths: List[Optional[RowExpression]] = []
     for a in un.args:
-        if not isinstance(a, T.ArrayConstructor):
+        av = an.analyze(a)
+        if not isinstance(av, ArrayValue):
             raise AnalysisError(
-                "UNNEST supports ARRAY[...] constructors")
-        if not a.items:
+                "UNNEST requires an array value (ARRAY[...] or an "
+                "array-producing function like split)")
+        if not av.elements:
             raise AnalysisError("cannot UNNEST an empty array")
-        elems = [fold_constants(an.analyze(e)) for e in a.items]
-        t = UNKNOWN
-        for e in elems:
-            st = common_super_type(t, e.type)
-            if st is None:
-                raise AnalysisError(
-                    "UNNEST array element types are incompatible")
-            t = st
-        if t == UNKNOWN:
+        if av.type.element == UNKNOWN:
             raise AnalysisError("cannot UNNEST an all-NULL array")
-        elems = [e if e.type == t else _coerce_to(e, t)
-                 for e in elems]
-        arrays.append(elems)
+        arrays.append(list(av.elements))
+        lengths.append(av.length)
 
     src_fields = tuple(source.node.output)
     assigns = [(f.symbol, InputRef(f.symbol, f.type))
                for f in src_fields]
     proj_fields = list(src_fields)
-    items: List[Tuple[str, List[str]]] = []
+    items: List[Tuple[str, List[str], Optional[str]]] = []
     new_fields: List[N.Field] = []
     for j, elems in enumerate(arrays):
         t = elems[0].type
@@ -1526,8 +1527,15 @@ def _plan_unnest(un: T.Unnest, source: Optional[RelationPlan],
             proj_fields.append(N.Field(s, e.type,
                                        an.dictionary_of(e)))
             elem_syms.append(s)
+        len_sym = None
+        if lengths[j] is not None:
+            # dynamic length (e.g. split): rows emit only their true
+            # element count, not the static width
+            len_sym = ctx.symbols.new("unnest_len")
+            assigns.append((len_sym, lengths[j]))
+            proj_fields.append(N.Field(len_sym, BIGINT, None))
         out_sym = ctx.symbols.new("unnest")
-        items.append((out_sym, elem_syms))
+        items.append((out_sym, elem_syms, len_sym))
         new_fields.append(N.Field(out_sym, t, union_dict))
     ord_sym = None
     if un.ordinality:
@@ -2442,6 +2450,78 @@ class _Analyzer:
             raise AnalysisError(f"EXTRACT({field}) not supported")
         return Call(field, (e,), BIGINT)
 
+    def _an_ArrayConstructor(self, a: T.ArrayConstructor):
+        """ARRAY[...] as an EXPRESSION: a fixed-width analysis-time
+        value; consumers (subscript, cardinality, UNNEST, ...) lower it
+        to scalar IR (see ir.ArrayValue)."""
+        from presto_tpu.types import array_type
+        if not a.items:
+            raise AnalysisError("empty ARRAY[] needs a type context")
+        elems = [fold_constants(self.analyze(x)) for x in a.items]
+        t = UNKNOWN
+        for e in elems:
+            st = common_super_type(t, e.type)
+            if st is None:
+                raise AnalysisError(
+                    "ARRAY element types are incompatible")
+            t = st
+        if t == UNKNOWN:
+            t = BIGINT
+        elems = [e if e.type == t else _coerce_to(e, t) for e in elems]
+        from presto_tpu.expr.ir import ArrayValue
+        return ArrayValue(tuple(elems), None, array_type(t))
+
+    def _array_element_switch(self, arr, idx: RowExpression):
+        """element_at / subscript over a fixed-width array: constant
+        index picks the element expression (negative counts from the
+        ROW's end — a length switch when the array is dynamic); a
+        dynamic index lowers to an if-chain over the static width
+        (1-based positive; dynamic NEGATIVE indexes are unsupported
+        and yield NULL)."""
+        elems = arr.elements
+        et = arr.type.element
+        if isinstance(idx, Literal):
+            i = int(idx.value)
+            if i < 0 and arr.length is not None:
+                # element len+1+i, switching on the dynamic length
+                out: RowExpression = Literal(None, et)
+                for ln in range(len(elems), 0, -1):
+                    pos = ln + 1 + i
+                    if 1 <= pos <= ln:
+                        out = SpecialForm(
+                            "if",
+                            (Call("equal",
+                                  (arr.length, Literal(ln, BIGINT)),
+                                  BOOLEAN), elems[pos - 1], out), et)
+                return out
+            if i < 0:  # static: count from the static end
+                i = len(elems) + 1 + i
+            if 1 <= i <= len(elems):
+                return elems[i - 1]
+            return Literal(None, et)
+        out = Literal(None, et)
+        for i in range(len(elems), 0, -1):
+            out = SpecialForm(
+                "if", (Call("equal", (idx, Literal(i, BIGINT)),
+                            BOOLEAN), elems[i - 1], out), et)
+        return out
+
+    def _array_guard(self, arr, i: int) -> Optional[RowExpression]:
+        """True iff slot i (1-based) is a REAL element of the row's
+        array (None when statically guaranteed)."""
+        if arr.length is None:
+            return None
+        return Call("less_than_or_equal",
+                    (Literal(i, BIGINT), arr.length), BOOLEAN)
+
+    def _an_Subscript(self, a: T.Subscript):
+        from presto_tpu.expr.ir import ArrayValue
+        base = self.analyze(a.base)
+        if not isinstance(base, ArrayValue):
+            raise AnalysisError("subscript requires an array value")
+        return self._array_element_switch(
+            base, fold_constants(self.analyze(a.index)))
+
     def _an_FunctionCall(self, a: T.FunctionCall):
         name = a.name
         if name in AGG_FUNCTIONS and a.window is None:
@@ -2451,7 +2531,118 @@ class _Analyzer:
             raise AnalysisError("window functions not yet supported "
                                 "in this position")
         args = [self.analyze(x) for x in a.args]
+        arr = self._resolve_array_fn(name, args)
+        if arr is not None:
+            return arr
         return self._resolve_scalar(name, args)
+
+    def _resolve_array_fn(self, name: str, args):
+        """Array functions lower to scalar IR over the fixed-width
+        elements (reference: operator/scalar/ArrayFunctions et al,
+        re-expressed as static expression forms)."""
+        from presto_tpu.expr.ir import ArrayValue
+        from presto_tpu.types import array_type
+
+        if name == "split":
+            # split(s, delim): W = max parts over s's DICTIONARY (the
+            # dictionary is host-side and static at analysis time), so
+            # a data-dependent array still has a static device width
+            if len(args) != 2:
+                raise AnalysisError("split(s, delimiter) takes two "
+                                    "arguments")
+            s, d = args
+            if not isinstance(d, Literal) or not isinstance(
+                    d.value, str) or d.value == "":
+                raise AnalysisError(
+                    "split delimiter must be a non-empty string "
+                    "constant")
+            dic = self.dictionary_of(s) or ()
+            w = max([len(v.split(d.value)) for v in dic] or [1])
+            elems = tuple(
+                Call("split_part", (s, d, Literal(i, BIGINT)), VARCHAR)
+                for i in range(1, w + 1))
+            length = Call("split_count", (s, d), BIGINT)
+            return ArrayValue(elems, length, array_type(VARCHAR),
+                              origin=("split", s, d))
+
+        has_array = args and isinstance(args[0], ArrayValue)
+        if not has_array:
+            return None
+        arr = args[0]
+        elems = arr.elements
+        et = arr.type.element
+        if name == "cardinality":
+            return arr.length if arr.length is not None \
+                else Literal(len(elems), BIGINT)
+        if name == "element_at":
+            return self._array_element_switch(
+                arr, fold_constants(args[1]))
+        if name == "contains":
+            x = _coerce_to(args[1], et)
+            from presto_tpu.expr.ir import and_, or_
+            terms = []
+            for i, e in enumerate(elems, 1):
+                eq = Call("equal", (e, x), BOOLEAN)
+                g = self._array_guard(arr, i)
+                # guard padding slots: (i <= len) AND eq — Kleene AND
+                # turns the structural-NULL slot into false, so a
+                # missing value yields false, not NULL
+                terms.append(eq if g is None else and_(g, eq))
+            return or_(*terms) if len(terms) > 1 else terms[0]
+        if name == "array_position":
+            x = _coerce_to(args[1], et)
+            from presto_tpu.expr.ir import and_
+            out: RowExpression = Literal(0, BIGINT)
+            for i in range(len(elems), 0, -1):
+                eq = Call("equal", (elems[i - 1], x), BOOLEAN)
+                g = self._array_guard(arr, i)
+                cond = eq if g is None else and_(g, eq)
+                out = SpecialForm(
+                    "if", (cond, Literal(i, BIGINT), out), BIGINT)
+            return out
+        if name in ("array_min", "array_max"):
+            if et.is_string:
+                raise AnalysisError(
+                    f"{name} over varchar arrays is not supported "
+                    "(element dictionaries are per-slot)")
+            fn = "least" if name == "array_min" else "greatest"
+            if arr.length is None:
+                return Call(fn, elems, et) if len(elems) > 1 \
+                    else elems[0]
+            # dynamic length: fold with per-slot guards so padding
+            # slots never poison the result
+            acc: RowExpression = elems[0]
+            for i in range(2, len(elems) + 1):
+                g = self._array_guard(arr, i)
+                acc = SpecialForm(
+                    "if", (g, Call(fn, (acc, elems[i - 1]), et), acc),
+                    et)
+            return acc
+        if name == "array_join":
+            sep = args[1]
+            if not isinstance(sep, Literal):
+                raise AnalysisError(
+                    "array_join separator must be a constant")
+            if not et.is_string:
+                raise AnalysisError(
+                    "array_join requires varchar elements")
+            if arr.origin is not None and arr.origin[0] == "split":
+                # split->join collapses to one host string function
+                _, s, d = arr.origin
+                return Call("split_join", (s, d, sep), VARCHAR)
+            if arr.length is not None:
+                raise AnalysisError(
+                    "array_join over this dynamic array is not "
+                    "supported")
+            parts: List[RowExpression] = []
+            for i, e in enumerate(elems):
+                if i:
+                    parts.append(sep)
+                parts.append(e)
+            return Call("concat", tuple(parts), VARCHAR) \
+                if len(parts) > 1 else parts[0]
+        raise AnalysisError(
+            f"{name} over array values is not supported")
 
     def _resolve_scalar(self, name: str, args: List[RowExpression]):
         if name in ("if",):

@@ -538,7 +538,7 @@ class LocalExecutionPlanner:
     def _visit_UnnestNode(self, node: N.UnnestNode, pipe: List):
         self._visit(node.source, pipe)
         out_dicts = {s: node.field(s).dictionary
-                     for s, _ in node.items}
+                     for s, _, _ in node.items}
         pipe.append(misc_ops.UnnestOperatorFactory(
             self._next_id(), node.items, node.ordinality_symbol,
             out_dicts))
@@ -760,10 +760,11 @@ def _child_demand(node: N.PlanNode, demand: set
         drop = {node.gid_symbol} | {s for s, _ in node.grouping_outputs}
         return [(node.source, (demand - drop) | set(node.all_keys))]
     if isinstance(node, N.UnnestNode):
-        drop = {s for s, _ in node.items}
+        drop = {s for s, _, _ in node.items}
         if node.ordinality_symbol:
             drop.add(node.ordinality_symbol)
-        elem = {e for _, syms in node.items for e in syms}
+        elem = {e for _, syms, _ in node.items for e in syms}
+        elem |= {ls for _, _, ls in node.items if ls}
         return [(node.source, (demand - drop) | elem)]
     if isinstance(node, N.UnionNode):
         out = []
@@ -831,7 +832,7 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
             set(node.all_keys) | {node.gid_symbol}
             | {s for s, _ in node.grouping_outputs})
     elif isinstance(node, N.UnnestNode):
-        keep = {s for s, _ in node.items}
+        keep = {s for s, _, _ in node.items}
         if node.ordinality_symbol:
             keep.add(node.ordinality_symbol)
         node.output = narrowed(keep)

@@ -226,8 +226,9 @@ class UnnestNode(PlanNode):
     column (pre-projected below this node); shorter arrays pad NULL
     (zip semantics), plus an optional 1-based ordinality column."""
     source: PlanNode
-    # per unnested array: (output symbol, element symbol per slot)
-    items: List[Tuple[str, List[str]]]
+    # per unnested array: (output symbol, element symbol per slot,
+    # optional dynamic-length symbol — None means the static width)
+    items: List[Tuple[str, List[str], Optional[str]]]
     ordinality_symbol: Optional[str]
     output: Tuple[Field, ...]
 

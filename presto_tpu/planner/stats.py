@@ -194,7 +194,7 @@ class StatsEstimator:
 
     def _est_UnnestNode(self, node: N.UnnestNode) -> PlanStats:
         inner = self.estimate(node.source)
-        depth = max(len(s) for _, s in node.items)
+        depth = max(len(s) for _, s, _ in node.items)
         return PlanStats(depth * inner.rows, dict(inner.columns))
 
     def _est_UnionNode(self, node: N.UnionNode) -> PlanStats:

@@ -135,7 +135,8 @@ class LocalRunner:
         if isinstance(stmt, T.Explain):
             return self._explain(stmt)
         if isinstance(stmt, (T.ShowTables, T.ShowSchemas, T.ShowCatalogs,
-                             T.ShowColumns, T.ShowSession)):
+                             T.ShowColumns, T.ShowSession,
+                             T.ShowFunctions)):
             return self._show(stmt)
         if isinstance(stmt, T.SetSession):
             return self._set_session(stmt)
@@ -517,6 +518,17 @@ class LocalRunner:
             b = Batch.from_pydict({
                 "column": ([r[0] for r in rows], VARCHAR),
                 "type": ([r[1] for r in rows], VARCHAR)})
+            return MaterializedResult(
+                names, [b],
+                tuple(N.Field(n, VARCHAR) for n in names))
+        if isinstance(stmt, T.ShowFunctions):
+            from presto_tpu.functions import registered_functions
+            from presto_tpu.types import VARCHAR
+            fns = registered_functions()
+            b = Batch.from_pydict({
+                "function": ([n for n, _ in fns], VARCHAR),
+                "kind": ([k for _, k in fns], VARCHAR)})
+            names = ["Function", "Kind"]
             return MaterializedResult(
                 names, [b],
                 tuple(N.Field(n, VARCHAR) for n in names))

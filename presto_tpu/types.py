@@ -59,6 +59,10 @@ class Type:
         return isinstance(self, DecimalType)
 
     @property
+    def is_array(self) -> bool:
+        return False
+
+    @property
     def is_numeric(self) -> bool:
         return self.is_integer or self.is_floating or self.is_decimal
 
@@ -89,6 +93,38 @@ class DecimalType(Type):
 
     def display(self) -> str:
         return repr(self)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class ArrayType(Type):
+    """ARRAY(element) stored FIXED-WIDTH on device: data [cap, W],
+    per-element mask [cap, W] (False past each row's length), where W
+    is a per-batch static width — the array analog of the power-of-two
+    capacity bucket. Dense 2-D blocks are the TPU-native layout (no
+    ragged offsets on device); W is chosen statically at construction
+    (constructor arity, dictionary-derived split width, or the bounded
+    array_agg cap). Reference: common/type/ArrayType.java (offsets +
+    child block) re-shaped for static-shape XLA."""
+
+    element: Type = None
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return self.element.np_dtype
+
+    @property
+    def is_array(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return f"array({self.element!r})"
+
+    def display(self) -> str:
+        return f"array({self.element.display()})"
+
+
+def array_type(element: Type) -> ArrayType:
+    return ArrayType("array", element)
 
 
 def decimal_type(precision: int, scale: int) -> DecimalType:

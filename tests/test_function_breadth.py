@@ -166,7 +166,7 @@ def test_moment_and_entropy_aggregates(runner):
     m2 = ((x - m) ** 2).mean()
     m3 = ((x - m) ** 3).mean()
     m4 = ((x - m) ** 4).mean()
-    skew = (n * (n - 1)) ** 0.5 / (n - 2) * m3 / m2 ** 1.5
+    skew = m3 / m2 ** 1.5  # Presto: uncorrected g1
     g2 = m4 / m2 ** 2 - 3
     kurt = (n - 1) / ((n - 2) * (n - 3)) * ((n + 1) * g2 + 6)
     c = (df.nationkey + 1).to_numpy().astype(float)
@@ -186,3 +186,18 @@ def test_time_extracts_and_aliases(runner):
     assert one(runner, "typeof(1.0)") == "double"
     assert one(runner, "substring('hello', 2, 3)") == "ell"
     assert one(runner, "char_length('abc')") == 3
+
+
+def test_show_functions(runner):
+    """SHOW FUNCTIONS lists the registry (reference: SHOW FUNCTIONS
+    over BuiltInFunctionNamespaceManager.listFunctions); every listed
+    scalar must actually resolve in the analyzer."""
+    rows = runner.execute("show functions").rows()
+    names = {r[0] for r in rows}
+    kinds = {r[0]: r[1] for r in rows}
+    assert len(rows) >= 150
+    assert {"regexp_like", "date_add", "sum", "row_number"} <= names
+    assert kinds["sum"] == "aggregate"
+    assert kinds["row_number"] == "window"
+    assert kinds["regexp_like"] == "scalar"
+    assert rows == sorted(rows)  # deterministic listing
