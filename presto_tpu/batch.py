@@ -345,6 +345,55 @@ def _compact(batch: Batch) -> Batch:
     return Batch(cols, batch.row_valid[order])
 
 
+#: Outputs at or under this capacity skip the deferred count/compact
+#: round entirely — the padding is too small to matter downstream.
+COMPACT_FLOOR = 8192
+#: Smallest capacity a deferred compaction shrinks to (keeps the
+#: compiled-shape set small: tiny outputs all land on one bucket).
+COMPACT_MIN = 1024
+
+
+def start_async_copy(x):
+    """Kick off the device->host transfer of a scalar/array so a later
+    blocking read is a cache hit, not a fresh roundtrip. No-op off
+    jax.Array (host values, tracers)."""
+    try:
+        x.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
+    return x
+
+
+def begin_deferred_compact(batch: "Batch", total=None):
+    """Start the one-round-delayed compaction protocol on a selective
+    operator's output: kick off an async device->host copy of the live
+    count NOW, so that when the batch is emitted one driver round later
+    the count is already on the host and `end_deferred_compact` can
+    shrink the batch without a blocking roundtrip (reference seam: the
+    page-compaction policy of OptimizedPartitionedOutputOperator).
+    Pass `total` when the producing kernel already computed the live
+    count (the lookup-join probe does); otherwise one is dispatched
+    here. Returns (batch, count_token) — token None when the batch is
+    already small."""
+    if batch.capacity <= COMPACT_FLOOR:
+        return batch, None
+    return batch, start_async_copy(
+        jnp.sum(batch.row_valid) if total is None else total)
+
+
+def end_deferred_compact(batch: "Batch", total) -> "Batch":
+    """Consume the count started by begin_deferred_compact (normally a
+    cache hit, not a fresh roundtrip) and pack the batch down to its
+    live bucket."""
+    if total is None:
+        return batch
+    n = int(np.asarray(total))
+    cap = max(COMPACT_MIN, bucket_capacity(max(n, 1)))
+    if cap < batch.capacity:
+        return batch.compact(cap, known_valid=n)
+    return batch
+
+
 def unify_dictionaries(cols: Sequence[Column]) -> List[Column]:
     """Re-encode string columns onto a shared sorted dictionary so their
     codes are directly comparable (needed before joins/set-ops on VARCHAR).

@@ -55,6 +55,25 @@ _jit_merge = jax.jit(hashagg.merge_partials, static_argnums=(1, 2))
 #: so the per-input-row sort cost stays ~(1 + 1/FANIN + ...) ~ 1.15x
 _MERGE_FANIN = 8
 
+#: live-group count of a partial (consumed one round later, async)
+_jit_count = jax.jit(lambda valid: jnp.sum(valid))
+
+#: Smallest state capacity the shrink protocol packs down to. Keeps the
+#: compiled-shape set bounded (tiny partials all land on one bucket) and
+#: leaves the default-small aggregations (max_groups 4096) untouched.
+_SHRINK_FLOOR = 4096
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _shrink_state(st: "hashagg.GroupByState", cap: int):
+    """Slice a PACKED sort-path state down to `cap` slots. Safe because
+    _group_reduce lands live groups at the front (valid = slots < n);
+    callers guarantee cap >= live via the observed count."""
+    return hashagg.GroupByState(
+        [(d[:cap], m[:cap]) for d, m in st.keys],
+        [tuple(a[:cap] for a in t) for t in st.states],
+        st.valid[:cap], st.overflow)
+
 #: Whole-step kernel cache keyed by the expression IRs + agg layout so a
 #: re-executed (or structurally identical) query reuses the compiled XLA
 #: program. Fusing key/input evaluation INTO the fold step matters on
@@ -234,14 +253,21 @@ class AggregationOperator(Operator):
                 [s.function for s in self.specs], slots)
         else:
             # sort path: per-batch compacted partials sized by the
-            # BATCH (distinct <= rows), tree-merged level-wise with
-            # capacities growing toward max_groups — no running
-            # max_groups state re-sorted every batch, and no FANIN
-            # giant buffers for high-cardinality aggregations
+            # BATCH (distinct <= rows), then SHRUNK to their OBSERVED
+            # live-group bucket one driver round later (async d2h count,
+            # the join-output compaction protocol) and tree-merged at
+            # capacities derived from live counts — never from stats
+            # estimates or batch capacity. The reference sizes its
+            # tables from observation the same way
+            # (InMemoryHashAggregationBuilder grows from actual group
+            # count, never pre-allocates the estimate).
             self._state = None
             self._cap = bucket_capacity(max_groups)
+            #: cap -> [(state, live_upper_bound)]
             self._levels: Dict[int, list] = {}
-            self._host_spill: list = []
+            #: states awaiting their async live count: [(state, count)]
+            self._pending: list = []
+            self._host_spill: list = []  # [(host_state, live)]
             self.ctx.register_revocable(self._revoke)
         self._finishing = False
         self._emitted = False
@@ -262,9 +288,19 @@ class AggregationOperator(Operator):
             self._state = self._kernel(self._state, batch)
             return
         c0 = min(self._cap, bucket_capacity(batch.capacity))
-        self._push(self._kernel(c0, batch))
+        self._enqueue(self._kernel(c0, batch))
+        self._drain_pending(keep=1)
 
     # -- sort-path partial management ---------------------------------
+    #
+    # Every state (per-batch partial or merge output) passes through a
+    # one-slot pending queue: its live-group count's d2h copy starts at
+    # dispatch and is consumed ONE DRIVER ROUND LATER, by which time the
+    # transfer has overlapped real work — the hot loop never blocks on a
+    # fresh roundtrip. The resolved count drives (a) shrinking the state
+    # to its live bucket and (b) sizing every downstream merge, so a
+    # 56-row aggregation never sorts a stats-estimated half-million-slot
+    # shape (the round-3 Q18 failure mode).
 
     @staticmethod
     def _state_bytes(st) -> int:
@@ -275,72 +311,117 @@ class AggregationOperator(Operator):
     def _state_cap(st) -> int:
         return st.valid.shape[0]
 
-    def _merge_cap(self, states) -> int:
-        # distinct(union) <= sum of live rows <= sum of caps, so this
-        # capacity can only flag overflow when max_groups truly
-        # overflows
-        return min(self._cap, bucket_capacity(
-            sum(self._state_cap(s) for s in states)))
+    def _live_cap(self, lives: int) -> int:
+        """Capacity for a merge of states with `lives` total live
+        groups: distinct(union) <= sum of live counts, so this can only
+        flag overflow when max_groups truly overflows."""
+        return min(self._cap, max(_SHRINK_FLOOR,
+                                  bucket_capacity(max(lives, 1))))
 
-    def _push(self, st) -> None:
-        """Buffer a partial, keyed by CAPACITY: merges then always see
-        FANIN equal-shaped states, so the jit specialization count is
-        bounded by the handful of power-of-two caps — not by the
-        combinatorics of mixed-cap tuples."""
-        pool_reserve = self.ctx.driver_context.memory is not None
-        if pool_reserve:
+    def _enqueue(self, st) -> None:
+        from presto_tpu.batch import start_async_copy
+        cnt = start_async_copy(_jit_count(st.valid))
+        if self.ctx.driver_context.memory is not None:
             self.ctx.driver_context.memory.reserve(
                 self.ctx.tag, self._state_bytes(st))
+        self._pending.append((st, cnt))
+
+    def _drain_pending(self, keep: int) -> None:
+        pool = self.ctx.driver_context.memory
+        while len(self._pending) > keep:
+            if keep and len(self._pending) <= keep + 2:
+                # a merge output's count may have been dispatched only
+                # this round — give it more overlap time unless the
+                # queue is backing up (bounded at keep+2)
+                try:
+                    if not self._pending[0][1].is_ready():
+                        break
+                except AttributeError:
+                    pass
+            st, cnt = self._pending.pop(0)
+            live = int(np.asarray(cnt))
+            cap = self._state_cap(st)
+            tgt = min(cap, self._live_cap(live))
+            if tgt < cap:
+                shrunk = _shrink_state(st, tgt)
+                if pool is not None:
+                    pool.free(self.ctx.tag, self._state_bytes(st))
+                    pool.reserve(self.ctx.tag,
+                                 self._state_bytes(shrunk))
+                st = shrunk
+            self._push(st, live)
+
+    def _push(self, st, live: int) -> None:
+        """Buffer a counted partial, keyed by CAPACITY: merges then
+        always see FANIN equal-shaped states, so the jit specialization
+        count is bounded by the handful of power-of-two caps — not by
+        the combinatorics of mixed-cap tuples. Merge outputs re-enter
+        the pending queue (append only — the _drain_pending loop picks
+        them up next iteration; no recursion)."""
         cap = self._state_cap(st)
         buf = self._levels.setdefault(cap, [])
-        buf.append(st)
+        buf.append((st, live))
         if len(buf) >= _MERGE_FANIN:
             aggs = tuple(s.function for s in self.specs)
-            merged = _jit_merge(tuple(buf), aggs, self._merge_cap(buf))
-            if pool_reserve:
+            states = tuple(s for s, _ in buf)
+            lives = sum(l for _, l in buf)
+            merged = _jit_merge(states, aggs, self._live_cap(lives))
+            if self.ctx.driver_context.memory is not None:
                 self.ctx.driver_context.memory.free(
                     self.ctx.tag,
-                    sum(self._state_bytes(s) for s in buf))
+                    sum(self._state_bytes(s) for s in states))
             self._levels[cap] = []
-            self._push(merged)
+            self._enqueue(merged)
 
-    def _merge_mixed(self, states):
-        """Merge leftover states of assorted caps with a bounded set of
-        kernel shapes: same-cap groups first (padded to FANIN with
-        empty states so each cap has ONE specialization), then a
-        pairwise ladder across ascending caps."""
+    def _merge_mixed(self, entries):
+        """Merge leftover (state, live) pairs of assorted caps with a
+        bounded set of kernel shapes: same-cap groups first (padded to
+        FANIN with empty states so each cap has ONE specialization),
+        then a pairwise ladder across ascending caps — every output
+        sized from live counts."""
         aggs = tuple(s.function for s in self.specs)
         key_types = [k.type for k in self.key_exprs]
         by_cap: Dict[int, list] = {}
-        for s in states:
-            by_cap.setdefault(self._state_cap(s), []).append(s)
+        for s, l in entries:
+            by_cap.setdefault(self._state_cap(s), []).append((s, l))
         level: list = []
         for cap in sorted(by_cap):
             group = by_cap[cap]
             if len(group) == 1:
                 level.append(group[0])
                 continue
+            lives = sum(l for _, l in group)
             while len(group) < _MERGE_FANIN:
-                group.append(hashagg.init_state(key_types, aggs, cap))
-            level.append(_jit_merge(tuple(group), aggs,
-                                    self._merge_cap(group)))
-        level.sort(key=self._state_cap)
+                group.append(
+                    (hashagg.init_state(key_types, aggs, cap), 0))
+            merged = _jit_merge(tuple(s for s, _ in group), aggs,
+                                self._live_cap(lives))
+            level.append((merged, lives))
+        level.sort(key=lambda e: self._state_cap(e[0]))
         while len(level) > 1:
-            a, b = level.pop(0), level.pop(0)
-            m = _jit_merge((a, b), aggs, self._merge_cap((a, b)))
-            level.append(m)
-            level.sort(key=self._state_cap)
-        return level[0]
+            (sa, la), (sb, lb) = level.pop(0), level.pop(0)
+            m = _jit_merge((sa, sb), aggs, self._live_cap(la + lb))
+            level.append((m, la + lb))
+            level.sort(key=lambda e: self._state_cap(e[0]))
+        return level[0][0]
 
     def _revoke(self) -> int:
-        """Pool callback: park every buffered partial in host RAM."""
-        states = [s for buf in self._levels.values() for s in buf]
-        if not states:
+        """Pool callback: park every buffered partial in host RAM.
+        Pending (uncounted) states get their live count from the host
+        copy itself — the revoke path is allowed to sync."""
+        entries = [e for buf in self._levels.values() for e in buf]
+        for st, cnt in self._pending:
+            entries.append((st, None))
+        self._pending = []
+        if not entries:
             return 0
-        freed = sum(self._state_bytes(s) for s in states)
-        for s in states:
-            self._host_spill.append(jax.device_get(s))
-            self.ctx.count_spill(1, self._state_bytes(s))
+        freed = sum(self._state_bytes(s) for s, _ in entries)
+        for s, live in entries:
+            host = jax.device_get(s)
+            if live is None:
+                live = int(np.sum(np.asarray(host.valid)))
+            self._host_spill.append((host, live))
+            self.ctx.count_spill(1, self._state_bytes(host))
         self._levels = {}
         pool = self.ctx.driver_context.memory
         if pool is not None:
@@ -350,31 +431,36 @@ class AggregationOperator(Operator):
     def _final_state(self):
         aggs = tuple(s.function for s in self.specs)
         key_types = [k.type for k in self.key_exprs]
-        states = [s for buf in self._levels.values() for s in buf]
+        self._drain_pending(keep=0)
+        entries = [e for buf in self._levels.values() for e in buf]
         self._levels = {}
         if self._host_spill:
             # spilled run: restore + merge host-resident partials one
             # same-cap FANIN group at a time, keeping only one merge
             # group on device at once
-            for s in states:
-                self._host_spill.append(jax.device_get(s))
-            work = sorted(self._host_spill, key=self._state_cap)
+            for s, l in entries:
+                self._host_spill.append((jax.device_get(s), l))
+            work = sorted(self._host_spill,
+                          key=lambda e: self._state_cap(e[0]))
             self._host_spill = []
             while len(work) > _MERGE_FANIN:
-                group = [jax.device_put(s) for s in work[:_MERGE_FANIN]]
-                merged = _jit_merge(tuple(group), aggs,
-                                    self._merge_cap(group))
+                group = work[:_MERGE_FANIN]
+                lives = sum(l for _, l in group)
+                merged = _jit_merge(
+                    tuple(jax.device_put(s) for s, _ in group), aggs,
+                    self._live_cap(lives))
                 work = work[_MERGE_FANIN:]
-                work.append(jax.device_get(merged))
-                work.sort(key=self._state_cap)
+                work.append((jax.device_get(merged), lives))
+                work.sort(key=lambda e: self._state_cap(e[0]))
             if not work:
                 return hashagg.init_state(key_types, aggs, self._cap)
-            return self._merge_mixed([jax.device_put(s) for s in work])
-        if not states:
+            return self._merge_mixed(
+                [(jax.device_put(s), l) for s, l in work])
+        if not entries:
             return hashagg.init_state(key_types, aggs, self._cap)
-        if len(states) > 1:
-            return self._merge_mixed(states)
-        return states[0]
+        if len(entries) > 1:
+            return self._merge_mixed(entries)
+        return entries[0][0]
 
     def get_output(self) -> Optional[Batch]:
         if not self._finishing or self._emitted:
@@ -429,6 +515,7 @@ class AggregationOperator(Operator):
             self.ctx.unregister_revocable()
             self.ctx.release_all()
             self._levels = {}
+            self._pending = []
             self._host_spill = []
 
 

@@ -186,28 +186,46 @@ def make_filter_project_kernel(
 
 
 class FilterProjectOperator(Operator):
-    def __init__(self, ctx: OperatorContext, kernel):
+    """`selective` (a filter is present) enables the one-round-delayed
+    count/compact protocol on outputs: a selective filter that emits a
+    handful of rows into a fat batch otherwise sends every downstream
+    operator sorting/merging dead lanes. Pure projections never change
+    row_valid, so they skip the count dispatch entirely."""
+
+    def __init__(self, ctx: OperatorContext, kernel,
+                 selective: bool = False):
         super().__init__(ctx)
         self._kernel = kernel
-        self._pending: Optional[Batch] = None
+        self._selective = selective
+        self._pending: List = []
         self._finishing = False
 
     def needs_input(self) -> bool:
-        return self._pending is None and not self._finishing
+        return len(self._pending) < (2 if self._selective else 1) \
+            and not self._finishing
 
     def add_input(self, batch: Batch) -> None:
+        from presto_tpu.batch import begin_deferred_compact
         self._count_in(batch)
-        self._pending = self._kernel(batch)
+        out = self._kernel(batch)
+        if self._selective:
+            self._pending.append(begin_deferred_compact(out))
+        else:
+            self._pending.append((out, None))
 
     def get_output(self) -> Optional[Batch]:
-        out, self._pending = self._pending, None
-        return self._count_out(out)
+        emit_at = 1 if self._selective and not self._finishing else 0
+        if len(self._pending) > emit_at:
+            from presto_tpu.batch import end_deferred_compact
+            out, total = self._pending.pop(0)
+            return self._count_out(end_deferred_compact(out, total))
+        return None
 
     def finish(self) -> None:
         self._finishing = True
 
     def is_finished(self) -> bool:
-        return self._finishing and self._pending is None
+        return self._finishing and not self._pending
 
 
 class FilterProjectOperatorFactory(OperatorFactory):
@@ -218,11 +236,12 @@ class FilterProjectOperatorFactory(OperatorFactory):
         super().__init__(operator_id, "filter_project")
         self._kernel = make_filter_project_kernel(filter_expr, projections,
                                                   input_dicts)
+        self._selective = filter_expr is not None
 
     def create(self, driver_context: DriverContext) -> Operator:
         return FilterProjectOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self._kernel)
+            self._kernel, self._selective)
 
 
 class LimitOperator(Operator):

@@ -328,9 +328,6 @@ class LookupJoinOperator(Operator):
         return self.bridge.ready and len(self._pending) < 2 \
             and not self._finishing
 
-    #: outputs at or under this capacity skip the count/compact round
-    COMPACT_FLOOR = 8192
-
     def _probe(self, table, batch: Batch) -> Batch:
         cap = bucket_capacity(batch.capacity * self.expansion_factor)
         out, ovf, total = join_ops.probe_join(
@@ -342,18 +339,12 @@ class LookupJoinOperator(Operator):
             else self._overflow | ovf
         if self.build_rename:
             out = out.rename(self.build_rename)
-        if out.capacity > self.COMPACT_FLOOR:
-            # selective joins emit few rows into a fat capacity; left
-            # uncompacted that padding would ride every downstream
-            # exchange/pad/spool. The live count's d2h copy starts NOW
-            # (async) and is consumed one driver round later in
-            # get_output — the hot loop never blocks on a fresh fetch.
-            try:
-                total.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
-            return out, total
-        return out, None
+        # selective joins emit few rows into a fat capacity; left
+        # uncompacted that padding would ride every downstream
+        # exchange/pad/spool. The probe kernel already computed the
+        # live count — hand it to the deferred-compact protocol.
+        from presto_tpu.batch import begin_deferred_compact
+        return begin_deferred_compact(out, total)
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
@@ -381,17 +372,9 @@ class LookupJoinOperator(Operator):
             self._cur_table, batch.filter(part == 0)))
 
     def _emit(self, pending) -> Batch:
+        from presto_tpu.batch import end_deferred_compact
         out, total = pending
-        if total is not None:
-            # the async copy has been in flight since add_input; this
-            # read is normally a cache hit, not a fresh roundtrip
-            n = int(np.asarray(total))
-            # floor keeps the compiled-shape set small (tiny outputs
-            # all land on one bucket)
-            cap = max(1024, bucket_capacity(max(n, 1)))
-            if cap < out.capacity:
-                out = out.compact(cap, known_valid=n)
-        return out
+        return end_deferred_compact(out, total)
 
     def get_output(self) -> Optional[Batch]:
         # emit the HEAD only once a second batch is queued behind it
@@ -430,7 +413,14 @@ class LookupJoinOperator(Operator):
 class SemiJoinOperator(Operator):
     """WHERE x IN (subquery) / EXISTS — filters probe rows by membership
     (reference: HashSemiJoinOperator; `negate` gives NOT IN/NOT EXISTS
-    anti-join semantics for non-null keys)."""
+    anti-join semantics for non-null keys).
+
+    Semi joins are usually highly selective, so outputs go through the
+    same one-round-delayed count/compact protocol as lookup-join
+    outputs: left at full capacity, the dead lanes would ride every
+    downstream sort/merge/exchange (the round-3 Q18 failure mode —
+    56-live-row batches at 64k capacity feeding the final
+    aggregation)."""
 
     def __init__(self, ctx: OperatorContext, bridge: JoinBridge,
                  key_names: Tuple[str, ...], negate: bool,
@@ -442,33 +432,40 @@ class SemiJoinOperator(Operator):
         self.build_keys = build_keys
         self.key_dicts = key_dicts
         self.negate = negate
-        self._pending: Optional[Batch] = None
+        # two-slot queue: emit a batch one driver pass after its
+        # dispatch so the live-count d2h copy overlaps the next probe
+        self._pending: List = []
         self._finishing = False
 
     def is_blocked(self):
         return False if self.bridge.ready else "waiting for semi build"
 
     def needs_input(self) -> bool:
-        return self.bridge.ready and self._pending is None \
+        return self.bridge.ready and len(self._pending) < 2 \
             and not self._finishing
 
     def add_input(self, batch: Batch) -> None:
+        from presto_tpu.batch import begin_deferred_compact
         self._count_in(batch)
         probe = _remap_keys(batch, self.key_names, self.key_dicts)
         found, valid = join_ops.semi_mark(self.bridge.table, probe,
                                           self.key_names, self.build_keys)
         keep = (~found & valid) if self.negate else found
-        self._pending = batch.filter(keep)
+        self._pending.append(begin_deferred_compact(batch.filter(keep)))
 
     def get_output(self) -> Optional[Batch]:
-        out, self._pending = self._pending, None
-        return self._count_out(out)
+        if self._pending and (len(self._pending) > 1
+                              or self._finishing):
+            from presto_tpu.batch import end_deferred_compact
+            out, total = self._pending.pop(0)
+            return self._count_out(end_deferred_compact(out, total))
+        return None
 
     def finish(self) -> None:
         self._finishing = True
 
     def is_finished(self) -> bool:
-        return self._finishing and self._pending is None
+        return self._finishing and not self._pending
 
 
 def _remap_keys(batch: Batch, key_names, key_dicts) -> Batch:

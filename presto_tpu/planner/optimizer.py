@@ -26,29 +26,64 @@ def optimize(root: N.PlanNode, catalogs=None) -> N.PlanNode:
     if catalogs is not None:
         from presto_tpu.planner.stats import StatsEstimator
         estimator = StatsEstimator(catalogs)
-    root = _rewrite(root, estimator)
-    _push_scan_constraints(root)
+    # Plans are DAGs (decorrelation shares subtrees), and several rules
+    # below rewrite IN PLACE. A node with more than one parent must not
+    # be mutated on behalf of one parent — the other consumer would
+    # silently see filtered rows. Parent counts are computed once here
+    # and consulted by every mutating rule.
+    shared, pin = _shared_nodes(root)
+    root = _rewrite(root, estimator, shared)
+    _push_scan_constraints(root, shared=shared)
+    del pin  # keeps every pre-rewrite node alive so the id()s in
+    #          `shared` can't be recycled onto freshly built nodes
     return root
 
 
+def _shared_nodes(root: N.PlanNode) -> Tuple[Set[int], list]:
+    """(ids of nodes reachable through MORE than one parent edge,
+    strong references to every visited node). The caller must hold the
+    reference list as long as it consults the id set — a rewritten-away
+    node's address could otherwise be reused by a new node, which would
+    then falsely test as shared."""
+    counts: Dict[int, int] = {}
+    seen: Set[int] = set()
+    nodes: list = []
+
+    def visit(n: N.PlanNode) -> None:
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        nodes.append(n)
+        for s in n.sources():
+            counts[id(s)] = counts.get(id(s), 0) + 1
+            visit(s)
+
+    visit(root)
+    return {i for i, c in counts.items() if c > 1}, nodes
+
+
 def _push_scan_constraints(node: N.PlanNode,
-                           _seen: Optional[set] = None) -> None:
+                           _seen: Optional[set] = None,
+                           shared: Optional[Set[int]] = None) -> None:
     """Derive TupleDomains from Filter-over-TableScan conjuncts and
     attach them to the scan (reference: PickTableLayout /
     PredicatePushDown into ConnectorPageSourceProvider). The filter
     stays in the plan — pushdown is advisory; connectors that honor it
-    shrink generation/decode/transfer work."""
+    shrink generation/decode/transfer work. A scan with another parent
+    besides this filter is left alone: narrowing it would drop rows the
+    other consumer needs."""
     seen = _seen if _seen is not None else set()
     if id(node) in seen:
         return
     seen.add(id(node))
     if isinstance(node, N.FilterNode) and \
-            isinstance(node.source, N.TableScanNode):
+            isinstance(node.source, N.TableScanNode) \
+            and (shared is None or id(node.source) not in shared):
         dom = _extract_domains(node.predicate, node.source)
         if dom:
             node.source.constraint = dom
     for s in node.sources():
-        _push_scan_constraints(s, seen)
+        _push_scan_constraints(s, seen, shared)
 
 
 def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
@@ -114,36 +149,48 @@ def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
         for col, d in sorted(doms.items())))
 
 
-def _rewrite(node: N.PlanNode, estimator=None) -> N.PlanNode:
+def _rewrite(node: N.PlanNode, estimator=None,
+             shared: Optional[Set[int]] = None) -> N.PlanNode:
+    shared = shared if shared is not None else set()
     # rewrite children first
     for attr in ("source", "left", "right", "filtering_source"):
         if hasattr(node, attr):
             setattr(node, attr,
-                    _rewrite(getattr(node, attr), estimator))
+                    _rewrite(getattr(node, attr), estimator, shared))
     if isinstance(node, N.UnionNode):
-        node.inputs = [_rewrite(x, estimator) for x in node.inputs]
+        node.inputs = [_rewrite(x, estimator, shared)
+                       for x in node.inputs]
     if isinstance(node, N.FilterNode):
-        fused = _fuse_topn_row_number(node)
+        fused = _fuse_topn_row_number(node, shared)
         if fused is not None:
             return fused
-        pushed = _push_filter_through_join(node, estimator)
+        pushed = _push_filter_through_join(node, estimator, shared)
         if pushed is not None:
             return pushed
         return _rewrite_filter(node, estimator)
     return node
 
 
-def _push_filter_through_join(node: N.FilterNode,
-                              estimator=None) -> Optional[N.PlanNode]:
+def _push_filter_through_join(node: N.FilterNode, estimator=None,
+                              shared: Optional[Set[int]] = None
+                              ) -> Optional[N.PlanNode]:
     """Filter over an explicit JOIN: push single-side conjuncts below
     the join (reference: PredicatePushDown.java's visitJoin). Inner
     joins push to both inputs; LEFT joins only to the preserved (left)
     input — filtering the nullable side above vs below an outer join
     differs. The pushed filters re-enter _rewrite so they keep sinking
-    through nested joins and onto scan constraints."""
+    through nested joins and onto scan constraints.
+
+    The rewrite MUTATES the JoinNode (src.left/right/output), so it is
+    skipped when the join or either input has another parent — pushing
+    one consumer's predicate into a shared subtree would filter the
+    other consumer's rows."""
     src = node.source
     if not isinstance(src, N.JoinNode) \
             or src.join_type not in ("inner", "left"):
+        return None
+    if shared and (id(src) in shared or id(src.left) in shared
+                   or id(src.right) in shared):
         return None
     left_syms = {f.symbol for f in src.left.output}
     right_syms = {f.symbol for f in src.right.output}
@@ -163,11 +210,11 @@ def _push_filter_through_join(node: N.FilterNode,
     if push_left:
         src.left = _rewrite(
             N.FilterNode(src.left, _combine_conjuncts(push_left),
-                         tuple(src.left.output)), estimator)
+                         tuple(src.left.output)), estimator, shared)
     if push_right:
         src.right = _rewrite(
             N.FilterNode(src.right, _combine_conjuncts(push_right),
-                         tuple(src.right.output)), estimator)
+                         tuple(src.right.output)), estimator, shared)
     if remaining:
         return N.FilterNode(src, _combine_conjuncts(remaining),
                             node.output)
@@ -205,17 +252,24 @@ def _rank_bound(conj: RowExpression,
     return None
 
 
-def _fuse_topn_row_number(node: N.FilterNode) -> Optional[N.PlanNode]:
+def _fuse_topn_row_number(node: N.FilterNode,
+                          shared: Optional[Set[int]] = None
+                          ) -> Optional[N.PlanNode]:
     """Filter(Window[single rank-family call]) with a rank <= N
     conjunct -> TopNRowNumberNode (+ residual Filter), peeling one
     rename-only Project (the subquery-projection shape). Reference:
-    PushdownFilterIntoWindow / TopNRowNumberOperator."""
+    PushdownFilterIntoWindow / TopNRowNumberOperator. The only in-place
+    mutation is `proj.source = topn`, so the fusion is skipped exactly
+    when that peeled Project has another parent (a shared Window input
+    is fine — the new TopN node only READS it)."""
     win = node.source
     proj: Optional[N.ProjectNode] = None
     rename_to_src: Dict[str, str] = {}
     if isinstance(win, N.ProjectNode) \
             and all(isinstance(e, InputRef)
                     for _, e in win.assignments):
+        if shared and id(win) in shared:
+            return None
         proj = win
         rename_to_src = {s: e.name for s, e in win.assignments}
         win = win.source

@@ -1,0 +1,145 @@
+"""Optimizer safety on DAG-shaped plans (decorrelation shares
+subtrees): in-place rewrite rules must not mutate a node that has more
+than one parent — pushing one consumer's predicate into a shared join
+would silently filter the other consumer's rows (reference contrast:
+PredicatePushDown.java rewrites immutably, so sharing is a non-issue
+there)."""
+
+import dataclasses
+
+import pytest
+
+from presto_tpu.expr.ir import Call, InputRef, Literal
+from presto_tpu.planner import nodes as N
+from presto_tpu.planner.optimizer import optimize
+from presto_tpu.types import BIGINT, BOOLEAN
+
+
+def _values(symbols):
+    fields = tuple(N.Field(s, BIGINT) for s in symbols)
+    return N.ValuesNode([], fields)
+
+
+def _join(left, right):
+    return N.JoinNode(
+        "inner", left, right, [(left.symbols[0], right.symbols[0])],
+        tuple(left.output) + tuple(right.output))
+
+
+def _pred(sym):
+    return Call("greater_than",
+                (InputRef(sym, BIGINT), Literal(5, BIGINT)), BOOLEAN)
+
+
+def test_filter_not_pushed_into_shared_join():
+    """Two parents over ONE JoinNode: a Filter (single-side conjunct,
+    normally pushed below the join) and a direct aggregation consumer.
+    The pushdown must be skipped — the join and its children stay
+    untouched."""
+    left = _values(["a", "b"])
+    right = _values(["c", "d"])
+    join = _join(left, right)
+    filt = N.FilterNode(join, _pred("b"), tuple(join.output))
+    agg = N.AggregationNode(join, [], [], "single", tuple(join.output))
+    sym_map = {f.symbol: f.symbol for f in join.output}
+    root = N.UnionNode([filt, agg], [sym_map, sym_map],
+                       tuple(join.output))
+
+    optimize(root)
+
+    assert join.left is left, "shared join's left input was mutated"
+    assert join.right is right, "shared join's right input was mutated"
+    assert [f.symbol for f in join.output] == ["a", "b", "c", "d"]
+
+
+def test_nested_push_keeps_shared_guard():
+    """A pushed-down filter re-enters _rewrite; the shared-node guard
+    must survive that recursion. Shape: Filter over an UNSHARED join
+    whose left subtree holds Filter(shared deep join) — pushing the
+    outer conjunct must not let the inner filter sink into the shared
+    join on the second pass."""
+    deep_l = _values(["a", "b"])
+    deep_r = _values(["c", "d"])
+    deep = _join(deep_l, deep_r)
+    inner_filter = N.FilterNode(deep, _pred("b"), tuple(deep.output))
+    right = _values(["e", "f"])
+    join1 = N.JoinNode("inner", inner_filter, right, [("a", "e")],
+                       tuple(deep.output) + tuple(right.output))
+    outer = N.FilterNode(join1, _pred("d"), tuple(join1.output))
+    # second parent makes `deep` shared
+    agg = N.AggregationNode(deep, [], [], "single", tuple(deep.output))
+    sym_map = {f.symbol: f.symbol for f in join1.output}
+    agg_map = {f.symbol: f.symbol for f in deep.output}
+    root = N.UnionNode([outer, agg], [sym_map, agg_map],
+                       tuple(join1.output))
+
+    optimize(root)
+
+    assert deep.left is deep_l, "shared deep join mutated via re-push"
+    assert deep.right is deep_r
+
+
+def test_filter_pushed_when_join_unshared():
+    """Sanity: the same shape with a single parent still pushes."""
+    left = _values(["a", "b"])
+    right = _values(["c", "d"])
+    join = _join(left, right)
+    filt = N.FilterNode(join, _pred("b"), tuple(join.output))
+
+    out = optimize(filt)
+
+    assert isinstance(join.left, N.FilterNode), \
+        "unshared join should receive the pushed filter"
+    assert join.left.source is left
+
+
+def test_scan_constraint_not_attached_to_shared_scan(tmp_path):
+    """Filter-over-scan constraint pushdown narrows what the connector
+    generates; a scan with a second (unfiltered) parent must keep its
+    full constraint-free form."""
+    from presto_tpu.runner import LocalRunner
+
+    runner = LocalRunner("tpch", "tiny")
+    plan = runner.create_plan(
+        "select count(*) from orders where orderkey = 7")
+    # locate the Filter(TableScan) pair
+    node = plan
+    scan = None
+    filt = None
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, N.FilterNode) and \
+                isinstance(node.source, N.TableScanNode):
+            filt, scan = node, node.source
+        stack.extend(node.sources())
+    assert scan is not None
+    # graft a second parent onto the scan
+    second = N.AggregationNode(scan, [], [], "single",
+                               tuple(scan.output))
+    sym_map = {f.symbol: f.symbol for f in plan.output}
+    root = N.UnionNode([plan, second], [sym_map, sym_map],
+                       tuple(plan.output))
+
+    optimize(root)
+
+    assert scan.constraint is None, \
+        "constraint pushed into a scan that another parent reads"
+
+
+def test_shared_join_query_results_correct():
+    """End-to-end: a WITH-subquery consumed twice, once filtered and
+    once aggregated — the filtered branch must not starve the other."""
+    from presto_tpu.runner import LocalRunner
+
+    runner = LocalRunner("tpch", "tiny")
+    res = runner.execute(
+        "with j as (select o.orderkey k, o.totalprice p"
+        "  from orders o join customer c on o.custkey = c.custkey) "
+        "select 0 tag, count(*) c from j where k < 100 "
+        "union all "
+        "select 1, count(*) from j")
+    rows = sorted(res.rows())
+    assert len(rows) == 2
+    small, everything = rows[0][1], rows[1][1]
+    assert 0 < small < everything, (small, everything)
