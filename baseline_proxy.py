@@ -1,0 +1,256 @@
+"""Measured CPU baseline for the bench suite (VERDICT r3 weak #5).
+
+The reference's own harness (presto-benchmark BenchmarkSuite /
+HandTpchQuery1, see BASELINE.md) cannot run in this image: there is no
+JVM (`which java` -> nothing) and no network egress to fetch one. The
+previous rounds therefore compared against hand-invented per-query
+"Java estimates" — unfalsifiable numbers. This module replaces them
+with a MEASURED proxy: the same five TPC-H queries, on the same
+generated data, executed by pyarrow's Acero engine (multithreaded
+C++ vectorized execution, the closest thing to a production columnar
+CPU engine available in this image). The proxy is deliberately
+engine-favourable:
+
+- tables are materialized to Arrow ONCE, untimed (the bench likewise
+  excludes datagen/transfer from warm timings);
+- dictionary-encoded VARCHAR filters compare int codes, not strings
+  (what the Java engine's dictionary blocks do);
+- each query gets a warmup run, then best-of-2 timed runs.
+
+Run `python baseline_proxy.py [schema]` to (re)measure and write
+BASELINE_MEASURED.json; bench.py loads that file as the denominator
+and labels its output "baseline": "measured:pyarrow-acero-<ver>".
+
+Query semantics are pinned by tests/test_baseline_proxy.py, which
+cross-checks every proxy query against the SQL engine at sf0_01.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days(iso: str) -> int:
+    y, m, d = map(int, iso.split("-"))
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+def _code(gen, table: str, column: str, value: str) -> int:
+    """Dictionary code of `value` in a dict-encoded VARCHAR column."""
+    for c in gen.schema(table).columns:
+        if c.name == column:
+            return list(c.dictionary).index(value)
+    raise KeyError(f"{table}.{column}")
+
+
+def load_tables(gen, names):
+    """Materialize tables as pyarrow Tables (dict VARCHARs stay as int
+    codes; dates stay as int days) — the same physical shapes the
+    engine's scan produces, so neither side pays a decode the other
+    doesn't."""
+    import pyarrow as pa
+
+    out = {}
+    for name in names:
+        n = gen.rows(name) if name != "lineitem" else None
+        cols = {}
+        if name == "lineitem":
+            # generate() takes an ORDER range for lineitem (rows
+            # expand ~4x per order)
+            data = gen.generate("lineitem", 0, gen.rows("orders"))
+        else:
+            data = gen.generate(name, 0, n)
+        for cname, arr in data.items():
+            cols[cname] = pa.array(np.ascontiguousarray(arr))
+        out[name] = pa.table(cols)
+    return out
+
+
+# --- the five suite queries, Acero-side ---------------------------------
+
+def q1(t, gen):
+    import pyarrow.compute as pc
+
+    li = t["lineitem"]
+    li = li.filter(pc.less_equal(li["shipdate"], _days("1998-09-02")))
+    one_minus = pc.subtract(1.0, li["discount"])
+    disc_price = pc.multiply(li["extendedprice"], one_minus)
+    charge = pc.multiply(disc_price, pc.add(1.0, li["tax"]))
+    li = li.append_column("disc_price", disc_price)
+    li = li.append_column("charge", charge)
+    res = li.group_by(["returnflag", "linestatus"]).aggregate([
+        ("quantity", "sum"), ("extendedprice", "sum"),
+        ("disc_price", "sum"), ("charge", "sum"),
+        ("quantity", "mean"), ("extendedprice", "mean"),
+        ("discount", "mean"), ("quantity", "count"),
+    ])
+    return res.sort_by([("returnflag", "ascending"),
+                        ("linestatus", "ascending")])
+
+
+def q3(t, gen):
+    import pyarrow.compute as pc
+
+    seg = _code(gen, "customer", "mktsegment", "BUILDING")
+    cutoff = _days("1995-03-15")
+    cust = t["customer"]
+    cust = cust.filter(pc.equal(cust["mktsegment"], seg)) \
+               .select(["custkey"])
+    orders = t["orders"]
+    orders = orders.filter(pc.less(orders["orderdate"], cutoff)) \
+                   .select(["orderkey", "custkey", "orderdate",
+                            "shippriority"])
+    orders = orders.join(cust, "custkey", join_type="inner")
+    li = t["lineitem"]
+    li = li.filter(pc.greater(li["shipdate"], cutoff)) \
+           .select(["orderkey", "extendedprice", "discount"])
+    j = li.join(orders, "orderkey", join_type="inner")
+    rev = pc.multiply(j["extendedprice"],
+                      pc.subtract(1.0, j["discount"]))
+    j = j.append_column("rev", rev)
+    res = j.group_by(["orderkey", "orderdate", "shippriority"]) \
+           .aggregate([("rev", "sum")])
+    return res.sort_by([("rev_sum", "descending"),
+                        ("orderdate", "ascending")]).slice(0, 10)
+
+
+def q5(t, gen):
+    import pyarrow.compute as pc
+
+    asia = _code(gen, "region", "name", "ASIA")
+    region = t["region"]
+    region = region.filter(pc.equal(region["name"], asia)) \
+                   .select(["regionkey"])
+    nation = t["nation"].select(["nationkey", "regionkey", "name"]) \
+        .join(region, "regionkey", join_type="inner") \
+        .select(["nationkey", "name"]) \
+        .rename_columns(["nationkey", "n_name"])
+    supp = t["supplier"].select(["suppkey", "nationkey"]) \
+        .join(nation, "nationkey", join_type="inner")
+    cust = t["customer"].select(["custkey", "nationkey"]) \
+        .rename_columns(["custkey", "c_nationkey"])
+    orders = t["orders"]
+    orders = orders.filter(pc.and_(
+        pc.greater_equal(orders["orderdate"], _days("1994-01-01")),
+        pc.less(orders["orderdate"], _days("1995-01-01")))) \
+        .select(["orderkey", "custkey"])
+    orders = orders.join(cust, "custkey", join_type="inner") \
+        .select(["orderkey", "c_nationkey"])
+    li = t["lineitem"].select(
+        ["orderkey", "suppkey", "extendedprice", "discount"])
+    j = li.join(orders, "orderkey", join_type="inner")
+    # c.nationkey = s.nationkey folds into the supplier join keys
+    j = j.join(supp, keys=["suppkey", "c_nationkey"],
+               right_keys=["suppkey", "nationkey"], join_type="inner")
+    rev = pc.multiply(j["extendedprice"],
+                      pc.subtract(1.0, j["discount"]))
+    j = j.append_column("rev", rev)
+    res = j.group_by(["n_name"]).aggregate([("rev", "sum")])
+    return res.sort_by([("rev_sum", "descending")])
+
+
+def q6(t, gen):
+    import pyarrow.compute as pc
+
+    li = t["lineitem"]
+    m = pc.and_(
+        pc.and_(pc.greater_equal(li["shipdate"], _days("1994-01-01")),
+                pc.less(li["shipdate"], _days("1995-01-01"))),
+        pc.and_(
+            pc.and_(pc.greater_equal(li["discount"], 0.05),
+                    pc.less_equal(li["discount"], 0.07)),
+            pc.less(li["quantity"], 24.0)))
+    li = li.filter(m)
+    import pyarrow as pa
+    s = pc.sum(pc.multiply(li["extendedprice"], li["discount"]))
+    return pa.table({"revenue": [s.as_py()]})
+
+
+def q18(t, gen):
+    import pyarrow.compute as pc
+
+    li = t["lineitem"].select(["orderkey", "quantity"])
+    big = li.group_by(["orderkey"]).aggregate([("quantity", "sum")])
+    big = big.filter(pc.greater(big["quantity_sum"], 300.0)) \
+             .select(["orderkey"])
+    orders = t["orders"] \
+        .select(["orderkey", "custkey", "orderdate", "totalprice"]) \
+        .join(big, "orderkey", join_type="inner")
+    cust = t["customer"].select(["custkey", "name"])
+    orders = orders.join(cust, "custkey", join_type="inner")
+    j = li.join(orders, "orderkey", join_type="inner")
+    res = j.group_by(["name", "custkey", "orderkey", "orderdate",
+                      "totalprice"]).aggregate([("quantity", "sum")])
+    return res.sort_by([("totalprice", "descending"),
+                        ("orderdate", "ascending")]).slice(0, 100)
+
+
+QUERIES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6, "q18": q18}
+TABLES = ["lineitem", "orders", "customer", "supplier", "nation",
+          "region"]
+
+
+def measure(schema: str = "sf1", runs: int = 2) -> dict:
+    import pyarrow
+
+    from presto_tpu.connectors.tpch import TpchGenerator
+
+    sf = {"tiny": 0.001, "sf0_01": 0.01, "sf0_1": 0.1, "sf1": 1.0,
+          "sf10": 10.0}[schema]
+    gen = TpchGenerator(sf)
+    t0 = time.perf_counter()
+    tables = load_tables(gen, TABLES)
+    print(f"datagen+arrow ({schema}): {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    import bench
+    rows_of = bench._scanned_rows(gen)
+
+    out = {}
+    for name, fn in QUERIES.items():
+        fn(tables, gen)  # warmup (plans/kernels/thread pool)
+        times = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            res = fn(tables, gen)
+            nrows = res.num_rows
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        out[name] = {"rows_per_sec": round(rows_of[name] / best, 1),
+                     "wall_s": round(best, 4), "result_rows": nrows}
+        print(f"{name}: best {best:.3f}s "
+              f"({out[name]['rows_per_sec']:.3g} rows/s)",
+              file=sys.stderr)
+    return {
+        "engine": "pyarrow-acero",
+        "engine_version": pyarrow.__version__,
+        "schema": schema,
+        "threads": os.cpu_count(),
+        "note": ("measured CPU proxy; the reference's Java harness "
+                 "cannot run here (no JVM in image) — see BASELINE.md"),
+        "queries": out,
+    }
+
+
+def main() -> int:
+    schema = sys.argv[1] if len(sys.argv) > 1 else "sf1"
+    result = measure(schema)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_MEASURED.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"wrote {path}", file=sys.stderr)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,12 +16,16 @@ Prints ONE json line:
 - "geomean_vs_baseline" is the geometric mean of the per-query
   speedups (the BASELINE.md north-star shape).
 
-The reference publishes no absolute numbers (BASELINE.md), so
-JAVA_BASELINE maps each query to an ESTIMATE of the single-node Java
-operator pipeline's input-rows/sec at SF1: ~10M rows/s for Q1 (the
-HandTpchQuery1 class of result on one modern core), ~25M for the
-lighter Q6, and 5-6M for the join/high-cardinality queries (deeper
-operator trees, hash tables of 10^5..10^6 entries).
+Baseline denominator (VERDICT r3 weak #5/next-step 2): the reference
+publishes no absolute numbers and its Java harness cannot run in this
+image (no JVM). The denominator is therefore MEASURED by
+baseline_proxy.py — the same five queries on the same generated data
+through pyarrow's Acero C++ engine — and recorded in
+BASELINE_MEASURED.json; the output line carries
+"baseline": "measured:pyarrow-acero-<ver>@<schema>". Only if that
+file is absent (or was measured at a different schema) does the old
+per-query Java ESTIMATE table apply, and the line then says
+"baseline": "estimate:java-guess" so nobody mistakes it for data.
 
 Methodology: per query, the reported number is the WARM rows/s — timed
 runs follow a warmup that compiles the kernels and populates the
@@ -54,8 +58,8 @@ METRIC = f"tpch_q1_{SCHEMA}_rows_per_sec"
 CHILD_TIMEOUT_S = 3000
 WARM_RUNS = 2
 
-#: per-query single-node Java estimates (input rows/sec) — see module
-#: docstring for the basis
+#: per-query single-node Java estimates (input rows/sec) — the
+#: UNMEASURED fallback, used only when BASELINE_MEASURED.json is absent
 JAVA_BASELINE = {
     "q1": 1.0e7,
     "q3": 6.0e6,
@@ -63,6 +67,34 @@ JAVA_BASELINE = {
     "q6": 2.5e7,
     "q18": 5.0e6,
 }
+
+
+def _load_baseline():
+    """(per-query rows/s denominators, label). Prefers the measured
+    Acero proxy (baseline_proxy.py) at the bench schema."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_MEASURED.json")
+    try:
+        with open(path) as f:
+            m = json.load(f)
+        if m.get("schema") != SCHEMA:
+            print(f"BASELINE_MEASURED.json schema={m.get('schema')!r} "
+                  f"!= bench schema {SCHEMA!r}; falling back to "
+                  f"estimates", file=sys.stderr)
+        else:
+            denom = {q: r["rows_per_sec"]
+                     for q, r in m["queries"].items()}
+            missing = [q for q in JAVA_BASELINE if q not in denom]
+            if not missing:
+                label = (f"measured:{m['engine']}-"
+                         f"{m['engine_version']}@{m['schema']}")
+                return denom, label
+            print(f"BASELINE_MEASURED.json missing queries {missing}; "
+                  f"falling back to estimates", file=sys.stderr)
+    except (OSError, KeyError, json.JSONDecodeError) as e:
+        print(f"no usable BASELINE_MEASURED.json ({e}); "
+              f"falling back to estimates", file=sys.stderr)
+    return dict(JAVA_BASELINE), "estimate:java-guess"
 
 
 def _queries():
@@ -128,10 +160,11 @@ def _child_main() -> int:
 
 
 def _combine(per_query: dict, platform: str) -> dict:
+    denom, baseline_label = _load_baseline()
     suite = {}
     speedups = []
     for name, r in per_query.items():
-        sp = r["rows_per_sec"] / JAVA_BASELINE[name]
+        sp = r["rows_per_sec"] / denom[name]
         suite[name] = {"rows_per_sec": r["rows_per_sec"],
                        "wall_s": r["wall_s"],
                        "vs_baseline": round(sp, 4)}
@@ -141,7 +174,8 @@ def _combine(per_query: dict, platform: str) -> dict:
         "metric": METRIC,
         "value": q1["rows_per_sec"],
         "unit": "rows/s",
-        "vs_baseline": round(q1["rows_per_sec"] / JAVA_BASELINE["q1"], 4),
+        "vs_baseline": round(q1["rows_per_sec"] / denom["q1"], 4),
+        "baseline": baseline_label,
         "platform": platform,
         "suite": suite,
     }

@@ -150,25 +150,41 @@ def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
 
 
 def _rewrite(node: N.PlanNode, estimator=None,
-             shared: Optional[Set[int]] = None) -> N.PlanNode:
+             shared: Optional[Set[int]] = None,
+             memo: Optional[Dict[int, N.PlanNode]] = None) -> N.PlanNode:
     shared = shared if shared is not None else set()
+    # Memoized by id: a DAG-shared node is rewritten ONCE and every
+    # parent receives the SAME result object — re-running the rewrite
+    # per parent would both stack duplicate pushed filters onto a
+    # shared join input and hand each parent a distinct copy, breaking
+    # the local planner's id-based CSE/spool sharing.
+    memo = memo if memo is not None else {}
+    hit = memo.get(id(node))
+    if hit is not None:
+        return hit
+    orig_id = id(node)
     # rewrite children first
     for attr in ("source", "left", "right", "filtering_source"):
         if hasattr(node, attr):
             setattr(node, attr,
-                    _rewrite(getattr(node, attr), estimator, shared))
+                    _rewrite(getattr(node, attr), estimator, shared,
+                             memo))
     if isinstance(node, N.UnionNode):
-        node.inputs = [_rewrite(x, estimator, shared)
+        node.inputs = [_rewrite(x, estimator, shared, memo)
                        for x in node.inputs]
+    out = node
     if isinstance(node, N.FilterNode):
         fused = _fuse_topn_row_number(node, shared)
+        pushed = None if fused is not None else \
+            _push_filter_through_join(node, estimator, shared)
         if fused is not None:
-            return fused
-        pushed = _push_filter_through_join(node, estimator, shared)
-        if pushed is not None:
-            return pushed
-        return _rewrite_filter(node, estimator)
-    return node
+            out = fused
+        elif pushed is not None:
+            out = pushed
+        else:
+            out = _rewrite_filter(node, estimator)
+    memo[orig_id] = out
+    return out
 
 
 def _push_filter_through_join(node: N.FilterNode, estimator=None,

@@ -1,0 +1,96 @@
+"""The measured-baseline proxy (baseline_proxy.py) must run the SAME
+queries as the engine's bench suite — otherwise its denominator is as
+soft as the estimates it replaced. Cross-checks every proxy query
+against the SQL engine at sf0_01."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import baseline_proxy  # noqa: E402
+from tpch_queries import QUERIES  # noqa: E402
+
+SCHEMA = "sf0_01"
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", SCHEMA)
+
+
+@pytest.fixture(scope="module")
+def proxy(runner):
+    gen = runner.catalogs.connector("tpch")._gens[SCHEMA]
+    tables = baseline_proxy.load_tables(gen, baseline_proxy.TABLES)
+    return gen, tables
+
+
+def _dict_of(gen, table, column):
+    for c in gen.schema(table).columns:
+        if c.name == column:
+            return list(c.dictionary)
+    raise KeyError(column)
+
+
+def _norm(rows):
+    out = []
+    for r in rows:
+        out.append(tuple(round(v, 4) if isinstance(v, float) else v
+                         for v in r))
+    return sorted(out)
+
+
+def _check(engine_rows, proxy_rows):
+    assert _norm(engine_rows) == _norm(proxy_rows)
+
+
+def test_q1(runner, proxy):
+    gen, tables = proxy
+    res = baseline_proxy.q1(tables, gen)
+    rf = _dict_of(gen, "lineitem", "returnflag")
+    ls = _dict_of(gen, "lineitem", "linestatus")
+    prox = [(rf[r["returnflag"]], ls[r["linestatus"]],
+             r["quantity_sum"], r["extendedprice_sum"],
+             r["disc_price_sum"], r["charge_sum"], r["quantity_mean"],
+             r["extendedprice_mean"], r["discount_mean"],
+             r["quantity_count"]) for r in res.to_pylist()]
+    _check(runner.execute(QUERIES[1]).rows(), prox)
+
+
+def test_q3(runner, proxy):
+    gen, tables = proxy
+    res = baseline_proxy.q3(tables, gen)
+    prox = [(r["orderkey"], r["rev_sum"], r["orderdate"],
+             r["shippriority"]) for r in res.to_pylist()]
+    _check(runner.execute(QUERIES[3]).rows(), prox)
+
+
+def test_q5(runner, proxy):
+    gen, tables = proxy
+    res = baseline_proxy.q5(tables, gen)
+    names = _dict_of(gen, "nation", "name")
+    prox = [(names[r["n_name"]], r["rev_sum"])
+            for r in res.to_pylist()]
+    _check(runner.execute(QUERIES[5]).rows(), prox)
+
+
+def test_q6(runner, proxy):
+    gen, tables = proxy
+    res = baseline_proxy.q6(tables, gen)
+    prox = [(r["revenue"],) for r in res.to_pylist()]
+    _check(runner.execute(QUERIES[6]).rows(), prox)
+
+
+def test_q18(runner, proxy):
+    gen, tables = proxy
+    res = baseline_proxy.q18(tables, gen)
+    names = _dict_of(gen, "customer", "name")
+    prox = [(names[r["name"]], r["custkey"], r["orderkey"],
+             r["orderdate"], r["totalprice"], r["quantity_sum"])
+            for r in res.to_pylist()]
+    _check(runner.execute(QUERIES[18]).rows(), prox)

@@ -93,6 +93,31 @@ def test_filter_pushed_when_join_unshared():
     assert join.left.source is left
 
 
+def test_shared_filter_rewritten_once():
+    """A SHARED FilterNode over an unshared join: both parents must
+    receive the SAME rewritten object, and the pushdown must run once —
+    without the _rewrite memo the second parent's visit re-split the
+    conjuncts and stacked a second identical filter onto the join
+    input (and each parent got a distinct copy, breaking downstream
+    id-based CSE)."""
+    left = _values(["a", "b"])
+    right = _values(["c", "d"])
+    join = _join(left, right)
+    filt = N.FilterNode(join, _pred("b"), tuple(join.output))
+    sym_map = {f.symbol: f.symbol for f in join.output}
+    root = N.UnionNode([filt, filt], [sym_map, sym_map],
+                       tuple(join.output))
+
+    optimize(root)
+
+    assert root.inputs[0] is root.inputs[1], \
+        "parents of a shared filter must share the rewrite result"
+    # exactly ONE pushed filter layer on the join's left input
+    assert isinstance(join.left, N.FilterNode)
+    assert join.left.source is left, \
+        "pushdown ran once per parent and stacked duplicate filters"
+
+
 def test_scan_constraint_not_attached_to_shared_scan(tmp_path):
     """Filter-over-scan constraint pushdown narrows what the connector
     generates; a scan with a second (unfiltered) parent must keep its
