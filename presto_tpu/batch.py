@@ -417,6 +417,16 @@ def unify_dictionaries(cols: Sequence[Column]) -> List[Column]:
     return out
 
 
+def union_dictionary(a: Optional[Tuple[str, ...]],
+                     b: Optional[Tuple[str, ...]]) -> Tuple[str, ...]:
+    """The union dictionary two string join-key sides re-encode onto
+    (sorted, so code order = string order). THE one definition: the
+    analyzer tags join output fields with it and the local planner
+    builds runtime remap tables from it — computed differently they
+    would silently decode garbage downstream."""
+    return tuple(sorted(set(a or ()) | set(b or ())))
+
+
 def remap_column(col: Column, target: Tuple[str, ...]) -> Column:
     """Re-encode a string column onto `target` (a superset dictionary,
     sorted). Used to align join-key codes across tables."""

@@ -102,6 +102,16 @@ class ConnectorMetadata(abc.ABC):
         Missing columns fall back to dictionary-derived NDVs."""
         return {}
 
+    def sorted_by(self, handle: TableHandle) -> Optional[List[str]]:
+        """Physical sort order of the table's rows, as column names in
+        significance order (ascending, nulls last), or None. A declared
+        order promises that every split's batches arrive sorted AND
+        that split ranges are ascending — the engine then plans
+        StreamingAggregationOperator over the scan (reference:
+        ConnectorMetadata local-property declarations feeding
+        StreamingAggregationOperator)."""
+        return None
+
 
 class ConnectorSplitManager(abc.ABC):
     @abc.abstractmethod

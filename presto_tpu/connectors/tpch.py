@@ -498,6 +498,21 @@ class _TpchMetadata(ConnectorMetadata):
             return gen.rows("orders") * 4  # ~4 lines per order
         return gen.rows(handle.table)
 
+    def sorted_by(self, handle: TableHandle):
+        """The generator emits rows in primary-key order and split
+        ranges ascend, so scans are physically key-sorted — declared
+        here so the planner may stream aggregations over them."""
+        return {
+            "orders": ["orderkey"],
+            "lineitem": ["orderkey", "linenumber"],
+            "customer": ["custkey"],
+            "part": ["partkey"],
+            "supplier": ["suppkey"],
+            "nation": ["nationkey"],
+            "region": ["regionkey"],
+            "partsupp": ["partkey", "suppkey"],
+        }.get(handle.table)
+
     def column_stats(self, handle: TableHandle):
         """Analytic per-column stats (the generator's value domains are
         known exactly — the analog of presto-tpch's TpchMetadata

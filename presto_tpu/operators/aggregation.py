@@ -519,6 +519,128 @@ class AggregationOperator(Operator):
             self._host_spill = []
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _stream_step(carry: "hashagg.GroupByState",
+                 partial: "hashagg.GroupByState",
+                 aggs, out_cap: int):
+    """One streaming-aggregation round: fold the carried boundary group
+    into this batch's packed partial, emit every COMPLETE group (all
+    but the last in key order — only the last can continue into the
+    next batch of a key-sorted stream), and slice the last group out as
+    the new carry. All on device; groups stay packed in ascending key
+    order, so emission preserves the input's sort order."""
+    merged = hashagg.merge_partials([carry, partial], aggs, out_cap)
+    ng = jnp.sum(merged.valid)
+    last = jnp.maximum(ng - 1, 0)
+    emit_valid = merged.valid & (jnp.arange(out_cap) < last)
+    emit = hashagg.GroupByState(merged.keys, merged.states, emit_valid,
+                                merged.overflow)
+
+    def slice1(a):
+        return jax.lax.dynamic_slice_in_dim(a, last, 1, axis=0)
+    carry_out = hashagg.GroupByState(
+        [(slice1(d), slice1(m)) for d, m in merged.keys],
+        [tuple(slice1(a) for a in st) for st in merged.states],
+        slice1(merged.valid), jnp.asarray(False))
+    return emit, carry_out, last
+
+
+class StreamingAggregationOperator(Operator):
+    """Aggregation over an input ALREADY SORTED by the group keys
+    (ascending, nulls last — the canonical packing order of the
+    grouping kernel), emitting each group as soon as its key range is
+    passed (reference: operator/StreamingAggregationOperator.java).
+
+    Memory is O(batch), independent of total group count: no
+    max_groups table, no overflow retry — the property the reference
+    operator exists for. Output batches hold groups in key order, so
+    an ORDER BY on the group keys above this operator is a no-op."""
+
+    def __init__(self, ctx: OperatorContext, key_names: Sequence[str],
+                 key_exprs: Sequence[CompiledExpr],
+                 specs: Sequence[AggSpec], step_kernel=None):
+        super().__init__(ctx)
+        self.key_names = list(key_names)
+        self.key_exprs = list(key_exprs)
+        self.specs = list(specs)
+        self._kernel = step_kernel if step_kernel is not None else \
+            make_agg_step_kernel(key_exprs, specs, "single", None)
+        self._carry = None
+        self._pending: list = []  # [(emit_state, live_count_async)]
+        self._finishing = False
+        self._emitted_tail = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing and len(self._pending) < 2
+
+    def _finalize_kernel(self):
+        key_types = tuple(k.type for k in self.key_exprs)
+        key_dicts = tuple(k.dictionary for k in self.key_exprs)
+        aggs = tuple(s.function for s in self.specs)
+        names = tuple(s.out_name for s in self.specs)
+        return make_agg_finalize_kernel(
+            "single", tuple(self.key_names), key_types, key_dicts,
+            None, names, aggs)
+
+    def add_input(self, batch: Batch) -> None:
+        from presto_tpu.batch import start_async_copy
+        self._count_in(batch)
+        aggs = tuple(s.function for s in self.specs)
+        c0 = bucket_capacity(batch.capacity)
+        partial = self._kernel(c0, batch)
+        if self._carry is None:
+            key_types = [k.type for k in self.key_exprs]
+            self._carry = hashagg.init_state(key_types, aggs, 1)
+        # distinct(carry ++ partial) <= batch rows + 1 <= 2 * c0:
+        # overflow is impossible by construction
+        emit, self._carry, live = _stream_step(
+            self._carry, partial, aggs, bucket_capacity(c0 + 1))
+        self._pending.append((emit, start_async_copy(live)))
+
+    def get_output(self) -> Optional[Batch]:
+        from presto_tpu.batch import end_deferred_compact
+        if self._pending and (len(self._pending) > 1
+                              or self._finishing):
+            emit, live = self._pending.pop(0)
+            out = self._finalize_kernel()(emit)
+            return self._count_out(end_deferred_compact(out, live))
+        if self._pending or not self._finishing or self._emitted_tail:
+            return None
+        self._emitted_tail = True
+        if self._carry is None:
+            return None  # zero input batches: grouped agg of nothing
+        return self._count_out(self._finalize_kernel()(self._carry))
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._pending \
+            and self._emitted_tail
+
+    def close(self) -> None:
+        self._carry = None
+        self._pending = []
+
+
+class StreamingAggregationOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, key_names: Sequence[str],
+                 key_exprs: Sequence[CompiledExpr],
+                 specs: Sequence[AggSpec], input_dicts=None):
+        super().__init__(operator_id, "aggregation(streaming)")
+        self.key_names = key_names
+        self.key_exprs = key_exprs
+        self.specs = specs
+        self._step_kernel = make_agg_step_kernel(
+            key_exprs, specs, "single", None, input_dicts)
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return StreamingAggregationOperator(
+            OperatorContext(self.operator_id, self.name, driver_context),
+            self.key_names, self.key_exprs, self.specs,
+            self._step_kernel)
+
+
 class AggregationOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, key_names: Sequence[str],
                  key_exprs: Sequence[CompiledExpr],

@@ -287,7 +287,8 @@ class LookupJoinOperator(Operator):
                  build_rename: Optional[dict] = None,
                  build_keys: Optional[Tuple[str, ...]] = None,
                  key_dicts: Optional[List[Optional[tuple]]] = None,
-                 expansion_factor: int = 1):
+                 expansion_factor: int = 1,
+                 probe_schema: Optional[Sequence[tuple]] = None):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
@@ -298,6 +299,20 @@ class LookupJoinOperator(Operator):
         self.build_output = tuple(build_output)
         self.build_rename = build_rename or {}
         self.expansion_factor = max(1, int(expansion_factor))
+        # FULL OUTER state: per-build-row matched flags (device array,
+        # scatter-updated by every probe dispatch) and the NULL probe
+        # side's schema. Key columns take the planner's unified
+        # dictionary — probe outputs were remapped onto it, and the
+        # final unmatched batch must concat with them.
+        self._matched = None
+        self._outer_emitted = False
+        if probe_schema is not None and key_dicts:
+            fix = {k: d for k, d in zip(key_names, key_dicts)
+                   if d is not None}
+            probe_schema = [(n, t, fix.get(n, dic))
+                            for n, t, dic in probe_schema]
+        self.probe_schema = tuple(probe_schema) if probe_schema \
+            is not None else None
         self._overflow = None
         # two-slot output queue: a probed batch is emitted one driver
         # PASS after its dispatch, so its live-count d2h copy (started
@@ -330,11 +345,19 @@ class LookupJoinOperator(Operator):
 
     def _probe(self, table, batch: Batch) -> Batch:
         cap = bucket_capacity(batch.capacity * self.expansion_factor)
-        out, ovf, total = join_ops.probe_join(
-            table, batch, self.key_names, cap, self.join_type,
-            self.probe_output, self.build_output,
-            self.build_keys if self.build_keys is not None
-            else self.key_names)
+        bkeys = self.build_keys if self.build_keys is not None \
+            else self.key_names
+        if self.join_type == "full":
+            if self._matched is None:
+                self._matched = jnp.zeros(table.sorted_hash.shape[0],
+                                          dtype=bool)
+            out, ovf, total, self._matched = join_ops.probe_join_full(
+                table, batch, self.key_names, self._matched, cap,
+                self.probe_output, self.build_output, bkeys)
+        else:
+            out, ovf, total = join_ops.probe_join(
+                table, batch, self.key_names, cap, self.join_type,
+                self.probe_output, self.build_output, bkeys)
         self._overflow = ovf if self._overflow is None \
             else self._overflow | ovf
         if self.build_rename:
@@ -354,6 +377,8 @@ class LookupJoinOperator(Operator):
             return
         # spilled build: probe the resident partition now, park the
         # rest of the batch's rows on the host per partition
+        assert self.join_type != "full", \
+            "full join builds are planned non-spillable"
         import jax
         sp = self.bridge.spilled
         if self._probe_bufs is None:
@@ -376,6 +401,25 @@ class LookupJoinOperator(Operator):
         out, total = pending
         return end_deferred_compact(out, total)
 
+    def _emit_outer(self) -> Batch:
+        """FULL OUTER tail: the never-matched build rows, NULL probe
+        side. One blocking compact — once per query, after the last
+        probe batch, so there is nothing left to overlap with."""
+        from presto_tpu.batch import (begin_deferred_compact,
+                                      end_deferred_compact)
+        assert self.probe_schema is not None, \
+            "full join needs the probe schema for its NULL side"
+        table = self.bridge.table
+        matched = self._matched if self._matched is not None else \
+            jnp.zeros(table.sorted_hash.shape[0], dtype=bool)
+        out, total = join_ops.unmatched_build(
+            table, matched, self.probe_schema, self.build_output)
+        if self.build_rename:
+            out = out.rename(self.build_rename)
+        self._outer_emitted = True
+        b, tok = begin_deferred_compact(out, total)
+        return end_deferred_compact(b, tok)
+
     def get_output(self) -> Optional[Batch]:
         # emit the HEAD only once a second batch is queued behind it
         # (or input ended): by then its count fetch has overlapped a
@@ -383,8 +427,11 @@ class LookupJoinOperator(Operator):
         if self._pending and (len(self._pending) > 1
                               or self._finishing):
             return self._count_out(self._emit(self._pending.pop(0)))
-        if self._pending or not self._finishing \
-                or self._probe_bufs is None:
+        if self._pending or not self._finishing:
+            return None
+        if self.join_type == "full" and not self._outer_emitted:
+            return self._count_out(self._emit_outer())
+        if self._probe_bufs is None:
             return None
         # drain the parked partitions: restore one probe batch per call
         import jax
@@ -407,7 +454,8 @@ class LookupJoinOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing and not self._pending \
-            and self._probe_bufs is None
+            and self._probe_bufs is None \
+            and (self.join_type != "full" or self._outer_emitted)
 
 
 class SemiJoinOperator(Operator):
@@ -508,7 +556,8 @@ class LookupJoinOperatorFactory(OperatorFactory):
                  build_rename: Optional[dict] = None,
                  build_keys: Optional[Sequence[str]] = None,
                  key_dicts: Optional[List[Optional[tuple]]] = None,
-                 expansion_factor: int = 1):
+                 expansion_factor: int = 1,
+                 probe_schema: Optional[Sequence[tuple]] = None):
         super().__init__(operator_id, f"lookup_join({join_type})")
         self.bridge = bridge
         self.key_names = tuple(key_names)
@@ -519,13 +568,15 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.build_output = build_output
         self.build_rename = build_rename
         self.expansion_factor = expansion_factor
+        self.probe_schema = probe_schema
 
     def create(self, driver_context: DriverContext) -> Operator:
         return LookupJoinOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.bridge, self.key_names, self.join_type,
             self.probe_output, self.build_output, self.build_rename,
-            self.build_keys, self.key_dicts, self.expansion_factor)
+            self.build_keys, self.key_dicts, self.expansion_factor,
+            self.probe_schema)
 
 
 class SemiJoinOperatorFactory(OperatorFactory):

@@ -17,15 +17,21 @@ CVal = Tuple[jnp.ndarray, jnp.ndarray]
 
 
 def hash64(data: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    """Splitmix64-style avalanche hash; NULL hashes to a fixed lane."""
+    """Splitmix64-style avalanche hash; NULL hashes to a fixed lane.
+    Mixing runs in uint64 so the xor-shifts are LOGICAL: an arithmetic
+    shift sign-extends and biases every high bit toward the sign —
+    harmless for low-bit bucketing, fatal for anything reading the top
+    bits (HLL rho, spill partitioning's h >> 32)."""
     if data.dtype in (jnp.float32, jnp.float64):
         x = jax.lax.bitcast_convert_type(data.astype(jnp.float64), jnp.int64)
     else:
         x = data.astype(jnp.int64)
     x = jnp.where(mask, x, jnp.int64(-0x61C8864680B583EB))
-    x = (x ^ (x >> 30)) * jnp.int64(-0x40A7B892E31B1A47)
-    x = (x ^ (x >> 27)) * jnp.int64(-0x6B2FB644ECCEEE15)
-    return x ^ (x >> 31)
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> 31)
+    return jax.lax.bitcast_convert_type(x, jnp.int64)
 
 
 def row_hash(cols: Sequence[CVal]) -> jnp.ndarray:

@@ -325,6 +325,74 @@ def make_approx_percentile(fraction: float) -> AggFunction:
                        DOUBLE, ())
 
 
+#: approx_distinct default standard error — matches the reference's
+#: ApproximateCountDistinctAggregation.DEFAULT_STANDARD_ERROR.
+HLL_DEFAULT_ERROR = 0.023
+#: Presto's accepted range for the explicit error argument.
+HLL_MIN_ERROR, HLL_MAX_ERROR = 0.0040625, 0.26
+
+
+def hll_registers_for_error(e: float) -> int:
+    """Register count m (power of two) with 1.04/sqrt(m) <= e, capped
+    at 2^14. Deviation from the reference: errors tighter than ~0.81%
+    clamp to 16384 registers — the per-row one-hot contribution is
+    [rows, m], and 2^16 registers (Presto's floor of 0.0040625) would
+    put a multi-GB intermediate in every batch step."""
+    m = 16
+    while 1.04 / np.sqrt(m) > e and m < (1 << 14):
+        m *= 2
+    return m
+
+
+@functools.lru_cache(maxsize=None)
+def make_approx_distinct(input_type: Type,
+                         max_error: float = HLL_DEFAULT_ERROR
+                         ) -> AggFunction:
+    """Dense HyperLogLog (reference: operator/aggregation/
+    ApproximateCountDistinctAggregation + HyperLogLog's dense mode).
+
+    State: one int8 register vector of m slots per group, merged with
+    elementwise MAX — it rides the same vector-state machinery as
+    approx_percentile's histogram ((dtype, K) component). Per row: the
+    low log2(m) hash bits pick the register, the leading-zero count of
+    the remaining bits (+1) is the candidate value, emitted as a
+    masked one-hot row. Registers use 0 = "empty"; rho <= 54 fits int8.
+    Memory is O(groups x m) regardless of input cardinality — the
+    whole point vs the exact-DISTINCT rewrite this replaces."""
+    m = hll_registers_for_error(max_error)
+    b = int(np.log2(m))
+
+    def init(value, w):
+        h = common.hash64(value, w).astype(jnp.uint64)
+        reg = (h & jnp.uint64(m - 1)).astype(jnp.int32)
+        wbits = h >> b  # top b bits now zero -> clz >= b
+        rho = (jax.lax.clz(wbits).astype(jnp.int32) - (b - 1))
+        oh = reg[:, None] == jnp.arange(m, dtype=jnp.int32)[None, :]
+        contrib = jnp.where(oh & w[:, None], rho[:, None], 0)
+        return (contrib.astype(np.int8),)
+
+    def final(state):
+        regs = jnp.maximum(state[0], 0).astype(jnp.float64)  # [G, m]
+        est = (_HLL_ALPHA[b] * m * m
+               / jnp.sum(jnp.exp2(-regs), axis=1))
+        zeros = jnp.sum(state[0] <= 0, axis=1).astype(jnp.float64)
+        # linear-counting correction for the small range
+        small = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+        est = jnp.where((est <= 2.5 * m) & (zeros > 0), small, est)
+        # empty group (all registers 0) -> 0, like the reference
+        return jnp.round(est).astype(np.int64), \
+            jnp.ones(est.shape[0], bool)
+    return AggFunction(f"approx_distinct[{m}]", ((np.int8, m),),
+                       ("max",), init, final, BIGINT, ())
+
+
+#: alpha_m bias constant per b = log2(m) (Flajolet et al. 2007).
+_HLL_ALPHA = {
+    4: 0.673, 5: 0.697, 6: 0.709,
+    **{bb: 0.7213 / (1 + 1.079 / (1 << bb)) for bb in range(7, 17)},
+}
+
+
 @functools.lru_cache(maxsize=None)
 def make_moments(kind: str) -> AggFunction:
     """skewness / kurtosis via sum-mergeable raw moments
@@ -476,6 +544,31 @@ def _group_reduce(keys: Sequence[CVal], valid: jnp.ndarray,
 
     Groups beyond out_cap are dropped and the overflow flag set (the
     caller's retry protocol). Output groups land packed in key order."""
+    if not keys:
+        # global aggregation: ONE group, no sort at all — a straight
+        # axis-0 reduction per state component. Contributions of
+        # non-contributing rows are already the reduce identity (init/
+        # _gate emit identity for w=False), and dead state slots hold
+        # identity by construction, so reducing the whole array is
+        # exact. This matters for vector states (HLL registers, pctl
+        # histograms): the sort path would drag an [n, K] payload
+        # through a variadic sort the compiler chews minutes on.
+        slots = jnp.arange(out_cap)
+        new_states = []
+        for st, agg in zip(contribs, aggs):
+            reduced = []
+            for arr, r, comp in zip(st, agg.reduces, agg.state_dtypes):
+                if r == "sum":
+                    v = jnp.sum(arr, axis=0)
+                elif r == "min":
+                    v = jnp.min(arr, axis=0)
+                else:
+                    v = jnp.max(arr, axis=0)
+                full = _full_state(out_cap, comp, r)
+                reduced.append(full.at[0].set(v.astype(full.dtype)))
+            new_states.append(tuple(reduced))
+        return GroupByState([], new_states, slots == 0,
+                            jnp.asarray(False))
     flat1d: List[jnp.ndarray] = []
     have_2d = any(arr.ndim == 2 for st in contribs for arr in st)
     for st in contribs:
@@ -665,7 +758,10 @@ def _slot_reduce(contrib: jnp.ndarray, gid: jnp.ndarray, num_slots: int,
     `num_slots` discarded). gid is int32 in [0, num_slots]. contrib may
     be [rows] or [rows, K] (vector state component)."""
     c = contrib.astype(dtype)
-    if num_slots <= _ONEHOT_SLOT_LIMIT:
+    # 2-D non-sum one-hot would materialize [rows, slots, K]; the
+    # segment path below keeps it at [rows, K] (HLL's max-merge)
+    if num_slots <= _ONEHOT_SLOT_LIMIT \
+            and (c.ndim == 1 or reduce == "sum"):
         oh = gid[:, None] == jnp.arange(num_slots, dtype=gid.dtype)[None, :]
         if c.ndim == 2:
             if reduce == "sum":
@@ -674,10 +770,6 @@ def _slot_reduce(contrib: jnp.ndarray, gid: jnp.ndarray, num_slots: int,
                 return jax.lax.dot_general(
                     oh.astype(jnp.float32).T, c.astype(jnp.float32),
                     (((1,), (0,)), ((), ()))).astype(dtype)
-            masked = jnp.where(oh[:, :, None], c[:, None, :],
-                               _ident_for(reduce, dtype))
-            op = jnp.min if reduce == "min" else jnp.max
-            return op(masked, axis=0)
         masked = jnp.where(oh, c[:, None], _ident_for(reduce, dtype))
         if reduce == "sum":
             return jnp.sum(masked, axis=0)

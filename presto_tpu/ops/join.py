@@ -10,8 +10,14 @@ k build rows) is resolved by a prefix-sum + searchsorted "expand" pattern
 with a host-chosen output capacity, then candidates are verified against
 the actual key columns so hash collisions only cost masked-out lanes.
 
-Join types: inner, left, semi (IN/EXISTS), anti (NOT IN/NOT EXISTS);
-right/full are planned as flipped/united variants by the planner.
+Join types: inner, left, full, semi (IN/EXISTS), anti (NOT IN/NOT
+EXISTS); right joins are planned as flipped left joins. FULL OUTER
+(reference: LookupJoinOperator + LookupOuterOperator.java:42) probes
+like a left join while scatter-accumulating a per-build-row matched
+flag on device; after the probe side is exhausted the operator emits
+the never-matched build rows with a NULL probe side — the analog of
+the reference's OuterPositionIterator, minus the shared-partition
+tracker (each task owns its hash partition of the build outright).
 """
 
 from __future__ import annotations
@@ -162,12 +168,66 @@ def probe_join(table: BuildTable, probe: Batch,
     return out, overflow, jnp.sum(out.row_valid)
 
 
+@functools.partial(jax.jit, static_argnums=(2, 4, 5, 6, 7))
+def probe_join_full(table: BuildTable, probe: Batch,
+                    key_names: Tuple[str, ...], matched: jnp.ndarray,
+                    out_capacity: int, probe_output: Tuple[str, ...],
+                    build_output: Tuple[str, ...],
+                    build_keys: Tuple[str, ...]):
+    """FULL OUTER probe step: identical to a left-join probe (unmatched
+    probe rows emit one NULL-build row), plus a scatter-max that folds
+    this batch's verified matches into the running per-build-row
+    `matched` flags — still one dispatch, zero host syncs (reference:
+    LookupJoinOperator.java:392 + the joinPositionsVisited bitmap
+    behind LookupOuterOperator.java:42)."""
+    lo, hi, counts, pkv = probe_counts(table, probe, key_names)
+    out, overflow, brow, verified = _expand_core(
+        table, probe, key_names, lo, hi, counts, pkv, out_capacity,
+        "full", probe_output, build_output, "", "", build_keys)
+    matched = matched.at[brow].max(verified)
+    return out, overflow, jnp.sum(out.row_valid), matched
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def unmatched_build(table: BuildTable, matched: jnp.ndarray,
+                    probe_schema: Tuple[Tuple, ...],
+                    build_output: Tuple[str, ...]):
+    """The FULL join's final batch: build rows no probe row ever
+    matched, probe side all-NULL (reference: LookupOuterOperator's
+    appendTo loop). `probe_schema` is ((name, type, dictionary), ...)
+    for the NULL probe columns. Returns (batch, live_count)."""
+    live = table.batch.row_valid & ~matched
+    n = matched.shape[0]
+    cols: Dict[str, Column] = {}
+    for name, typ, dic in probe_schema:
+        cols[name] = Column(jnp.zeros(n, dtype=typ.np_dtype),
+                            jnp.zeros(n, dtype=bool), typ, dic)
+    for name in build_output:
+        c = table.batch.columns[name]
+        cols[name] = Column(c.data, c.mask & live, c.type, c.dictionary)
+    return Batch(cols, live), jnp.sum(live)
+
+
 @functools.partial(jax.jit, static_argnums=(2, 7, 8, 9, 10, 11, 12, 13))
 def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
             probe_key_valid, out_capacity: int, join_type: str,
             probe_output, build_output, probe_prefix, build_prefix,
             build_keys) -> Tuple[Batch, jnp.ndarray]:
-    left_join = join_type == "left"
+    out, overflow, _, _ = _expand_core(
+        table, probe, key_names, lo, hi, counts, probe_key_valid,
+        out_capacity, join_type, probe_output, build_output,
+        probe_prefix, build_prefix, build_keys)
+    return out, overflow
+
+
+def _expand_core(table: BuildTable, probe: Batch, key_names, lo, hi,
+                 counts, probe_key_valid, out_capacity: int,
+                 join_type: str, probe_output, build_output,
+                 probe_prefix, build_prefix, build_keys):
+    """Expansion body; additionally returns (brow, verified) — the
+    per-output-slot build row index and verified-match flag — so the
+    FULL-join wrapper can scatter-accumulate build-side match state."""
+    left_join = join_type in ("left", "full")
     # per-probe emitted rows: matches, or 1 unmatched row for LEFT
     emit = counts
     if left_join:
@@ -218,7 +278,7 @@ def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
         bmask = c.mask[brow] & verified  # NULL build side on unmatched
         cols[build_prefix + name] = Column(c.data[brow], bmask, c.type,
                                            c.dictionary)
-    return Batch(cols, live), total > out_capacity
+    return Batch(cols, live), total > out_capacity, brow, verified
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))

@@ -59,6 +59,25 @@ def _agg_arg_and_params(c, an):
         if not 0 < frac < 1:
             raise AnalysisError("percentile must be in (0, 1)")
         return fold_constants(an.analyze(c.args[0])), (frac,)
+    if c.name == "approx_distinct":
+        from presto_tpu.ops.hashagg import (
+            HLL_DEFAULT_ERROR, HLL_MAX_ERROR, HLL_MIN_ERROR,
+        )
+        if len(c.args) not in (1, 2):
+            raise AnalysisError("approx_distinct takes (value[, e])")
+        err = HLL_DEFAULT_ERROR
+        if len(c.args) == 2:
+            e = fold_constants(an.analyze(c.args[1]))
+            if not isinstance(e, Literal) or e.value is None:
+                raise AnalysisError(
+                    "approx_distinct's error bound must be a constant")
+            err = float(e.value) if not e.type.is_decimal \
+                else e.value / 10 ** e.type.scale
+            if not HLL_MIN_ERROR <= err <= HLL_MAX_ERROR:
+                raise AnalysisError(
+                    f"approx_distinct error bound must be in "
+                    f"[{HLL_MIN_ERROR}, {HLL_MAX_ERROR}]")
+        return fold_constants(an.analyze(c.args[0])), (err,)
     if len(c.args) != 1:
         raise AnalysisError(f"{c.name} takes one argument")
     arg = fold_constants(an.analyze(c.args[0]))
@@ -664,7 +683,7 @@ def _collect_agg_calls(node, out: List[T.FunctionCall]):
 
 
 def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
-    if fn in ("count", "count_if", "checksum"):
+    if fn in ("count", "count_if", "checksum", "approx_distinct"):
         return BIGINT
     if fn in ("avg", "var_samp", "var_pop", "variance", "stddev",
               "stddev_samp", "stddev_pop", "geometric_mean",
@@ -1087,15 +1106,6 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
         _collect_agg_calls(spec.having, calls)
     for o in order_items:
         _collect_agg_calls(o.expr, calls)
-
-    # approx_distinct(x) is satisfied exactly: rewrite to
-    # count(DISTINCT x) (an exact answer is within any approximation
-    # bound; the reference's HLL sketch trades exactness for fixed
-    # state — our sort-based pre-distinct already has bounded state)
-    for c in calls:
-        if c.name == "approx_distinct":
-            c.name = "count"
-            c.distinct = True
 
     # DISTINCT aggregates (e.g. Q16's count(distinct suppkey)): insert a
     # pre-aggregation producing the distinct (group keys, arg) rows, then
@@ -1683,6 +1693,32 @@ def _plan_join(rel: T.Join, ctx: PlannerContext,
             raise AnalysisError("non-equi outer joins not supported yet")
         node = N.JoinNode("cross", ln, rn, [], out_fields, res_expr)
         return RelationPlan(node, combined)
+    # string equi-keys: the executor re-encodes BOTH sides onto the
+    # union dictionary before building/probing, so the join's output
+    # key columns carry union-coded data — the output FIELD metadata
+    # must say so too, or a downstream projection re-tags them with
+    # the stale per-side dictionary and decodes garbage
+    from presto_tpu.batch import union_dictionary
+    merged_dicts = {}
+    for l, r in criteria:
+        lf = combined.fields[[f.symbol for f in combined.fields]
+                             .index(l)]
+        rf = combined.fields[[f.symbol for f in combined.fields]
+                             .index(r)]
+        if lf.type.is_string or rf.type.is_string:
+            merged_dicts[l] = merged_dicts[r] = union_dictionary(
+                lf.dictionary, rf.dictionary)
+    if merged_dicts:
+        out_fields = tuple(
+            N.Field(f.symbol, f.type,
+                    merged_dicts.get(f.symbol, f.dictionary))
+            for f in out_fields)
+        # the scope drives select-list analysis — its dictionary
+        # metadata must match the union-coded runtime columns too
+        combined = Scope(
+            [ScopeField(f.qualifier, f.name, f.symbol, f.type,
+                        merged_dicts.get(f.symbol, f.dictionary))
+             for f in combined.fields], outer)
     node = N.JoinNode(jt, ln, rn, criteria, out_fields, res_expr)
     return RelationPlan(node, combined)
 

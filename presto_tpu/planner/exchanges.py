@@ -150,8 +150,20 @@ class _Exchanger:
 
     def _rw_SortNode(self, node):
         src, props = self._rw(node.source)
-        node.source = self._to_single(src, props)
-        return node, SINGLE
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        # P11 sorted-merge exchange: each task sorts its shard, the
+        # single consumer MERGES the pre-sorted runs (rank-arithmetic
+        # pairwise merge) instead of re-sorting the union (reference:
+        # MergeOperator.java:44 + SystemPartitioningHandle's
+        # FIXED_PASSTHROUGH merge exchanges)
+        partial = N.SortNode(src, list(node.keys),
+                             list(node.descending),
+                             list(node.nulls_first), tuple(src.output))
+        gather = self._exchange(partial, "gather")
+        return N.MergeNode(gather, node.keys, node.descending,
+                           node.nulls_first, node.output), SINGLE
 
     def _rw_EnforceSingleRowNode(self, node):
         src, props = self._rw(node.source)
@@ -369,7 +381,15 @@ class _Exchanger:
         build_node = left if build_attr == "left" else right
         build_props = lp if build_attr == "left" else rp
         probe_props = rp if build_attr == "left" else lp
-        if self._est(build_node) <= self.threshold:
+        # a FULL join's build side must never be broadcast: every task
+        # would re-emit the replicated unmatched build rows. Hash both
+        # sides so each task owns its build partition (the reference
+        # forbids REPLICATED full joins the same way). Pulling the
+        # build to a SINGLE probe task is still fine — one owner.
+        small_build_ok = self._est(build_node) <= self.threshold \
+            and (node.join_type != "full"
+                 or probe_props.kind == P_SINGLE)
+        if small_build_ok:
             if probe_props.kind == P_SINGLE:
                 # keep the whole join on the probe's single task
                 bc = self._to_single(build_node, build_props)
@@ -387,6 +407,16 @@ class _Exchanger:
             for (l, r) in node.criteria)
         node.left = self._ensure_hashed(left, lp, lkeys, dicts)
         node.right = self._ensure_hashed(right, rp, rkeys, dicts)
+        # the declared keys must be NON-NULL-extended in the output:
+        # a RIGHT join NULL-extends the left side (unmatched right
+        # rows land by hash(rkey) with lkey NULL on many tasks), and a
+        # FULL join NULL-extends both — claiming P_HASH there would
+        # let a downstream _ensure_hashed skip a needed re-exchange
+        # and emit per-task NULL groups
+        if node.join_type == "full":
+            return node, SOURCE
+        if node.join_type == "right":
+            return node, Props(P_HASH, rkeys, dicts)
         return node, Props(P_HASH, lkeys, dicts)
 
     def _rw_SemiJoinNode(self, node: N.SemiJoinNode):
@@ -416,10 +446,10 @@ def _field(node: N.PlanNode, symbol: str) -> N.Field:
 
 
 def _pair_dict(lf: N.Field, rf: N.Field):
+    from presto_tpu.batch import union_dictionary
     if lf.dictionary is None and rf.dictionary is None:
         return None
-    return tuple(sorted(set(lf.dictionary or ())
-                        | set(rf.dictionary or ())))
+    return union_dictionary(lf.dictionary, rf.dictionary)
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,7 @@ from presto_tpu.expr.compile import CompiledExpr, compile_expression
 from presto_tpu.expr.ir import InputRef, RowExpression, walk, InputRef
 from presto_tpu.operators import misc_ops
 from presto_tpu.operators.aggregation import (
-    AggSpec, AggregationOperatorFactory,
+    AggSpec, AggregationOperatorFactory, _direct_domains,
 )
 from presto_tpu.operators.core import (
     FilterProjectOperatorFactory, OutputCollectorOperatorFactory,
@@ -319,9 +319,73 @@ class LocalExecutionPlanner:
         if est is not None:
             max_groups = max(max_groups,
                              min(int(est * 2), 1 << 22))
+        if self._streaming_agg_eligible(node, key_exprs):
+            from presto_tpu.operators.aggregation import (
+                StreamingAggregationOperatorFactory,
+            )
+            pipe.append(StreamingAggregationOperatorFactory(
+                self._next_id(), key_names, key_exprs, specs,
+                input_dicts=_schema_dicts(schema)))
+            return
         pipe.append(AggregationOperatorFactory(
             self._next_id(), key_names, key_exprs, specs, node.step,
             max_groups, input_dicts=_schema_dicts(schema)))
+
+    def _streaming_agg_eligible(self, node: N.AggregationNode,
+                                key_exprs) -> bool:
+        """True when the aggregation's input arrives sorted by its
+        group keys (ascending, nulls last — the grouping kernel's
+        canonical packing order, so the carried boundary group is
+        always the packed-last slot): a sorted subquery, a merge, or a
+        scan whose connector declares a physical sort order. The
+        streaming operator then runs in O(batch) memory with no
+        overflow retry (reference: StreamingAggregationOperator +
+        connector local properties)."""
+        if node.step != "single" or not node.keys:
+            return False
+        if not bool(get_property(self.session.properties,
+                                 "streaming_aggregation")):
+            return False
+        if _direct_domains(key_exprs) is not None:
+            return False  # the slot-table path is already bounded
+        # group-key symbols in kernel key order (must be bare columns)
+        syms = []
+        for _, e in node.keys:
+            if not isinstance(e, InputRef):
+                return False
+            syms.append(e.name)
+        cur = node.source
+        while True:
+            if isinstance(cur, N.ProjectNode):
+                asg = dict(cur.assignments)
+                mapped = []
+                for s in syms:
+                    e = asg.get(s)
+                    if not isinstance(e, InputRef):
+                        return False
+                    mapped.append(e.name)
+                syms = mapped
+                cur = cur.source
+            elif isinstance(cur, N.FilterNode):
+                cur = cur.source
+            elif isinstance(cur, (N.SortNode, N.MergeNode)):
+                k = len(syms)
+                if list(cur.keys[:k]) != syms:
+                    return False
+                return not any(cur.descending[:k]) \
+                    and not any(cur.nulls_first[:k])
+            elif isinstance(cur, N.TableScanNode):
+                try:
+                    conn = self.catalogs.connector(cur.handle.catalog)
+                    order = conn.metadata.sorted_by(cur.handle)
+                except Exception:
+                    return False
+                if not order:
+                    return False
+                cols = [cur.assignments.get(s) for s in syms]
+                return order[:len(cols)] == cols
+            else:
+                return False
 
     def _estimated_groups(self, node: N.AggregationNode):
         """Estimated distinct groups, or None when unknowable."""
@@ -352,7 +416,7 @@ class LocalExecutionPlanner:
             self._visit(node.left, pipe)
             pipe.append(misc_ops.nested_loop_join_factory(
                 self._next_id(), bridge))
-        elif node.join_type in ("inner", "left", "right"):
+        elif node.join_type in ("inner", "left", "right", "full"):
             probe, build = node.left, node.right
             criteria = node.criteria
             jt = node.join_type
@@ -371,8 +435,11 @@ class LocalExecutionPlanner:
                 key_dicts,
                 schema_cols=[(f.symbol, f.type, f.dictionary)
                              for f in build.output],
+                # a spilled FULL-join build would need per-partition
+                # matched-flag tracking; the build stays resident
                 spillable=bool(get_property(self.session.properties,
-                                            "spill_enabled")),
+                                            "spill_enabled"))
+                and jt != "full",
                 df_publish=df_publish))
             self._pipelines.append(build_pipe)
             self._visit(probe, pipe)
@@ -384,7 +451,10 @@ class LocalExecutionPlanner:
                 build_keys=[r for _, r in criteria],
                 key_dicts=key_dicts,
                 expansion_factor=int(get_property(
-                    self.session.properties, "join_expansion_factor"))))
+                    self.session.properties, "join_expansion_factor")),
+                probe_schema=[(f.symbol, f.type, f.dictionary)
+                              for f in probe.output]
+                if jt == "full" else None))
         else:
             raise LocalPlanningError(
                 f"{node.join_type} join not supported yet")
@@ -501,6 +571,13 @@ class LocalExecutionPlanner:
             self._next_id(), node.keys, node.descending,
             node.nulls_first))
 
+    def _visit_MergeNode(self, node: N.MergeNode, pipe: List):
+        from presto_tpu.operators.sort_ops import MergeOperatorFactory
+        self._visit(node.source, pipe)
+        pipe.append(MergeOperatorFactory(
+            self._next_id(), node.keys, node.descending,
+            node.nulls_first))
+
     def _visit_TopNNode(self, node: N.TopNNode, pipe: List):
         self._visit(node.source, pipe)
         schema_cols = [(f.symbol, f.type, f.dictionary)
@@ -585,7 +662,7 @@ _VARIANCE_CANON = {"variance": "var_samp", "stddev_samp": "stddev"}
 #: aggregates whose state has no intermediate column representation —
 #: the planner co-locates whole groups (like DISTINCT aggs) instead of
 #: splitting partial/final across an exchange
-NO_SPLIT_AGGS = {"approx_percentile"}
+NO_SPLIT_AGGS = {"approx_percentile", "approx_distinct"}
 
 
 def agg_function_for(name: str, input_type: Optional[Type],
@@ -596,6 +673,9 @@ def agg_function_for(name: str, input_type: Optional[Type],
     (both sides must construct bit-identical state layouts)."""
     if name == "approx_percentile":
         return hashagg.make_approx_percentile(params[0])
+    if name == "approx_distinct":
+        return hashagg.make_approx_distinct(
+            input_type, params[0] if params else hashagg.HLL_DEFAULT_ERROR)
     if name == "count":
         return hashagg.make_count(input_type)
     if name == "sum":
@@ -627,6 +707,7 @@ def _unified_key_dicts(probe: N.PlanNode, build: N.PlanNode,
                        criteria) -> Optional[List[Optional[tuple]]]:
     """For string join keys, the union dictionary both sides re-encode
     onto so code equality is string equality (batch.remap_column)."""
+    from presto_tpu.batch import union_dictionary
     out: List[Optional[tuple]] = []
     any_string = False
     for l, r in criteria:
@@ -634,9 +715,7 @@ def _unified_key_dicts(probe: N.PlanNode, build: N.PlanNode,
         rf = build.field(r)
         if lf.type.is_string or rf.type.is_string:
             any_string = True
-            merged = tuple(sorted(set(lf.dictionary or ())
-                                  | set(rf.dictionary or ())))
-            out.append(merged)
+            out.append(union_dictionary(lf.dictionary, rf.dictionary))
         else:
             out.append(None)
     return out if any_string else None
@@ -736,7 +815,7 @@ def _child_demand(node: N.PlanNode, demand: set
     if isinstance(node, N.SemiJoinNode):
         return [(node.source, demand | {node.source_key}),
                 (node.filtering_source, {node.filtering_key})]
-    if isinstance(node, (N.SortNode, N.TopNNode)):
+    if isinstance(node, (N.SortNode, N.TopNNode, N.MergeNode)):
         return [(node.source, demand | set(node.keys))]
     if isinstance(node, N.WindowNode):
         child = (demand - {c.out_symbol for c in node.calls}) \
@@ -815,7 +894,7 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
         node.output = narrowed(extra)
     elif isinstance(node, N.SemiJoinNode):
         node.output = narrowed({node.source_key})
-    elif isinstance(node, (N.SortNode, N.TopNNode)):
+    elif isinstance(node, (N.SortNode, N.TopNNode, N.MergeNode)):
         node.output = narrowed(set(node.keys))
     elif isinstance(node, N.WindowNode):
         node.calls = [c for c in node.calls if c.out_symbol in demand]

@@ -146,6 +146,23 @@ class SortNode(PlanNode):
 
 
 @dataclasses.dataclass
+class MergeNode(PlanNode):
+    """k-way merge of PRE-SORTED inputs (reference:
+    operator/MergeOperator.java:44): the root of a distributed ORDER
+    BY merges its tasks' sorted shards instead of re-sorting their
+    union. Fields mirror SortNode; the input batches must each be
+    sorted by the same keys."""
+    source: PlanNode
+    keys: List[str]
+    descending: List[bool]
+    nulls_first: List[bool]
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
 class TopNNode(PlanNode):
     source: PlanNode
     n: int
@@ -376,7 +393,7 @@ def plan_text(node: PlanNode, indent: int = 0) -> str:
                   f"step={node.step}]"
     elif isinstance(node, JoinNode):
         details = f"[{node.join_type} on {node.criteria}]"
-    elif isinstance(node, (SortNode, TopNNode)):
+    elif isinstance(node, (SortNode, TopNNode, MergeNode)):
         details = f"[{node.keys}]"
     elif isinstance(node, LimitNode):
         details = f"[{node.n}]"

@@ -50,6 +50,18 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "with 4x (reference: MultiChannelGroupByHash rehash)",
         _positive),
     PropertyDef(
+        "query_memory_bytes", "bigint", 0,
+        "Declared per-query memory reservation charged against "
+        "resource-group memory caps at admission (0 = unaccounted; "
+        "reference: query_max_memory against resource-group "
+        "softMemoryLimit)", _non_negative),
+    PropertyDef(
+        "streaming_aggregation", "boolean", True,
+        "Aggregate key-sorted inputs (declared-sorted scans, sorted "
+        "subqueries) with the streaming operator: O(batch) memory, "
+        "groups emitted in key order (reference: "
+        "streaming-for-partial-aggregation-enabled)"),
+    PropertyDef(
         "dynamic_filtering", "boolean", True,
         "Inner-join build-side key bounds prune probe-side scans in "
         "the same fragment (reference: enable-dynamic-filtering)"),

@@ -134,82 +134,81 @@ def test_semi_join():
     assert f == [False, True, True, True, False]
 
 
-def _hash64_collisions(seed: int):
-    """Enumerate distinct int64 keys whose `common.hash64` values are
-    IDENTICAL. hash64's xorshift stages use arithmetic shifts
-    (`x ^ (x >> k)` on int64), which zero the output sign bit — each
-    stage is exactly 2-to-1, so every final hash has up to 8 distinct
-    preimages. We walk the pipeline backwards from hash64(seed),
-    enumerating both preimages at each of the three xorshift stages."""
-    M = 1 << 64
+_M64 = 1 << 64
+_C1 = 0xBF58476D1CE4E5B9
+_C2 = 0x94D049BB133111EB
 
-    def asr(x, k):
-        """64-bit arithmetic shift right (two's complement)."""
-        s = x if x < 1 << 63 else x - M
-        return (s >> k) % M
 
-    def xorshift(x, k):
-        return x ^ asr(x, k)
+def _hash64_py(v: int) -> int:
+    """Pure-python mirror of common.hash64 (uint64 logical shifts)."""
+    x = v % _M64
+    x = (x ^ (x >> 30)) * _C1 % _M64
+    x = (x ^ (x >> 27)) * _C2 % _M64
+    return x ^ (x >> 31)
 
-    def preimages(y, k):
-        """Both solutions x of x ^ asr(x, k) == y (bit j of the result
-        is x_j ^ x_{min(j+k,63)}; choosing the sign bit fixes the top k
-        bits, then lower bits resolve top-down)."""
-        out = []
-        for s in (0, 1):
-            bits = [0] * 64
-            bits[63] = s
-            # positions [64-k, 63): x_j = y_j ^ x_63
-            for j in range(64 - k, 63):
-                bits[j] = ((y >> j) & 1) ^ s
-            # then resolve downwards: x_j = y_j ^ x_{j+k}
-            for j in range(63 - k, -1, -1):
-                bits[j] = ((y >> j) & 1) ^ bits[j + k]
-            x = sum(b << j for j, b in enumerate(bits))
-            if xorshift(x, k) == y:
-                out.append(x)
-        return out
 
-    C1 = 0xBF58476D1CE4E5B9
-    C2 = 0x94D049BB133111EB
-    INV1 = pow(C1, -1, M)
-    INV2 = pow(C2, -1, M)
-    x = seed % M
-    h = xorshift((xorshift((xorshift(x, 30) * C1) % M, 27) * C2) % M, 31)
-    keys = set()
-    for x4 in preimages(h, 31):
-        x3 = (x4 * INV2) % M
-        for x2 in preimages(x3, 27):
-            x1 = (x2 * INV1) % M
-            for x0 in preimages(x1, 30):
-                keys.add(x0 - M if x0 >= 1 << 63 else x0)  # signed
-    return sorted(keys)
+def _hash64_inv(h: int) -> int:
+    """hash64 is a BIJECTION since the uint64 fix (logical xorshifts
+    invert exactly; the multiplies are odd -> invertible mod 2^64).
+    This walks it backwards."""
+    def unshift(y, k):
+        x = y
+        for _ in range(0, 64, k):
+            x = y ^ (x >> k)
+        return x % _M64
+    x = unshift(h % _M64, 31)
+    x = x * pow(_C2, -1, _M64) % _M64
+    x = unshift(x, 27)
+    x = x * pow(_C1, -1, _M64) % _M64
+    return unshift(x, 30)
+
+
+def _row_hash_collisions(n: int):
+    """Engineer n distinct TWO-COLUMN rows sharing one row_hash.
+    row_hash(a, b) = hash64(a) * 31 + hash64(b) (mod 2^64); hash64 is
+    now bijective (no single-column collisions exist at all), so we
+    fix a target T, pick distinct a_i, and solve b_i =
+    hash64^-1(T - 31 * hash64(a_i))."""
+    T = 0xDEAD_BEEF_CAFE_F00D
+    rows = []
+    for i in range(n):
+        a = i + 1
+        hb = (T - 31 * _hash64_py(a)) % _M64
+        b = _hash64_inv(hb)
+        rows.append((a, b - _M64 if b >= 1 << 63 else b))
+    return rows
 
 
 def test_semi_join_exact_under_hash_collisions():
-    """Adversarial: >4 distinct build keys sharing ONE 64-bit hash, plus
-    a colliding probe key NOT in the build. The old MAX_RUN=4 fallback
-    marked any row of a long run as a member by hash equality alone —
-    a silent wrong IN/NOT IN answer. semi_mark must now be exact."""
+    """Adversarial: >4 distinct (two-column) build keys sharing ONE
+    64-bit row hash, plus a colliding key pair NOT in the build. The
+    old MAX_RUN=4 fallback marked any row of a long run as a member by
+    hash equality alone — a silent wrong IN/NOT IN answer. semi_mark
+    must be exact for every run length."""
     from presto_tpu.ops import common
     import jax.numpy as jnp
 
-    keys = _hash64_collisions(0x1234_5678_9ABC)
-    assert len(keys) >= 4, f"need >=4 colliding keys, got {len(keys)}"
+    rows = _row_hash_collisions(5)
+    ones = jnp.ones(len(rows), bool)
     hs = np.asarray(common.row_hash(
-        [(jnp.asarray(keys, jnp.int64), jnp.ones(len(keys), bool))]))
-    assert len(set(hs.tolist())) == 1, "engineered keys must collide"
+        [(jnp.asarray([a for a, _ in rows], jnp.int64), ones),
+         (jnp.asarray([b for _, b in rows], jnp.int64), ones)]))
+    assert len(set(hs.tolist())) == 1, "engineered rows must collide"
 
-    # duplicates stretch the hash run to 6 (> old MAX_RUN of 4) while
-    # keeping a distinct colliding key OUT of the build side
-    build_keys = [keys[0], keys[0], keys[0], keys[1], keys[1], keys[2]]
-    outsider = keys[3]             # collides, but NOT a member
-    member_deep = build_keys[5]    # member sitting past offset 4
-    bb = Batch.from_pydict({"k": (build_keys, BIGINT)})
-    pb = Batch.from_pydict(
-        {"k": ([outsider, member_deep, build_keys[0], 42], BIGINT)})
-    table = join.build(bb, ("k",))
-    found, valid = join.semi_mark(table, pb, ("k",))
+    # duplicates stretch the hash run to 6 (> the unrolled prefix of
+    # 4) while keeping a distinct colliding pair OUT of the build
+    build = [rows[0], rows[0], rows[0], rows[1], rows[1], rows[2]]
+    outsider = rows[3]            # collides, but NOT a member
+    member_deep = build[5]        # member sitting past offset 4
+    bb = Batch.from_pydict({
+        "a": ([a for a, _ in build], BIGINT),
+        "b": ([b for _, b in build], BIGINT)})
+    probe_rows = [outsider, member_deep, build[0], (42, 43)]
+    pb = Batch.from_pydict({
+        "a": ([a for a, _ in probe_rows], BIGINT),
+        "b": ([b for _, b in probe_rows], BIGINT)})
+    table = join.build(bb, ("a", "b"))
+    found, valid = join.semi_mark(table, pb, ("a", "b"))
     f = np.asarray(found)[:4].tolist()
     assert f == [False, True, True, False]
     assert np.asarray(valid)[:4].tolist() == [True] * 4
