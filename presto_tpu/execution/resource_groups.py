@@ -1,0 +1,264 @@
+"""Hierarchical resource groups (reference:
+execution/resourceGroups/InternalResourceGroup.java +
+presto-resource-group-managers' static selectors).
+
+A tree of named groups, each with a hard concurrency cap, a queue
+bound, an optional memory cap, and a scheduling weight. A query is
+routed to a LEAF group by the first matching selector (user/source
+regexes), then admission walks the path root->leaf: it may RUN only
+if every ancestor has concurrency and memory headroom; otherwise it
+queues in its leaf (rejected when any ancestor's queue is full).
+Releases dispatch the next queued query by weighted fairness among
+eligible leaves (lowest running/weight ratio first — the analog of
+the reference's weighted scheduling policy).
+
+Memory accounting uses per-query declared reservations (the session's
+query_memory_bytes): the coordinator has no live worker memory feed,
+so groups bound the SUM of declared reservations — the same contract
+as the reference's softMemoryLimit against cluster memory POOLS."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class GroupSpec:
+    """Static definition of one group (reference:
+    resource_groups.json's resourceGroups entries)."""
+    name: str
+    hard_concurrency: int = 4
+    max_queued: int = 100
+    memory_limit_bytes: Optional[int] = None
+    weight: int = 1
+    subgroups: List["GroupSpec"] = dataclasses.field(
+        default_factory=list)
+
+
+@dataclasses.dataclass
+class Selector:
+    """Routes a query to a leaf group by user/source regex (reference:
+    StaticSelector.java). `group` is a dotted path under root."""
+    group: str
+    user: Optional[str] = None
+    source: Optional[str] = None
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user is not None and not re.fullmatch(self.user, user):
+            return False
+        if self.source is not None \
+                and not re.fullmatch(self.source, source):
+            return False
+        return True
+
+
+class _Group:
+    def __init__(self, spec: GroupSpec, parent: Optional["_Group"]):
+        self.spec = spec
+        self.parent = parent
+        self.path = spec.name if parent is None or parent.parent is None \
+            else f"{parent.path}.{spec.name}"
+        self.running = 0
+        self.queued: List[Tuple[str, int, Callable[[], None]]] = []
+        self.memory_reserved = 0
+        self.children: Dict[str, _Group] = {}
+        for sub in spec.subgroups:
+            self.children[sub.name] = _Group(sub, self)
+
+    # admission headroom must hold at EVERY level up to the root
+    def _can_run(self, memory: int) -> bool:
+        g = self
+        while g is not None:
+            if g.running >= g.spec.hard_concurrency:
+                return False
+            if g.spec.memory_limit_bytes is not None \
+                    and g.memory_reserved + memory \
+                    > g.spec.memory_limit_bytes:
+                return False
+            g = g.parent
+        return True
+
+    def _queue_full(self) -> bool:
+        g = self
+        while g is not None:
+            if sum_queued(g) >= g.spec.max_queued:
+                return True
+            g = g.parent
+        return False
+
+    def _charge(self, memory: int, delta: int) -> None:
+        g = self
+        while g is not None:
+            g.running += delta
+            g.memory_reserved += delta * memory
+            g = g.parent
+
+
+def sum_queued(g: _Group) -> int:
+    n = len(g.queued)
+    for c in g.children.values():
+        n += sum_queued(c)
+    return n
+
+
+class QueryRejected(Exception):
+    pass
+
+
+class ResourceGroupManager:
+    """Thread-safe admission front end.
+
+    submit() returns ("run", group_path) when admitted immediately, or
+    ("queued", group_path) after parking `on_dispatch` to be called
+    (on the releasing thread) when capacity frees; it raises
+    QueryRejected when the leaf's (or an ancestor's) queue is full.
+    finish() releases a slot and dispatches queued work by weighted
+    fairness."""
+
+    def __init__(self, root: GroupSpec,
+                 selectors: Optional[List[Selector]] = None):
+        self._root = _Group(root, None)
+        self._selectors = selectors or []
+        self._lock = threading.Lock()
+
+    # -- routing -----------------------------------------------------------
+
+    def _leaf_for(self, user: str, source: str) -> _Group:
+        g = None
+        for sel in self._selectors:
+            if sel.matches(user, source):
+                g = self._root
+                for part in sel.group.split("."):
+                    child = g.children.get(part)
+                    if child is None:
+                        break
+                    g = child
+                break
+        if g is None:
+            if self._selectors:
+                # the reference rejects no-match queries rather than
+                # letting them consume some other team's quota
+                raise QueryRejected(
+                    f"no resource group selector matches user="
+                    f"{user!r} source={source!r}")
+            g = self._root  # selector-less setups: the single group
+        # queries must land on a LEAF: finish()'s dispatch scan only
+        # walks leaves, so an interior queue would never drain. A
+        # selector naming an interior (or misspelled) group descends
+        # to its first leaf.
+        while g.children:
+            g = next(iter(g.children.values()))
+        return g
+
+    # -- protocol ----------------------------------------------------------
+
+    def submit(self, user: str = "", source: str = "",
+               memory_bytes: int = 0,
+               on_dispatch: Optional[Callable[[], None]] = None
+               ) -> Tuple[str, str]:
+        with self._lock:
+            leaf = self._leaf_for(user, source)
+            # a reservation no amount of draining can satisfy must
+            # fail NOW — queued it would wedge its leaf's FIFO head
+            # forever (the reference fails over-limit queries at
+            # submission)
+            g = leaf
+            while g is not None:
+                if g.spec.memory_limit_bytes is not None \
+                        and memory_bytes > g.spec.memory_limit_bytes:
+                    raise QueryRejected(
+                        f"query memory {memory_bytes} exceeds group "
+                        f"{g.path}'s limit "
+                        f"{g.spec.memory_limit_bytes}")
+                g = g.parent
+            if leaf._can_run(memory_bytes):
+                leaf._charge(memory_bytes, +1)
+                return "run", leaf.path
+            if leaf._queue_full():
+                raise QueryRejected(
+                    f"queue full for resource group {leaf.path}")
+            leaf.queued.append((user, memory_bytes,
+                                on_dispatch or (lambda: None)))
+            return "queued", leaf.path
+
+    def finish(self, group_path: str, memory_bytes: int = 0) -> None:
+        """Release one running slot of `group_path`, then dispatch as
+        many queued queries (across ALL leaves) as now fit, weighted-
+        fair: eligible leaves drain in ascending running/weight."""
+        dispatch: List[Callable[[], None]] = []
+        with self._lock:
+            g = self._find(group_path)
+            g._charge(memory_bytes, -1)
+            while True:
+                leaves = [x for x in self._leaves(self._root)
+                          if x.queued]
+                leaves.sort(key=lambda x: x.running
+                            / max(x.spec.weight, 1))
+                fired = False
+                for leaf in leaves:
+                    _, mem, cb = leaf.queued[0]
+                    if leaf._can_run(mem):
+                        leaf.queued.pop(0)
+                        leaf._charge(mem, +1)
+                        dispatch.append(cb)
+                        fired = True
+                        break
+                if not fired:
+                    break
+        for cb in dispatch:
+            cb()
+
+    def cancel_queued(self, group_path: str, on_dispatch) -> bool:
+        """Drop an abandoned queued entry (its callback identity) so it
+        stops holding a queue position."""
+        with self._lock:
+            g = self._find(group_path)
+            for i, (_, _, cb) in enumerate(g.queued):
+                if cb is on_dispatch:
+                    del g.queued[i]
+                    return True
+        return False
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        """system.runtime-style rows: one per group."""
+        out: List[dict] = []
+        with self._lock:
+            stack = [self._root]
+            while stack:
+                g = stack.pop()
+                out.append({
+                    "group": g.path,
+                    "running": g.running,
+                    "queued": sum_queued(g),
+                    "memory_reserved": g.memory_reserved,
+                    "hard_concurrency": g.spec.hard_concurrency,
+                    "max_queued": g.spec.max_queued,
+                })
+                stack.extend(g.children.values())
+        return sorted(out, key=lambda r: r["group"])
+
+    # -- internals ---------------------------------------------------------
+
+    def _find(self, path: str) -> _Group:
+        g = self._root
+        if path == g.path:
+            return g
+        for part in path.split("."):
+            child = g.children.get(part)
+            if child is None:
+                return g
+            g = child
+        return g
+
+    def _leaves(self, g: _Group) -> List[_Group]:
+        if not g.children:
+            return [g]
+        out = []
+        for c in g.children.values():
+            out.extend(self._leaves(c))
+        return out

@@ -26,6 +26,7 @@ padded with empty batches).
 from __future__ import annotations
 
 import collections
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -72,6 +73,37 @@ def partition_key_hash(batch: Batch, partition_keys: Sequence[str],
             d = remaps[i][d]
         cols.append((d, c.mask))
     return jnp.abs(common.row_hash(cols))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 3))
+def partition_segments(batch: Batch, partition_keys: Tuple[str, ...],
+                       remaps, n_consumers: int):
+    """ONE dispatch for a whole hash repartition: sort rows by
+    destination (columns ride the variadic sort as payloads) and
+    return the sorted batch plus the destination segment bounds —
+    segment c is rows [bounds[c], bounds[c+1]), dead rows parked at
+    the end. The DCN push then does a single device->host transfer
+    and slices per destination on the host, instead of per-consumer
+    mask+compact+serialize rounds (reference seam: the block-level
+    repartition of OptimizedPartitionedOutputOperator.java:82)."""
+    h = partition_key_hash(batch, partition_keys, remaps)
+    dest = (h % n_consumers).astype(jnp.int32)
+    dest = jnp.where(batch.row_valid, dest, n_consumers)
+    payloads = [batch.row_valid]
+    for n in batch.names:
+        payloads.extend(batch.columns[n].astuple())
+    out = jax.lax.sort((dest,) + tuple(payloads), num_keys=1,
+                       is_stable=True)
+    cols = {}
+    for i, n in enumerate(batch.names):
+        c = batch.columns[n]
+        cols[n] = Column(out[2 + 2 * i], out[3 + 2 * i], c.type,
+                         c.dictionary)
+    bounds = jnp.searchsorted(out[0],
+                              jnp.arange(n_consumers + 1,
+                                         dtype=jnp.int32),
+                              side="left")
+    return Batch(cols, out[1]), bounds
 
 
 def edge_key_dicts(edge) -> List:

@@ -60,6 +60,11 @@ class _Query:
         self.columns: Optional[List[dict]] = None
         self.data: Optional[List[list]] = None
         self.done_at: Optional[float] = None  # set at terminal state
+        self.user = ""
+        self.source = ""
+        self.group = "root"
+        self.dispatch = None  # resource-group dispatch callback
+        self.last_poll = time.monotonic()
 
 
 #: result rows per client page (reference: the target-result-size
@@ -68,28 +73,39 @@ PAGE_ROWS = 4096
 
 
 class Coordinator(Node):
-    """`max_concurrent_queries` / `max_queued_queries` give minimal
-    resource-group admission control (reference:
-    execution/resourceGroups/InternalResourceGroup +
-    DispatchManager.java:167): queries past the concurrency cap wait
-    QUEUED; past the queue cap they fail immediately."""
+    """Admission control runs through hierarchical RESOURCE GROUPS
+    (reference: execution/resourceGroups/InternalResourceGroup +
+    DispatchManager.java:167): the client's X-Presto-User /
+    X-Presto-Source headers route each query to a leaf group via the
+    configured selectors; per-group concurrency/memory caps gate
+    execution, per-group queue bounds reject overload, and releases
+    dispatch queued queries weighted-fair across leaves. The default
+    configuration (no `resource_groups` argument) is one root group
+    sized by max_concurrent_queries / max_queued_queries — the old
+    single-semaphore behavior, expressed as the trivial hierarchy."""
 
     def __init__(self, worker_urls: List[str],
                  catalog: str = "tpch", schema: str = "tiny",
                  properties: Optional[dict] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  max_concurrent_queries: int = 4,
-                 max_queued_queries: int = 100):
+                 max_queued_queries: int = 100,
+                 resource_groups=None, selectors=None):
+        from presto_tpu.execution.resource_groups import (
+            GroupSpec, ResourceGroupManager,
+        )
         super().__init__(host, port)
         self.worker_urls = list(worker_urls)
         self.catalog = catalog
         self.schema = schema
         self.properties = dict(properties or {})
         self.queries: Dict[str, _Query] = {}
-        self._admission = threading.Semaphore(max_concurrent_queries)
-        self._queued = 0
-        self._queue_cap = max_queued_queries
-        self._admission_lock = threading.Lock()
+        if resource_groups is None:
+            resource_groups = GroupSpec(
+                "root", hard_concurrency=max_concurrent_queries,
+                max_queued=max_queued_queries)
+        self.resource_groups = ResourceGroupManager(
+            resource_groups, selectors)
 
     # -- health / membership (reference: failureDetector/
     # HeartbeatFailureDetector pinging discovered nodes) ---------------
@@ -103,37 +119,58 @@ class Coordinator(Node):
 
     # -- client protocol ---------------------------------------------------
 
-    def handle_post(self, path: str, body: bytes) -> bytes:
+    def handle_post(self, path: str, body: bytes,
+                    headers: Optional[dict] = None) -> bytes:
         if path == "/v1/statement":
+            from presto_tpu.execution.resource_groups import (
+                QueryRejected,
+            )
             self._prune_queries()
+            h = {k.lower(): v for k, v in (headers or {}).items()}
             q = _Query(body.decode())
-            # admission control, decided synchronously AT SUBMIT so
-            # the queue accounting can't race the worker thread: take
-            # a concurrency slot if one is free, else count as queued
-            # (rejecting past the queue bound)
-            has_slot = self._admission.acquire(blocking=False)
-            if not has_slot:
-                with self._admission_lock:
-                    if self._queued >= self._queue_cap:
-                        q.state = "FAILED"
-                        q.error = "query queue is full"
-                        q.done_at = time.monotonic()
-                        self.queries[q.id] = q
-                        return json.dumps({
-                            "id": q.id,
-                            "nextUri": f"{self.url}/v1/statement/"
-                                       f"executing/{q.id}/0"}).encode()
-                    self._queued += 1
+            q.user = h.get("x-presto-user", "")
+            q.source = h.get("x-presto-source", "")
+            # admission decided synchronously AT SUBMIT so queue
+            # accounting can't race the worker thread: the resource-
+            # group manager either grants a slot, parks the dispatch
+            # callback, or rejects on a full queue
+            dispatched = threading.Event()
+            q.dispatch = dispatched.set
+            try:
+                state, q.group = self.resource_groups.submit(
+                    q.user, q.source, self._query_memory(),
+                    on_dispatch=q.dispatch)
+            except QueryRejected as e:
+                q.state = "FAILED"
+                q.error = str(e)
+                q.done_at = time.monotonic()
+                self.queries[q.id] = q
+                return json.dumps({
+                    "id": q.id,
+                    "nextUri": f"{self.url}/v1/statement/"
+                               f"executing/{q.id}/0"}).encode()
+            has_slot = state == "run"
             self.queries[q.id] = q
             threading.Thread(target=self._run_query,
-                             args=(q, has_slot),
+                             args=(q, has_slot, dispatched),
                              daemon=True).start()
             return json.dumps({
                 "id": q.id,
                 "nextUri": f"{self.url}/v1/statement/executing/"
                            f"{q.id}/0",
             }).encode()
-        return super().handle_post(path, body)
+        return super().handle_post(path, body, headers)
+
+    def _query_memory(self) -> int:
+        """Declared per-query memory reservation charged against the
+        resource-group memory caps (the coordinator has no live worker
+        memory feed; see resource_groups.py)."""
+        from presto_tpu.session_properties import get_property
+        try:
+            return int(get_property(self.properties,
+                                    "query_memory_bytes"))
+        except Exception:
+            return 0
 
     def handle_get(self, path: str) -> bytes:
         if path.startswith("/v1/statement/executing/"):
@@ -141,6 +178,7 @@ class Coordinator(Node):
             qid = parts[4]
             token = int(parts[5]) if len(parts) > 5 else 0
             q = self.queries[qid]
+            q.last_poll = time.monotonic()
             out = {"id": q.id, "stats": {"state": q.state}}
             # columns surface as soon as planning determines them —
             # before FINISHED (reference: ExecutingStatementResource
@@ -167,24 +205,43 @@ class Coordinator(Node):
 
     # -- query execution ---------------------------------------------------
 
-    def _prune_queries(self, ttl_s: float = 600.0) -> None:
+    def _prune_queries(self, ttl_s: float = 600.0,
+                       queued_abandon_s: float = 60.0) -> None:
         """Evict terminal queries (and their buffered result rows)
         `ttl_s` after they FINISHED/FAILED — the clock starts at
         completion so a slow query's results stay fetchable. pop()
-        keeps concurrent handler threads from double-deleting."""
+        keeps concurrent handler threads from double-deleting.
+
+        QUEUED queries whose client stopped polling for
+        `queued_abandon_s` are cancelled out of their resource group's
+        queue — an abandoned submission must not hold a queue position
+        against live clients (reference: DispatchManager's
+        query-abandonment pruning)."""
         now = time.monotonic()
+        for q in list(self.queries.values()):
+            if q.state == "QUEUED" and q.dispatch is not None \
+                    and now - q.last_poll > queued_abandon_s:
+                if self.resource_groups.cancel_queued(q.group,
+                                                      q.dispatch):
+                    q.state = "FAILED"
+                    q.error = "query abandoned while queued"
+                    q.done_at = now
+                    q.dispatch()  # unblock the waiting runner thread
         for qid in [qid for qid, q in list(self.queries.items())
                     if q.done_at is not None
                     and now - q.done_at > ttl_s]:
             self.queries.pop(qid, None)
 
-    def _run_query(self, q: _Query, has_slot: bool = True) -> None:
-        # admission: wait for a concurrency slot (QUEUED state is
-        # client-visible while waiting)
+    def _run_query(self, q: _Query, has_slot: bool = True,
+                   dispatched: Optional[threading.Event] = None) -> None:
+        # admission: wait for the group's dispatch callback (QUEUED
+        # state is client-visible while waiting). An abandoned queued
+        # query (client stopped polling) is cancelled by the pruner —
+        # its queue position frees without running.
         if not has_slot:
-            self._admission.acquire()
-            with self._admission_lock:
-                self._queued -= 1
+            dispatched.wait()
+            if q.state == "FAILED":  # cancelled while queued
+                return
         q.state = "RUNNING"
         try:
             result = self.execute(
@@ -201,7 +258,7 @@ class Coordinator(Node):
             q.state = "FAILED"
         finally:
             q.done_at = time.monotonic()
-            self._admission.release()
+            self.resource_groups.finish(q.group, self._query_memory())
 
     def execute(self, sql: str, on_columns=None):
         """Distributed execution with elastic retry: a failed or dead
@@ -313,7 +370,7 @@ class Coordinator(Node):
         exchanges = build_http_exchanges(
             query_id, fplan, consumer_urls_by_edge, worker_urls,
             self.url, self.registry,
-            n_producers_by_edge=n_producers_by_edge)
+            n_producers_by_edge=n_producers_by_edge, self_url=self.url)
 
         # everything from first dispatch to completion runs under one
         # release guard: a failure at ANY point (dead worker mid-
@@ -467,14 +524,25 @@ class Coordinator(Node):
 
 class StatementClient:
     """Minimal client protocol driver (reference: presto-client
-    StatementClientV1.advance:323 following nextUri)."""
+    StatementClientV1.advance:323 following nextUri). `user`/`source`
+    travel as X-Presto-User / X-Presto-Source and drive resource-group
+    selection."""
 
-    def __init__(self, server: str):
+    def __init__(self, server: str, user: str = "",
+                 source: str = ""):
         self.server = server.rstrip("/")
+        self.user = user
+        self.source = source
 
     def execute(self, sql: str, timeout: float = 600.0):
-        resp = json.loads(http_post(f"{self.server}/v1/statement",
-                                    sql.encode()))
+        headers = {}
+        if self.user:
+            headers["X-Presto-User"] = self.user
+        if self.source:
+            headers["X-Presto-Source"] = self.source
+        resp = json.loads(http_post(
+            f"{self.server}/v1/statement", sql.encode(),
+            timeout=timeout, headers=headers))
         deadline = time.time() + timeout
         next_uri = resp["nextUri"]
         columns = None

@@ -31,8 +31,11 @@ from presto_tpu.operators.exchange_ops import edge_key_dicts
 from presto_tpu.server.serde import batch_from_bytes, batch_to_bytes
 
 
-def http_post(url: str, body: bytes, timeout: float = 60.0) -> bytes:
+def http_post(url: str, body: bytes, timeout: float = 60.0,
+              headers: Optional[dict] = None) -> bytes:
     req = urllib.request.Request(url, data=body, method="POST")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return r.read()
 
@@ -86,6 +89,14 @@ class ExchangeRegistry:
             if not self._is_released(key):
                 self._eos[(key, consumer)].add(producer)
 
+    def receive_local(self, key: str, consumer: int,
+                      batch: Batch) -> None:
+        """Same-process delivery: enqueue the batch object directly —
+        no serde, no HTTP, no copy (the self-delivery short circuit)."""
+        with self._lock:
+            if not self._is_released(key):
+                self._queues[(key, consumer)].append(batch)
+
     def pop(self, key: str, consumer: int) -> Optional[Batch]:
         with self._lock:
             q = self._queues[(key, consumer)]
@@ -118,15 +129,45 @@ class ExchangeRegistry:
                 del self._expected[k]
 
 
+def _host_segment(host: Batch, lo: int, hi: int) -> Batch:
+    """Numpy slice [lo, hi) of a host-side batch whose live rows are a
+    prefix-packed run, padded up to the power-of-two capacity bucket
+    (downstream jitted operators keep their small compiled-shape set)."""
+    import numpy as np
+
+    from presto_tpu.batch import Column, bucket_capacity
+    n = hi - lo
+    cap = bucket_capacity(max(n, 1))
+    cols = {}
+    for name, c in host.columns.items():
+        d = np.zeros(cap, dtype=np.asarray(c.data).dtype)
+        m = np.zeros(cap, dtype=bool)
+        d[:n] = np.asarray(c.data)[lo:hi]
+        m[:n] = np.asarray(c.mask)[lo:hi]
+        cols[name] = Column(d, m, c.type, c.dictionary)
+    rv = np.zeros(cap, dtype=bool)
+    rv[:n] = np.asarray(host.row_valid)[lo:hi]
+    return Batch(cols, rv)
+
+
 class HttpExchange:
     """MeshExchange-compatible facade over the DCN data plane: pushes
     route batches to consumer NODES over HTTP; pops read this node's
-    registry queues (filled by the HTTP handler thread)."""
+    registry queues (filled by the HTTP handler thread).
+
+    Cost discipline (the round-3 lesson): a hash repartition is ONE
+    jitted dispatch (destination-sorted batch + segment bounds), ONE
+    device->host transfer, then host-side numpy slices per consumer —
+    not O(consumers) mask/compact/serialize rounds. Consumers that live
+    in THIS process (self_url match) receive the batch object through
+    the registry directly: no serde, no localhost HTTP — which also
+    collapses a mesh-per-worker node's intra-node shuffle legs."""
 
     def __init__(self, exchange_key: str, scheme: str,
                  partition_keys, hash_dicts, key_dictionaries,
                  consumer_urls: List[str], n_producers: int,
-                 registry: ExchangeRegistry):
+                 registry: ExchangeRegistry,
+                 self_url: Optional[str] = None):
         from presto_tpu.operators.exchange_ops import build_remap_tables
         self.exchange_id = exchange_key
         self.scheme = scheme
@@ -134,46 +175,81 @@ class HttpExchange:
         self.consumer_urls = consumer_urls
         self.n_consumers = len(consumer_urls)
         self.registry = registry
+        self.self_url = self_url
         registry.expect_producers(exchange_key, n_producers)
         self._rr = 0
         self._remaps = build_remap_tables(hash_dicts, key_dictionaries)
 
     # -- producer side (outgoing HTTP) -------------------------------------
 
-    def _send(self, consumer: int, batch: Batch) -> None:
+    def _is_local(self, consumer: int) -> bool:
+        return self.self_url is not None \
+            and self.consumer_urls[consumer] == self.self_url
+
+    def _post(self, consumer: int, payload: bytes) -> None:
         url = f"{self.consumer_urls[consumer]}/v1/exchange/" \
               f"{self.exchange_id}/{consumer}"
-        http_post(url, batch_to_bytes(batch))
+        http_post(url, payload)
+
+    def _deliver_whole(self, consumers: List[int], batch: Batch) -> None:
+        """Route one un-split batch to each listed consumer: local ones
+        share the compacted host batch, remote ones share ONE
+        serialization."""
+        import jax
+
+        from presto_tpu.batch import bucket_capacity
+        local = [c for c in consumers if self._is_local(c)]
+        remote = [c for c in consumers if not self._is_local(c)]
+        if local:
+            n = batch.num_valid()
+            host = jax.device_get(
+                batch.compact(bucket_capacity(max(n, 1)), known_valid=n))
+            for c in local:
+                self.registry.receive_local(self.exchange_id, c, host)
+            if remote:
+                payload = batch_to_bytes(host, assume_compact=True)
+        elif remote:
+            payload = batch_to_bytes(batch)
+        for c in remote:
+            self._post(c, payload)
 
     def push(self, producer: int, batch: Batch) -> None:
-        import jax.numpy as jnp
-        from presto_tpu.ops import common
         if self.scheme == "gather":
-            self._send(0, batch)
+            self._deliver_whole([0], batch)
         elif self.scheme == "broadcast":
-            for c in range(self.n_consumers):
-                self._send(c, batch)
+            self._deliver_whole(list(range(self.n_consumers)), batch)
         elif self.scheme == "passthrough":
-            self._send(producer, batch)
+            self._deliver_whole([producer], batch)
         elif self.scheme == "repartition" and not self.partition_keys:
             c = self._rr % self.n_consumers
             self._rr += 1
-            self._send(c, batch)
+            self._deliver_whole([c], batch)
         else:
+            import jax
+
             from presto_tpu.operators.exchange_ops import (
-                partition_key_hash,
+                partition_segments,
             )
-            h = partition_key_hash(batch, self.partition_keys,
-                                   self._remaps)
-            dest = (h % self.n_consumers).astype(jnp.int32)
+            dev_sorted, bounds = partition_segments(
+                batch, tuple(self.partition_keys), self._remaps,
+                self.n_consumers)
+            host, hbounds = jax.device_get((dev_sorted, bounds))
             for c in range(self.n_consumers):
-                part = Batch(batch.columns,
-                             jnp.asarray(batch.row_valid)
-                             & (dest == c))
-                self._send(c, part)
+                lo, hi = int(hbounds[c]), int(hbounds[c + 1])
+                if lo == hi:
+                    continue  # nothing for this consumer
+                seg = _host_segment(host, lo, hi)
+                if self._is_local(c):
+                    self.registry.receive_local(self.exchange_id, c, seg)
+                else:
+                    self._post(c, batch_to_bytes(seg,
+                                                 assume_compact=True))
 
     def producer_done(self, producer: int) -> None:
         for c in range(self.n_consumers):
+            if self._is_local(c):
+                self.registry.receive_eos(self.exchange_id, c, producer)
+                continue
             http_post(
                 f"{self.consumer_urls[c]}/v1/exchange/"
                 f"{self.exchange_id}/{c}/eos?producer={producer}",
@@ -239,7 +315,8 @@ class NodeHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         try:
-            body = self.node.handle_post(self.path, self._read_body())
+            body = self.node.handle_post(self.path, self._read_body(),
+                                         dict(self.headers))
             self._reply(200, body)
         except Exception as e:  # noqa: BLE001 — surface to caller
             self._reply(500, json.dumps(
@@ -298,7 +375,8 @@ class Node:
                                "suggested": t.suggested}).encode()
         raise KeyError(path)
 
-    def handle_post(self, path: str, body: bytes) -> bytes:
+    def handle_post(self, path: str, body: bytes,
+                    headers: Optional[dict] = None) -> bytes:
         if path.startswith("/v1/exchange/"):
             rest = path[len("/v1/exchange/"):]
             if "/eos" in rest:
@@ -398,7 +476,8 @@ class Node:
             spec["query_id"], fplan,
             spec.get("consumer_urls_by_edge"), spec["worker_urls"],
             spec["coordinator_url"], self.registry,
-            n_producers_by_edge=spec.get("n_producers_by_edge"))
+            n_producers_by_edge=spec.get("n_producers_by_edge"),
+            self_url=self.url)
         k = int(spec.get("local_count", 1))
         base = int(spec.get("local_base", spec.get("task_index", 0)))
         devices = [None] * k
@@ -446,8 +525,9 @@ def build_http_exchanges(query_id: str, fplan,
                          worker_urls: List[str],
                          coordinator_url: str,
                          registry: ExchangeRegistry,
-                         n_producers_by_edge=None) -> Dict[int,
-                                                           HttpExchange]:
+                         n_producers_by_edge=None,
+                         self_url: Optional[str] = None
+                         ) -> Dict[int, HttpExchange]:
     """One HttpExchange per edge. The coordinator pre-computes a
     GLOBAL consumer URL table per edge (one slot per consumer TASK —
     a mesh-per-worker node's url appears once per device) plus the
@@ -474,7 +554,7 @@ def build_http_exchanges(query_id: str, fplan,
         out[xid] = HttpExchange(
             f"{query_id}:{xid}", edge.scheme, edge.partition_keys,
             edge.hash_dicts, edge_key_dicts(edge), consumer_urls,
-            n_producers, registry)
+            n_producers, registry, self_url=self_url)
     return out
 
 

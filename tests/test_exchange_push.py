@@ -1,0 +1,131 @@
+"""HttpExchange producer-side unit tests: the one-dispatch hash
+repartition (destination-sorted segments, single d2h) and the
+self-delivery short circuit (zero HTTP, zero serde for consumers in
+this process) — reference seam:
+OptimizedPartitionedOutputOperator.java:82's block-level repartition.
+"""
+
+import numpy as np
+import pytest
+
+import presto_tpu.server.node as node_mod
+from presto_tpu.batch import Batch
+from presto_tpu.server.node import ExchangeRegistry, HttpExchange
+from presto_tpu.types import BIGINT
+
+
+def _batch(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return Batch.from_numpy({
+        "k": rng.integers(0, 1000, size=n),
+        "v": rng.integers(0, 10, size=n),
+    }, {"k": BIGINT, "v": BIGINT})
+
+
+def _drain(registry, key, consumer):
+    rows = []
+    while True:
+        b = registry.pop(key, consumer)
+        if b is None:
+            return rows
+        d = b.to_pydict()
+        rows.extend(zip(d["k"], d["v"]))
+
+
+def test_self_delivery_no_http(monkeypatch):
+    """Every consumer is this process: a push must touch neither
+    http_post nor the serde."""
+    def boom(*a, **kw):
+        raise AssertionError("HTTP used for self-delivery")
+    monkeypatch.setattr(node_mod, "http_post", boom)
+    monkeypatch.setattr(node_mod, "batch_to_bytes", boom)
+    registry = ExchangeRegistry()
+    me = "http://127.0.0.1:7"
+    ex = HttpExchange("q:0", "repartition", ["k"], None, [None],
+                      [me, me, me], 1, registry, self_url=me)
+    b = _batch()
+    expect = sorted(zip(b.to_pydict()["k"], b.to_pydict()["v"]))
+    ex.push(0, b)
+    ex.producer_done(0)
+    got = []
+    for c in range(3):
+        assert registry.finished("q:0", c) or \
+            registry.has_output("q:0", c)
+        got.extend(_drain(registry, "q:0", c))
+    assert sorted(got) == expect
+
+
+def test_segments_route_by_hash(monkeypatch):
+    """Rows land on the consumer their key hashes to; remote consumers
+    receive serialized segments, local ones raw batches."""
+    posts = []
+    monkeypatch.setattr(
+        node_mod, "http_post",
+        lambda url, body, timeout=60.0: posts.append((url, body)))
+    registry = ExchangeRegistry()
+    me = "http://127.0.0.1:7"
+    other = "http://127.0.0.1:8"
+    ex = HttpExchange("q:1", "repartition", ["k"], None, [None],
+                      [me, other], 1, registry, self_url=me)
+    b = _batch(200, seed=1)
+    ex.push(0, b)
+    local_rows = _drain(registry, "q:1", 0)
+    from presto_tpu.server.serde import batch_from_bytes
+    remote_rows = []
+    for url, body in posts:
+        assert url.startswith(other)
+        rb = batch_from_bytes(body)
+        d = rb.to_pydict()
+        remote_rows.extend(zip(d["k"], d["v"]))
+    all_rows = sorted(local_rows + remote_rows)
+    assert all_rows == sorted(zip(b.to_pydict()["k"],
+                                  b.to_pydict()["v"]))
+    # routing consistency: recompute each row's consumer
+    from presto_tpu.operators.exchange_ops import partition_key_hash
+    import jax.numpy as jnp
+    h = np.asarray(partition_key_hash(b, ["k"], None))
+    dests = h % 2
+    k_to_dest = dict(zip(np.asarray(b.columns["k"].data).tolist(),
+                         dests.tolist()))
+    for k, _ in local_rows:
+        assert k_to_dest[k] == 0
+    for k, _ in remote_rows:
+        assert k_to_dest[k] == 1
+
+
+def test_broadcast_serializes_once(monkeypatch):
+    """Broadcast to R remote consumers: ONE serialization, R posts."""
+    calls = {"serde": 0}
+    real = node_mod.batch_to_bytes
+
+    def counting(batch, assume_compact=False):
+        calls["serde"] += 1
+        return real(batch, assume_compact)
+    posts = []
+    monkeypatch.setattr(node_mod, "batch_to_bytes", counting)
+    monkeypatch.setattr(
+        node_mod, "http_post",
+        lambda url, body, timeout=60.0: posts.append(url))
+    registry = ExchangeRegistry()
+    ex = HttpExchange("q:2", "broadcast", [], None, [],
+                      ["http://a:1", "http://a:2", "http://a:3"],
+                      1, registry, self_url=None)
+    ex.push(0, _batch(50))
+    assert calls["serde"] == 1
+    assert len(posts) == 3
+
+
+def test_empty_segments_not_sent(monkeypatch):
+    """Consumers with no rows receive nothing (no empty-page posts)."""
+    posts = []
+    monkeypatch.setattr(
+        node_mod, "http_post",
+        lambda url, body, timeout=60.0: posts.append(url))
+    registry = ExchangeRegistry()
+    # all keys identical -> exactly one destination gets traffic
+    b = Batch.from_numpy({"k": np.full(64, 7), "v": np.arange(64)},
+                   {"k": BIGINT, "v": BIGINT})
+    ex = HttpExchange("q:3", "repartition", ["k"], None, [None],
+                      [f"http://a:{i}" for i in range(8)], 1, registry)
+    ex.push(0, b)
+    assert len(posts) == 1
