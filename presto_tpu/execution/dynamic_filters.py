@@ -1,50 +1,132 @@
 """Dynamic filtering (reference: operator/DynamicFilterSourceOperator
 + the dynamic-filter planner rules under sql/planner/iterative/rule/
-and DynamicFilterService).
+and server/DynamicFilterService.java).
 
-TPU-native shape: the join BUILD operator keeps running per-key
-min/max as DEVICE scalars (two tiny fused reductions per batch, no
-host sync) and publishes them to a per-plan registry at build finish.
-Probe-side TABLE SCANS in the same fragment consult the registry per
-batch and narrow `row_valid` with one fused compare — rows outside the
-build side's key range never reach the exchange/probe at all. Because
-a probe operator blocks on its bridge, the driver never pulls the
-probe-side scan before the build finishes, so the bounds are always
-ready by the time scan batches flow (no wait protocol needed).
+TPU-native shape, two tiers:
 
-Scope mirrors where this is sound and local: INNER equi-joins whose
-probe key traces through filters/identity projections to a scan column
-in the SAME fragment — in mesh plans that is exactly the broadcast
-(star-schema) join, the reference's headline dynamic-filter case.
+- Co-fragment (broadcast/star joins): the join BUILD operator keeps
+  running per-key min/max as DEVICE scalars (two tiny fused reductions
+  per batch, no host sync) and, at finish, a bounded DISTINCT SET of
+  build keys (one sort + dedupe of the already-merged build column).
+  Probe-side scans in the same fragment consult the registry per batch
+  and narrow `row_valid` with one fused compare + membership probe.
+  Because a probe operator blocks on its bridge, the driver never
+  pulls the probe-side scan before the build finishes, so the filter
+  is always ready by the time scan batches flow.
+
+- Cross-fragment (repartitioned joins, mesh runner): every build task
+  (x every lifespan generation) publishes its PARTIAL filter to a
+  query-wide DynamicFilterService; scans in other fragments apply the
+  filter only once ALL expected partials arrived and were merged — a
+  partial union applied early would wrongly prune rows belonging to
+  build partitions that have not reported yet. Scans that finish
+  before completion simply go unpruned (the join still verifies).
+
+The distinct set is the remedy for the min/max blind spot the
+reference's DynamicFilterService also addresses: surrogate-key
+dimension filters often span the whole key range (bounds prune
+nothing) while their distinct set prunes hard.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+import threading
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from presto_tpu.batch import Batch
 
+#: Max distinct build keys carried as a set; more degrades to bounds
+#: only (reference: dynamic-filtering.max-distinct-values-per-driver).
+DF_SET_MAX = 4096
+
 
 class DynamicFilterRegistry:
-    """Per-plan handoff: df_id -> (min, max) device scalars."""
+    """Per-plan handoff for CO-FRAGMENT filters: df_id -> filter.
+    One publisher per id; lifespan generations each get a fresh
+    planner (and so a fresh registry), so stale cross-generation
+    bounds cannot leak."""
 
     def __init__(self):
-        self._bounds: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        self._filters: Dict[int, "DFilter"] = {}
         self._seq = 0
 
     def new_id(self) -> int:
         self._seq += 1
         return self._seq
 
-    def publish(self, df_id: int, mn, mx) -> None:
-        self._bounds[df_id] = (mn, mx)
+    def publish(self, df_id: int, mn, mx, dset=None) -> None:
+        self._filters[df_id] = DFilter(mn, mx, dset)
 
-    def get(self, df_id: int):
-        return self._bounds.get(df_id)
+    def get(self, df_id: int) -> Optional["DFilter"]:
+        return self._filters.get(df_id)
+
+
+class DFilter:
+    """One published filter: bounds + optional (values, count) set."""
+
+    def __init__(self, mn, mx, dset=None):
+        self.mn = mn
+        self.mx = mx
+        self.dset = dset  # (sorted values [DF_SET_MAX], count) | None
+
+
+class DynamicFilterService:
+    """Query-wide CROSS-FRAGMENT filter collection (reference:
+    DynamicFilterService.java — collected on the coordinator; here the
+    mesh runner's fragments share one process, so the service is an
+    in-memory meeting point). `expect()` arms an id with its publisher
+    count (build tasks x lifespan generations); `get()` returns the
+    merged filter only once complete."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._expected: Dict[int, int] = {}
+        self._parts: Dict[int, List[DFilter]] = {}
+        self._merged: Dict[int, DFilter] = {}
+        self._seq = 0
+
+    def new_id(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def expect(self, df_id: int, publishers: int) -> None:
+        with self._lock:
+            self._expected[df_id] = publishers
+
+    def publish(self, df_id: int, mn, mx, dset=None) -> None:
+        with self._lock:
+            self._parts.setdefault(df_id, []).append(
+                DFilter(mn, mx, dset))
+
+    def get(self, df_id: int) -> Optional[DFilter]:
+        with self._lock:
+            hit = self._merged.get(df_id)
+            if hit is not None:
+                return hit
+            parts = self._parts.get(df_id, [])
+            expected = self._expected.get(df_id)
+            if expected is None or len(parts) < expected:
+                return None
+        mn = parts[0].mn
+        mx = parts[0].mx
+        for p in parts[1:]:
+            mn = jnp.minimum(mn, p.mn)
+            mx = jnp.maximum(mx, p.mx)
+        dset = None
+        if all(p.dset is not None for p in parts):
+            merged_vals, n, ovf = _merge_sets(
+                [(p.dset[0], p.dset[1]) for p in parts])
+            if not bool(ovf):
+                dset = (merged_vals, n)
+        merged = DFilter(mn, mx, dset)
+        with self._lock:
+            self._merged[df_id] = merged
+        return merged
 
 
 def _ident(dtype):
@@ -78,12 +160,72 @@ def bounds_init(dtype):
     return (jnp.asarray(info.max, dtype), jnp.asarray(info.min, dtype))
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def apply_bounds(batch: Batch, col: str, mn, mx) -> Batch:
+@jax.jit
+def distinct_set(data, mask):
+    """Bounded distinct set of a (merged) build key column: ONE sort +
+    boundary dedupe, packed into DF_SET_MAX slots. Returns
+    (sorted values [DF_SET_MAX], count, overflow) — on overflow the
+    caller publishes bounds only. Dead lanes sort strictly after valid
+    ones via a leading ~mask key (a legit dtype-max key must not
+    dedupe against padding); unused slots hold the dtype max so the
+    membership searchsorted stays within the sorted prefix."""
+    info = _ident(data.dtype)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        mask = mask & ~jnp.isnan(data)  # NaN never equi-matches
+    nm, sk = jax.lax.sort((~mask, data), num_keys=2, is_stable=True)
+    sv = ~nm
+    first = jnp.concatenate([
+        jnp.asarray([True]),
+        (sk[1:] != sk[:-1]) | (nm[1:] != nm[:-1])])
+    keep = first & sv
+    n = jnp.sum(keep)
+    # pack distinct values to the front (stable sort by ~keep keeps
+    # them in ascending key order)
+    _, pk = jax.lax.sort((~keep, sk), num_keys=1, is_stable=True)
+    if pk.shape[0] >= DF_SET_MAX:
+        pk = pk[:DF_SET_MAX]
+    else:
+        pk = jnp.pad(pk, (0, DF_SET_MAX - pk.shape[0]),
+                     constant_values=info.max)
+    out = jnp.where(jnp.arange(DF_SET_MAX) < n, pk,
+                    jnp.asarray(info.max, data.dtype))
+    return out, n, n > DF_SET_MAX
+
+
+def _merge_sets(parts):
+    """Union of several (values, count) sets into one DF_SET_MAX set
+    (host-side concat of device arrays + one jitted distinct_set)."""
+    vals = jnp.concatenate([v for v, _ in parts])
+    mask = jnp.concatenate([
+        jnp.arange(v.shape[0]) < c for v, c in parts])
+    return distinct_set(vals, mask)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4))
+def apply_filter(batch: Batch, col: str, mn, mx, has_set: bool,
+                 dset_vals=None, dset_count=None) -> Batch:
     """Narrow row_valid to rows whose key can possibly match the build
-    side (inner-join semantics: NULL keys never match, so they drop
+    side: bounds always, set membership when a set survived
+    (inner-join semantics: NULL keys never match, so they drop
     too)."""
     c = batch.columns[col]
     keep = (c.data >= mn.astype(c.data.dtype)) \
         & (c.data <= mx.astype(c.data.dtype)) & c.mask
+    if has_set:
+        idx = jnp.searchsorted(dset_vals, c.data)
+        idx = jnp.clip(idx, 0, dset_vals.shape[0] - 1)
+        keep = keep & (dset_vals[idx] == c.data) \
+            & (idx < dset_count)
     return Batch(batch.columns, batch.row_valid & keep)
+
+
+def apply(batch: Batch, col: str, f: DFilter) -> Batch:
+    if f.dset is not None:
+        return apply_filter(batch, col, f.mn, f.mx, True,
+                            f.dset[0], f.dset[1])
+    return apply_filter(batch, col, f.mn, f.mx, False)
+
+
+# back-compat alias (pre-set callers)
+def apply_bounds(batch: Batch, col: str, mn, mx) -> Batch:
+    return apply_filter(batch, col, mn, mx, False)

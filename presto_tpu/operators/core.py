@@ -92,12 +92,10 @@ class TableScanOperator(SourceOperator):
             self._finished = True
             return None
         for col, df_id, reg in self._df_specs:
-            bounds = reg.get(df_id)
-            if bounds is not None:
-                from presto_tpu.execution.dynamic_filters import (
-                    apply_bounds,
-                )
-                b = apply_bounds(b, col, bounds[0], bounds[1])
+            f = reg.get(df_id)
+            if f is not None:
+                from presto_tpu.execution.dynamic_filters import apply
+                b = apply(b, col, f)
         # (live-row counts stay device-side; EXPLAIN ANALYZE
         #  materializes them once at drain)
         return self._count_out(b)

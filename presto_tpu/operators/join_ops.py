@@ -213,25 +213,41 @@ class HashBuildOperator(Operator):
     def get_output(self) -> Optional[Batch]:
         return None
 
-    def finish(self) -> None:
-        if self._finished:
-            return
-        self._finished = True
-        self.ctx.unregister_revocable()
+    def _publish_df(self, merged: Optional[Batch]) -> None:
+        """Publish per-key dynamic filters: running bounds always,
+        plus a bounded DISTINCT SET computed in one shot from the
+        merged build column when it is resident (the spill path keeps
+        bounds only). The overflow resolution is one host sync — at
+        build finish, next to the existing total-count sync."""
+        from presto_tpu.execution import dynamic_filters as df
         for key, df_id, reg in self._df_publish:
             if df_id in self._df_state:
                 mn, mx = self._df_state[df_id]
-                reg.publish(df_id, mn, mx)
+                dset = None
+                if merged is not None:
+                    c = merged.columns[key]
+                    vals, n, ovf = df.distinct_set(
+                        c.data, c.mask & merged.row_valid)
+                    if not bool(ovf):
+                        dset = (vals, n)
+                reg.publish(df_id, mn, mx, dset)
             else:
-                # empty build side: publish the impossible range so
-                # inner-join probe scans prune everything
-                from presto_tpu.execution import dynamic_filters as df
+                # empty build side: publish the impossible range (and
+                # the empty set) so inner-join probe scans prune
+                # everything
                 col = dict(
                     (n, t) for n, t, _ in (self.schema_cols or []))
                 if key in col:
                     mn, mx = df.bounds_init(col[key].np_dtype)
                     reg.publish(df_id, mn, mx)
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self.ctx.unregister_revocable()
         if self._spill is not None:
+            self._publish_df(None)
             if self._batches:  # revoked mid-stream leftovers
                 self._spill_batches(self._batches)
                 self._batches = []
@@ -254,6 +270,7 @@ class HashBuildOperator(Operator):
                                  self.key_names, self.key_dicts)
         else:
             raise RuntimeError("empty build side needs schema plumbing")
+        self._publish_df(merged)
         self.bridge.table = join_ops.build(merged, self.key_names)
         self._batches = []
 

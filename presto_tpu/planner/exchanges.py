@@ -506,6 +506,106 @@ def fragment_plan(root: N.OutputNode) -> FragmentedPlan:
     return FragmentedPlan(root_id, f.fragments, f.edges)
 
 
+@dataclasses.dataclass
+class CrossFragmentFilters:
+    """Wiring for cross-fragment dynamic filters (the in-process
+    analog of the reference's coordinator-side DynamicFilterService
+    collection plan): build-side publications keyed by join node
+    identity, scan-side applications keyed by scan node identity, and
+    the fragment whose tasks publish each filter (so the runner can
+    arm the service with the right expected-publisher count)."""
+    joins: Dict[int, List[Tuple[str, int]]]
+    scans: Dict[int, List[Tuple[str, int]]]
+    build_fragment: Dict[int, int]  # df_id -> join's fragment id
+
+
+def plan_cross_fragment_filters(fplan: FragmentedPlan
+                                ) -> CrossFragmentFilters:
+    """Find inner/semi joins whose probe key traces through one or
+    more exchanges to a scan column in ANOTHER fragment, and allocate
+    a df_id for each such (join build key, scan column) pair. The
+    trace crosses a RemoteSourceNode only when its producer fragment
+    feeds exactly one consumer edge (pruning a shared producer's scan
+    would starve its other consumers), and skips DAG-shared nodes
+    inside each fragment for the same reason. Co-fragment joins are
+    left to the registry fast path (trace that never crosses an
+    exchange -> not registered here)."""
+    from presto_tpu.expr.ir import InputRef
+    from presto_tpu.planner.local_planner import _parent_counts
+
+    consumers_of: Dict[int, int] = {}
+    for e in fplan.edges.values():
+        consumers_of[e.producer] = consumers_of.get(e.producer, 0) + 1
+    frag_of_edge = {xid: e.producer for xid, e in fplan.edges.items()}
+    shared_by_frag = {
+        fid: frozenset(nid for nid, c
+                       in _parent_counts(f.root).items() if c > 1)
+        for fid, f in fplan.fragments.items()
+    }
+
+    def trace(fid: int, node: N.PlanNode, symbol: str):
+        """-> (scan_node, scan_symbol, crossed_exchange) or None."""
+        crossed = False
+        while True:
+            if id(node) in shared_by_frag[fid]:
+                return None
+            if isinstance(node, N.TableScanNode):
+                return (node, symbol, crossed) \
+                    if symbol in node.assignments else None
+            if isinstance(node, N.FilterNode):
+                node = node.source
+            elif isinstance(node, N.ProjectNode):
+                expr = dict(node.assignments).get(symbol)
+                if not isinstance(expr, InputRef):
+                    return None
+                symbol = expr.name
+                node = node.source
+            elif isinstance(node, N.RemoteSourceNode):
+                pfid = frag_of_edge[node.exchange_id]
+                if consumers_of.get(pfid, 0) != 1:
+                    return None
+                fid = pfid
+                node = fplan.fragments[pfid].root
+                crossed = True
+            else:
+                return None
+
+    out = CrossFragmentFilters({}, {}, {})
+    seq = 0
+    for fid, frag in fplan.fragments.items():
+        stack = [frag.root]
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            stack.extend(n.sources())
+            if isinstance(n, N.JoinNode) and n.join_type == "inner" \
+                    and n.criteria:
+                pairs = [(l, r, n.right.field(r)) for l, r in n.criteria]
+                probe = n.left
+            elif isinstance(n, N.SemiJoinNode) and not n.negate:
+                pairs = [(n.source_key, n.filtering_key,
+                          n.filtering_source.field(n.filtering_key))]
+                probe = n.source
+            else:
+                continue
+            for l, r, bf in pairs:
+                if bf.dictionary is not None:
+                    continue  # numeric/date keys only
+                t = trace(fid, probe, l)
+                if t is None or not t[2]:
+                    continue  # unreachable or co-fragment (registry)
+                scan_node, scan_sym, _ = t
+                seq += 1
+                out.joins.setdefault(id(n), []).append((r, seq))
+                out.scans.setdefault(id(scan_node), []).append(
+                    (scan_sym, seq))
+                out.build_fragment[seq] = fid
+    return out
+
+
 class _Fragmenter:
     def __init__(self):
         self.fragments: Dict[int, Fragment] = {}

@@ -50,12 +50,17 @@ class LocalExecutionPlan:
 class TaskContext:
     """Identity of one fragment task on the mesh (reference: TaskId +
     the split assignment NodeScheduler hands each task). `exchanges`
-    maps exchange ids to their MeshExchange runtime objects."""
+    maps exchange ids to their MeshExchange runtime objects.
+    `df_service`/`cross_df` carry the query-wide cross-fragment
+    dynamic-filter service and its plan-derived wiring (see
+    exchanges.plan_cross_fragment_filters)."""
     index: int = 0
     count: int = 1
     device: object = None
     exchanges: Dict[int, object] = dataclasses.field(
         default_factory=dict)
+    df_service: object = None
+    cross_df: object = None
 
 
 class LocalPlanningError(Exception):
@@ -222,9 +227,16 @@ class LocalExecutionPlanner:
                     if task.device is not None:
                         b = _jax.device_put(b, task.device)
                     yield b
+        df_specs = list(self._df_scans.get(id(node), []))
+        if self.task.df_service is not None \
+                and self.task.cross_df is not None:
+            df_specs += [
+                (sym, df_id, self.task.df_service)
+                for sym, df_id
+                in self.task.cross_df.scans.get(id(node), [])]
         pipe.append(TableScanOperatorFactory(
             self._next_id(), f"scan:{handle.table}", batch_iter,
-            df_specs=self._df_scans.get(id(node))))
+            df_specs=df_specs or None))
 
     def _visit_RemoteSourceNode(self, node, pipe: List):
         from presto_tpu.operators.exchange_ops import (
@@ -428,6 +440,9 @@ class LocalExecutionPlanner:
             key_dicts = _unified_key_dicts(probe, build, criteria)
             df_publish = self._plan_dynamic_filters(
                 probe, build, criteria) if jt == "inner" else None
+            cross = self._cross_df_publish(node)
+            if cross:
+                df_publish = (df_publish or []) + cross
             build_pipe = []
             self._visit(build, build_pipe)
             build_pipe.append(HashBuildOperatorFactory(
@@ -469,6 +484,18 @@ class LocalExecutionPlanner:
                 self._next_id(), pred, projections,
                 _schema_dicts(schema)))
 
+    def _cross_df_publish(self, node) -> List[tuple]:
+        """Cross-fragment publications this join owes the query-wide
+        DynamicFilterService (wired by plan_cross_fragment_filters;
+        node identity keys survive fragmentation — fragments reference
+        subtrees of the same plan object)."""
+        svc = self.task.df_service
+        cdf = self.task.cross_df
+        if svc is None or cdf is None:
+            return []
+        return [(key, df_id, svc)
+                for key, df_id in cdf.joins.get(id(node), [])]
+
     def _plan_dynamic_filters(self, probe, build, criteria):
         """For an INNER join, wire build-key min/max bounds to probe-
         side scans in THIS fragment (reference: the dynamic-filter
@@ -498,12 +525,24 @@ class LocalExecutionPlanner:
         key_dicts = _unified_key_dicts(
             node.source, node.filtering_source,
             [(node.source_key, node.filtering_key)])
+        # IN/EXISTS keeps only source rows whose key appears in the
+        # filtering side — the same pruning contract as an inner join,
+        # so the build publishes dynamic filters too (NOT IN must not:
+        # pruning would drop exactly the rows it keeps)
+        df_publish = self._plan_dynamic_filters(
+            node.source, node.filtering_source,
+            [(node.source_key, node.filtering_key)]) \
+            if not node.negate else None
+        cross = self._cross_df_publish(node) if not node.negate else []
+        if cross:
+            df_publish = (df_publish or []) + cross
         build_pipe: List = []
         self._visit(node.filtering_source, build_pipe)
         build_pipe.append(HashBuildOperatorFactory(
             self._next_id(), bridge, [node.filtering_key], key_dicts,
             schema_cols=[(f.symbol, f.type, f.dictionary)
-                         for f in node.filtering_source.output]))
+                         for f in node.filtering_source.output],
+            df_publish=df_publish))
         self._pipelines.append(build_pipe)
         self._visit(node.source, pipe)
         pipe.append(SemiJoinOperatorFactory(

@@ -170,6 +170,28 @@ class MeshRunner(LocalRunner):
                 host_spool_bytes=int(get_property(
                     session.properties, "host_spool_bytes")))
 
+        # cross-fragment dynamic filters: one query-wide service; each
+        # filter expects (build fragment tasks x lifespan generations)
+        # publications before scans may apply it (see
+        # exchanges.plan_cross_fragment_filters)
+        df_service = cross_df = None
+        if bool(get_property(session.properties, "dynamic_filtering")):
+            from presto_tpu.execution.dynamic_filters import (
+                DynamicFilterService,
+            )
+            from presto_tpu.planner.exchanges import (
+                plan_cross_fragment_filters,
+            )
+            cdf = plan_cross_fragment_filters(fplan)
+            if cdf.build_fragment:
+                df_service = DynamicFilterService()
+                cross_df = cdf
+                for df_id, fid in cdf.build_fragment.items():
+                    df_service.expect(
+                        df_id,
+                        self._task_count(fplan.fragments[fid])
+                        * lifespans_of[fid])
+
         dctx = DriverContext(profile=profile, memory=pool)
         result = None
         all_drivers: List[Driver] = []
@@ -188,7 +210,8 @@ class MeshRunner(LocalRunner):
                     index=t, count=n_tasks,
                     device=self._devices[t] if n_tasks > 1
                     else self._devices[0],
-                    exchanges=exchanges)
+                    exchanges=exchanges,
+                    df_service=df_service, cross_df=cross_df)
                 planner = LocalExecutionPlanner(self.catalogs, session,
                                                 task=task)
                 if fid == fplan.root_id:
