@@ -1,11 +1,19 @@
-"""File connector: directories of Parquet files as catalog tables
-(reference: the hive connector's HivePageSourceProvider.java:89 +
-presto-parquet reader, collapsed to a local-filesystem catalog; CTAS
-and INSERT write Parquet through the same layer — the TableWriter path).
+"""File connector: directories of Parquet OR ORC files as catalog
+tables (reference: the hive connector's HivePageSourceProvider.java:89
++ presto-parquet/presto-orc readers, collapsed to a local-filesystem
+catalog; CTAS and INSERT write Parquet through the same layer — the
+TableWriter path).
 
-Layout: <root>/<schema>/<table>.parquet. One split per row group;
-pushed-down TupleDomains prune row groups on footer min/max statistics
-before any page is read (the OrcSelectiveRecordReader.java:86 move).
+Layout: <root>/<schema>/<table>.parquet or <table>.orc. One split per
+row group (parquet) / stripe (ORC); pushed-down TupleDomains prune
+groups on footer min/max statistics before any page is read (the
+OrcSelectiveRecordReader.java:86 move — for ORC these are the real
+per-stripe statistics of the metadata section). Both formats read
+through one format-neutral `_TableView`, so planner/scan code never
+branches on the format. Writes always produce parquet: an INSERT into
+an ORC table commits the rewritten table in the write format and
+removes the original .orc (files are immutable, every INSERT is a
+rewrite — see _FilePageSink.finish).
 
 VARCHAR columns: the engine's plan-time dictionaries come from a
 one-pass scan of the file's string values at first table access,
@@ -14,9 +22,12 @@ immutable between mtimes."""
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import os
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (
+    Callable, Dict, Iterator, List, Optional, Sequence, Tuple,
+)
 
 import numpy as np
 
@@ -60,12 +71,88 @@ def _engine_type(col: pq.ParquetColumn) -> Type:
     return t
 
 
+# ---------------------------------------------------------------------------
+# format-neutral table view
+
+
+@dataclasses.dataclass
+class _TableView:
+    """One open table file, independent of its on-disk format:
+    `groups` are opaque row-group/stripe handles consumed by the
+    callbacks."""
+    columns: List[Tuple[str, Type]]
+    groups: List
+    num_rows: int
+    read: "Callable"        # (group, name) -> (values, present|None)
+    min_max: "Callable"     # (group, name) -> (min, max) | (None, None)
+    group_rows: "Callable"  # group -> row count
+
+
+def _parquet_view(path: str) -> _TableView:
+    info = pq.read_footer(path)
+    return _TableView(
+        columns=[(c.name, _engine_type(c)) for c in info.columns],
+        groups=list(info.row_groups),
+        num_rows=info.num_rows,
+        read=lambda g, name: pq.read_column(path, g, name),
+        min_max=lambda g, name: pq.group_min_max(g, name),
+        group_rows=lambda g: g.num_rows)
+
+
+_ORC_TO_TYPE = {}
+
+
+def _orc_view(path: str) -> _TableView:
+    from presto_tpu.storage import orc as orc_mod
+    if not _ORC_TO_TYPE:
+        _ORC_TO_TYPE.update({
+            orc_mod.K_BOOLEAN: BOOLEAN,
+            orc_mod.K_BYTE: INTEGER,
+            orc_mod.K_SHORT: INTEGER,
+            orc_mod.K_INT: INTEGER,
+            orc_mod.K_LONG: BIGINT,
+            orc_mod.K_FLOAT: DOUBLE,
+            orc_mod.K_DOUBLE: DOUBLE,
+            orc_mod.K_STRING: VARCHAR,
+            orc_mod.K_VARCHAR: VARCHAR,
+            orc_mod.K_CHAR: VARCHAR,
+            orc_mod.K_DATE: DATE,
+        })
+    info = orc_mod.read_footer(path)
+    cols = []
+    ids = {}
+    for c in info.columns:
+        t = _ORC_TO_TYPE.get(c.kind)
+        if t is None:
+            raise orc_mod.OrcError(
+                f"column {c.name}: unsupported ORC type {c.kind}")
+        cols.append((c.name, t))
+        ids[c.name] = c.column_id
+
+    def read(g, name):
+        return orc_mod.read_stripe_column(path, info, g, name)
+
+    def min_max(g, name):
+        return g.stats.get(ids[name], (None, None))
+
+    return _TableView(
+        columns=cols, groups=list(info.stripes),
+        num_rows=info.num_rows, read=read, min_max=min_max,
+        group_rows=lambda g: g.num_rows)
+
+
+def _open_view(path: str) -> _TableView:
+    if path.endswith(".orc"):
+        return _orc_view(path)
+    return _parquet_view(path)
+
+
 class _FileCatalog:
     """Footer + dictionary cache keyed by (path, mtime)."""
 
     def __init__(self, root: str):
         self.root = root
-        self._cache: Dict[str, Tuple[float, pq.FileInfo,
+        self._cache: Dict[str, Tuple[float, _TableView,
                                      Dict[str, tuple]]] = {}
         # string -> code reverse indexes, one entry per path replaced
         # wholesale on rewrite (keyed by the mtime of the CACHED
@@ -95,11 +182,22 @@ class _FileCatalog:
         return idx
 
     def path(self, handle: TableHandle) -> str:
+        """The table's existing file (either format); defaults to the
+        parquet name for new tables."""
+        base = os.path.join(self.root, handle.schema, handle.table)
+        for ext in (".parquet", ".orc"):
+            if os.path.exists(base + ext):
+                return base + ext
+        return base + ".parquet"
+
+    def write_path(self, handle: TableHandle) -> str:
+        """Writes always produce parquet (an INSERT into an ORC table
+        rewrites it in the write format)."""
         return os.path.join(self.root, handle.schema,
                             handle.table + ".parquet")
 
     def info(self, handle: TableHandle
-             ) -> Tuple[pq.FileInfo, Dict[str, tuple]]:
+             ) -> Tuple[_TableView, Dict[str, tuple]]:
         path = self.path(handle)
         try:
             mtime = os.stat(path).st_mtime
@@ -108,18 +206,18 @@ class _FileCatalog:
         hit = self._cache.get(path)
         if hit is not None and hit[0] == mtime:
             return hit[1], hit[2]
-        info = pq.read_footer(path)
+        view = _open_view(path)
         dicts: Dict[str, tuple] = {}
-        for col in info.columns:
-            if _engine_type(col).is_string:
+        for name, typ in view.columns:
+            if typ.is_string:
                 vals = set()
-                for g in info.row_groups:
-                    v, m = pq.read_column(path, g, col.name)
+                for g in view.groups:
+                    v, m = view.read(g, name)
                     vals.update(v)
-                dicts[col.name] = tuple(sorted(
+                dicts[name] = tuple(sorted(
                     x.decode("utf-8", "replace") for x in vals))
-        self._cache[path] = (mtime, info, dicts)
-        return info, dicts
+        self._cache[path] = (mtime, view, dicts)
+        return view, dicts
 
 
 class _FileMetadata(ConnectorMetadata):
@@ -136,25 +234,28 @@ class _FileMetadata(ConnectorMetadata):
 
     def list_tables(self, schema: str) -> List[str]:
         try:
-            return sorted(
-                f[:-8] for f in os.listdir(
-                    os.path.join(self._cat.root, schema))
-                if f.endswith(".parquet"))
+            out = []
+            for f in os.listdir(os.path.join(self._cat.root, schema)):
+                if f.endswith(".parquet"):
+                    out.append(f[:-8])
+                elif f.endswith(".orc"):
+                    out.append(f[:-4])
+            return sorted(set(out))
         except OSError:
             return []
 
     def get_table_schema(self, handle: TableHandle) -> RelationSchema:
-        info, dicts = self._cat.info(handle)
+        view, dicts = self._cat.info(handle)
         return RelationSchema.of(*[
-            ColumnSchema(c.name, _engine_type(c), dicts.get(c.name))
-            for c in info.columns])
+            ColumnSchema(name, typ, dicts.get(name))
+            for name, typ in view.columns])
 
     def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
         try:
-            info, _ = self._cat.info(handle)
+            view, _ = self._cat.info(handle)
         except KeyError:
             return None
-        return info.num_rows
+        return view.num_rows
 
 
 class _FileSplitManager(ConnectorSplitManager):
@@ -163,21 +264,22 @@ class _FileSplitManager(ConnectorSplitManager):
 
     def get_splits(self, handle: TableHandle,
                    target_splits: int) -> List[Split]:
-        info, _ = self._cat.info(handle)
-        n = len(info.row_groups)
+        view, _ = self._cat.info(handle)
+        n = len(view.groups)
         per = max(1, math.ceil(n / max(target_splits, 1)))
         return [Split(handle, (lo, min(lo + per, n)), partition=i)
                 for i, lo in enumerate(range(0, n, per))] \
             or [Split(handle, (0, 0), partition=0)]
 
 
-def _group_pruned(info: pq.FileInfo, g: pq.RowGroupInfo,
+def _group_pruned(view: _TableView, g,
                   constraint: Optional[TupleDomain]) -> bool:
-    """True when footer min/max statistics prove no row matches."""
+    """True when footer min/max statistics prove no row matches
+    (parquet row-group stats / ORC per-stripe statistics)."""
     if not constraint:
         return False
     for col, dom in constraint.domains:
-        mn, mx = pq.group_min_max(g, col)
+        mn, mx = view.min_max(g, col)
         if mn is None or mx is None \
                 or isinstance(mn, str) or isinstance(mx, str):
             continue
@@ -199,19 +301,18 @@ class _FilePageSource(ConnectorPageSource):
                 batch_rows: int,
                 constraint: Optional[TupleDomain] = None
                 ) -> Iterator[Batch]:
-        info, dicts = self._cat.info(split.table)
+        view, dicts = self._cat.info(split.table)
         path = self._cat.path(split.table)
-        by_name = {c.name: c for c in info.columns}
+        by_name = dict(view.columns)
         lo, hi = split.info
-        for g in info.row_groups[lo:hi]:
-            if _group_pruned(info, g, constraint):
+        for g in view.groups[lo:hi]:
+            if _group_pruned(view, g, constraint):
                 continue
             cols: Dict[str, Column] = {}
-            n = g.num_rows
+            n = view.group_rows(g)
             for name in columns:
-                pcol = by_name[name]
-                typ = _engine_type(pcol)
-                vals, present = pq.read_column(path, g, name)
+                typ = by_name[name]
+                vals, present = view.read(g, name)
                 mask = np.ones(n, bool) if present is None else present
                 if typ.is_string:
                     dic = dicts.get(name, ())
@@ -238,15 +339,13 @@ def _cap(n: int) -> int:
     return bucket_capacity(max(n, 1))
 
 
-def _read_full(path: str, g: pq.RowGroupInfo,
-               col: pq.ParquetColumn):
+def _read_full(view: _TableView, g, name: str, typ: Type):
     """One row group's column as FULL-length host values + mask (the
-    parquet reader returns present values compacted): strings as
-    list[bytes] with b'' at nulls, numerics as zero-filled arrays —
-    exactly the layouts pq.write_table stages."""
-    typ = _engine_type(col)
-    vals, present = pq.read_column(path, g, col.name)
-    n = g.num_rows
+    readers return present values compacted): strings as list[bytes]
+    with b'' at nulls, numerics as zero-filled arrays — exactly the
+    layouts pq.write_table stages."""
+    vals, present = view.read(g, name)
+    n = view.group_rows(g)
     mask = np.ones(n, bool) if present is None else present
     if typ.is_string:
         full: list = [b""] * n
@@ -292,16 +391,15 @@ class _FilePageSink(ConnectorPageSink):
             # pages — copying untouched rows must not round-trip the
             # device or re-encode strings through dictionaries
             schema = _FileMetadata(self._cat).get_table_schema(handle)
-            info, _ = self._cat.info(handle)
-            path = self._cat.path(handle)
-            base: Dict[str, list] = {c.name: [] for c in info.columns}
-            base_masks: Dict[str, list] = {c.name: []
-                                           for c in info.columns}
-            for g in info.row_groups:
-                for col in info.columns:
-                    full, mask = _read_full(path, g, col)
-                    base[col.name].append(full)
-                    base_masks[col.name].append(mask)
+            view, _ = self._cat.info(handle)
+            base: Dict[str, list] = {n: [] for n, _ in view.columns}
+            base_masks: Dict[str, list] = {n: []
+                                           for n, _ in view.columns}
+            for g in view.groups:
+                for name, typ in view.columns:
+                    full, mask = _read_full(view, g, name, typ)
+                    base[name].append(full)
+                    base_masks[name].append(mask)
             self._pending[key] = (schema, [])
             self._base[key] = (base, base_masks)
         self._pending[key][1].append(batch)
@@ -347,12 +445,17 @@ class _FilePageSink(ConnectorPageSink):
                     else np.zeros(0, c.type.np_dtype)
             flat_masks[c.name] = np.concatenate(
                 masks[c.name]) if masks[c.name] else np.zeros(0, bool)
-        path = self._cat.path(handle)
+        old_path = self._cat.path(handle)
+        path = self._cat.write_path(handle)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         pq.write_table(tmp, cols, flat_data, flat_masks,
                        row_group_rows=1 << 20)
         os.replace(tmp, path)
+        if old_path != path and os.path.exists(old_path):
+            # INSERT into an ORC table rewrote it in the write format
+            os.unlink(old_path)
+            self._cat.evict(old_path)
         self._cat.evict(path)
 
     def drop_table(self, handle: TableHandle) -> None:

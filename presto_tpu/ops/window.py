@@ -291,14 +291,42 @@ def window_kernel(batch: Batch,
         return jnp.where(fhi >= flo, hi_v - lo_v,
                          jnp.zeros((), pre.dtype))
 
+    def range_sum_dd(arr, flo, fhi):
+        """Compensated framed float sum: prefix sums kept as
+        DOUBLE-DOUBLE (hi, lo) pairs via a two-sum associative scan,
+        so the prefix-difference trick keeps ~107 bits through the
+        cancellation that kills a plain f64 cumsum difference (one
+        large early value would otherwise poison every later frame —
+        the reference's per-frame accumulation never differences)."""
+        def two_sum(a, b):
+            s = a + b
+            bp = s - a
+            return s, (a - (s - bp)) + (b - bp)
+
+        def combine(l, r):
+            s, e = two_sum(l[0], r[0])
+            return s, e + l[1] + r[1]
+
+        hi, lo = jax.lax.associative_scan(
+            combine, (arr, jnp.zeros_like(arr)))
+        hi_h = hi[jnp.clip(fhi, 0, cap - 1)]
+        lo_h = lo[jnp.clip(fhi, 0, cap - 1)]
+        zero = jnp.zeros((), arr.dtype)
+        at_lo = jnp.clip(flo - 1, 0, cap - 1)
+        hi_l = jnp.where(flo > 0, hi[at_lo], zero)
+        lo_l = jnp.where(flo > 0, lo[at_lo], zero)
+        v = (hi_h - hi_l) + (lo_h - lo_l)
+        return jnp.where(fhi >= flo, v, zero)
+
     def float_range_sum(arr, w, flo, fhi):
         """Float framed sum with EXACT IEEE special-value semantics: a
         plain cumsum difference would leak one row's NaN/Inf into every
         LATER frame (x - NaN = NaN). The finite part flows through the
-        cumsum; NaN/+Inf/-Inf presence is counted with integer prefix
-        sums (exact) and re-applied only to frames that contain them."""
+        compensated scan; NaN/+Inf/-Inf presence is counted with
+        integer prefix sums (exact) and re-applied only to frames that
+        contain them."""
         finite = jnp.isfinite(arr)
-        base = range_sum(jnp.where(finite, arr, 0.0), flo, fhi)
+        base = range_sum_dd(jnp.where(finite, arr, 0.0), flo, fhi)
         n_nan = range_sum((w & jnp.isnan(arr)).astype(jnp.int32),
                           flo, fhi)
         n_pinf = range_sum((w & (arr == jnp.inf)).astype(jnp.int32),

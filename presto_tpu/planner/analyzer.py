@@ -1511,8 +1511,9 @@ def _plan_unnest(un: T.Unnest, source: Optional[RelationPlan],
                 "array-producing function like split)")
         if not av.elements:
             raise AnalysisError("cannot UNNEST an empty array")
-        if av.type.element == UNKNOWN:
-            raise AnalysisError("cannot UNNEST an all-NULL array")
+        # (an all-NULL array's element type is coerced to BIGINT by
+        #  _an_ArrayConstructor, so UNNEST(ARRAY[NULL]) emits one NULL
+        #  row — Presto's behavior; pinned by tests/test_unnest.py)
         arrays.append(list(av.elements))
         lengths.append(av.length)
 

@@ -167,3 +167,14 @@ def test_width_bucket_descending(runner):
         "width_bucket(5.0, 0.0, 10.0, 4) b, "
         "regexp_extract('bar', '(foo)?bar', 1) g")
     assert r.rows() == [(3, 3, None)]
+
+
+def test_unnest_all_null_array(runner):
+    """UNNEST(ARRAY[NULL]) emits one NULL row (the all-NULL array's
+    element type coerces to BIGINT) — Presto's behavior, pinned here
+    because an earlier analysis error for this case became dead code."""
+    assert runner.execute(
+        "select * from unnest(array[null])").rows() == [(None,)]
+    assert runner.execute(
+        "select x from unnest(array[null, 3]) as t(x)").rows() \
+        == [(None,), (3,)]

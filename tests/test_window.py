@@ -255,3 +255,19 @@ def test_fractional_rows_frame_rejected(runner):  # noqa: F811
             select sum(acctbal) over (order by custkey
                 rows between 1.5 preceding and current row)
             from customer""")
+
+
+def test_framed_float_sum_resists_cancellation():
+    """A huge early value must not destroy later frames' precision:
+    the compensated double-double prefix scan keeps framed sums exact
+    where a plain f64 cumsum difference loses every low bit."""
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    rows = r.execute(
+        "select x, sum(v) over (order by x rows between 1 preceding "
+        "and current row) from (values "
+        "(1, 1e18), (2, 1.0), (3, 2.0), (4, 3.0)) as t(x, v) "
+        "order by x").rows()
+    by_x = {x: s for x, s in rows}
+    assert by_x[3] == 3.0   # 1.0 + 2.0 — plain cumsum diff gives 0.0
+    assert by_x[4] == 5.0   # 2.0 + 3.0
