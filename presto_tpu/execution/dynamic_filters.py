@@ -112,17 +112,30 @@ class DynamicFilterService:
             expected = self._expected.get(df_id)
             if expected is None or len(parts) < expected:
                 return None
-        mn = parts[0].mn
-        mx = parts[0].mx
-        for p in parts[1:]:
-            mn = jnp.minimum(mn, p.mn)
-            mx = jnp.maximum(mx, p.mx)
+        # merge ON THE HOST: the partials were published by build
+        # tasks pinned to DIFFERENT devices, and a cross-device
+        # jnp.minimum is an error. The merged (numpy) filter is
+        # uncommitted, so apply_filter follows each scan batch's own
+        # device. Happens once per filter, tiny data.
+        import numpy as np
+
+        import jax
+        host = jax.device_get([(p.mn, p.mx) for p in parts])
+        mn = np.min(np.asarray([h[0] for h in host]))
+        mx = np.max(np.asarray([h[1] for h in host]))
         dset = None
         if all(p.dset is not None for p in parts):
-            merged_vals, n, ovf = _merge_sets(
-                [(p.dset[0], p.dset[1]) for p in parts])
-            if not bool(ovf):
-                dset = (merged_vals, n)
+            chunks = []
+            for p in parts:
+                v, c = jax.device_get(p.dset)
+                chunks.append(np.asarray(v)[:int(c)])
+            u = np.unique(np.concatenate(chunks)) if chunks else \
+                np.zeros(0, np.asarray(mn).dtype)
+            if len(u) <= DF_SET_MAX:
+                info = _ident(u.dtype)
+                padded = np.full(DF_SET_MAX, info.max, dtype=u.dtype)
+                padded[:len(u)] = u
+                dset = (padded, np.int64(len(u)))
         merged = DFilter(mn, mx, dset)
         with self._lock:
             self._merged[df_id] = merged
@@ -190,15 +203,6 @@ def distinct_set(data, mask):
     out = jnp.where(jnp.arange(DF_SET_MAX) < n, pk,
                     jnp.asarray(info.max, data.dtype))
     return out, n, n > DF_SET_MAX
-
-
-def _merge_sets(parts):
-    """Union of several (values, count) sets into one DF_SET_MAX set
-    (host-side concat of device arrays + one jitted distinct_set)."""
-    vals = jnp.concatenate([v for v, _ in parts])
-    mask = jnp.concatenate([
-        jnp.arange(v.shape[0]) < c for v, c in parts])
-    return distinct_set(vals, mask)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 4))

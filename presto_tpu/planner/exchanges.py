@@ -506,6 +506,78 @@ def fragment_plan(root: N.OutputNode) -> FragmentedPlan:
     return FragmentedPlan(root_id, f.fragments, f.edges)
 
 
+def plan_phases(fplan: FragmentedPlan) -> Dict[int, List[int]]:
+    """Phased execution policy (reference: execution/scheduler/
+    PhasedExecutionSchedule.java): fragments that produce a join's
+    PROBE side wait for the fragments producing its BUILD side to
+    finish. Gains: the build table exists before probe pages flood
+    its exchange (peak memory), and cross-fragment dynamic filters
+    are complete before probe scans run (pruning becomes
+    deterministic, not a race).
+
+    Returns {fragment_id: [fragment ids that must FINISH first]}.
+    Consumer fragments themselves are never gated — they must run to
+    drain their build edges. Dependency edges that would create a
+    cycle (e.g. a shared spooled subtree feeding both sides) are
+    dropped; the policy is an optimization, all-at-once is always
+    correct."""
+    deps: Dict[int, set] = {fid: set() for fid in fplan.fragments}
+
+    def remote_edges(node: N.PlanNode) -> List[int]:
+        out, stack = [], [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, N.RemoteSourceNode):
+                out.append(n.exchange_id)
+                continue
+            stack.extend(n.sources())
+        return out
+
+    def upstream(fid: int, acc: set) -> set:
+        """fid's producer fragments, transitively."""
+        for e in fplan.edges.values():
+            if e.consumer == fid and e.producer not in acc:
+                acc.add(e.producer)
+                upstream(e.producer, acc)
+        return acc
+
+    def reaches(a: int, b: int, seen: set) -> bool:
+        """Would b -> a create a cycle (a already depends on b)?"""
+        if a == b:
+            return True
+        for d in deps.get(a, ()):
+            if d not in seen:
+                seen.add(d)
+                if reaches(d, b, seen):
+                    return True
+        return False
+
+    for fid, frag in fplan.fragments.items():
+        stack = [frag.root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.sources())
+            if isinstance(n, N.JoinNode) and n.join_type != "cross":
+                build, probe = n.right, n.left
+                if n.join_type == "right":
+                    build, probe = n.left, n.right
+            elif isinstance(n, N.SemiJoinNode):
+                build, probe = n.filtering_source, n.source
+            else:
+                continue
+            build_frags: set = set()
+            for xid in remote_edges(build):
+                b = fplan.edges[xid].producer
+                build_frags.add(b)
+                upstream(b, build_frags)
+            for xid in remote_edges(probe):
+                p = fplan.edges[xid].producer
+                for b in build_frags:
+                    if p != b and not reaches(b, p, set()):
+                        deps[p].add(b)
+    return {fid: sorted(d) for fid, d in deps.items()}
+
+
 @dataclasses.dataclass
 class CrossFragmentFilters:
     """Wiring for cross-fragment dynamic filters (the in-process

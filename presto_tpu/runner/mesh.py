@@ -226,11 +226,26 @@ class MeshRunner(LocalRunner):
                                for pipe in pipelines)
             return created
 
+        # phased execution (reference: PhasedExecutionSchedule):
+        # probe-producer fragments wait for their build-producer
+        # fragments to finish — build tables exist and dynamic
+        # filters are complete before probe pages flow
+        phase_deps: Dict[int, List[int]] = {
+            fid: [] for fid in fplan.fragments}
+        if bool(get_property(session.properties, "phased_execution")):
+            from presto_tpu.planner.exchanges import plan_phases
+            phase_deps = plan_phases(fplan)
+        deferred = [fid for fid in fplan.fragments
+                    if phase_deps[fid]]
         for fid in fplan.fragments:
+            if fid in deferred:
+                continue
             drivers = spawn_fragment(fid)
             all_drivers.extend(drivers)
             instance_drivers[fid] = drivers
             remaining_lifespans[fid] = lifespans_of[fid] - 1
+        # the root fragment is never gated (it produces nothing), so
+        # `result` is always materialized by the eager spawns
         assert result is not None
 
         t0 = _time.perf_counter()
@@ -239,7 +254,10 @@ class MeshRunner(LocalRunner):
             self._drive_phased(fplan, all_drivers, instance_drivers,
                                remaining_lifespans, exchanges,
                                spawn_fragment,
-                               stat_snaps if profile else None)
+                               stat_snaps if profile else None,
+                               deferred=deferred,
+                               phase_deps=phase_deps,
+                               lifespans_of=lifespans_of)
             from presto_tpu.operators.base import run_deferred_checks
             run_deferred_checks(dctx)
         finally:
@@ -259,7 +277,11 @@ class MeshRunner(LocalRunner):
     def _drive_phased(fplan, all_drivers, instance_drivers,
                       remaining_lifespans, exchanges, spawn_fragment,
                       stat_snaps: Optional[List] = None,
-                      max_rounds: int = 2_000_000) -> None:
+                      max_rounds: int = 2_000_000,
+                      deferred: Optional[List[int]] = None,
+                      phase_deps: Optional[Dict[int, List[int]]] = None,
+                      lifespans_of: Optional[Dict[int, int]] = None
+                      ) -> None:
         """Round-robin drive with lifespan phases: when the loop stalls
         because a grouped fragment's current bucket is drained, advance
         its input exchanges to the next bucket and spawn fresh task
@@ -277,15 +299,38 @@ class MeshRunner(LocalRunner):
                 stat_snaps.extend(
                     LocalRunner.snapshot_driver_stats(drivers))
 
+        deferred = list(deferred or [])
+
+        def fragment_complete(fid: int) -> bool:
+            if fid in deferred or fid not in instance_drivers:
+                return False
+            return remaining_lifespans.get(fid, 0) <= 0 and \
+                all(d.is_finished() for d in instance_drivers[fid])
+
+        def spawn_ready_deferred() -> bool:
+            fired = False
+            for fid in list(deferred):
+                if all(fragment_complete(b) for b in phase_deps[fid]):
+                    deferred.remove(fid)
+                    fresh = spawn_fragment(fid)
+                    instance_drivers[fid] = fresh
+                    all_drivers.extend(fresh)
+                    remaining_lifespans[fid] = \
+                        (lifespans_of[fid] if lifespans_of else 1) - 1
+                    fired = True
+            return fired
+
         rounds = 0
         while True:
-            all_done = True
+            all_done = not deferred
             progress = False
             for d in all_drivers:
                 if d.is_finished():
                     continue
                 all_done = False
                 progress = d.process() or progress
+            if deferred and spawn_ready_deferred():
+                continue
             if all_done:
                 break
             if not progress:

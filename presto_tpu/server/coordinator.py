@@ -65,6 +65,7 @@ class _Query:
         self.group = "root"
         self.dispatch = None  # resource-group dispatch callback
         self.last_poll = time.monotonic()
+        self.created_at = time.monotonic()
 
 
 #: result rows per client page (reference: the target-result-size
@@ -106,6 +107,18 @@ class Coordinator(Node):
                 max_queued=max_queued_queries)
         self.resource_groups = ResourceGroupManager(
             resource_groups, selectors)
+        #: event listener SPI (reference: spi/eventlistener/
+        #: EventListener + EventListenerManager.java): callables
+        #: receiving {"event": "query_created"|"query_completed", ...};
+        #: listener errors never fail queries
+        self.event_listeners: List = []
+
+    def _fire_event(self, payload: dict) -> None:
+        for listener in self.event_listeners:
+            try:
+                listener(payload)
+            except Exception:  # noqa: BLE001 — observers cannot fail
+                pass          # the query (EventListenerManager.java)
 
     # -- health / membership (reference: failureDetector/
     # HeartbeatFailureDetector pinging discovered nodes) ---------------
@@ -151,6 +164,9 @@ class Coordinator(Node):
                                f"executing/{q.id}/0"}).encode()
             has_slot = state == "run"
             self.queries[q.id] = q
+            self._fire_event({"event": "query_created", "id": q.id,
+                              "user": q.user, "source": q.source,
+                              "group": q.group, "sql": q.sql})
             threading.Thread(target=self._run_query,
                              args=(q, has_slot, dispatched),
                              daemon=True).start()
@@ -172,7 +188,41 @@ class Coordinator(Node):
         except Exception:
             return 0
 
+    # -- observability surface (reference: server/QueryResource.java:49
+    # + the webapp/ status UI, collapsed to one self-contained page) ---
+
+    def _query_rows(self) -> List[dict]:
+        now = time.monotonic()
+        out = []
+        for q in list(self.queries.values()):
+            elapsed = ((q.done_at or now) - q.created_at) \
+                if q.created_at is not None else 0.0
+            out.append({
+                "id": q.id, "state": q.state, "user": q.user,
+                "source": q.source, "group": q.group,
+                "elapsed_ms": round(elapsed * 1000, 1),
+                "rows": len(q.data) if q.data is not None else 0,
+                "error": q.error,
+                "sql": q.sql[:500],
+            })
+        return sorted(out, key=lambda r: -r["elapsed_ms"])
+
     def handle_get(self, path: str) -> bytes:
+        if path == "/v1/query":
+            return json.dumps(self._query_rows()).encode()
+        if path.startswith("/v1/query/"):
+            qid = path.rsplit("/", 1)[1]
+            for row in self._query_rows():
+                if row["id"] == qid:
+                    q = self.queries[qid]
+                    row["sql"] = q.sql
+                    row["columns"] = q.columns
+                    return json.dumps(row).encode()
+            raise KeyError(qid)
+        if path == "/v1/resourceGroups":
+            return json.dumps(self.resource_groups.snapshot()).encode()
+        if path in ("/ui", "/ui/"):
+            return self._ui_page()
         if path.startswith("/v1/statement/executing/"):
             parts = path.split("/")
             qid = parts[4]
@@ -202,6 +252,66 @@ class Coordinator(Node):
                                  f"{qid}/{token}"
             return json.dumps(out).encode()
         return super().handle_get(path)
+
+    def _ui_page(self) -> bytes:
+        """Single self-contained cluster status page (the webapp/
+        analog): workers, resource groups, recent queries; refreshes
+        itself from the JSON endpoints."""
+        import html as _html
+        from concurrent.futures import ThreadPoolExecutor
+
+        def probe(url):
+            try:
+                info = json.loads(http_get(f"{url}/v1/info",
+                                           timeout=2))
+                return (url, info.get("state", "?"),
+                        info.get("devices", "?"))
+            except Exception:  # noqa: BLE001
+                return (url, "unreachable", "-")
+        # concurrent probes: with dead workers, serial 2s timeouts
+        # would make the status page slower than its own 5s refresh
+        # exactly when the operator needs it
+        with ThreadPoolExecutor(
+                max_workers=max(len(self.worker_urls), 1)) as pool:
+            workers = list(pool.map(probe, self.worker_urls))
+        rows = "".join(
+            f"<tr><td><a href='/v1/query/{r['id']}'>{r['id']}</a></td>"
+            f"<td class='{r['state']}'>{r['state']}</td>"
+            f"<td>{_html.escape(r['user'] or '-')}</td>"
+            f"<td>{_html.escape(r['group'])}</td>"
+            f"<td>{r['elapsed_ms']}</td><td>{r['rows']}</td>"
+            f"<td><code>{_html.escape(r['sql'][:120])}</code></td></tr>"
+            for r in self._query_rows()[:100])
+        wrows = "".join(
+            f"<tr><td>{u}</td><td>{s}</td><td>{d}</td></tr>"
+            for u, s, d in workers)
+        grows = "".join(
+            f"<tr><td>{g['group']}</td><td>{g['running']}/"
+            f"{g['hard_concurrency']}</td><td>{g['queued']}/"
+            f"{g['max_queued']}</td>"
+            f"<td>{g['memory_reserved']}</td></tr>"
+            for g in self.resource_groups.snapshot())
+        page = f"""<!doctype html><html><head>
+<meta http-equiv="refresh" content="5">
+<title>presto-tpu coordinator</title><style>
+body{{font-family:monospace;margin:2em;background:#111;color:#ddd}}
+table{{border-collapse:collapse;margin:1em 0}}
+td,th{{border:1px solid #444;padding:4px 10px;text-align:left}}
+th{{background:#222}}
+.FINISHED{{color:#7c7}}.FAILED{{color:#e77}}.RUNNING{{color:#7cf}}
+.QUEUED{{color:#fc7}} a{{color:#9cf}}
+</style></head><body>
+<h2>presto-tpu coordinator</h2>
+<h3>workers ({len(workers)})</h3>
+<table><tr><th>url</th><th>state</th><th>devices</th></tr>{wrows}</table>
+<h3>resource groups</h3>
+<table><tr><th>group</th><th>running</th><th>queued</th>
+<th>mem reserved</th></tr>{grows}</table>
+<h3>queries</h3>
+<table><tr><th>id</th><th>state</th><th>user</th><th>group</th>
+<th>elapsed ms</th><th>rows</th><th>query</th></tr>{rows}</table>
+</body></html>"""
+        return page.encode()
 
     # -- query execution ---------------------------------------------------
 
@@ -259,6 +369,13 @@ class Coordinator(Node):
         finally:
             q.done_at = time.monotonic()
             self.resource_groups.finish(q.group, self._query_memory())
+            self._fire_event({
+                "event": "query_completed", "id": q.id,
+                "state": q.state, "user": q.user, "group": q.group,
+                "elapsed_ms": round(
+                    (q.done_at - q.created_at) * 1000, 1),
+                "rows": len(q.data) if q.data is not None else 0,
+                "error": q.error})
 
     def execute(self, sql: str, on_columns=None):
         """Distributed execution with elastic retry: a failed or dead

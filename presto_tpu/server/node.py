@@ -311,7 +311,9 @@ class NodeHandler(BaseHTTPRequestHandler):
                 {"error": f"{type(e).__name__}: {e}",
                  "trace": traceback.format_exc(limit=5)}).encode())
             return
-        self._reply(200, body)
+        ctype = "text/html" if self.path.startswith("/ui") \
+            else "application/json"
+        self._reply(200, body, ctype)
 
     def do_POST(self):
         try:

@@ -50,6 +50,12 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "with 4x (reference: MultiChannelGroupByHash rehash)",
         _positive),
     PropertyDef(
+        "phased_execution", "boolean", True,
+        "Gate probe-producer fragments until their join's "
+        "build-producer fragments finish (reference: "
+        "PhasedExecutionSchedule): bounds peak memory and makes "
+        "cross-fragment dynamic filters deterministic"),
+    PropertyDef(
         "query_memory_bytes", "bigint", 0,
         "Declared per-query memory reservation charged against "
         "resource-group memory caps at admission (0 = unaccounted; "

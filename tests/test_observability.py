@@ -1,0 +1,67 @@
+"""Coordinator observability surface (reference:
+server/QueryResource.java:49, the webapp/ status UI, and
+spi/eventlistener/EventListener + EventListenerManager.java)."""
+
+import json
+
+import pytest
+
+from test_distributed import cluster, local_rows  # noqa: F401
+
+
+def _get(url):
+    from presto_tpu.server.node import http_get
+    return http_get(url, timeout=30)
+
+
+def test_query_resource_lists_queries(cluster):  # noqa: F811
+    from presto_tpu.server.coordinator import StatementClient
+    StatementClient(cluster.url, user="alice").execute(
+        "select count(*) from nation")
+    rows = json.loads(_get(f"{cluster.url}/v1/query"))
+    assert rows and any(r["user"] == "alice"
+                        and r["state"] == "FINISHED" for r in rows)
+    qid = next(r["id"] for r in rows if r["user"] == "alice")
+    detail = json.loads(_get(f"{cluster.url}/v1/query/{qid}"))
+    assert detail["sql"].startswith("select count(*)")
+    assert detail["columns"]
+
+
+def test_resource_groups_endpoint(cluster):  # noqa: F811
+    snap = json.loads(_get(f"{cluster.url}/v1/resourceGroups"))
+    assert any(g["group"] == "root" for g in snap)
+    assert {"running", "queued", "hard_concurrency"} <= set(snap[0])
+
+
+def test_ui_page_renders(cluster):  # noqa: F811
+    page = _get(f"{cluster.url}/ui").decode()
+    assert "<html" in page and "presto-tpu coordinator" in page
+    assert "workers (" in page and "resource groups" in page
+    # worker table shows the registered workers as active
+    for url in cluster.worker_urls:
+        assert url in page
+
+
+def test_event_listeners_fire_and_cannot_fail_queries(cluster):  # noqa: F811
+    from presto_tpu.server.coordinator import StatementClient
+    events = []
+
+    def bad_listener(_):
+        raise RuntimeError("observer bug")
+    cluster.event_listeners.append(events.append)
+    cluster.event_listeners.append(bad_listener)
+    try:
+        _, rows = StatementClient(cluster.url, user="bob").execute(
+            "select count(*) from region")
+        assert rows == [[5]]
+        kinds = [e["event"] for e in events
+                 if e.get("user") == "bob"]
+        assert kinds == ["query_created", "query_completed"]
+        done = next(e for e in events
+                    if e.get("user") == "bob"
+                    and e["event"] == "query_completed")
+        assert done["state"] == "FINISHED"
+        assert done["rows"] == 1
+        assert done["elapsed_ms"] > 0
+    finally:
+        cluster.event_listeners.clear()
