@@ -101,20 +101,65 @@ def _like_to_regex(pattern: str, escape: Optional[str] = None) -> str:
     return "^" + "".join(out) + "$"
 
 
+#: per-evaluation memo for SHARED IR subtrees: the analyzer emits DAGs
+#: (decorrelated plans, lambda reduce() chains where the accumulator
+#: appears in both branches of every step's IF) — without sharing, a
+#: width-W reduce would trace 2^W accumulator evaluations. Thread-local
+#: because compiled closures may evaluate concurrently across drivers.
+import threading as _threading
+
+_EVAL_MEMO = _threading.local()
+
+
+def _share(fn, key: int):
+    """Wrap a compiled closure so one EVALUATION of a shared node runs
+    once per env (trace-time sharing == shared HLO subgraph)."""
+    def wrapped(env):
+        memo = getattr(_EVAL_MEMO, "m", None)
+        top = memo is None
+        if top:
+            memo = {}
+            _EVAL_MEMO.m = memo
+        try:
+            k = (id(env), key)
+            hit = memo.get(k)
+            if hit is None:
+                hit = fn(env)
+                memo[k] = hit
+            return hit
+        finally:
+            if top:
+                _EVAL_MEMO.m = None
+    return wrapped
+
+
 class _Compiler:
     def __init__(self, schema: Dict[str, ColumnSchema]):
         self.schema = schema
+        #: id(node) -> CompiledExpr. Safe: the root expression keeps
+        #: every child alive for the compiler's lifetime, so ids
+        #: cannot be recycled mid-compilation.
+        self._memo: Dict[int, CompiledExpr] = {}
 
     def compile(self, expr: RowExpression) -> CompiledExpr:
+        hit = self._memo.get(id(expr))
+        if hit is not None:
+            return hit
         if isinstance(expr, Literal):
-            return self._literal(expr)
-        if isinstance(expr, InputRef):
-            return self._input(expr)
-        if isinstance(expr, SpecialForm):
-            return self._special(expr)
-        if isinstance(expr, Call):
-            return self._call(expr)
-        raise ExpressionCompileError(f"unknown expression node: {expr!r}")
+            out = self._literal(expr)
+        elif isinstance(expr, InputRef):
+            out = self._input(expr)
+        elif isinstance(expr, SpecialForm):
+            out = self._special(expr)
+        elif isinstance(expr, Call):
+            out = self._call(expr)
+        else:
+            raise ExpressionCompileError(
+                f"unknown expression node: {expr!r}")
+        out = CompiledExpr(_share(out.fn, id(expr)), out.type,
+                           out.dictionary, out.ir)
+        self._memo[id(expr)] = out
+        return out
 
     # -- leaves ------------------------------------------------------------
 
@@ -1339,30 +1384,41 @@ def _json_try(v: str):
         return _JSONERR
 
 
-def fold_constants(expr: RowExpression) -> RowExpression:
+def fold_constants(expr: RowExpression,
+                   _memo: Optional[dict] = None) -> RowExpression:
     """Evaluate literal-only subtrees host-side (reference analog:
     sql/planner ConstantExpressionVerifier + interpreter folding).
-    E.g. `date '1998-12-01' - interval '90' day` becomes a DATE literal."""
+    E.g. `date '1998-12-01' - interval '90' day` becomes a DATE literal.
+
+    Memoized by node identity: analyzer output is a DAG (a lambda
+    reduce() references its accumulator twice per step), and a naive
+    rebuild both blows up exponentially AND destroys the sharing the
+    compiler's own memo depends on."""
     if isinstance(expr, (Literal, InputRef)):
         return expr
-    kids = tuple(fold_constants(c) for c in expr.children())
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(expr))
+    if hit is not None:
+        return hit
+    original = expr
+    kids = tuple(fold_constants(c, _memo) for c in expr.children())
     if isinstance(expr, Call):
         expr = Call(expr.name, kids, expr.type)
     elif isinstance(expr, SpecialForm):
         expr = SpecialForm(expr.form, kids, expr.type)
-    if all(isinstance(k, Literal) for k in kids) and kids:
-        if any(k.value is None for k in kids):
-            return expr  # null-folding: keep simple, evaluate at runtime
-        if expr.type.is_string:
-            return expr
+    out = expr
+    if all(isinstance(k, Literal) for k in kids) and kids \
+            and not any(k.value is None for k in kids) \
+            and not expr.type.is_string:
         try:
             compiled = compile_expression(expr, {})
             d, m = compiled.fn({})
             if not bool(np.asarray(m)):
-                return Literal(None, expr.type)
-            val = np.asarray(d)
-            pyval = val.item()
-            return Literal(pyval, expr.type)
+                out = Literal(None, expr.type)
+            else:
+                out = Literal(np.asarray(d).item(), expr.type)
         except ExpressionCompileError:
-            return expr
-    return expr
+            out = expr
+    _memo[id(original)] = out
+    return out

@@ -115,10 +115,54 @@ def not_(arg: RowExpression) -> SpecialForm:
     return SpecialForm("not", (arg,), BOOLEAN)
 
 
-def walk(expr: RowExpression):
+def fingerprint(expr: RowExpression, _memo: Optional[dict] = None
+                ) -> bytes:
+    """Memoized 128-bit structural digest of an expression DAG, for
+    kernel-cache KEYS. The frozen dataclasses' own __hash__/__eq__
+    recurse by value, which is exponential on self-similar DAGs (a
+    lambda reduce() references its accumulator twice per step — a
+    26-wide reduce would hash 2^26 paths); the digest visits each
+    node once. Collisions are cryptographically negligible and a
+    collision's worst case is reusing a compiled kernel for the wrong
+    expression within one process."""
+    import hashlib
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(expr))
+    if hit is not None:
+        return hit
+    h = hashlib.blake2b(digest_size=16)
+    h.update(type(expr).__name__.encode())
+    if isinstance(expr, Literal):
+        h.update(repr((expr.value, expr.type)).encode())
+    elif isinstance(expr, InputRef):
+        h.update(repr((expr.name, expr.type)).encode())
+    elif isinstance(expr, Call):
+        h.update(repr((expr.name, expr.type)).encode())
+    elif isinstance(expr, SpecialForm):
+        h.update(repr((expr.form, expr.type)).encode())
+    else:
+        h.update(repr(expr.type).encode())
+    for c in expr.children():
+        h.update(fingerprint(c, _memo))
+    d = h.digest()
+    _memo[id(expr)] = d
+    return d
+
+
+def walk(expr: RowExpression, _seen: Optional[set] = None):
+    """DFS over the expression DAG, each node yielded ONCE: analyzer
+    output shares subtrees (lambda reduce() chains reference the
+    accumulator twice per step), and an unshared walk would revisit
+    them exponentially."""
+    if _seen is None:
+        _seen = set()
+    if id(expr) in _seen:
+        return
+    _seen.add(id(expr))
     yield expr
     for c in expr.children():
-        yield from walk(c)
+        yield from walk(c, _seen)
 
 
 def referenced_inputs(expr: RowExpression):

@@ -106,11 +106,17 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
     key = None
     if all(e.ir is not None for e in exprs):
         try:
+            # fingerprints, not raw IR: see operators/core.py — IR
+            # hash/eq is exponential on lambda-produced DAGs
+            from presto_tpu.expr.ir import fingerprint as _fp
             key = (mode, domains, input_dicts,
-                   tuple((ke.ir, ke.dictionary) for ke in key_exprs),
+                   tuple((_fp(ke.ir), ke.dictionary)
+                         for ke in key_exprs),
                    tuple((s.out_name if mode == "final" else None,
-                          s.input.ir if s.input is not None else None,
-                          s.mask.ir if s.mask is not None else None,
+                          _fp(s.input.ir) if s.input is not None
+                          else None,
+                          _fp(s.mask.ir) if s.mask is not None
+                          else None,
                           s.function) for s in specs))
             cached = _AGG_STEP_CACHE.get(key)
             if cached is not None:

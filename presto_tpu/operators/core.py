@@ -150,8 +150,15 @@ def make_filter_project_kernel(
         key = None
     else:
         try:
-            key = (filter_expr.ir if filter_expr else None,
-                   tuple((n, ce.ir, ce.dictionary) for n, ce in projections),
+            # keys carry structural FINGERPRINTS, not the IR itself:
+            # IR __hash__/__eq__ recurse by value, exponential on the
+            # shared-accumulator DAGs lambdas produce (expr/ir.py
+            # fingerprint)
+            from presto_tpu.expr.ir import fingerprint
+            key = (fingerprint(filter_expr.ir) if filter_expr
+                   else None,
+                   tuple((n, fingerprint(ce.ir), ce.dictionary)
+                         for n, ce in projections),
                    input_dicts)
             cached = _FP_KERNEL_CACHE.get(key)
             if cached is not None:

@@ -25,7 +25,7 @@ KEYWORDS = {
     "current", "exclude", "ties", "no", "others", "semi", "anti",
 }
 
-MULTI_OPS = ["<>", "!=", ">=", "<=", "||"]
+MULTI_OPS = ["<>", "!=", ">=", "<=", "||", "->"]
 SINGLE_OPS = "+-*/%(),.<>=;[]?"
 
 

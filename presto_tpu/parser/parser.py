@@ -496,7 +496,43 @@ class _Parser:
     # -- expressions (Pratt) ----------------------------------------------
 
     def expr(self) -> T.Node:
+        lam = self._try_lambda()
+        if lam is not None:
+            return lam
         return self.or_expr()
+
+    def _try_lambda(self) -> Optional[T.Node]:
+        """`x -> body` or `(a, b) -> body`; lookahead-based so `(x)`
+        as a parenthesized expression stays untouched."""
+        t = self.cur
+        if t.kind == "ident" \
+                and self.toks[self.i + 1].kind == "op" \
+                and self.toks[self.i + 1].value == "->":
+            name = self.advance().value
+            self.advance()  # ->
+            return T.Lambda([name], self.expr())
+        if t.kind == "op" and t.value == "(":
+            j = self.i + 1
+            params = []
+            while True:
+                if self.toks[j].kind != "ident":
+                    return None
+                params.append(self.toks[j].value)
+                j += 1
+                if self.toks[j].kind == "op" \
+                        and self.toks[j].value == ",":
+                    j += 1
+                    continue
+                break
+            if not (self.toks[j].kind == "op"
+                    and self.toks[j].value == ")"):
+                return None
+            if not (self.toks[j + 1].kind == "op"
+                    and self.toks[j + 1].value == "->"):
+                return None
+            self.i = j + 2
+            return T.Lambda(params, self.expr())
+        return None
 
     def or_expr(self) -> T.Node:
         left = self.and_expr()

@@ -236,6 +236,14 @@ class Subscript(Node):
 
 
 @dataclasses.dataclass
+class Lambda(Node):
+    """x -> body / (a, b) -> body — valid only as an argument of the
+    lambda-taking array functions (reference: SqlBase.g4 lambda)."""
+    params: List[str] = None
+    body: "Node" = None
+
+
+@dataclasses.dataclass
 class ArrayConstructor(Node):
     items: List[Node]
 

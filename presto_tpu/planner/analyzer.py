@@ -2308,6 +2308,8 @@ class _Analyzer:
         return Literal(ms, INTERVAL_DAY)
 
     def _an_Identifier(self, a: T.Identifier):
+        if len(a.parts) == 1 and a.parts[0] in self._lambda_bindings:
+            return self._lambda_bindings[a.parts[0]]
         f, is_outer = self.scope.resolve(a.parts)
         if is_outer:
             raise AnalysisError(
@@ -2559,6 +2561,33 @@ class _Analyzer:
         return self._array_element_switch(
             base, fold_constants(self.analyze(a.index)))
 
+    #: immutable by convention: rebinding replaces the whole dict
+    _lambda_bindings: dict = {}
+
+    def _an_Lambda(self, a: T.Lambda):
+        raise AnalysisError(
+            "a lambda is only valid as an argument of "
+            "transform/reduce/any_match/all_match/none_match/zip_with")
+
+    def _bind_lambda(self, lam: T.Lambda,
+                     values: List[RowExpression]) -> RowExpression:
+        """Analyze a lambda body with its parameters bound to concrete
+        element expressions — lambdas lower by SUBSTITUTION at
+        analysis time (reference: LambdaBytecodeGenerator compiles a
+        method per lambda; our fixed-width arrays make inlining per
+        element slot the natural form)."""
+        if len(lam.params) != len(values):
+            raise AnalysisError(
+                f"lambda takes {len(lam.params)} parameters, "
+                f"{len(values)} given")
+        old = self._lambda_bindings
+        self._lambda_bindings = {**old,
+                                 **dict(zip(lam.params, values))}
+        try:
+            return self.analyze(lam.body)
+        finally:
+            self._lambda_bindings = old
+
     def _an_FunctionCall(self, a: T.FunctionCall):
         name = a.name
         if name in AGG_FUNCTIONS and a.window is None:
@@ -2567,11 +2596,135 @@ class _Analyzer:
         if a.window is not None:
             raise AnalysisError("window functions not yet supported "
                                 "in this position")
+        if any(isinstance(x, T.Lambda) for x in a.args):
+            return self._resolve_lambda_fn(name, a.args)
         args = [self.analyze(x) for x in a.args]
         arr = self._resolve_array_fn(name, args)
         if arr is not None:
             return arr
         return self._resolve_scalar(name, args)
+
+    def _resolve_lambda_fn(self, name: str, raw_args):
+        """Lambda-taking array functions (reference: operator/scalar/
+        ArrayTransformFunction, ReduceFunction, ArrayAnyMatchFunction,
+        ZipWithFunction), lowered to scalar IR over the fixed-width
+        elements with the usual (i <= length) padding guards."""
+        from presto_tpu.expr.ir import ArrayValue, and_, or_
+        from presto_tpu.types import array_type
+
+        def arr_arg(i):
+            v = self.analyze(raw_args[i])
+            if not isinstance(v, ArrayValue):
+                raise AnalysisError(
+                    f"{name}: argument {i + 1} must be an array")
+            return v
+
+        def lam_arg(i, nparams):
+            lam = raw_args[i]
+            if not isinstance(lam, T.Lambda) \
+                    or len(lam.params) != nparams:
+                raise AnalysisError(
+                    f"{name}: argument {i + 1} must be a "
+                    f"{nparams}-parameter lambda")
+            return lam
+
+        if name == "transform":
+            if len(raw_args) != 2:
+                raise AnalysisError("transform(array, x -> f(x))")
+            arr = arr_arg(0)
+            lam = lam_arg(1, 1)
+            elems = [self._bind_lambda(lam, [e])
+                     for e in arr.elements]
+            t0 = elems[0].type
+            elems = tuple(_coerce_to(e, t0) for e in elems)
+            return ArrayValue(elems, arr.length, array_type(t0))
+
+        if name == "reduce":
+            if len(raw_args) not in (3, 4):
+                raise AnalysisError(
+                    "reduce(array, init, (acc, x) -> f, "
+                    "[acc -> final])")
+            arr = arr_arg(0)
+            acc = self.analyze(raw_args[1])
+            comb = lam_arg(2, 2)
+            first = self._bind_lambda(comb, [acc, arr.elements[0]])
+            state_t = first.type
+            acc = _coerce_to(acc, state_t)
+            for i, e in enumerate(arr.elements, 1):
+                step = _coerce_to(
+                    self._bind_lambda(comb, [acc, e]), state_t)
+                g = self._array_guard(arr, i)
+                acc = step if g is None else \
+                    SpecialForm("if", (g, step, acc), state_t)
+            if len(raw_args) == 4:
+                acc = self._bind_lambda(lam_arg(3, 1), [acc])
+            return acc
+
+        if name in ("any_match", "all_match", "none_match"):
+            if len(raw_args) != 2:
+                raise AnalysisError(f"{name}(array, x -> pred)")
+            arr = arr_arg(0)
+            lam = lam_arg(1, 1)
+            terms = []
+            for i, e in enumerate(arr.elements, 1):
+                p = _coerce_to(self._bind_lambda(lam, [e]), BOOLEAN)
+                g = self._array_guard(arr, i)
+                if name == "all_match":
+                    # padding slots must not fail the conjunction:
+                    # (NOT in-array) OR pred
+                    terms.append(p if g is None else or_(
+                        SpecialForm("not", (g,), BOOLEAN), p))
+                else:
+                    terms.append(p if g is None else and_(g, p))
+            if name == "all_match":
+                out = and_(*terms) if len(terms) > 1 else terms[0]
+            else:
+                out = or_(*terms) if len(terms) > 1 else terms[0]
+            if name == "none_match":
+                out = SpecialForm("not", (out,), BOOLEAN)
+            return out
+
+        if name == "zip_with":
+            if len(raw_args) != 3:
+                raise AnalysisError(
+                    "zip_with(array, array, (x, y) -> f)")
+            a1, a2 = arr_arg(0), arr_arg(1)
+            lam = lam_arg(2, 2)
+            w = max(len(a1.elements), len(a2.elements))
+
+            def slot(arr, i):
+                """Element i (1-based) or typed NULL (Presto pads the
+                shorter array with NULLs)."""
+                et = arr.type.element
+                if i <= len(arr.elements):
+                    e = arr.elements[i - 1]
+                    g = self._array_guard(arr, i)
+                    if g is None:
+                        return e
+                    return SpecialForm(
+                        "if", (g, e, Literal(None, et)), e.type)
+                return Literal(None, et)
+            elems = [self._bind_lambda(lam, [slot(a1, i), slot(a2, i)])
+                     for i in range(1, w + 1)]
+            t0 = elems[0].type
+            elems = tuple(_coerce_to(e, t0) for e in elems)
+            l1 = a1.length if a1.length is not None \
+                else Literal(len(a1.elements), BIGINT)
+            l2 = a2.length if a2.length is not None \
+                else Literal(len(a2.elements), BIGINT)
+            length = None
+            if a1.length is not None or a2.length is not None \
+                    or len(a1.elements) != len(a2.elements):
+                length = Call("greatest", (l1, l2), BIGINT)
+            return ArrayValue(elems, length, array_type(t0))
+
+        if name == "filter":
+            raise AnalysisError(
+                "filter(array, lambda) is not supported: fixed-width "
+                "array values cannot compact at analysis time — use "
+                "transform with a conditional, or UNNEST + WHERE")
+        raise AnalysisError(
+            f"{name} does not take lambda arguments")
 
     def _resolve_array_fn(self, name: str, args):
         """Array functions lower to scalar IR over the fixed-width

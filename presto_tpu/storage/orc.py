@@ -359,16 +359,28 @@ def _col_stats(cs: Dict[int, list], kind: int):
 
 
 def read_footer(path: str) -> OrcInfo:
+    import os
+    size = os.path.getsize(path)
     with open(path, "rb") as f:
-        data = f.read()
-    if not data.startswith(MAGIC):
-        raise OrcError("not an ORC file")
-    ps_len = data[-1]
-    ps = _pb(data[-1 - ps_len:-1])
-    footer_len = _one(ps, 1, 0)
-    compression = _one(ps, 2, COMP_NONE)
-    metadata_len = _one(ps, 5, 0)
-    magic = _one(ps, 8000)
+        # tail-read only: postscript length byte, then postscript,
+        # footer and metadata — never the whole file (multi-GB tables;
+        # same discipline as the parquet reader's footer seek)
+        tail_guess = min(size, 1 << 18)
+        f.seek(size - tail_guess)
+        data = f.read(tail_guess)
+        ps_len = data[-1]
+        ps = _pb(data[-1 - ps_len:-1])
+        footer_len = _one(ps, 1, 0)
+        compression = _one(ps, 2, COMP_NONE)
+        metadata_len = _one(ps, 5, 0)
+        need = 1 + ps_len + footer_len + metadata_len
+        if need > len(data):
+            f.seek(size - need)
+            data = f.read(need)
+        if size >= 3:
+            f.seek(0)
+            if f.read(3) != MAGIC:
+                raise OrcError("not an ORC file")
     footer_raw = data[-1 - ps_len - footer_len:-1 - ps_len]
     footer = _pb(_decompress(footer_raw, compression))
 
@@ -389,8 +401,8 @@ def read_footer(path: str) -> OrcInfo:
         columns.append(OrcColumn(name, kind, sub))
 
     # per-stripe statistics from the metadata section
-    meta_raw = data[-1 - ps_len - footer_len - metadata_len:
-                    -1 - ps_len - footer_len]
+    meta_raw = data[len(data) - 1 - ps_len - footer_len - metadata_len:
+                    len(data) - 1 - ps_len - footer_len]
     stripe_stats: List[Dict[int, Tuple[Any, Any]]] = []
     if metadata_len:
         meta = _pb(_decompress(meta_raw, compression))
@@ -429,38 +441,41 @@ def read_stripe_column(path: str, info: OrcInfo, stripe: StripeInfo,
     if col is None:
         raise OrcError(f"no such column {name}")
     with open(path, "rb") as f:
-        f.seek(stripe.offset)
-        raw = f.read(stripe.index_length + stripe.data_length
-                     + stripe.footer_length)
-    sfooter = _pb(_decompress(raw[stripe.index_length
-                                  + stripe.data_length:],
-                              info.compression))
-    streams = [_pb(s) for s in sfooter.get(1, [])]
-    encodings = [_pb(e) for e in sfooter.get(2, [])]
-    enc = _one(encodings[col.column_id], 1, E_DIRECT) \
-        if col.column_id < len(encodings) else E_DIRECT
-    dict_size = _one(encodings[col.column_id], 2, 0) \
-        if col.column_id < len(encodings) else 0
-    if enc in (E_DIRECT, E_DICTIONARY) and col.kind not in (
-            K_FLOAT, K_DOUBLE, K_BOOLEAN, K_BYTE, K_BINARY):
-        raise OrcError("RLE v1 files are not supported")
+        # read only the stripe FOOTER, then seek to just this
+        # column's streams — reading the whole stripe would multiply
+        # stripe I/O by the column count
+        f.seek(stripe.offset + stripe.index_length
+               + stripe.data_length)
+        sfooter = _pb(_decompress(f.read(stripe.footer_length),
+                                  info.compression))
+        streams = [_pb(s) for s in sfooter.get(1, [])]
+        encodings = [_pb(e) for e in sfooter.get(2, [])]
+        enc = _one(encodings[col.column_id], 1, E_DIRECT) \
+            if col.column_id < len(encodings) else E_DIRECT
+        dict_size = _one(encodings[col.column_id], 2, 0) \
+            if col.column_id < len(encodings) else 0
+        if enc in (E_DIRECT, E_DICTIONARY) and col.kind not in (
+                K_FLOAT, K_DOUBLE, K_BOOLEAN, K_BYTE):
+            # integer/string/binary DIRECT here means RLE v1 framing
+            raise OrcError("RLE v1 files are not supported")
 
-    # locate this column's streams inside the data region
-    off = stripe.index_length
-    pieces: Dict[int, bytes] = {}
-    for s in streams:
-        skind = _one(s, 1, 0)
-        scol = _one(s, 2, 0)
-        ln = _one(s, 3, 0)
-        if skind >= S_ROW_INDEX:
-            # ROW_INDEX (6) and the bloom-filter kinds (7, 8) live in
-            # the INDEX region before the data region — they must not
-            # advance the data offset
-            continue
-        if scol == col.column_id:
-            pieces[skind] = _decompress(raw[off:off + ln],
-                                        info.compression)
-        off += ln
+        # locate this column's streams inside the data region
+        off = stripe.index_length
+        pieces: Dict[int, bytes] = {}
+        for s in streams:
+            skind = _one(s, 1, 0)
+            scol = _one(s, 2, 0)
+            ln = _one(s, 3, 0)
+            if skind >= S_ROW_INDEX:
+                # ROW_INDEX (6) and the bloom-filter kinds (7, 8)
+                # live in the INDEX region before the data region —
+                # they must not advance the data offset
+                continue
+            if scol == col.column_id:
+                f.seek(stripe.offset + off)
+                pieces[skind] = _decompress(f.read(ln),
+                                            info.compression)
+            off += ln
 
     n = stripe.num_rows
     present = None

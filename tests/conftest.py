@@ -38,3 +38,20 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+# XLA:CPU segfaults once a process accumulates enough live compiled
+# executables (the full suite crosses the threshold; the mesh battery
+# hits it in isolation too — see test_mesh_tpch). Dropping compiled
+# programs BETWEEN MODULES keeps the live-executable count bounded at
+# the cost of some recompiles; in-module caching still applies.
+_last_module = [None]
+
+
+@pytest.fixture(autouse=True)
+def _clear_xla_caches_between_modules(request):
+    mod = request.module.__name__
+    if _last_module[0] is not None and _last_module[0] != mod:
+        jax.clear_caches()
+    _last_module[0] = mod
+    yield

@@ -1,0 +1,111 @@
+"""Lambda functions over fixed-width arrays (reference:
+sql/gen/LambdaBytecodeGenerator + operator/scalar ArrayTransform/
+Reduce/AnyMatch/ZipWith functions). Lambdas lower by SUBSTITUTION at
+analysis time: each element slot inlines the body with the parameter
+bound to that slot's expression, padding slots guarded by
+(i <= length)."""
+
+import pytest
+
+from test_tpch_suite import runner  # noqa: F401 (fixture)
+
+
+CASES = {
+    "transform": (
+        "select element_at(transform(array[1, 2, 3], x -> x * 10), 2)",
+        [(20,)]),
+    "transform_nested": (
+        "select element_at(transform(array[1, 2], "
+        "x -> x + cardinality(array[7, 8, 9])), 1)",
+        [(4,)]),
+    "transform_null_element": (
+        "select element_at(transform(array[1, null, 3], "
+        "x -> x + 1), 2)",
+        [(None,)]),
+    "reduce_sum": (
+        "select reduce(array[1, 2, 3, 4], 0, (s, x) -> s + x)",
+        [(10,)]),
+    "reduce_final": (
+        "select reduce(array[1.5, 2.5], 0, (s, x) -> s + x, "
+        "s -> s / 2)",
+        [(2.0,)]),
+    "reduce_over_split": (
+        "select reduce(split('a,bb,ccc', ','), 0, "
+        "(s, x) -> s + length(x))",
+        [(6,)]),
+    "reduce_min": (
+        "select reduce(array[5, 2, 9], 1000, "
+        "(s, x) -> if(x < s, x, s))",
+        [(2,)]),
+    "any_all_none": (
+        "select any_match(array[1, 2, 3], x -> x > 2), "
+        "all_match(array[1, 2, 3], x -> x > 0), "
+        "none_match(array[1, 2, 3], x -> x > 5)",
+        [(True, True, True)]),
+    "any_match_null_semantics": (
+        # no true, one null -> NULL (Kleene OR)
+        "select any_match(array[1, null], x -> x > 5)",
+        [(None,)]),
+    "all_match_null_semantics": (
+        # no false, one null -> NULL (Kleene AND)
+        "select all_match(array[1, null], x -> x > 0)",
+        [(None,)]),
+    "match_over_split": (
+        "select any_match(split('a,bb,ccc', ','), "
+        "x -> length(x) = 3), "
+        "all_match(split('a,bb', ','), x -> length(x) <= 2)",
+        [(True, True)]),
+    "zip_with": (
+        "select element_at(zip_with(array[1, 2], array[10, 20, 30], "
+        "(a, b) -> coalesce(a, 0) + b), 3)",
+        [(30,)]),
+    "zip_with_equal": (
+        "select reduce(zip_with(array[1, 2], array[3, 4], "
+        "(a, b) -> a * b), 0, (s, x) -> s + x)",
+        [(11,)]),
+    "lambda_over_column": (
+        "select sum(reduce(split(mktsegment, 'U'), 0, "
+        "(s, x) -> s + length(x))) from customer",
+        None),  # checked against a non-lambda formulation below
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_lambda(name, runner):  # noqa: F811
+    sql, expected = CASES[name]
+    got = runner.execute(sql).rows()
+    if expected is None:
+        # split removes the delimiter; summed part lengths equal
+        # total length minus the delimiters removed
+        want = runner.execute(
+            "select sum(length(replace(mktsegment, 'U', ''))) "
+            "from customer").rows()
+        assert got == want
+    else:
+        assert got == expected, (sql, got)
+
+
+def test_wide_reduce_is_linear(runner):  # noqa: F811
+    """A 26-wide reduce's IR references the accumulator twice per
+    step (a DAG): folding, walking, compiling and CACHE-KEYING must
+    all be linear via node-identity memoization — a by-value
+    hash/compare would take 2^26 steps."""
+    import time
+    s = ",".join(list("abcdefghijklmnopqrstuvwxyz"))
+    t0 = time.time()
+    got = runner.execute(
+        f"select reduce(split('{s}', ','), 0, "
+        "(s, x) -> s + length(x))").rows()
+    assert got == [(26,)]
+    assert time.time() - t0 < 30, "reduce must not be exponential"
+
+
+def test_lambda_errors(runner):  # noqa: F811
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises(QueryError, match="filter.*not supported"):
+        runner.execute(
+            "select cardinality(filter(array[1, 2], x -> x > 1))")
+    with pytest.raises(QueryError, match="only valid as an argument"):
+        runner.execute("select (x -> x + 1)")
+    with pytest.raises(QueryError, match="2-parameter"):
+        runner.execute("select reduce(array[1], 0, x -> x)")
