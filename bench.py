@@ -55,7 +55,12 @@ import traceback
 SCHEMA = "sf1"          # 6,001,215 lineitem rows at SF1 scaling
 BATCH_ROWS = 1 << 20
 METRIC = f"tpch_q1_{SCHEMA}_rows_per_sec"
-CHILD_TIMEOUT_S = 3000
+#: per-QUERY child timeout: each query runs in its own subprocess so
+#: one wedged tunnel RPC cannot take the rest of the suite with it
+#: (the r4 native capture lost Q3-Q18 to exactly that)
+QUERY_TIMEOUT_S = 700
+#: total wall budget across all children + fallbacks
+TOTAL_BUDGET_S = 5000
 WARM_RUNS = 2
 
 #: per-query single-node Java estimates (input rows/sec) — the
@@ -122,17 +127,25 @@ def _scanned_rows(gen):
 
 
 def _child_main() -> int:
-    """Run the suite in this process, one JSON line per query (the
-    parent aggregates them into the single driver line). A query that
-    fails is reported and skipped — later queries still run."""
+    """Run the selected queries in this process, one JSON line per
+    query (the parent aggregates them into the single driver line).
+    A query that fails is reported and skipped — later queries still
+    run. PRESTO_TPU_BENCH_QUERIES selects a subset (the parent runs
+    one query per child so a wedged tunnel RPC only costs that
+    query)."""
     from presto_tpu.runner import LocalRunner
 
     runner = LocalRunner("tpch", SCHEMA)
     runner.session.properties["batch_rows"] = BATCH_ROWS
     rows_of = _scanned_rows(runner.catalogs.connector("tpch")._gens[SCHEMA])
 
+    subset = os.environ.get("PRESTO_TPU_BENCH_QUERIES")
+    queries = _queries()
+    if subset:
+        queries = {q: queries[q] for q in subset.split(",")
+                   if q in queries}
     ok = True
-    for name, sql in _queries().items():
+    for name, sql in queries.items():
         try:
             t0 = time.perf_counter()
             result = runner.execute(sql)  # warmup: compile + first run
@@ -186,68 +199,107 @@ def _combine(per_query: dict, platform: str) -> dict:
     return line
 
 
+def _probe(name: str, env: dict) -> bool:
+    """Cheap backend probe: a wedged TPU tunnel hangs inside native
+    plugin discovery; bound that to 300s instead of a query timeout."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp, numpy as np; "
+             "print(np.asarray(jnp.arange(4).sum())); "
+             "print(jax.default_backend())"],
+            env=env, timeout=300, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"backend probe for {name} hung (300s); skipping",
+              file=sys.stderr)
+        return False
+    if probe.returncode != 0:
+        print(f"backend probe for {name} failed:\n"
+              f"{probe.stderr[-1500:]}", file=sys.stderr)
+        return False
+    print(f"{name} backend: "
+          f"{probe.stdout.strip().splitlines()[-1]}", file=sys.stderr)
+    return True
+
+
+def _run_one(qname: str, env: dict, timeout_s: float):
+    """One query in its own child; returns its result dict or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**env, "PRESTO_TPU_BENCH_QUERIES": qname},
+            timeout=timeout_s, capture_output=True, text=True)
+        out, rc = proc.stdout, proc.returncode
+        sys.stderr.write(proc.stderr[-2500:])
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        rc = -1
+        print(f"{qname} child timed out after {timeout_s:.0f}s",
+              file=sys.stderr)
+    for ln in out.splitlines():
+        if ln.startswith("{"):
+            try:
+                r = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if r.get("q") == qname:
+                return r
+    if rc not in (0, -1):
+        print(f"{qname} child failed rc={rc}", file=sys.stderr)
+    return None
+
+
 def main() -> int:
     if os.environ.get("PRESTO_TPU_BENCH_CHILD") == "1":
         return _child_main()
 
+    deadline = time.time() + TOTAL_BUDGET_S
     attempts = [
         ("native", {}),
         # the axon plugin sitecustomize (PYTHONPATH) can hang discovery
         # even when cpu is selected — clear it for the fallback child
         ("cpu_fallback", {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}),
     ]
+    envs = {}
     for name, env_mod in attempts:
-        env = {**os.environ, **env_mod, "PRESTO_TPU_BENCH_CHILD": "1"}
-        print(f"bench attempt: {name}", file=sys.stderr)
-        # cheap probe child first: a wedged TPU tunnel hangs inside
-        # native plugin discovery; bound that to 300s instead of a full
-        # bench timeout
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax, jax.numpy as jnp, numpy as np; "
-                 "print(np.asarray(jnp.arange(4).sum())); "
-                 "print(jax.default_backend())"],
-                env=env, timeout=300, capture_output=True, text=True)
-        except subprocess.TimeoutExpired:
-            print(f"backend probe for {name} hung (300s); skipping",
-                  file=sys.stderr)
-            continue
-        if probe.returncode != 0:
-            print(f"backend probe for {name} failed:\n"
-                  f"{probe.stderr[-1500:]}", file=sys.stderr)
-            continue
-        print(f"backend: {probe.stdout.strip().splitlines()[-1]}",
-              file=sys.stderr)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                timeout=CHILD_TIMEOUT_S, capture_output=True, text=True)
-            out = proc.stdout
-            rc = proc.returncode
-        except subprocess.TimeoutExpired as e:
-            # salvage finished queries from the partial output
-            out = (e.stdout or b"").decode() \
-                if isinstance(e.stdout, bytes) else (e.stdout or "")
-            rc = -1
-            print(f"bench attempt {name} timed out after "
-                  f"{CHILD_TIMEOUT_S}s", file=sys.stderr)
-        if rc != -1:
-            sys.stderr.write(proc.stderr[-4000:])
-        per_query = {}
-        for ln in out.splitlines():
-            if ln.startswith("{"):
-                try:
-                    r = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue
-                if "q" in r:
-                    per_query[r["q"]] = r
-        if per_query:
-            print(json.dumps(_combine(per_query, name)))
-            return 0
-        print(f"bench attempt {name} produced no results "
-              f"(rc={rc})", file=sys.stderr)
+        envs[name] = {**os.environ, **env_mod,
+                      "PRESTO_TPU_BENCH_CHILD": "1"}
+    alive = {name: None for name, _ in attempts}  # None = unprobed
+
+    per_query = {}
+    platforms = {}
+    for qname in _queries():
+        for name, _ in attempts:
+            left = deadline - time.time()
+            if left < 120:
+                break
+            if alive[name] is None:
+                alive[name] = _probe(name, envs[name])
+            if not alive[name]:
+                continue
+            r = _run_one(qname, envs[name],
+                         min(QUERY_TIMEOUT_S, left))
+            if r is not None:
+                per_query[qname] = r
+                platforms[qname] = name
+                break
+            if name == "native":
+                # a wedge mid-query usually means the tunnel needs a
+                # re-probe before the next native attempt
+                alive[name] = None
+        if deadline - time.time() < 120:
+            print("bench wall budget exhausted", file=sys.stderr)
+            break
+
+    if per_query:
+        plats = set(platforms.values())
+        platform = plats.pop() if len(plats) == 1 else "mixed"
+        line = _combine(per_query, platform)
+        if platform == "mixed":
+            line["platform_by_query"] = platforms
+        print(json.dumps(line))
+        return 0
     print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "rows/s",
                       "vs_baseline": 0.0,
                       "error": "all bench attempts failed or timed out"}))

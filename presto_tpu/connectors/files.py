@@ -458,6 +458,22 @@ class _FilePageSink(ConnectorPageSink):
             self._cat.evict(old_path)
         self._cat.evict(path)
 
+    def abort(self, handle: TableHandle) -> None:
+        """Drop uncommitted appends AND the staged base rows of an
+        INSERT rewrite (the retry re-stages them); a CTAS's created
+        marker keeps its (schema, []) entry so retried appends do not
+        fall into the INSERT-rewrite branch against a file that does
+        not exist yet."""
+        key = (handle.schema, handle.table)
+        self._base.pop(key, None)
+        if key in self._pending:
+            schema, _ = self._pending[key]
+            self._pending[key] = (schema, [])
+            if os.path.exists(self._cat.path(handle)):
+                # an existing table's INSERT staging resets wholesale:
+                # the retry's first append re-stages base rows
+                del self._pending[key]
+
     def drop_table(self, handle: TableHandle) -> None:
         path = self._cat.path(handle)
         try:

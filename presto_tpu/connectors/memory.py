@@ -130,6 +130,11 @@ class _MemoryPageSink(ConnectorPageSink):
             t.batches.append(b)
             t.row_count += b.num_valid()
 
+    def abort(self, handle: TableHandle) -> None:
+        # the created table (schema registration) survives; only the
+        # uncommitted appends drop
+        self._pending.pop((handle.schema, handle.table), None)
+
     def drop_table(self, handle: TableHandle) -> None:
         self._pending.pop((handle.schema, handle.table), None)
         del self._tables[(handle.schema, handle.table)]

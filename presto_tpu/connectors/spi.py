@@ -134,7 +134,16 @@ class ConnectorPageSource(abc.ABC):
 class ConnectorPageSink(abc.ABC):
     """Accepts written batches for one table (reference:
     spi ConnectorPageSink + ConnectorPageSinkProvider; commit protocol
-    collapsed to create/append/finish for in-process connectors)."""
+    collapsed to create/append/finish for in-process connectors).
+    `abort` drops UNCOMMITTED appends (a write-query retry must not
+    duplicate rows — the reference's ConnectorPageSink.abort)."""
+
+    def abort(self, handle: "TableHandle") -> None:
+        """Drop buffered UNCOMMITTED appends for the table, keeping
+        any created-table marker so a retried write can append again.
+        Default no-op suits sinks that do not buffer; every buffering
+        sink must override (a missing override would let a write
+        retry duplicate rows)."""
 
     @abc.abstractmethod
     def create_table(self, handle: TableHandle,

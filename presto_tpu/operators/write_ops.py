@@ -1,0 +1,153 @@
+"""Table write operators (reference: operator/TableWriterOperator.java
++ operator/TableFinishOperator.java).
+
+A distributed write runs one TableWriterOperator per task — each
+appends its shard to the connector sink in parallel — and ONE
+TableFinishOperator at the root, which commits (sink.finish) only
+after every writer's count row arrived. The sink protocol stays
+create/append/finish; parallel writers interleave appends and the
+finish point is the transactional commit (the file connector's
+write-then-rename, the memory connector's table swap)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+from presto_tpu.types import BIGINT
+
+
+class TableWriterOperator(Operator):
+    def __init__(self, ctx: OperatorContext, sink, handle,
+                 column_sources: Dict[str, Optional[str]],
+                 schema_cols: Sequence[tuple], out_symbol: str):
+        super().__init__(ctx)
+        self.sink = sink
+        self.handle = handle
+        self.column_sources = column_sources
+        self.schema_cols = schema_cols
+        self.out_symbol = out_symbol
+        self._rows = None  # device-accumulated written-row count
+        self._finishing = False
+        self._emitted = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        cols = {}
+        for name, typ, dic in self.schema_cols:
+            src = self.column_sources.get(name)
+            if src is not None:
+                cols[name] = batch.columns[src]
+            else:  # unspecified target column -> NULLs
+                cols[name] = Column(
+                    jnp.zeros(batch.capacity, typ.np_dtype),
+                    jnp.zeros(batch.capacity, bool), typ,
+                    () if typ.is_string else None)
+        self.sink.append(self.handle, Batch(cols, batch.row_valid))
+        n = jnp.sum(batch.row_valid)
+        self._rows = n if self._rows is None else self._rows + n
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        from presto_tpu.batch import MIN_CAPACITY
+        cap = MIN_CAPACITY
+        n = self._rows if self._rows is not None \
+            else jnp.asarray(0, jnp.int64)
+        data = jnp.zeros(cap, jnp.int64).at[0].set(
+            n.astype(jnp.int64))
+        rv = jnp.zeros(cap, bool).at[0].set(True)
+        out = Batch({self.out_symbol: Column(data, rv, BIGINT)}, rv)
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class TableFinishOperator(Operator):
+    """Sums writer count rows into the statement's result. The COMMIT
+    itself happens in the runner AFTER the drive loop's deferred
+    overflow checks pass (LocalRunner._run_write): a deferred
+    JoinCapacityExceeded fires only once all drivers finished, and a
+    commit inside this operator would land before it — the retry
+    would then duplicate already-committed rows (reference analog:
+    TableFinishOperator runs inside the transaction; the commit is the
+    statement completing)."""
+
+    def __init__(self, ctx: OperatorContext, sink, handle,
+                 count_symbol: str, out_symbol: str):
+        super().__init__(ctx)
+        self.sink = sink
+        self.handle = handle
+        self.count_symbol = count_symbol
+        self.out_symbol = out_symbol
+        self._rows = None
+        self._finishing = False
+        self._emitted = False
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        c = batch.columns[self.count_symbol]
+        n = jnp.sum(jnp.where(batch.row_valid & c.mask, c.data, 0))
+        self._rows = n if self._rows is None else self._rows + n
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        from presto_tpu.batch import MIN_CAPACITY
+        cap = MIN_CAPACITY
+        n = self._rows if self._rows is not None \
+            else jnp.asarray(0, jnp.int64)
+        data = jnp.zeros(cap, jnp.int64).at[0].set(
+            n.astype(jnp.int64))
+        rv = jnp.zeros(cap, bool).at[0].set(True)
+        out = Batch({self.out_symbol: Column(data, rv, BIGINT)}, rv)
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class TableWriterOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, sink, handle, column_sources,
+                 schema_cols, out_symbol: str):
+        super().__init__(operator_id, "table_writer")
+        self.args = (sink, handle, dict(column_sources),
+                     list(schema_cols), out_symbol)
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return TableWriterOperator(
+            OperatorContext(self.operator_id, self.name,
+                            driver_context), *self.args)
+
+
+class TableFinishOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, sink, handle,
+                 count_symbol: str, out_symbol: str):
+        super().__init__(operator_id, "table_finish")
+        self.args = (sink, handle, count_symbol, out_symbol)
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return TableFinishOperator(
+            OperatorContext(self.operator_id, self.name,
+                            driver_context), *self.args)

@@ -31,6 +31,7 @@ RemoteSourceNodes; the MeshRunner maps each fragment onto mesh tasks
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.expr.ir import InputRef
@@ -146,6 +147,36 @@ class _Exchanger:
         return node, SOURCE
 
     def _rw_ValuesNode(self, node):
+        return node, SINGLE
+
+    #: target rows per writer task for the scaled-writer exchange
+    #: (reference: ScaledWriterScheduler's per-writer throughput goal,
+    #: made static from stats — writers are sized by estimated data
+    #: volume instead of growing dynamically)
+    ROWS_PER_WRITER = 1 << 18
+
+    def _rw_TableWriterNode(self, node):
+        src, props = self._rw(node.source)
+        if props.kind == P_SINGLE:
+            node.source = src
+            return node, SINGLE
+        # scaled writers: a round-robin exchange whose consumer
+        # fragment runs ceil(rows / ROWS_PER_WRITER) tasks (>= 1),
+        # capped by the mesh width at runtime
+        est = self._est(src)
+        writers = None
+        from presto_tpu.planner.stats import UNKNOWN_ROWS
+        if est < UNKNOWN_ROWS * 0.99:
+            writers = max(1, int(math.ceil(est
+                                           / self.ROWS_PER_WRITER)))
+        ex = self._exchange(src, "repartition")
+        ex.consumer_max_tasks = writers
+        node.source = ex
+        return node, Props(P_SOURCE)
+
+    def _rw_TableFinishNode(self, node):
+        src, props = self._rw(node.source)
+        node.source = self._to_single(src, props)
         return node, SINGLE
 
     def _rw_SortNode(self, node):
@@ -476,6 +507,8 @@ class Fragment:
     root: N.PlanNode
     partitioning: str            # "single" | "distributed"
     source_edges: List[int]      # exchange ids feeding this fragment
+    #: scaled-writer cap on this fragment's task count (None = width)
+    max_tasks: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -690,7 +723,8 @@ class _Fragmenter:
         fid = self._next_fragment
         self._next_fragment += 1
         info = {"has_scan": False, "gather_in": False,
-                "source_edges": [], "passthrough_producers": []}
+                "source_edges": [], "passthrough_producers": [],
+                "max_tasks": None}
         new_root = self._cut(root, fid, info)
         if info["gather_in"]:
             assert not info["has_scan"], \
@@ -709,7 +743,8 @@ class _Fragmenter:
         else:
             part = "single"  # values / constants only
         self.fragments[fid] = Fragment(fid, new_root, part,
-                                       info["source_edges"])
+                                       info["source_edges"],
+                                       max_tasks=info["max_tasks"])
         return fid
 
     def _cut(self, node: N.PlanNode, fid: int, info) -> N.PlanNode:
@@ -727,6 +762,10 @@ class _Fragmenter:
                 tuple(node.output))
             self.edges[xid] = edge
             info["source_edges"].append(xid)
+            if node.consumer_max_tasks is not None:
+                m = info["max_tasks"]
+                info["max_tasks"] = node.consumer_max_tasks if m is None \
+                    else min(m, node.consumer_max_tasks)
             if node.scheme == "gather":
                 info["gather_in"] = True
             if node.scheme == "passthrough":

@@ -399,6 +399,32 @@ class LocalExecutionPlanner:
             else:
                 return False
 
+    def _estimated_expansion(self, node: N.JoinNode, probe) -> int:
+        """Estimated join output rows per probe row, rounded UP to a
+        power of two and capped (overshooting inflates every output
+        shape; a real underestimate still trips the on-device overflow
+        retry). 1 when stats are unknowable — the FK->PK common case
+        (reference analog: the row-count estimates behind
+        DetermineJoinDistributionType)."""
+        try:
+            from presto_tpu.planner.stats import (
+                StatsEstimator, UNKNOWN_ROWS,
+            )
+            est = StatsEstimator(self.catalogs)
+            out_rows = est.estimate(node).rows
+            probe_rows = est.estimate(probe).rows
+        except Exception:
+            return 1
+        if out_rows >= UNKNOWN_ROWS * 0.99 \
+                or probe_rows >= UNKNOWN_ROWS * 0.99 \
+                or probe_rows <= 0:
+            return 1
+        ratio = out_rows / probe_rows
+        factor = 1
+        while factor < ratio and factor < 16:
+            factor *= 2
+        return factor
+
     def _estimated_groups(self, node: N.AggregationNode):
         """Estimated distinct groups, or None when unknowable."""
         try:
@@ -458,6 +484,17 @@ class LocalExecutionPlanner:
                 df_publish=df_publish))
             self._pipelines.append(build_pipe)
             self._visit(probe, pipe)
+            # stats-seeded output capacity: a many-to-many join whose
+            # estimated expansion exceeds the session factor starts
+            # with a big-enough capacity instead of paying whole-query
+            # x4 retries (the overflow protocol still catches real
+            # underestimates). NEVER below the session value — the
+            # retry protocol bumps it, and clamping under it would
+            # livelock the retry.
+            factor = max(
+                int(get_property(self.session.properties,
+                                 "join_expansion_factor")),
+                self._estimated_expansion(node, probe))
             pipe.append(LookupJoinOperatorFactory(
                 self._next_id(), bridge,
                 [l for l, _ in criteria], jt,
@@ -465,8 +502,7 @@ class LocalExecutionPlanner:
                 build_output=[f.symbol for f in build.output],
                 build_keys=[r for _, r in criteria],
                 key_dicts=key_dicts,
-                expansion_factor=int(get_property(
-                    self.session.properties, "join_expansion_factor")),
+                expansion_factor=factor,
                 probe_schema=[(f.symbol, f.type, f.dictionary)
                               for f in probe.output]
                 if jt == "full" else None))
@@ -609,6 +645,29 @@ class LocalExecutionPlanner:
         pipe.append(OrderByOperatorFactory(
             self._next_id(), node.keys, node.descending,
             node.nulls_first))
+
+    def _visit_TableWriterNode(self, node: N.TableWriterNode,
+                               pipe: List):
+        from presto_tpu.operators.write_ops import (
+            TableWriterOperatorFactory,
+        )
+        self._visit(node.source, pipe)
+        conn = self.catalogs.connector(node.handle.catalog)
+        pipe.append(TableWriterOperatorFactory(
+            self._next_id(), conn.page_sink, node.handle,
+            node.column_sources, node.schema_cols,
+            node.output[0].symbol))
+
+    def _visit_TableFinishNode(self, node: N.TableFinishNode,
+                               pipe: List):
+        from presto_tpu.operators.write_ops import (
+            TableFinishOperatorFactory,
+        )
+        self._visit(node.source, pipe)
+        conn = self.catalogs.connector(node.handle.catalog)
+        pipe.append(TableFinishOperatorFactory(
+            self._next_id(), conn.page_sink, node.handle,
+            node.source.output[0].symbol, node.output[0].symbol))
 
     def _visit_MergeNode(self, node: N.MergeNode, pipe: List):
         from presto_tpu.operators.sort_ops import MergeOperatorFactory
@@ -822,6 +881,13 @@ def _child_demand(node: N.PlanNode, demand: set
         child = set(demand)
         _refs(node.predicate, child)
         return [(node.source, child)]
+    if isinstance(node, N.TableWriterNode):
+        return [(node.source,
+                 {s for s in node.column_sources.values()
+                  if s is not None})]
+    if isinstance(node, N.TableFinishNode):
+        return [(node.source,
+                 {f.symbol for f in node.source.output})]
     if isinstance(node, N.ProjectNode):
         child: set = set()
         for s, e in node.assignments:

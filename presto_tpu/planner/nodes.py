@@ -362,6 +362,40 @@ class ExchangeNode(PlanNode):
     partition_keys: List[str]
     output: Tuple[Field, ...]
     hash_dicts: Optional[List[Optional[Tuple[str, ...]]]] = None
+    #: cap on the CONSUMER fragment's task count (the scaled-writer
+    #: exchange: writer fragments size by data volume, not mesh width)
+    consumer_max_tasks: Optional[int] = None
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class TableWriterNode(PlanNode):
+    """Writes its input to a connector sink, one writer per task
+    (reference: operator/TableWriterOperator.java + the scaled-writer
+    exchange in front of it); emits one row carrying this writer's
+    written-row count."""
+    source: PlanNode
+    handle: Any                       # connectors.spi.TableHandle
+    #: target column name -> source symbol (None = fill NULLs)
+    column_sources: Any
+    #: target schema columns [(name, type, dictionary)]
+    schema_cols: Any
+    output: Tuple[Field, ...]
+
+    def sources(self):
+        return (self.source,)
+
+
+@dataclasses.dataclass
+class TableFinishNode(PlanNode):
+    """Commits the write after all writers finished and sums their
+    row counts (reference: operator/TableFinishOperator.java — the
+    single commit point of a distributed write)."""
+    source: PlanNode
+    handle: Any
+    output: Tuple[Field, ...]
 
     def sources(self):
         return (self.source,)

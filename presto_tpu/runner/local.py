@@ -197,7 +197,11 @@ class LocalRunner:
         return plan_statement(stmt, self.catalogs, self.session)
 
     def _run_plan(self, plan: N.OutputNode,
-                  profile: bool = False) -> MaterializedResult:
+                  profile: bool = False,
+                  on_retry=None) -> MaterializedResult:
+        """`on_retry` fires before every overflow re-execution — write
+        plans use it to drop the sink's uncommitted appends so the
+        retry cannot duplicate rows."""
         from presto_tpu.execution.memory import MemoryPool
         from presto_tpu.operators.aggregation import GroupLimitExceeded
         from presto_tpu.operators.join_ops import JoinCapacityExceeded
@@ -230,6 +234,8 @@ class LocalRunner:
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "max_groups": e.suggested})
+                if on_retry is not None:
+                    on_retry()
                 continue
             except JoinCapacityExceeded as e:
                 # a join emitted more rows than probe capacity x factor
@@ -241,6 +247,8 @@ class LocalRunner:
                     session, properties={
                         **session.properties,
                         "join_expansion_factor": e.suggested})
+                if on_retry is not None:
+                    on_retry()
                 continue
             if profile:
                 # snapshot the stats TEXT now and drop the driver refs:
@@ -316,13 +324,43 @@ class LocalRunner:
                 f"catalog {handle.catalog!r} does not support writes")
         return sink
 
-    def _run_query_for_write(self, q: T.Query) -> MaterializedResult:
+    def _plan_for_write(self, q: T.Query) -> N.OutputNode:
         try:
             plan = plan_statement(q, self.catalogs, self.session)
         except AnalysisError as e:
             raise QueryError(str(e)) from e
         from presto_tpu.planner.optimizer import optimize
-        return self._run_plan(optimize(plan, self.catalogs))
+        return optimize(plan, self.catalogs)
+
+    def _run_write(self, qplan: N.OutputNode, handle, sink,
+                   schema, column_sources: Dict[str, Optional[str]]
+                   ) -> int:
+        """Wrap a SELECT plan with TableWriter -> TableFinish and run
+        it through the normal (possibly distributed) executor: one
+        writer per task appends in parallel (reference:
+        TableWriterOperator/TableFinishOperator + the scaled-writer
+        exchange AddExchanges inserts). The COMMIT happens HERE, only
+        after _run_plan returned — which is after the drive loop's
+        deferred overflow checks (a deferred JoinCapacityExceeded
+        surfaces once all drivers finish; committing any earlier would
+        let the retry duplicate committed rows). Overflow retries drop
+        uncommitted appends first (ConnectorPageSink.abort)."""
+        from presto_tpu.types import BIGINT
+        schema_cols = [(c.name, c.type, c.dictionary)
+                       for c in schema.columns]
+        wsym, fsym = "__write_rows__", "__commit_rows__"
+        writer = N.TableWriterNode(
+            qplan.source, handle, dict(column_sources), schema_cols,
+            (N.Field(wsym, BIGINT),))
+        finish = N.TableFinishNode(
+            writer, handle,
+            (N.Field(fsym, writer.output[0].type),))
+        out = N.OutputNode(finish, ["rows"], [fsym], finish.output)
+        result = self._run_plan(out,
+                                on_retry=lambda: sink.abort(handle))
+        n = int(result.rows()[0][0])
+        sink.finish(handle)  # THE commit point
+        return n
 
     def _create_table_as(self, stmt: T.CreateTableAs
                          ) -> MaterializedResult:
@@ -340,26 +378,23 @@ class LocalRunner:
                 return self._text_result("result",
                                          ["CREATE TABLE skipped"])
             raise QueryError(f"table {handle} already exists")
-        result = self._run_query_for_write(stmt.query)
-        if len(set(result.names)) != len(result.names):
+        qplan = self._plan_for_write(stmt.query)
+        if len(set(qplan.names)) != len(qplan.names):
             raise QueryError(
                 "CREATE TABLE AS query has duplicate column names; "
                 "alias them")
+        fields = [qplan.source.field(s) for s in qplan.source_symbols]
         schema = RelationSchema([
             ColumnSchema(n, f.type, f.dictionary)
-            for n, f in zip(result.names, result.fields)])
+            for n, f in zip(qplan.names, fields)])
         sink.create_table(handle, schema)
-        rename = {f.symbol: n
-                  for f, n in zip(result.fields, result.names)}
-        for b in result.batches:
-            sink.append(handle, b.rename(rename).select(result.names))
-        sink.finish(handle)
+        column_sources = dict(zip(qplan.names, qplan.source_symbols))
+        n = self._run_write(qplan, handle, sink, schema,
+                            column_sources)
         return self._text_result(
-            "result", [f"CREATE TABLE: {result.row_count} rows"])
+            "result", [f"CREATE TABLE: {n} rows"])
 
     def _insert_into(self, stmt: T.InsertInto) -> MaterializedResult:
-        import jax.numpy as jnp
-        from presto_tpu.batch import Column
         handle = self._handle_for(stmt.name)
         sink = self._sink_for(handle)
         conn = self.catalogs.connector(handle.catalog)
@@ -376,16 +411,17 @@ class LocalRunner:
                 f"in {handle}")
         if len(set(target_cols)) != len(target_cols):
             raise QueryError("INSERT target columns must be distinct")
-        result = self._run_query_for_write(stmt.query)
-        if len(result.fields) != len(target_cols):
+        qplan = self._plan_for_write(stmt.query)
+        fields = [qplan.source.field(s) for s in qplan.source_symbols]
+        if len(fields) != len(target_cols):
             raise QueryError(
-                f"INSERT has {len(result.fields)} columns but "
+                f"INSERT has {len(fields)} columns but "
                 f"{len(target_cols)} targets")
         # INSERT matches by POSITION (duplicate query names are fine):
         # target column name -> source symbol
         by_target = dict(zip(target_cols,
-                             (f.symbol for f in result.fields)))
-        field_of = {f.symbol: f for f in result.fields}
+                             (f.symbol for f in fields)))
+        field_of = {f.symbol: f for f in fields}
         for cs in schema.columns:
             src = by_target.get(cs.name)
             if src is None:
@@ -395,21 +431,11 @@ class LocalRunner:
                 raise QueryError(
                     f"INSERT type mismatch on {cs.name}: "
                     f"{ft.type.display()} vs {cs.type.display()}")
-        for b in result.batches:
-            cols = {}
-            for cs in schema.columns:
-                src = by_target.get(cs.name)
-                if src is not None:
-                    cols[cs.name] = b.columns[src]
-                else:  # unspecified target column -> NULLs
-                    cols[cs.name] = Column(
-                        jnp.zeros(b.capacity, cs.type.np_dtype),
-                        jnp.zeros(b.capacity, bool), cs.type,
-                        () if cs.type.is_string else None)
-            sink.append(handle, Batch(cols, b.row_valid))
-        sink.finish(handle)
-        return self._text_result(
-            "result", [f"INSERT: {result.row_count} rows"])
+        column_sources = {cs.name: by_target.get(cs.name)
+                          for cs in schema.columns}
+        n = self._run_write(qplan, handle, sink, schema,
+                            column_sources)
+        return self._text_result("result", [f"INSERT: {n} rows"])
 
     def _drop_table(self, stmt: T.DropTable) -> MaterializedResult:
         handle = self._handle_for(stmt.name)

@@ -45,7 +45,10 @@ class MeshRunner(LocalRunner):
     # ------------------------------------------------------------------
 
     def _run_plan(self, plan: N.OutputNode,
-                  profile: bool = False) -> MaterializedResult:
+                  profile: bool = False,
+                  on_retry=None) -> MaterializedResult:
+        """`on_retry` fires before every overflow/OOM re-execution —
+        write plans drop uncommitted sink appends there."""
         from presto_tpu.execution.memory import MemoryLimitExceeded
         from presto_tpu.operators.aggregation import GroupLimitExceeded
         from presto_tpu.operators.join_ops import JoinCapacityExceeded
@@ -66,6 +69,8 @@ class MeshRunner(LocalRunner):
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "max_groups": e.suggested})
+                if on_retry is not None:
+                    on_retry()
             except JoinCapacityExceeded as e:
                 if e.suggested > 1 << 10:
                     raise QueryError(
@@ -74,6 +79,8 @@ class MeshRunner(LocalRunner):
                     session, properties={
                         **session.properties,
                         "join_expansion_factor": e.suggested})
+                if on_retry is not None:
+                    on_retry()
             except MemoryLimitExceeded as e:
                 # grouped (bucket-wise) execution retry: split the hash
                 # space into lifespans so only 1/G of each shuffled
@@ -111,10 +118,16 @@ class MeshRunner(LocalRunner):
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "lifespans": new})
+                if on_retry is not None:
+                    on_retry()
 
     def _task_count(self, fragment) -> int:
-        return 1 if fragment.partitioning == "single" \
-            else self.n_workers
+        if fragment.partitioning == "single":
+            return 1
+        if getattr(fragment, "max_tasks", None):
+            # scaled writers: fragment width sized by data volume
+            return max(1, min(self.n_workers, fragment.max_tasks))
+        return self.n_workers
 
     @staticmethod
     def _grouped_eligible(fplan: FragmentedPlan, fragment) -> bool:

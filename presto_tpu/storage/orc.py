@@ -362,25 +362,30 @@ def read_footer(path: str) -> OrcInfo:
     import os
     size = os.path.getsize(path)
     with open(path, "rb") as f:
+        # magic FIRST: garbage/empty files must raise OrcError, not
+        # whatever the postscript parser trips over
+        if size < 4 or f.read(3) != MAGIC:
+            raise OrcError("not an ORC file")
         # tail-read only: postscript length byte, then postscript,
         # footer and metadata — never the whole file (multi-GB tables;
         # same discipline as the parquet reader's footer seek)
         tail_guess = min(size, 1 << 18)
         f.seek(size - tail_guess)
         data = f.read(tail_guess)
-        ps_len = data[-1]
-        ps = _pb(data[-1 - ps_len:-1])
-        footer_len = _one(ps, 1, 0)
-        compression = _one(ps, 2, COMP_NONE)
-        metadata_len = _one(ps, 5, 0)
+        try:
+            ps_len = data[-1]
+            ps = _pb(data[-1 - ps_len:-1])
+            footer_len = _one(ps, 1, 0)
+            compression = _one(ps, 2, COMP_NONE)
+            metadata_len = _one(ps, 5, 0)
+        except (IndexError, ValueError) as e:
+            raise OrcError(f"corrupt ORC postscript: {e}") from e
         need = 1 + ps_len + footer_len + metadata_len
+        if need > size:
+            raise OrcError("corrupt ORC tail lengths")
         if need > len(data):
             f.seek(size - need)
             data = f.read(need)
-        if size >= 3:
-            f.seek(0)
-            if f.read(3) != MAGIC:
-                raise OrcError("not an ORC file")
     footer_raw = data[-1 - ps_len - footer_len:-1 - ps_len]
     footer = _pb(_decompress(footer_raw, compression))
 
