@@ -79,6 +79,28 @@ class ArrayValue(RowExpression):
 
 
 @dataclasses.dataclass(frozen=True)
+class MapValue(RowExpression):
+    """ANALYSIS-TIME-ONLY fixed-width map value: parallel key/value
+    expression lists plus an optional dynamic entry count (None = the
+    static list length). Consumers (subscript, element_at,
+    cardinality, map_keys/values, lambdas) lower it to scalar IR —
+    like ArrayValue, it never reaches the compiler (reference:
+    common/type/MapType's key/value blocks, static-shaped)."""
+    keys: tuple
+    values: tuple
+    length: Optional["RowExpression"]
+    type: "Type"
+
+
+@dataclasses.dataclass(frozen=True)
+class RowValue(RowExpression):
+    """ANALYSIS-TIME-ONLY row value: named field expressions consumed
+    by field access (reference: common/type/RowType)."""
+    fields: tuple  # ((name|None, RowExpression), ...)
+    type: "Type"
+
+
+@dataclasses.dataclass(frozen=True)
 class SpecialForm(RowExpression):
     """Non-function forms with their own evaluation/null rules
     (reference: spi SpecialFormExpression.Form): AND OR NOT IF COALESCE

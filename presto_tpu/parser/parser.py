@@ -645,6 +645,18 @@ class _Parser:
 
     def primary(self) -> T.Node:
         t = self.cur
+        # ROW(...) constructor: "row" is a reserved word (frame
+        # grammar), so the generic ident-"(" call path misses it
+        if t.kind == "keyword" and t.value == "row" \
+                and self.toks[self.i + 1].kind == "op" \
+                and self.toks[self.i + 1].value == "(":
+            self.advance()
+            self.expect_op("(")
+            args = [self.expr()]
+            while self.accept_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            return T.FunctionCall("row", args)
         if t.kind == "ident" and t.value.lower() == "array" \
                 and self.toks[self.i + 1].kind == "op" \
                 and self.toks[self.i + 1].value == "[":

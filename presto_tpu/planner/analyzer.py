@@ -543,12 +543,14 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
     item_key_to_symbol: Dict[tuple, str] = {}
     for item in select_items:
         e = fold_constants(an.analyze(item.expr))
-        from presto_tpu.expr.ir import ArrayValue
-        if isinstance(e, ArrayValue):
+        from presto_tpu.expr.ir import ArrayValue, MapValue, RowValue
+        if isinstance(e, (ArrayValue, MapValue, RowValue)):
+            kind = {ArrayValue: "array", MapValue: "map",
+                    RowValue: "row"}[type(e)]
             raise AnalysisError(
-                "array values cannot be projected as columns yet — "
-                "consume them with element_at/cardinality/contains/"
-                "array_join or UNNEST")
+                f"{kind} values cannot be projected as columns yet — "
+                "consume them with subscripts/element_at/cardinality/"
+                "map_keys/map_values/contains/array_join or UNNEST")
         name = item.alias or _derive_name(item.expr)
         sym = ctx.symbols.new(name)
         assignments.append((sym, e))
@@ -2554,10 +2556,26 @@ class _Analyzer:
                     (Literal(i, BIGINT), arr.length), BOOLEAN)
 
     def _an_Subscript(self, a: T.Subscript):
-        from presto_tpu.expr.ir import ArrayValue
+        from presto_tpu.expr.ir import ArrayValue, MapValue, RowValue
         base = self.analyze(a.base)
+        if isinstance(base, MapValue):
+            return self._map_lookup(
+                base, fold_constants(self.analyze(a.index)))
+        if isinstance(base, RowValue):
+            idx = fold_constants(self.analyze(a.index))
+            if not isinstance(idx, Literal) or idx.value is None \
+                    or not idx.type.is_integer:
+                raise AnalysisError(
+                    "row field access needs a constant integer index")
+            i = int(idx.value)
+            if not 1 <= i <= len(base.fields):
+                raise AnalysisError(
+                    f"row has {len(base.fields)} fields; "
+                    f"index {i} is out of range")
+            return base.fields[i - 1][1]
         if not isinstance(base, ArrayValue):
-            raise AnalysisError("subscript requires an array value")
+            raise AnalysisError(
+                "subscript requires an array, map or row value")
         return self._array_element_switch(
             base, fold_constants(self.analyze(a.index)))
 
@@ -2599,6 +2617,11 @@ class _Analyzer:
         if any(isinstance(x, T.Lambda) for x in a.args):
             return self._resolve_lambda_fn(name, a.args)
         args = [self.analyze(x) for x in a.args]
+        # map resolver first: it owns map()/row() constructors, whose
+        # args are ArrayValues the array resolver would reject
+        mp = self._resolve_map_fn(name, args)
+        if mp is not None:
+            return mp
         arr = self._resolve_array_fn(name, args)
         if arr is not None:
             return arr
@@ -2684,6 +2707,24 @@ class _Analyzer:
                 out = SpecialForm("not", (out,), BOOLEAN)
             return out
 
+        if name == "transform_values":
+            from presto_tpu.expr.ir import MapValue
+            from presto_tpu.types import map_type
+            if len(raw_args) != 2:
+                raise AnalysisError(
+                    "transform_values(map, (k, v) -> f)")
+            m = self.analyze(raw_args[0])
+            if not isinstance(m, MapValue):
+                raise AnalysisError(
+                    "transform_values: first argument must be a map")
+            lam = lam_arg(1, 2)
+            vals = [self._bind_lambda(lam, [k, v])
+                    for k, v in zip(m.keys, m.values)]
+            t0 = vals[0].type
+            vals = tuple(_coerce_to(v, t0) for v in vals)
+            return MapValue(m.keys, vals, m.length,
+                            map_type(m.type.key, t0))
+
         if name == "zip_with":
             if len(raw_args) != 3:
                 raise AnalysisError(
@@ -2725,6 +2766,83 @@ class _Analyzer:
                 "transform with a conditional, or UNNEST + WHERE")
         raise AnalysisError(
             f"{name} does not take lambda arguments")
+
+    def _resolve_map_fn(self, name: str, args):
+        """Map/row functions over the analysis-time MapValue/RowValue
+        forms (reference: operator/scalar/MapFunctions + RowType) —
+        same lowering discipline as the array functions."""
+        from presto_tpu.expr.ir import ArrayValue, MapValue, RowValue
+        from presto_tpu.types import array_type, map_type, row_type
+
+        if name == "map":
+            if len(args) != 2 \
+                    or not isinstance(args[0], ArrayValue) \
+                    or not isinstance(args[1], ArrayValue):
+                return None
+            ka, va = args
+            n = min(len(ka.elements), len(va.elements))
+            if ka.length is None and va.length is None:
+                # both static: a size mismatch is knowable NOW
+                # (Presto raises the same complaint at runtime)
+                if len(ka.elements) != len(va.elements):
+                    raise AnalysisError(
+                        "map(): key and value arrays differ in size")
+                length = None
+            else:
+                # entry i is real only if BOTH arrays reach it —
+                # deviation from the reference (which raises on a
+                # runtime size mismatch): extra slots of the longer
+                # array are dropped
+                kl = ka.length if ka.length is not None \
+                    else Literal(len(ka.elements), BIGINT)
+                vl = va.length if va.length is not None \
+                    else Literal(len(va.elements), BIGINT)
+                length = Call("least", (kl, vl), BIGINT)
+            return MapValue(tuple(ka.elements[:n]),
+                            tuple(va.elements[:n]), length,
+                            map_type(ka.type.element, va.type.element))
+
+        if name == "row":
+            if not args:
+                raise AnalysisError("row() needs at least one field")
+            return RowValue(
+                tuple((None, a) for a in args),
+                row_type([(f"field{i}", a.type)
+                          for i, a in enumerate(args)]))
+
+        if not args or not isinstance(args[0], MapValue):
+            return None
+        m = args[0]
+        if name == "cardinality":
+            return m.length if m.length is not None \
+                else Literal(len(m.keys), BIGINT)
+        if name == "map_keys":
+            return ArrayValue(m.keys, m.length,
+                              array_type(m.type.key))
+        if name == "map_values":
+            return ArrayValue(m.values, m.length,
+                              array_type(m.type.value))
+        if name == "element_at":
+            if len(args) != 2:
+                raise AnalysisError("element_at(map, key)")
+            return self._map_lookup(m, args[1])
+        return None
+
+    def _map_lookup(self, m, probe: RowExpression) -> RowExpression:
+        """m[k]: reverse if-chain over the entries; missing keys (and
+        padding slots via the (i <= length) guard) yield NULL."""
+        from presto_tpu.expr.ir import and_
+        probe = _coerce_to(probe, m.type.key)
+        vt = m.type.value
+        out: RowExpression = Literal(None, vt)
+        for i in range(len(m.keys), 0, -1):
+            eq = Call("equal", (m.keys[i - 1], probe), BOOLEAN)
+            g = self._array_guard(m, i)
+            cond = eq if g is None else and_(g, eq)
+            out = SpecialForm("if", (cond,
+                                     _coerce_to(m.values[i - 1], vt),
+                                     out), vt)
+        return out
 
     def _resolve_array_fn(self, name: str, args):
         """Array functions lower to scalar IR over the fixed-width

@@ -574,14 +574,25 @@ def plan_phases(fplan: FragmentedPlan) -> Dict[int, List[int]]:
                 upstream(e.producer, acc)
         return acc
 
-    def reaches(a: int, b: int, seen: set) -> bool:
-        """Would b -> a create a cycle (a already depends on b)?"""
+    data_succ: Dict[int, set] = {}
+    for e in fplan.edges.values():
+        data_succ.setdefault(e.producer, set()).add(e.consumer)
+
+    def precedes(a: int, b: int, seen: set) -> bool:
+        """True if a must complete before b can (combined graph:
+        data edges — a consumer completes only after its producers —
+        plus already-added dependency edges). Adding 'b before p' is
+        safe only if p does NOT already precede b, else deadlock (the
+        Q21 shape: a shared lineitem fragment feeds the join, the
+        semi AND the anti side)."""
         if a == b:
             return True
-        for d in deps.get(a, ()):
-            if d not in seen:
-                seen.add(d)
-                if reaches(d, b, seen):
+        succ = set(data_succ.get(a, ()))
+        succ |= {q for q, ds in deps.items() if a in ds}
+        for s in succ:
+            if s not in seen:
+                seen.add(s)
+                if precedes(s, b, seen):
                     return True
         return False
 
@@ -606,8 +617,9 @@ def plan_phases(fplan: FragmentedPlan) -> Dict[int, List[int]]:
             for xid in remote_edges(probe):
                 p = fplan.edges[xid].producer
                 for b in build_frags:
-                    if p != b and not reaches(b, p, set()):
-                        deps[p].add(b)
+                    if p == b or precedes(p, b, set()):
+                        continue
+                    deps[p].add(b)
     return {fid: sorted(d) for fid, d in deps.items()}
 
 

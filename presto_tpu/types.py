@@ -127,6 +127,51 @@ def array_type(element: Type) -> ArrayType:
     return ArrayType("array", element)
 
 
+@dataclasses.dataclass(frozen=True, repr=False)
+class MapType(Type):
+    """MAP(key, value) as an ANALYSIS-TIME value form: parallel
+    fixed-width key/value expression lists (expr/ir.MapValue), lowered
+    to scalar IR by every consumer — the map analog of the fixed-width
+    ArrayType. Reference: common/type/MapType.java."""
+
+    key: Type = None
+    value: Type = None
+
+    def __repr__(self) -> str:
+        return f"map({self.key!r}, {self.value!r})"
+
+    def display(self) -> str:
+        return f"map({self.key.display()}, {self.value.display()})"
+
+
+def map_type(key: Type, value: Type) -> MapType:
+    return MapType("map", key, value)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class RowType(Type):
+    """ROW(name type, ...) as an ANALYSIS-TIME value form
+    (expr/ir.RowValue): named field expressions, consumed by field
+    subscripts. Reference: common/type/RowType.java."""
+
+    field_names: tuple = ()
+    field_types: tuple = ()
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{n} {t!r}" for n, t in
+                          zip(self.field_names, self.field_types))
+        return f"row({inner})"
+
+    def display(self) -> str:
+        return repr(self)
+
+
+def row_type(fields) -> RowType:
+    names = tuple(n for n, _ in fields)
+    types = tuple(t for _, t in fields)
+    return RowType("row", names, types)
+
+
 def decimal_type(precision: int, scale: int) -> DecimalType:
     """We carry at most 18 digits exactly in int64. When a derived type
     (e.g. from common_super_type) exceeds that, preserve integer digits by
