@@ -85,7 +85,12 @@ class DynamicFilterService:
     def __init__(self):
         self._lock = threading.Lock()
         self._expected: Dict[int, int] = {}
-        self._parts: Dict[int, List[DFilter]] = {}
+        #: df_id -> {publisher token: DFilter}. Keyed by token so a
+        #: RETRIED recoverable generation re-publishing its partial
+        #: REPLACES it instead of over-counting toward `expected` —
+        #: an over-count would complete the filter while later
+        #: generations' partials are missing and wrongly prune rows.
+        self._parts: Dict[int, Dict] = {}
         self._merged: Dict[int, DFilter] = {}
         self._seq = 0
 
@@ -98,17 +103,20 @@ class DynamicFilterService:
         with self._lock:
             self._expected[df_id] = publishers
 
-    def publish(self, df_id: int, mn, mx, dset=None) -> None:
+    def publish(self, df_id: int, mn, mx, dset=None,
+                token=None) -> None:
         with self._lock:
-            self._parts.setdefault(df_id, []).append(
-                DFilter(mn, mx, dset))
+            d = self._parts.setdefault(df_id, {})
+            if token is None:
+                token = ("anon", len(d))
+            d[token] = DFilter(mn, mx, dset)
 
     def get(self, df_id: int) -> Optional[DFilter]:
         with self._lock:
             hit = self._merged.get(df_id)
             if hit is not None:
                 return hit
-            parts = self._parts.get(df_id, [])
+            parts = list(self._parts.get(df_id, {}).values())
             expected = self._expected.get(df_id)
             if expected is None or len(parts) < expected:
                 return None
@@ -140,6 +148,23 @@ class DynamicFilterService:
         with self._lock:
             self._merged[df_id] = merged
         return merged
+
+
+class BoundPublisher:
+    """A DynamicFilterService facade carrying the publisher's stable
+    identity (task index, lifespan generation): build operators
+    publish through it without knowing about tokens, and a retried
+    generation's re-publication replaces rather than double-counts."""
+
+    def __init__(self, svc: DynamicFilterService, token):
+        self._svc = svc
+        self._token = token
+
+    def publish(self, df_id: int, mn, mx, dset=None) -> None:
+        self._svc.publish(df_id, mn, mx, dset, token=self._token)
+
+    def get(self, df_id: int):
+        return self._svc.get(df_id)
 
 
 def _ident(dtype):

@@ -12,6 +12,16 @@ from typing import Any, Dict, List, Optional
 from presto_tpu.batch import Batch
 
 
+class RetryableTaskError(Exception):
+    """A TRANSIENT task failure (lost device, dropped RPC, injected
+    fault): the mesh driver may re-run just the failed lifespan
+    generation from its retained exchange inputs instead of the whole
+    query (P7 recoverable grouped execution; reference:
+    PlanFragmenter.java:243-260 recoverable lifespans). Deterministic
+    errors (OOM, overflow protocols) must NOT use this type — their
+    retries need changed settings, not a re-roll."""
+
+
 @dataclasses.dataclass
 class OperatorStats:
     """Per-operator counters surfaced through EXPLAIN ANALYZE / REST

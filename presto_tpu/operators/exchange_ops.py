@@ -139,7 +139,8 @@ class MeshExchange:
                  mesh, n_producers: int, n_consumers: int,
                  lifespans: int = 1, producer_finishes: int = 1,
                  pool=None,
-                 host_spool_bytes: int = DEFAULT_HOST_SPOOL_BYTES):
+                 host_spool_bytes: int = DEFAULT_HOST_SPOOL_BYTES,
+                 recoverable: bool = False):
         self.exchange_id = exchange_id
         self.scheme = scheme
         self.partition_keys = list(partition_keys)
@@ -173,6 +174,14 @@ class MeshExchange:
         self._spill_dir: Optional[str] = None
         self._spill_seq = 0
         self.spilled_pages = 0  # observability + tests
+        #: P7 recoverable grouped execution: keep a bucket's
+        #: materialized pages until commit_lifespan() so a failed
+        #: bucket can be restored and re-run (reference:
+        #: PlanFragmenter.java:243-260 recoverable lifespans — the
+        #: materialize-to-recover trade). Bucket 0 streams un-
+        #: materialized and stays whole-query-retry territory.
+        self.recoverable = recoverable
+        self._retained: Optional[list] = None  # current bucket's spool
 
     # -- memory accounting -------------------------------------------------
 
@@ -239,31 +248,68 @@ class MeshExchange:
         return self.current_lifespan + 1 < self.lifespans
 
     def advance_lifespan(self) -> None:
-        """Reload the next bucket's spooled batches (host RAM or spill
-        files) onto their consumer devices."""
-        import os
+        """Reload the next bucket's spooled batches (host RAM or disk)
+        onto their consumer devices. Under `recoverable`, the bucket's
+        materialized pages are RETAINED until commit_lifespan() so a
+        failed generation can restore_lifespan() and re-run."""
         self.current_lifespan += 1
         g = self.current_lifespan
-        for c, dq in enumerate(self._spooled.pop(g, [])):
+        bucket = self._spooled.pop(g, [])
+        self._deliver_spooled(bucket)
+        if self.recoverable:
+            self._retained = bucket
+        else:
+            self._discard_bucket(bucket)
+            if self.current_lifespan + 1 >= self.lifespans:
+                self._drop_spill_dir()
+
+    def _deliver_spooled(self, bucket) -> None:
+        for c, dq in enumerate(bucket):
             dev = self.devices[c] if c < len(self.devices) \
                 else self.devices[0]
-            while dq:
-                tier, payload, nbytes = dq.popleft()
+            for tier, payload, nbytes in dq:
                 if tier == "disk":
                     from presto_tpu.server.serde import batch_from_bytes
                     with open(payload, "rb") as f:
                         host_batch = batch_from_bytes(f.read())
-                    os.unlink(payload)
                 else:
-                    self._host_bytes -= nbytes
                     host_batch = payload
                 # pad on the HOST to the quantized capacity ladder:
                 # exact tiny buckets would each compile fresh kernels
                 # downstream; numpy padding costs nothing
                 host_batch = _host_pad_quantized(host_batch)
                 self._enqueue(c, jax.device_put(host_batch, dev))
+
+    def _discard_bucket(self, bucket) -> None:
+        import os
+        for dq in bucket:
+            for tier, payload, nbytes in dq:
+                if tier == "disk":
+                    try:
+                        os.unlink(payload)
+                    except OSError:
+                        pass
+                else:
+                    self._host_bytes -= nbytes
+
+    def commit_lifespan(self) -> None:
+        """The current bucket completed: drop its retained pages."""
+        if self._retained is not None:
+            self._discard_bucket(self._retained)
+            self._retained = None
         if self.current_lifespan + 1 >= self.lifespans:
             self._drop_spill_dir()
+
+    def restore_lifespan(self) -> None:
+        """Re-deliver the current bucket's retained pages after a
+        failed generation (its device queues are dropped first — the
+        failed attempt may have consumed some)."""
+        assert self._retained is not None, \
+            "restore without retained bucket (bucket 0 or committed)"
+        for q in self.queues:
+            while q:
+                self._free(q.popleft())
+        self._deliver_spooled(self._retained)
 
     def _drop_spill_dir(self) -> None:
         if self._spill_dir is not None:
@@ -276,6 +322,7 @@ class MeshExchange:
         for ANY reason (error paths included), so spill files never
         outlive their query."""
         self._spooled = {}
+        self._retained = None
         self._host_bytes = 0
         self._drop_spill_dir()
 
@@ -447,13 +494,22 @@ def _host_pad_quantized(batch: Batch) -> Batch:
 class ExchangeSinkOperator(Operator):
     """Tail of a producer task's pipeline; tees every batch into each
     consumer edge of this fragment's output (the analog of one
-    OutputBuffer with several buffer ids)."""
+    OutputBuffer with several buffer ids).
+
+    `staged` (P7 recoverable grouped execution): outputs buffer until
+    finish() and flush atomically — a generation that fails mid-bucket
+    has then published NOTHING downstream, so the bucket can re-run
+    without duplicating rows (the reference's task-attempt output
+    isolation, traded as materialize-then-release)."""
 
     def __init__(self, ctx: OperatorContext,
-                 exchanges: Sequence[MeshExchange], producer: int):
+                 exchanges: Sequence[MeshExchange], producer: int,
+                 staged: bool = False):
         super().__init__(ctx)
         self.exchanges = list(exchanges)
         self.producer = producer
+        self.staged = staged
+        self._staged_batches: List[Batch] = []
         self._finished = False
 
     def needs_input(self) -> bool:
@@ -461,6 +517,10 @@ class ExchangeSinkOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        if self.staged:
+            self.ctx.reserve_batch(batch)
+            self._staged_batches.append(batch)
+            return
         for ex in self.exchanges:
             ex.push(self.producer, batch)
 
@@ -470,6 +530,11 @@ class ExchangeSinkOperator(Operator):
     def finish(self) -> None:
         if not self._finished:
             self._finished = True
+            for b in self._staged_batches:
+                for ex in self.exchanges:
+                    ex.push(self.producer, b)
+            self._staged_batches = []
+            self.ctx.release_all()
             for ex in self.exchanges:
                 ex.producer_done(self.producer)
 
@@ -477,6 +542,13 @@ class ExchangeSinkOperator(Operator):
         return self._finished
 
     def close(self) -> None:
+        # an ABORTED attempt (closed unfinished by the recovery path)
+        # must publish nothing: drop the stage without flushing
+        if not self._finished and self.staged:
+            self._staged_batches = []
+            self.ctx.release_all()
+            self._finished = True
+            return
         self.finish()
 
 
@@ -523,15 +595,17 @@ class ExchangeSourceOperator(Operator):
 
 class ExchangeSinkOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int,
-                 exchanges: Sequence[MeshExchange], producer: int):
+                 exchanges: Sequence[MeshExchange], producer: int,
+                 staged: bool = False):
         super().__init__(operator_id, "exchange_sink")
         self.exchanges = exchanges
         self.producer = producer
+        self.staged = staged
 
     def create(self, driver_context: DriverContext) -> Operator:
         return ExchangeSinkOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self.exchanges, self.producer)
+            self.exchanges, self.producer, self.staged)
 
 
 class ExchangeSourceOperatorFactory(OperatorFactory):

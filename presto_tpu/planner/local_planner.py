@@ -61,6 +61,9 @@ class TaskContext:
         default_factory=dict)
     df_service: object = None
     cross_df: object = None
+    #: lifespan generation this task instance belongs to (publisher
+    #: identity for cross-fragment dynamic-filter dedup on retry)
+    generation: int = 0
 
 
 class LocalPlanningError(Exception):
@@ -153,11 +156,14 @@ class LocalExecutionPlanner:
                                   root.output)
 
     def plan_fragment(self, root: N.PlanNode,
-                      sink_exchanges: Sequence) -> List[List]:
+                      sink_exchanges: Sequence,
+                      staged_output: bool = False) -> List[List]:
         """Plan a non-root fragment for one task: pipelines whose tail
         tees into this fragment's consumer exchange edges (reference:
         LocalExecutionPlanner.plan for a fragment whose root is a
-        PartitionedOutput/TaskOutput operator)."""
+        PartitionedOutput/TaskOutput operator). `staged_output` holds
+        outputs until finish (P7 recoverable generations publish
+        atomically)."""
         from presto_tpu.operators.exchange_ops import (
             ExchangeSinkOperatorFactory,
         )
@@ -165,7 +171,8 @@ class LocalExecutionPlanner:
         pipeline: List = []
         self._visit(root, pipeline)
         pipeline.append(ExchangeSinkOperatorFactory(
-            self._next_id(), list(sink_exchanges), self.task.index))
+            self._next_id(), list(sink_exchanges), self.task.index,
+            staged=staged_output))
         self._pipelines.append(pipeline)
         return self._pipelines
 
@@ -529,7 +536,10 @@ class LocalExecutionPlanner:
         cdf = self.task.cross_df
         if svc is None or cdf is None:
             return []
-        return [(key, df_id, svc)
+        from presto_tpu.execution.dynamic_filters import BoundPublisher
+        bound = BoundPublisher(
+            svc, (self.task.index, self.task.generation))
+        return [(key, df_id, bound)
                 for key, df_id in cdf.joins.get(id(node), [])]
 
     def _plan_dynamic_filters(self, probe, build, criteria):

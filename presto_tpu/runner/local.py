@@ -124,6 +124,36 @@ class LocalRunner:
         self.query_history: List[Dict[str, Any]] = []
         self.catalogs.register("system", runner_system_connector(self))
         self.session = Session(catalog, schema, dict(properties or {}))
+        self._load_plugins()
+
+    def _load_plugins(self) -> None:
+        """Plugin + catalog-properties loading (reference:
+        PluginManager + StaticCatalogStore): PRESTO_TPU_PLUGIN_DIR
+        holds plugin modules contributing connector factories;
+        PRESTO_TPU_CATALOG_DIR holds <catalog>.properties files with
+        connector.name=<factory> lines."""
+        import os
+        plugin_dir = os.environ.get("PRESTO_TPU_PLUGIN_DIR")
+        catalog_dir = os.environ.get("PRESTO_TPU_CATALOG_DIR")
+        if not plugin_dir and not catalog_dir:
+            return
+        from presto_tpu.connectors.files import FileConnector
+        from presto_tpu.connectors.memory import MemoryConnector
+        from presto_tpu.connectors.tpch import TpchConnector
+        from presto_tpu.server.plugins import (
+            PluginRegistry, load_catalogs, load_plugins,
+        )
+        reg = PluginRegistry()
+        reg.register_connector_factory(
+            "file", lambda cfg: FileConnector(cfg.get("file.root")))
+        reg.register_connector_factory(
+            "memory", lambda cfg: MemoryConnector())
+        reg.register_connector_factory(
+            "tpch", lambda cfg: TpchConnector())
+        if plugin_dir:
+            load_plugins(plugin_dir, reg)
+        if catalog_dir:
+            load_catalogs(catalog_dir, reg, self.catalogs)
 
     def register_connector(self, name: str, connector: Connector):
         self.catalogs.register(name, connector)

@@ -168,6 +168,8 @@ class MeshRunner(LocalRunner):
             for fid, frag in fplan.fragments.items()
         }
 
+        recover = bool(get_property(session.properties,
+                                    "recoverable_grouped_execution"))
         exchanges: Dict[int, MeshExchange] = {}
         for xid, edge in fplan.edges.items():
             producer = fplan.fragments[edge.producer]
@@ -181,7 +183,9 @@ class MeshRunner(LocalRunner):
                 producer_finishes=lifespans_of[edge.producer],
                 pool=pool,
                 host_spool_bytes=int(get_property(
-                    session.properties, "host_spool_bytes")))
+                    session.properties, "host_spool_bytes")),
+                recoverable=recover
+                and lifespans_of[edge.consumer] > 1)
 
         # cross-fragment dynamic filters: one query-wide service; each
         # filter expects (build fragment tasks x lifespan generations)
@@ -218,13 +222,20 @@ class MeshRunner(LocalRunner):
                           for e in fplan.producer_edges(fid)]
             created: List[Driver] = []
             nonlocal result
+            # generation number derives from the remaining-lifespan
+            # counter (call sites update it BEFORE spawning); a
+            # recovery respawn leaves it unchanged, so the retried
+            # generation keeps its publisher identity
+            gen = (lifespans_of[fid] - 1) \
+                - remaining_lifespans.get(fid, lifespans_of[fid] - 1)
             for t in range(n_tasks):
                 task = TaskContext(
                     index=t, count=n_tasks,
                     device=self._devices[t] if n_tasks > 1
                     else self._devices[0],
                     exchanges=exchanges,
-                    df_service=df_service, cross_df=cross_df)
+                    df_service=df_service, cross_df=cross_df,
+                    generation=gen)
                 planner = LocalExecutionPlanner(self.catalogs, session,
                                                 task=task)
                 if fid == fplan.root_id:
@@ -233,8 +244,10 @@ class MeshRunner(LocalRunner):
                     pipelines = lplan.pipelines
                     result = lplan
                 else:
-                    pipelines = planner.plan_fragment(fragment.root,
-                                                      sink_edges)
+                    pipelines = planner.plan_fragment(
+                        fragment.root, sink_edges,
+                        staged_output=recover
+                        and lifespans_of[fid] > 1)
                 created.extend(Driver([f.create(dctx) for f in pipe])
                                for pipe in pipelines)
             return created
@@ -253,10 +266,10 @@ class MeshRunner(LocalRunner):
         for fid in fplan.fragments:
             if fid in deferred:
                 continue
+            remaining_lifespans[fid] = lifespans_of[fid] - 1
             drivers = spawn_fragment(fid)
             all_drivers.extend(drivers)
             instance_drivers[fid] = drivers
-            remaining_lifespans[fid] = lifespans_of[fid] - 1
         # the root fragment is never gated (it produces nothing), so
         # `result` is always materialized by the eager spawns
         assert result is not None
@@ -270,7 +283,8 @@ class MeshRunner(LocalRunner):
                                stat_snaps if profile else None,
                                deferred=deferred,
                                phase_deps=phase_deps,
-                               lifespans_of=lifespans_of)
+                               lifespans_of=lifespans_of,
+                               recover=recover)
             from presto_tpu.operators.base import run_deferred_checks
             run_deferred_checks(dctx)
         finally:
@@ -293,8 +307,8 @@ class MeshRunner(LocalRunner):
                       max_rounds: int = 2_000_000,
                       deferred: Optional[List[int]] = None,
                       phase_deps: Optional[Dict[int, List[int]]] = None,
-                      lifespans_of: Optional[Dict[int, int]] = None
-                      ) -> None:
+                      lifespans_of: Optional[Dict[int, int]] = None,
+                      recover: bool = False) -> None:
         """Round-robin drive with lifespan phases: when the loop stalls
         because a grouped fragment's current bucket is drained, advance
         its input exchanges to the next bucket and spawn fresh task
@@ -325,23 +339,82 @@ class MeshRunner(LocalRunner):
             for fid in list(deferred):
                 if all(fragment_complete(b) for b in phase_deps[fid]):
                     deferred.remove(fid)
+                    remaining_lifespans[fid] = \
+                        (lifespans_of[fid] if lifespans_of else 1) - 1
                     fresh = spawn_fragment(fid)
                     instance_drivers[fid] = fresh
                     all_drivers.extend(fresh)
-                    remaining_lifespans[fid] = \
-                        (lifespans_of[fid] if lifespans_of else 1) - 1
                     fired = True
             return fired
+
+        from presto_tpu.operators.base import RetryableTaskError
+        bucket_retries: Dict[int, int] = {}
+
+        def swap_generation(fid: int, close_fn) -> None:
+            """Replace a fragment's current driver generation: retire
+            (or abort) the old drivers, fix the driver lists, spawn a
+            fresh generation — the ONE copy of this bookkeeping shared
+            by lifespan advance and bucket recovery."""
+            retiring = instance_drivers[fid]
+            close_fn(retiring)
+            gone = set(map(id, retiring))
+            all_drivers[:] = [d for d in all_drivers
+                              if id(d) not in gone]
+            fresh = spawn_fragment(fid)
+            instance_drivers[fid] = fresh
+            all_drivers.extend(fresh)
+
+        def recover_generation(failed_driver) -> bool:
+            """P7: re-run ONLY the failed bucket's generation from its
+            retained exchange inputs (reference: recoverable grouped
+            execution, PlanFragmenter.java:243-260). Possible when the
+            fragment is recoverable (staged outputs + retained bucket
+            pages, i.e. bucket > 0), NO task of the generation has
+            flushed yet (a finished task already published its staged
+            output and signaled done — re-running it would duplicate
+            both), and retries remain."""
+            fid = next((f for f, ds in instance_drivers.items()
+                        if any(d is failed_driver for d in ds)), None)
+            if fid is None or not recover:
+                return False
+            g = (lifespans_of[fid] - 1) - remaining_lifespans[fid] \
+                if lifespans_of else 0
+            if g <= 0:  # bucket 0 streamed unmaterialized
+                return False
+            if bucket_retries.get((fid, g), 0) >= 2:
+                return False
+            if any(d.is_finished() for d in instance_drivers[fid]):
+                return False  # a task already published its stage
+            in_ex = [exchanges[x] for x in
+                     fplan.fragments[fid].source_edges]
+            if any(ex._retained is None for ex in in_ex):
+                return False
+            bucket_retries[(fid, g)] = \
+                bucket_retries.get((fid, g), 0) + 1
+            for ex in in_ex:
+                ex.restore_lifespan()
+
+            def abort(retiring):
+                for dd in retiring:
+                    dd.close()  # aborted: staged sinks publish nothing
+            swap_generation(fid, abort)
+            return True
 
         rounds = 0
         while True:
             all_done = not deferred
             progress = False
-            for d in all_drivers:
+            for d in list(all_drivers):
                 if d.is_finished():
                     continue
                 all_done = False
-                progress = d.process() or progress
+                try:
+                    progress = d.process() or progress
+                except RetryableTaskError:
+                    if not recover_generation(d):
+                        raise
+                    progress = True
+                    break  # driver list mutated; restart the round
             if deferred and spawn_ready_deferred():
                 continue
             if all_done:
@@ -349,28 +422,31 @@ class MeshRunner(LocalRunner):
             if not progress:
                 advanced = False
                 for fid, left in remaining_lifespans.items():
+                    in_exchanges = [
+                        exchanges[x] for x in
+                        fplan.fragments[fid].source_edges]
                     if left <= 0:
+                        # LAST bucket of a recoverable fragment: once
+                        # its drivers finish, drop the retained pages
+                        # now instead of at query-end close()
+                        if recover and fid not in deferred \
+                                and fid in instance_drivers \
+                                and all(d.is_finished() for d
+                                        in instance_drivers[fid]):
+                            for ex in in_exchanges:
+                                ex.commit_lifespan()
                         continue
                     if not all(d.is_finished()
                                for d in instance_drivers[fid]):
                         continue
-                    in_exchanges = [
-                        exchanges[x] for x in
-                        fplan.fragments[fid].source_edges]
                     if not all(ex.lifespan_drained()
                                for ex in in_exchanges):
                         continue
-                    retiring = instance_drivers[fid]
-                    retire(retiring)
-                    gone = set(map(id, retiring))
-                    all_drivers[:] = [d for d in all_drivers
-                                      if id(d) not in gone]
                     for ex in in_exchanges:
-                        ex.advance_lifespan()
-                    fresh = spawn_fragment(fid)
-                    instance_drivers[fid] = fresh
-                    all_drivers.extend(fresh)
+                        ex.commit_lifespan()  # bucket done: drop its
+                        ex.advance_lifespan()  # retained pages
                     remaining_lifespans[fid] = left - 1
+                    swap_generation(fid, retire)
                     advanced = True
                 if advanced:
                     continue

@@ -1,0 +1,136 @@
+"""Plugin loading (reference: server/PluginManager.java:64 +
+spi/Plugin.java:34's getConnectorFactories facet).
+
+A plugin is a Python module (a file in the plugin directory, or an
+installed module named by configuration) exposing either
+
+    CONNECTOR_FACTORIES: dict[str, Callable[[dict], Connector]]
+
+or a `presto_tpu_plugin(registry)` entry function that registers
+factories itself. Catalogs are then declared by properties files —
+`<catalog>.properties` with a `connector.name=<factory>` line plus
+arbitrary config passed to the factory — the reference's
+etc/catalog/*.properties protocol.
+
+Deviation from the reference: no classloader isolation (one Python
+process, one import space) — the reference isolates each plugin's
+dependencies; here a plugin is trusted code, same as a connector
+compiled into the tree. The FACTORY/catalog-properties seams are the
+part the reference's connectors actually program against.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import Callable, Dict, Optional
+
+from presto_tpu.connectors.spi import Connector
+
+
+class PluginError(Exception):
+    pass
+
+
+class PluginRegistry:
+    """Connector factories by name (reference:
+    connectorFactories in ConnectorManager.java)."""
+
+    def __init__(self):
+        self._factories: Dict[str, Callable[[dict], Connector]] = {}
+
+    def register_connector_factory(
+            self, name: str,
+            factory: Callable[[dict], Connector]) -> None:
+        if name in self._factories:
+            raise PluginError(
+                f"connector factory {name!r} already registered")
+        self._factories[name] = factory
+
+    def factory(self, name: str) -> Callable[[dict], Connector]:
+        if name not in self._factories:
+            raise PluginError(
+                f"no connector factory {name!r}; registered: "
+                f"{sorted(self._factories)}")
+        return self._factories[name]
+
+    def factories(self):
+        return sorted(self._factories)
+
+
+def load_plugin_module(path: str, registry: PluginRegistry) -> None:
+    """Import one plugin file and collect its factories."""
+    name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(
+        f"presto_tpu_plugin_{name}", path)
+    if spec is None or spec.loader is None:
+        raise PluginError(f"cannot load plugin {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    hook = getattr(mod, "presto_tpu_plugin", None)
+    if callable(hook):
+        hook(registry)
+        return
+    factories = getattr(mod, "CONNECTOR_FACTORIES", None)
+    if not isinstance(factories, dict) or not factories:
+        raise PluginError(
+            f"plugin {path} defines neither presto_tpu_plugin() nor "
+            f"CONNECTOR_FACTORIES")
+    for fname, factory in factories.items():
+        registry.register_connector_factory(fname, factory)
+
+
+def load_plugins(plugin_dir: str,
+                 registry: Optional[PluginRegistry] = None
+                 ) -> PluginRegistry:
+    """Import every *.py in `plugin_dir` (reference:
+    PluginManager.loadPlugins over the plugin/ installation dir)."""
+    registry = registry or PluginRegistry()
+    if os.path.isdir(plugin_dir):
+        for f in sorted(os.listdir(plugin_dir)):
+            if f.endswith(".py") and not f.startswith("_"):
+                load_plugin_module(os.path.join(plugin_dir, f),
+                                   registry)
+    return registry
+
+
+def _parse_properties(path: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def load_catalogs(catalog_dir: str, registry: PluginRegistry,
+                  catalog_manager) -> list:
+    """Register a catalog per `<name>.properties` file (reference:
+    StaticCatalogStore over etc/catalog/). `connector.name` picks the
+    factory; the remaining keys are the factory's config. Returns the
+    registered catalog names."""
+    names = []
+    if not os.path.isdir(catalog_dir):
+        return names
+    for f in sorted(os.listdir(catalog_dir)):
+        if not f.endswith(".properties"):
+            continue
+        catalog = f[:-len(".properties")]
+        props = _parse_properties(os.path.join(catalog_dir, f))
+        cname = props.pop("connector.name", None)
+        if cname is None:
+            raise PluginError(
+                f"catalog {catalog}: missing connector.name")
+        if catalog in catalog_manager.catalogs():
+            # the reference's StaticCatalogStore rejects duplicates;
+            # silently replacing a built-in (system, tpch) would make
+            # queries misbehave invisibly
+            raise PluginError(
+                f"catalog {catalog!r} is already registered")
+        conn = registry.factory(cname)(props)
+        catalog_manager.register(catalog, conn)
+        names.append(catalog)
+    return names

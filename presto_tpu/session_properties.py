@@ -50,6 +50,14 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "with 4x (reference: MultiChannelGroupByHash rehash)",
         _positive),
     PropertyDef(
+        "recoverable_grouped_execution", "boolean", False,
+        "Retain each lifespan bucket's materialized exchange pages "
+        "and stage generation outputs until the bucket completes, so "
+        "a TRANSIENT failure re-runs only that bucket (reference: "
+        "recoverable grouped execution). Costs host RAM + per-bucket "
+        "latency; bucket 0 streams unmaterialized and keeps "
+        "whole-query retry"),
+    PropertyDef(
         "phased_execution", "boolean", True,
         "Gate probe-producer fragments until their join's "
         "build-producer fragments finish (reference: "

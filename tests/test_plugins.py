@@ -1,0 +1,78 @@
+"""Plugin loading + catalog properties (reference:
+server/PluginManager.java:64, spi/Plugin.java:34,
+StaticCatalogStore over etc/catalog/*.properties)."""
+
+import os
+import textwrap
+
+import pytest
+
+PLUGIN_SRC = textwrap.dedent("""
+    from presto_tpu.connectors.memory import MemoryConnector
+
+    def _make(config):
+        conn = MemoryConnector()
+        # prove config flows through: stash it for the test
+        conn.plugin_config = dict(config)
+        return conn
+
+    CONNECTOR_FACTORIES = {"toy": _make}
+""")
+
+HOOK_SRC = textwrap.dedent("""
+    from presto_tpu.connectors.memory import MemoryConnector
+
+    def presto_tpu_plugin(registry):
+        registry.register_connector_factory(
+            "hooked", lambda cfg: MemoryConnector())
+""")
+
+
+def test_registry_and_module_loading(tmp_path):
+    from presto_tpu.server.plugins import (
+        PluginError, PluginRegistry, load_plugins,
+    )
+    (tmp_path / "toy_plugin.py").write_text(PLUGIN_SRC)
+    (tmp_path / "hook_plugin.py").write_text(HOOK_SRC)
+    (tmp_path / "_ignored.py").write_text("raise RuntimeError('no')")
+    reg = load_plugins(str(tmp_path))
+    assert reg.factories() == ["hooked", "toy"]
+    with pytest.raises(PluginError, match="already registered"):
+        reg.register_connector_factory("toy", lambda c: None)
+    with pytest.raises(PluginError, match="no connector factory"):
+        reg.factory("nope")
+
+
+def test_catalog_properties_end_to_end(tmp_path, monkeypatch):
+    """A plugin-provided connector becomes a queryable catalog via a
+    properties file, through a plain LocalRunner."""
+    plug = tmp_path / "plugins"
+    cat = tmp_path / "catalog"
+    plug.mkdir()
+    cat.mkdir()
+    (plug / "toy_plugin.py").write_text(PLUGIN_SRC)
+    (cat / "lake.properties").write_text(
+        "connector.name=toy\nsome.key=some value\n")
+    (cat / "gen.properties").write_text("connector.name=tpch\n")
+    monkeypatch.setenv("PRESTO_TPU_PLUGIN_DIR", str(plug))
+    monkeypatch.setenv("PRESTO_TPU_CATALOG_DIR", str(cat))
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    assert {"lake", "gen"} <= set(r.catalogs.catalogs())
+    assert r.catalogs.connector("lake").plugin_config == {
+        "some.key": "some value"}
+    # the plugin catalog is fully usable: DDL + DML + query
+    r.execute("create table lake.d.t as select 1 as x")
+    assert r.execute("select x from lake.d.t").rows() == [(1,)]
+    # and the properties-declared built-in factory works too
+    assert r.execute(
+        "select count(*) from gen.tiny.nation").rows() == [(25,)]
+
+
+def test_missing_connector_name_rejected(tmp_path):
+    from presto_tpu.server.plugins import (
+        PluginError, PluginRegistry, load_catalogs,
+    )
+    (tmp_path / "bad.properties").write_text("foo=bar\n")
+    with pytest.raises(PluginError, match="connector.name"):
+        load_catalogs(str(tmp_path), PluginRegistry(), None)
