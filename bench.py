@@ -269,6 +269,18 @@ def main() -> int:
 
     per_query = {}
     platforms = {}
+
+    def emit() -> None:
+        """Print the combined line NOW: the driver takes the last
+        JSON line, so emitting after every query guarantees a valid
+        (partial) capture even if the whole bench is killed."""
+        plats = set(platforms.values())
+        platform = plats.pop() if len(plats) == 1 else "mixed"
+        line = _combine(per_query, platform)
+        if platform == "mixed":
+            line["platform_by_query"] = platforms
+        print(json.dumps(line), flush=True)
+
     for qname in _queries():
         for name, _ in attempts:
             left = deadline - time.time()
@@ -283,6 +295,7 @@ def main() -> int:
             if r is not None:
                 per_query[qname] = r
                 platforms[qname] = name
+                emit()
                 break
             if name == "native":
                 # a wedge mid-query usually means the tunnel needs a
@@ -293,12 +306,7 @@ def main() -> int:
             break
 
     if per_query:
-        plats = set(platforms.values())
-        platform = plats.pop() if len(plats) == 1 else "mixed"
-        line = _combine(per_query, platform)
-        if platform == "mixed":
-            line["platform_by_query"] = platforms
-        print(json.dumps(line))
+        emit()
         return 0
     print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "rows/s",
                       "vs_baseline": 0.0,

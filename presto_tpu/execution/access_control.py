@@ -1,0 +1,62 @@
+"""Access control (reference: spi/security/SystemAccessControl.java +
+presto-main security/AccessControlManager.java, collapsed to the
+table-level checks the engine actually enforces).
+
+Rule-based: the first rule matching (user, catalog, schema, table)
+decides; no match = allow (the reference's default allow-all system
+access control). Checks run at name-resolution time for reads and at
+sink acquisition for writes — every query path goes through both."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional
+
+
+class AccessDeniedError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class AccessRule:
+    """Patterns are full-match regexes (reference: the file-based
+    access-control rules of presto-resource-group-managers'
+    security config)."""
+    user: str = ".*"
+    catalog: str = ".*"
+    schema: str = ".*"
+    table: str = ".*"
+    allow_select: bool = True
+    allow_write: bool = True
+
+    def matches(self, user: str, handle) -> bool:
+        return bool(re.fullmatch(self.user, user or "")
+                    and re.fullmatch(self.catalog, handle.catalog)
+                    and re.fullmatch(self.schema, handle.schema)
+                    and re.fullmatch(self.table, handle.table))
+
+
+class AccessControlManager:
+    def __init__(self, rules: Optional[List[AccessRule]] = None):
+        self.rules = list(rules or [])
+
+    def _rule_for(self, user: str, handle) -> Optional[AccessRule]:
+        for r in self.rules:
+            if r.matches(user, handle):
+                return r
+        return None
+
+    def check_can_select(self, user: str, handle) -> None:
+        r = self._rule_for(user, handle)
+        if r is not None and not r.allow_select:
+            raise AccessDeniedError(
+                f"user {user or '<anonymous>'!r} cannot select from "
+                f"{handle}")
+
+    def check_can_write(self, user: str, handle) -> None:
+        r = self._rule_for(user, handle)
+        if r is not None and not r.allow_write:
+            raise AccessDeniedError(
+                f"user {user or '<anonymous>'!r} cannot write to "
+                f"{handle}")

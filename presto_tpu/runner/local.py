@@ -25,18 +25,27 @@ class QueryError(Exception):
     pass
 
 
+#: (plugin_dir, catalog_dir) -> [(catalog name, connector)] — loaded
+#: once per process, shared by every runner (see _load_plugins)
+_PLUGIN_CATALOG_CACHE: Dict[Tuple, List] = {}
+
+
 @dataclasses.dataclass
 class Session:
     catalog: str = "tpch"
     schema: str = "tiny"
     properties: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    user: str = ""  # identity for access control + resource groups
 
 
 class CatalogManager:
-    """Reference: metadata/CatalogManager + MetadataManager.java:124."""
+    """Reference: metadata/CatalogManager + MetadataManager.java:124.
+    `access_control`, when set, gates table reads at name resolution
+    (spi/security SystemAccessControl.checkCanSelectFromColumns)."""
 
     def __init__(self):
         self._connectors: Dict[str, Connector] = {}
+        self.access_control = None
 
     def register(self, name: str, connector: Connector) -> None:
         self._connectors[name] = connector
@@ -63,9 +72,29 @@ class CatalogManager:
             return TableHandle(parts[0], parts[1], parts[2])
         raise QueryError(f"invalid table name {'.'.join(parts)}")
 
+    def check_access(self, kind: str, user: str,
+                     handle: TableHandle) -> None:
+        """Gate `kind` ("select" | "write") on the handle; raises
+        QueryError on denial. The ONE access-check path for reads
+        (name resolution) and writes (sink acquisition)."""
+        if self.access_control is None:
+            return
+        from presto_tpu.execution.access_control import (
+            AccessDeniedError,
+        )
+        try:
+            if kind == "select":
+                self.access_control.check_can_select(user, handle)
+            else:
+                self.access_control.check_can_write(user, handle)
+        except AccessDeniedError as e:
+            raise QueryError(str(e)) from e
+
     def resolve_table(self, parts: Tuple[str, ...], session: Session
                       ) -> Tuple[TableHandle, RelationSchema]:
         handle = self.handle_for(parts, session)
+        self.check_access("select", getattr(session, "user", ""),
+                          handle)
         conn = self.connector(handle.catalog)
         try:
             schema = conn.metadata.get_table_schema(handle)
@@ -106,7 +135,8 @@ class MaterializedResult:
 
 class LocalRunner:
     def __init__(self, catalog: str = "tpch", schema: str = "tiny",
-                 properties: Optional[Dict[str, Any]] = None):
+                 properties: Optional[Dict[str, Any]] = None,
+                 user: str = "", access_control=None):
         from presto_tpu.connectors.memory import (
             BlackholeConnector, MemoryConnector,
         )
@@ -123,7 +153,9 @@ class LocalRunner:
         from presto_tpu.connectors.system import runner_system_connector
         self.query_history: List[Dict[str, Any]] = []
         self.catalogs.register("system", runner_system_connector(self))
-        self.session = Session(catalog, schema, dict(properties or {}))
+        self.session = Session(catalog, schema, dict(properties or {}),
+                               user=user)
+        self.catalogs.access_control = access_control
         self._load_plugins()
 
     def _load_plugins(self) -> None:
@@ -137,23 +169,41 @@ class LocalRunner:
         catalog_dir = os.environ.get("PRESTO_TPU_CATALOG_DIR")
         if not plugin_dir and not catalog_dir:
             return
-        from presto_tpu.connectors.files import FileConnector
-        from presto_tpu.connectors.memory import MemoryConnector
-        from presto_tpu.connectors.tpch import TpchConnector
-        from presto_tpu.server.plugins import (
-            PluginRegistry, load_catalogs, load_plugins,
-        )
-        reg = PluginRegistry()
-        reg.register_connector_factory(
-            "file", lambda cfg: FileConnector(cfg.get("file.root")))
-        reg.register_connector_factory(
-            "memory", lambda cfg: MemoryConnector())
-        reg.register_connector_factory(
-            "tpch", lambda cfg: TpchConnector())
-        if plugin_dir:
-            load_plugins(plugin_dir, reg)
-        if catalog_dir:
-            load_catalogs(catalog_dir, reg, self.catalogs)
+        # process-wide memo: the server builds a LocalRunner per
+        # statement/task, and re-exec'ing plugin modules + rebuilding
+        # connectors per query would put file I/O and plugin
+        # import-time side effects on the hot path
+        key = (plugin_dir, catalog_dir)
+        cached = _PLUGIN_CATALOG_CACHE.get(key)
+        if cached is None:
+            from presto_tpu.connectors.files import FileConnector
+            from presto_tpu.connectors.memory import MemoryConnector
+            from presto_tpu.connectors.tpch import TpchConnector
+            from presto_tpu.server.plugins import (
+                PluginRegistry, load_catalogs, load_plugins,
+            )
+            reg = PluginRegistry()
+            reg.register_connector_factory(
+                "file",
+                lambda cfg: FileConnector(cfg.get("file.root")))
+            reg.register_connector_factory(
+                "memory", lambda cfg: MemoryConnector())
+            reg.register_connector_factory(
+                "tpch", lambda cfg: TpchConnector())
+            if plugin_dir:
+                load_plugins(plugin_dir, reg)
+            staged = CatalogManager()
+            if catalog_dir:
+                load_catalogs(catalog_dir, reg, staged)
+            cached = [(n, staged.connector(n))
+                      for n in staged.catalogs()]
+            _PLUGIN_CATALOG_CACHE[key] = cached
+        for name, conn in cached:
+            if name in self.catalogs.catalogs():
+                from presto_tpu.server.plugins import PluginError
+                raise PluginError(
+                    f"catalog {name!r} is already registered")
+            self.catalogs.register(name, conn)
 
     def register_connector(self, name: str, connector: Connector):
         self.catalogs.register(name, connector)
@@ -347,6 +397,8 @@ class LocalRunner:
         return CatalogManager.handle_for(parts, self.session)
 
     def _sink_for(self, handle: TableHandle):
+        self.catalogs.check_access(
+            "write", getattr(self.session, "user", ""), handle)
         conn = self.catalogs.connector(handle.catalog)
         sink = conn.page_sink
         if sink is None:

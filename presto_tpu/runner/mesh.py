@@ -36,8 +36,10 @@ from presto_tpu.runner.local import (
 class MeshRunner(LocalRunner):
     def __init__(self, catalog: str = "tpch", schema: str = "tiny",
                  properties: Optional[Dict[str, Any]] = None,
-                 n_workers: Optional[int] = None, mesh=None):
-        super().__init__(catalog, schema, properties)
+                 n_workers: Optional[int] = None, mesh=None,
+                 user: str = "", access_control=None):
+        super().__init__(catalog, schema, properties, user=user,
+                         access_control=access_control)
         self.mesh = mesh if mesh is not None else make_mesh(n_workers)
         self.n_workers = int(self.mesh.devices.size)
         self._devices = list(self.mesh.devices.reshape(-1))
@@ -383,8 +385,17 @@ class MeshRunner(LocalRunner):
                 return False
             if bucket_retries.get((fid, g), 0) >= 2:
                 return False
-            if any(d.is_finished() for d in instance_drivers[fid]):
-                return False  # a task already published its stage
+            # a generation is retryable only while nothing PUBLISHED:
+            # the staged SINK is the sole publisher — a finished build
+            # pipeline (bridge feed) is fine, a flushed sink is not
+            from presto_tpu.operators.exchange_ops import (
+                ExchangeSinkOperator,
+            )
+            for d in instance_drivers[fid]:
+                for op in d.operators:
+                    if isinstance(op, ExchangeSinkOperator) \
+                            and (op.is_finished() or not op.staged):
+                        return False
             in_ex = [exchanges[x] for x in
                      fplan.fragments[fid].source_edges]
             if any(ex._retained is None for ex in in_ex):

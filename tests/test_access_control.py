@@ -1,0 +1,24 @@
+import pytest
+from presto_tpu.execution.access_control import (
+    AccessControlManager, AccessRule,
+)
+
+def test_access_control():
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.runner.local import QueryError
+    ac = AccessControlManager([
+        AccessRule(user="intern", table="orders",
+                   allow_select=False, allow_write=False),
+        AccessRule(user="intern", catalog="memory",
+                   allow_select=True, allow_write=False),
+    ])
+    r = LocalRunner("tpch", "tiny", user="intern", access_control=ac)
+    # unmatched tables default-allow
+    assert r.execute("select count(*) from nation").rows() == [(25,)]
+    with pytest.raises(QueryError, match="cannot select"):
+        r.execute("select count(*) from orders")
+    with pytest.raises(QueryError, match="cannot write"):
+        r.execute("create table memory.default.x as select 1 a")
+    # another user is unaffected
+    r2 = LocalRunner("tpch", "tiny", user="admin", access_control=ac)
+    assert r2.execute("select count(*) from orders").rows()[0][0] > 0
