@@ -306,8 +306,7 @@ def main() -> int:
             break
 
     if per_query:
-        emit()
-        return 0
+        return 0  # emit() already printed the final combined line
     print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "rows/s",
                       "vs_baseline": 0.0,
                       "error": "all bench attempts failed or timed out"}))

@@ -564,13 +564,16 @@ class StreamingAggregationOperator(Operator):
 
     def __init__(self, ctx: OperatorContext, key_names: Sequence[str],
                  key_exprs: Sequence[CompiledExpr],
-                 specs: Sequence[AggSpec], step_kernel=None):
+                 specs: Sequence[AggSpec], step_kernel=None,
+                 mode: str = "single"):
         super().__init__(ctx)
         self.key_names = list(key_names)
         self.key_exprs = list(key_exprs)
         self.specs = list(specs)
+        self.mode = mode  # "single" | "partial" (final merges shuffled
+        # states, whose arrival order is not key-sorted)
         self._kernel = step_kernel if step_kernel is not None else \
-            make_agg_step_kernel(key_exprs, specs, "single", None)
+            make_agg_step_kernel(key_exprs, specs, mode, None)
         self._carry = None
         self._pending: list = []  # [(emit_state, live_count_async)]
         self._finishing = False
@@ -585,7 +588,7 @@ class StreamingAggregationOperator(Operator):
         aggs = tuple(s.function for s in self.specs)
         names = tuple(s.out_name for s in self.specs)
         return make_agg_finalize_kernel(
-            "single", tuple(self.key_names), key_types, key_dicts,
+            self.mode, tuple(self.key_names), key_types, key_dicts,
             None, names, aggs)
 
     def add_input(self, batch: Batch) -> None:
@@ -632,19 +635,23 @@ class StreamingAggregationOperator(Operator):
 class StreamingAggregationOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, key_names: Sequence[str],
                  key_exprs: Sequence[CompiledExpr],
-                 specs: Sequence[AggSpec], input_dicts=None):
-        super().__init__(operator_id, "aggregation(streaming)")
+                 specs: Sequence[AggSpec], input_dicts=None,
+                 mode: str = "single"):
+        super().__init__(operator_id,
+                         "aggregation(streaming)" if mode == "single"
+                         else f"aggregation(streaming-{mode})")
         self.key_names = key_names
         self.key_exprs = key_exprs
         self.specs = specs
+        self.mode = mode
         self._step_kernel = make_agg_step_kernel(
-            key_exprs, specs, "single", None, input_dicts)
+            key_exprs, specs, mode, None, input_dicts)
 
     def create(self, driver_context: DriverContext) -> Operator:
         return StreamingAggregationOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.key_names, self.key_exprs, self.specs,
-            self._step_kernel)
+            self._step_kernel, mode=self.mode)
 
 
 class AggregationOperatorFactory(OperatorFactory):

@@ -344,7 +344,7 @@ class LocalExecutionPlanner:
             )
             pipe.append(StreamingAggregationOperatorFactory(
                 self._next_id(), key_names, key_exprs, specs,
-                input_dicts=_schema_dicts(schema)))
+                input_dicts=_schema_dicts(schema), mode=node.step))
             return
         pipe.append(AggregationOperatorFactory(
             self._next_id(), key_names, key_exprs, specs, node.step,
@@ -360,7 +360,10 @@ class LocalExecutionPlanner:
         streaming operator then runs in O(batch) memory with no
         overflow retry (reference: StreamingAggregationOperator +
         connector local properties)."""
-        if node.step != "single" or not node.keys:
+        # single AND partial steps stream over sorted inputs (the
+        # reference's streaming-for-partial-aggregation-enabled); the
+        # FINAL step's shuffled state arrival order is never sorted
+        if node.step not in ("single", "partial") or not node.keys:
             return False
         if not bool(get_property(self.session.properties,
                                  "streaming_aggregation")):

@@ -25,9 +25,15 @@ class QueryError(Exception):
     pass
 
 
-#: (plugin_dir, catalog_dir) -> [(catalog name, connector)] — loaded
-#: once per process, shared by every runner (see _load_plugins)
-_PLUGIN_CATALOG_CACHE: Dict[Tuple, List] = {}
+#: plugin_dir -> PluginRegistry — module EXECUTION (the expensive,
+#: side-effecting part) happens once per process; each runner still
+#: builds its own connector instances from the cached factories, so
+#: runners stay isolated (a shared stateful connector would leak one
+#: session's tables into another). Guarded: concurrent first loads
+#: must not exec plugin modules twice.
+_PLUGIN_REGISTRY_CACHE: Dict[str, Any] = {}
+import threading as _threading
+_PLUGIN_CACHE_LOCK = _threading.Lock()
 
 
 @dataclasses.dataclass
@@ -169,41 +175,32 @@ class LocalRunner:
         catalog_dir = os.environ.get("PRESTO_TPU_CATALOG_DIR")
         if not plugin_dir and not catalog_dir:
             return
-        # process-wide memo: the server builds a LocalRunner per
-        # statement/task, and re-exec'ing plugin modules + rebuilding
-        # connectors per query would put file I/O and plugin
-        # import-time side effects on the hot path
-        key = (plugin_dir, catalog_dir)
-        cached = _PLUGIN_CATALOG_CACHE.get(key)
-        if cached is None:
-            from presto_tpu.connectors.files import FileConnector
-            from presto_tpu.connectors.memory import MemoryConnector
-            from presto_tpu.connectors.tpch import TpchConnector
-            from presto_tpu.server.plugins import (
-                PluginRegistry, load_catalogs, load_plugins,
-            )
-            reg = PluginRegistry()
-            reg.register_connector_factory(
-                "file",
-                lambda cfg: FileConnector(cfg.get("file.root")))
-            reg.register_connector_factory(
-                "memory", lambda cfg: MemoryConnector())
-            reg.register_connector_factory(
-                "tpch", lambda cfg: TpchConnector())
-            if plugin_dir:
-                load_plugins(plugin_dir, reg)
-            staged = CatalogManager()
-            if catalog_dir:
-                load_catalogs(catalog_dir, reg, staged)
-            cached = [(n, staged.connector(n))
-                      for n in staged.catalogs()]
-            _PLUGIN_CATALOG_CACHE[key] = cached
-        for name, conn in cached:
-            if name in self.catalogs.catalogs():
-                from presto_tpu.server.plugins import PluginError
-                raise PluginError(
-                    f"catalog {name!r} is already registered")
-            self.catalogs.register(name, conn)
+        from presto_tpu.connectors.files import FileConnector
+        from presto_tpu.connectors.memory import MemoryConnector
+        from presto_tpu.connectors.tpch import TpchConnector
+        from presto_tpu.server.plugins import (
+            PluginRegistry, load_catalogs, load_plugins,
+        )
+        # module EXECUTION memoized per process (the server builds a
+        # runner per statement/task; re-exec'ing plugin files each
+        # query would put import side effects on the hot path);
+        # connector INSTANCES stay per-runner for session isolation
+        with _PLUGIN_CACHE_LOCK:
+            reg = _PLUGIN_REGISTRY_CACHE.get(plugin_dir or "")
+            if reg is None:
+                reg = PluginRegistry()
+                reg.register_connector_factory(
+                    "file",
+                    lambda cfg: FileConnector(cfg.get("file.root")))
+                reg.register_connector_factory(
+                    "memory", lambda cfg: MemoryConnector())
+                reg.register_connector_factory(
+                    "tpch", lambda cfg: TpchConnector())
+                if plugin_dir:
+                    load_plugins(plugin_dir, reg)
+                _PLUGIN_REGISTRY_CACHE[plugin_dir or ""] = reg
+        if catalog_dir:
+            load_catalogs(catalog_dir, reg, self.catalogs)
 
     def register_connector(self, name: str, connector: Connector):
         self.catalogs.register(name, connector)

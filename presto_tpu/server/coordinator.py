@@ -91,7 +91,8 @@ class Coordinator(Node):
                  host: str = "127.0.0.1", port: int = 0,
                  max_concurrent_queries: int = 4,
                  max_queued_queries: int = 100,
-                 resource_groups=None, selectors=None):
+                 resource_groups=None, selectors=None,
+                 access_control=None):
         from presto_tpu.execution.resource_groups import (
             GroupSpec, ResourceGroupManager,
         )
@@ -107,6 +108,9 @@ class Coordinator(Node):
                 max_queued=max_queued_queries)
         self.resource_groups = ResourceGroupManager(
             resource_groups, selectors)
+        #: table-level access control applied at analysis, with the
+        #: client's X-Presto-User identity (None = allow all)
+        self.access_control = access_control
         #: event listener SPI (reference: spi/eventlistener/
         #: EventListener + EventListenerManager.java): callables
         #: receiving {"event": "query_created"|"query_completed", ...};
@@ -356,7 +360,7 @@ th{{background:#222}}
         try:
             result = self.execute(
                 q.sql, on_columns=lambda cols: setattr(
-                    q, "columns", cols))
+                    q, "columns", cols), user=q.user)
             q.columns = [
                 {"name": n, "type": f.type.display()}
                 for n, f in zip(result.names, result.fields)]
@@ -377,7 +381,7 @@ th{{background:#222}}
                 "rows": len(q.data) if q.data is not None else 0,
                 "error": q.error})
 
-    def execute(self, sql: str, on_columns=None):
+    def execute(self, sql: str, on_columns=None, user: str = ""):
         """Distributed execution with elastic retry: a failed or dead
         worker fails the attempt, the membership is re-probed, and the
         query re-runs on the survivors — splits regenerate identically
@@ -396,7 +400,8 @@ th{{background:#222}}
         while True:
             try:
                 return self._execute_attempt(sql, workers, props,
-                                             on_columns=on_columns)
+                                             on_columns=on_columns,
+                                             user=user)
             except Exception as e:  # noqa: BLE001 — inspect + retry
                 # sync-free overflow protocol: re-run the WHOLE query
                 # with the suggested setting (any fragment may have
@@ -444,7 +449,7 @@ th{{background:#222}}
 
     def _execute_attempt(self, sql: str, worker_urls: List[str],
                          properties: Optional[dict] = None,
-                         on_columns=None):
+                         on_columns=None, user: str = ""):
         """One scheduling attempt over a fixed worker set."""
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
@@ -454,7 +459,12 @@ th{{background:#222}}
         )
         properties = dict(self.properties if properties is None
                           else properties)
-        runner = LocalRunner(self.catalog, self.schema, properties)
+        # the client's identity gates access control at the
+        # COORDINATOR, where analysis happens — workers only execute
+        # already-authorized fragments
+        runner = LocalRunner(self.catalog, self.schema, properties,
+                             user=user,
+                             access_control=self.access_control)
         fplan = derive_fragments(runner, sql)
         if not worker_urls and any(
                 f.partitioning == "distributed"

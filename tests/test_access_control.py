@@ -22,3 +22,37 @@ def test_access_control():
     # another user is unaffected
     r2 = LocalRunner("tpch", "tiny", user="admin", access_control=ac)
     assert r2.execute("select count(*) from orders").rows()[0][0] > 0
+
+
+def test_coordinator_enforces_identity():
+    """The X-Presto-User identity gates access at the coordinator,
+    where analysis runs (workers only execute authorized fragments)."""
+    import json, os, signal, subprocess, sys
+    from presto_tpu.server.coordinator import Coordinator, StatementClient
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.server.node", "--port", "0"],
+        cwd="/root/repo", env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    url = json.loads(proc.stdout.readline())["url"]
+    ac = AccessControlManager([
+        AccessRule(user="intern", table="orders", allow_select=False)])
+    c = Coordinator([url], "tpch", "tiny", access_control=ac)
+    c.start()
+    try:
+        _, rows = StatementClient(c.url, user="intern").execute(
+            "select count(*) from nation")
+        assert rows == [[25]]
+        with pytest.raises(RuntimeError, match="cannot select"):
+            StatementClient(c.url, user="intern").execute(
+                "select count(*) from orders")
+        _, rows = StatementClient(c.url, user="analyst").execute(
+            "select count(*) from orders")
+        assert rows[0][0] > 0
+    finally:
+        c.stop()
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

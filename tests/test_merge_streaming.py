@@ -214,3 +214,22 @@ def test_streaming_bounded_state():
     exp = r.execute(
         "select count(distinct orderkey) from lineitem").rows()
     assert got == exp
+
+
+def test_streaming_partial_on_mesh():
+    """The PARTIAL step streams over declared-sorted scans too (the
+    reference's streaming-for-partial-aggregation): mesh plans show
+    aggregation(streaming-partial) feeding the shuffled final, with
+    oracle-matched results."""
+    import re
+    from presto_tpu.runner import LocalRunner, MeshRunner
+    sql = ("select count(*) from (select orderkey from lineitem "
+           "group by orderkey having sum(quantity) > 150)")
+    local = LocalRunner("tpch", "tiny")
+    mesh = MeshRunner("tpch", "tiny", {"target_splits": 8})
+    assert mesh.execute(sql).rows() == local.execute(sql).rows()
+    res = mesh.execute("explain analyze select orderkey, count(*) "
+                       "from lineitem group by orderkey")
+    text = "\n".join(r[0] for r in res.rows())
+    assert "aggregation(streaming-partial)" in text
+    assert "aggregation(final)" in text
