@@ -1113,6 +1113,13 @@ _MATH_FNS = {
     "bitwise_right_shift": jnp.right_shift,
     "cot": lambda x: 1.0 / jnp.tan(x),
     "log1p": jnp.log1p, "expm1": jnp.expm1,
+    # popcount of the low `bits` bits of x's two's complement
+    # (reference: MathFunctions.bitCount)
+    "bit_count": lambda x, bits: jax.lax.population_count(
+        x.astype(jnp.uint64)
+        & jnp.where(bits >= 64, jnp.uint64(0xFFFFFFFFFFFFFFFF),
+                    (jnp.uint64(1) << bits.astype(jnp.uint64))
+                    - jnp.uint64(1))).astype(jnp.int64),
 }
 
 _DATE_EXTRACT = {

@@ -30,6 +30,14 @@ _ANALYZER_SCALARS = (
     "from_unixtime", "to_unixtime",
     "length", "char_length", "character_length", "substring",
     "grouping",
+    # array / map / row value forms (analysis-time lowering; arrays
+    # construct via the ARRAY[...] syntax form, not a function name)
+    "split", "cardinality", "element_at",
+    "contains", "array_position", "array_min", "array_max",
+    "array_join", "map", "row", "map_keys", "map_values",
+    # lambda-taking functions
+    "transform", "reduce", "any_match", "all_match", "none_match",
+    "zip_with", "transform_values",
 )
 
 

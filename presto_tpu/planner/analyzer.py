@@ -3002,6 +3002,21 @@ class _Analyzer:
                     "bitwise_not", "bitwise_left_shift",
                     "bitwise_right_shift"):
             return Call(name, tuple(args), BIGINT)
+        if name == "bit_count":
+            # reference: MathFunctions.bitCount requires bits in
+            # [2, 64]. Deviation: values not representable in `bits`
+            # bits are masked to their low bits, not rejected (a
+            # per-row data-dependent error has no sync-free channel)
+            if len(args) != 2:
+                raise AnalysisError("bit_count(x, bits) takes two "
+                                    "arguments")
+            b = fold_constants(args[1])
+            if not isinstance(b, Literal) or b.value is None \
+                    or not b.type.is_integer \
+                    or not 2 <= int(b.value) <= 64:
+                raise AnalysisError(
+                    "bit_count's bits must be a constant in [2, 64]")
+            return Call(name, tuple(args), BIGINT)
         if name == "pi" and not args:
             import math as _math
             return Literal(_math.pi, DOUBLE)

@@ -201,3 +201,25 @@ def test_show_functions(runner):
     assert kinds["row_number"] == "window"
     assert kinds["regexp_like"] == "scalar"
     assert rows == sorted(rows)  # deterministic listing
+
+
+def test_round4_additions(runner):
+    """bit_count + the round-4 value forms are registered AND execute
+    (maps/rows/lambdas, SHOW FUNCTIONS lists them)."""
+    from presto_tpu.functions import registered_functions
+    listed = {n for n, _ in registered_functions()}
+    for name in ("bit_count", "map", "row", "map_keys", "map_values",
+                 "transform", "reduce", "zip_with", "any_match",
+                 "transform_values", "approx_distinct"):
+        assert name in listed, name
+    assert len(listed) >= 170, len(listed)
+    assert one(runner, "bit_count(9, 64)") == 2
+    assert one(runner, "bit_count(-7, 64)") == 62
+    # documented deviation: unrepresentable values mask to their low
+    # bits (the reference raises per-row)
+    assert one(runner, "bit_count(255, 4)") == 4
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises(QueryError, match="two arguments"):
+        runner.execute("select bit_count(9)")
+    with pytest.raises(QueryError, match="constant in"):
+        runner.execute("select bit_count(9, 1)")
