@@ -66,3 +66,17 @@ def test_tpcds_query(qn, runner, oracle):
     exp = [tuple(r) for r in cur.fetchall()]
     assert len(exp) > 0 or qn in (19,), f"oracle empty for q{qn}"
     assert_rows_equal(got, exp, qn, qn in FULLY_ORDERED)
+
+
+def test_tpcds_mesh_sample():
+    """A TPC-DS sample on the 8-device mesh matches local execution
+    (the TPC-H battery runs distributed elsewhere; TPC-DS exercises
+    different join/rollup shapes)."""
+    from tpcds_queries import QUERIES
+    from presto_tpu.runner import LocalRunner, MeshRunner
+    local = LocalRunner("tpcds", "tiny")
+    mesh = MeshRunner("tpcds", "tiny", {"target_splits": 8})
+    for n in sorted(QUERIES)[:4]:
+        a = sorted(map(str, local.execute(QUERIES[n]).rows()))
+        b = sorted(map(str, mesh.execute(QUERIES[n]).rows()))
+        assert a == b, (n, a[:2], b[:2])
