@@ -2760,10 +2760,55 @@ class _Analyzer:
             return ArrayValue(elems, length, array_type(t0))
 
         if name == "filter":
-            raise AnalysisError(
-                "filter(array, lambda) is not supported: fixed-width "
-                "array values cannot compact at analysis time — use "
-                "transform with a conditional, or UNNEST + WHERE")
+            # filter compacts passing elements to the front: result
+            # slot j takes element i where (count of passes among
+            # elements 1..i) == j+1 and element i passes. The CASE
+            # chains are O(W^2) IR with SHARED predicate/count
+            # subtrees (the DAG the compiler memoizes), so width stays
+            # cheap to compile; capped anyway to keep lowered
+            # expressions reviewable (reference:
+            # operator/scalar/ArrayFilterFunction).
+            if len(raw_args) != 2:
+                raise AnalysisError("filter(array, x -> pred)")
+            arr = arr_arg(0)
+            lam = lam_arg(1, 1)
+            w = len(arr.elements)
+            if w > 16:
+                raise AnalysisError(
+                    "filter over arrays wider than 16 is not "
+                    "supported — use UNNEST + WHERE")
+            et = arr.type.element
+            passes = []
+            for i, e in enumerate(arr.elements, 1):
+                p = _coerce_to(self._bind_lambda(lam, [e]), BOOLEAN)
+                g = self._array_guard(arr, i)
+                # padding slots and NULL predicates both exclude
+                p = SpecialForm(
+                    "if", (p if g is None else and_(g, p),
+                           Literal(True, BOOLEAN),
+                           Literal(False, BOOLEAN)), BOOLEAN)
+                passes.append(p)
+            # running pass counts (shared subtrees)
+            counts: List[RowExpression] = []
+            run: RowExpression = Literal(0, BIGINT)
+            for p in passes:
+                run = Call("add", (run, SpecialForm(
+                    "cast", (p,), BIGINT)), BIGINT)
+                counts.append(run)
+            elems = []
+            for j in range(w):
+                out: RowExpression = Literal(None, et)
+                for i in range(w, 0, -1):
+                    cond = and_(passes[i - 1],
+                                Call("equal",
+                                     (counts[i - 1],
+                                      Literal(j + 1, BIGINT)),
+                                     BOOLEAN))
+                    out = SpecialForm(
+                        "if", (cond, arr.elements[i - 1], out), et)
+                elems.append(out)
+            return ArrayValue(tuple(elems), counts[-1] if w else None,
+                              array_type(et))
         raise AnalysisError(
             f"{name} does not take lambda arguments")
 
