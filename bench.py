@@ -280,6 +280,22 @@ def main() -> int:
         if platform == "mixed":
             line["platform_by_query"] = platforms
         print(json.dumps(line), flush=True)
+        # every capture containing >= 1 NATIVE query is committed as an
+        # artifact the moment it exists (VERDICT r4: "a number that
+        # isn't in a committed JSON with platform + timestamp doesn't
+        # exist") — bench.py itself only writes the file; committing is
+        # the runner's job, but the file survives a crashed run
+        native_qs = {q: r for q, r in per_query.items()
+                     if platforms.get(q) == "native"}
+        if native_qs:
+            artifact = dict(line)
+            artifact["platform_by_query"] = dict(platforms)
+            artifact["captured_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%S%z")
+            path = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "BENCH_NATIVE_r05.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=1)
 
     for qname in _queries():
         for name, _ in attempts:

@@ -13,11 +13,36 @@ arhimondr/presto), built idiomatically for JAX/XLA/TPU:
   HTTP exchange) becomes `jax.lax.all_to_all` over an ICI device mesh.
 """
 
+import os as _os
+
 import jax
 
 # SQL semantics need exact 64-bit integer arithmetic (BIGINT, DECIMAL as
 # scaled int64); enable before any array is created.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: every engine process (bench children,
+# wedge retries, worker agents) reuses compiled kernels from disk, so a
+# retry after a TPU-tunnel wedge repays ~0 compile time (cold Q18 was
+# 53.8s vs 30.5s warm in round 4 — mostly compiles). NOT enabled when
+# JAX_PLATFORMS=cpu: XLA:CPU's persistent entries are AOT executables
+# stamped with synthetic machine features (+prefer-no-scatter) that
+# fail the loader's host check on reload (SIGILL-risk error spam, no
+# speedup) — and CPU compiles are cheap anyway. Opt in/out explicitly
+# with PRESTO_TPU_COMPILE_CACHE=<dir>/0; default-on otherwise (TPU).
+_cc = _os.environ.get("PRESTO_TPU_COMPILE_CACHE", "")
+if _cc != "0" and (_cc or
+                   "cpu" not in _os.environ.get("JAX_PLATFORMS", "")):
+    if not _cc:
+        _cc = _os.path.join(_os.path.expanduser("~"), ".cache",
+                            "presto_tpu_xla")
+    try:
+        _os.makedirs(_cc, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cc)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # cache is an optimization, never a requirement
+        pass
 
 from presto_tpu.types import (  # noqa: E402
     BIGINT, INTEGER, SMALLINT, TINYINT, DOUBLE, REAL, BOOLEAN, VARCHAR,

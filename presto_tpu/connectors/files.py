@@ -59,6 +59,15 @@ _TYPE_TO_PQ = {
     "date": (pq.T_INT32, pq.CONV_DATE),
     "varchar": (pq.T_BYTE_ARRAY, pq.CONV_UTF8),
 }
+#: engine type name -> ORC Type.Kind for the ORC write path
+_TYPE_TO_ORC = {
+    "boolean": 0,   # K_BOOLEAN
+    "integer": 3,   # K_INT
+    "bigint": 4,    # K_LONG
+    "double": 6,    # K_DOUBLE
+    "varchar": 7,   # K_STRING
+    "date": 15,     # K_DATE
+}
 
 
 def _engine_type(col: pq.ParquetColumn) -> Type:
@@ -190,11 +199,10 @@ class _FileCatalog:
                 return base + ext
         return base + ".parquet"
 
-    def write_path(self, handle: TableHandle) -> str:
-        """Writes always produce parquet (an INSERT into an ORC table
-        rewrites it in the write format)."""
+    def write_path(self, handle: TableHandle,
+                   fmt: str = "parquet") -> str:
         return os.path.join(self.root, handle.schema,
-                            handle.table + ".parquet")
+                            handle.table + "." + fmt)
 
     def info(self, handle: TableHandle
              ) -> Tuple[_TableView, Dict[str, tuple]]:
@@ -369,17 +377,33 @@ class _FilePageSink(ConnectorPageSink):
                             Tuple[RelationSchema, List[Batch]]] = {}
         # INSERT rewrites: existing rows staged host-side per table
         self._base: Dict[Tuple[str, str], Tuple[Dict, Dict]] = {}
+        #: committed write format per staged table (CTAS WITH
+        #: (format=...); INSERT keeps the existing file's format)
+        self._formats: Dict[Tuple[str, str], str] = {}
 
     def create_table(self, handle: TableHandle,
-                     schema: RelationSchema) -> None:
+                     schema: RelationSchema,
+                     properties: Optional[dict] = None) -> None:
         path = self._cat.path(handle)
         if os.path.exists(path):
             raise FileExistsError(f"table {handle} already exists")
+        props = properties or {}
+        fmt = str(props.get("format", "parquet")).lower()
+        if fmt not in ("parquet", "orc"):
+            raise ValueError(
+                f"file connector format must be parquet or orc, "
+                f"got {fmt!r}")
+        unknown = set(props) - {"format"}
+        if unknown:
+            raise ValueError(
+                f"unknown table properties {sorted(unknown)} "
+                f"(file connector supports: format)")
         for c in schema.columns:
             if c.type.name not in _TYPE_TO_PQ:
                 raise pq.ParquetError(
                     f"cannot write {c.type.name} column {c.name}")
         self._pending[(handle.schema, handle.table)] = (schema, [])
+        self._formats[(handle.schema, handle.table)] = fmt
 
     def append(self, handle: TableHandle, batch: Batch) -> None:
         key = (handle.schema, handle.table)
@@ -392,6 +416,8 @@ class _FilePageSink(ConnectorPageSink):
             # device or re-encode strings through dictionaries
             schema = _FileMetadata(self._cat).get_table_schema(handle)
             view, _ = self._cat.info(handle)
+            self._formats[key] = "orc" \
+                if self._cat.path(handle).endswith(".orc") else "parquet"
             base: Dict[str, list] = {n: [] for n, _ in view.columns}
             base_masks: Dict[str, list] = {n: []
                                            for n, _ in view.columns}
@@ -445,15 +471,24 @@ class _FilePageSink(ConnectorPageSink):
                     else np.zeros(0, c.type.np_dtype)
             flat_masks[c.name] = np.concatenate(
                 masks[c.name]) if masks[c.name] else np.zeros(0, bool)
+        fmt = self._formats.pop(key, "parquet")
         old_path = self._cat.path(handle)
-        path = self._cat.write_path(handle)
+        path = self._cat.write_path(handle, fmt)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
-        pq.write_table(tmp, cols, flat_data, flat_masks,
-                       row_group_rows=1 << 20)
+        if fmt == "orc":
+            from presto_tpu.storage import orc as orc_mod
+            ocols = [(c.name, _TYPE_TO_ORC[c.type.name])
+                     for c in schema.columns]
+            orc_mod.write_table(tmp, ocols, flat_data, flat_masks,
+                                stripe_rows=1 << 18)
+        else:
+            pq.write_table(tmp, cols, flat_data, flat_masks,
+                           row_group_rows=1 << 20)
         os.replace(tmp, path)
         if old_path != path and os.path.exists(old_path):
-            # INSERT into an ORC table rewrote it in the write format
+            # a CREATE in one format replacing a prior file of the
+            # other format (or a legacy rewrite) removes the original
             os.unlink(old_path)
             self._cat.evict(old_path)
         self._cat.evict(path)

@@ -86,7 +86,12 @@ class _MemoryPageSink(ConnectorPageSink):
         self._pending: Dict[Tuple[str, str], List[Batch]] = {}
 
     def create_table(self, handle: TableHandle,
-                     schema: RelationSchema) -> None:
+                     schema: RelationSchema,
+                     properties=None) -> None:
+        if properties:
+            raise ValueError(
+                f"memory connector supports no table properties, "
+                f"got {sorted(properties)}")
         key = (handle.schema, handle.table)
         if key in self._tables:
             raise ValueError(f"table {handle} already exists")
@@ -175,7 +180,12 @@ class _BlackholeSink(ConnectorPageSink):
         self._tables = tables
 
     def create_table(self, handle: TableHandle,
-                     schema: RelationSchema) -> None:
+                     schema: RelationSchema,
+                     properties=None) -> None:
+        if properties:
+            raise ValueError(
+                f"blackhole connector supports no table properties, "
+                f"got {sorted(properties)}")
         self._tables[(handle.schema, handle.table)] = _Table(schema)
 
     def append(self, handle: TableHandle, batch: Batch) -> None:

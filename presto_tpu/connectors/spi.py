@@ -147,7 +147,14 @@ class ConnectorPageSink(abc.ABC):
 
     @abc.abstractmethod
     def create_table(self, handle: TableHandle,
-                     schema: RelationSchema) -> None: ...
+                     schema: RelationSchema,
+                     properties: Optional[dict] = None) -> None:
+        """Stage a new table. `properties` carries the CREATE TABLE
+        WITH (...) clause (reference: ConnectorMetadata
+        createTable's ConnectorTableMetadata.getProperties) — e.g.
+        the file connector's format='orc'/'parquet' and
+        partitioned_by=ARRAY['col']. Connectors must REJECT
+        properties they do not support (silent drops hide typos)."""
 
     @abc.abstractmethod
     def append(self, handle: TableHandle, batch: Batch) -> None: ...

@@ -510,7 +510,13 @@ class _TpchMetadata(ConnectorMetadata):
             "supplier": ["suppkey"],
             "nation": ["nationkey"],
             "region": ["regionkey"],
-            "partsupp": ["partkey", "suppkey"],
+            # partkey ONLY: _gen_partsupp emits suppkey as
+            # (partkey + i*step) % nsupp + 1, which wraps modulo nsupp
+            # and is NOT ascending within a partkey — declaring the
+            # second key would let the streaming-aggregation carry
+            # protocol (key-sorted input contract) silently drop or
+            # duplicate a group spanning a batch boundary
+            "partsupp": ["partkey"],
         }.get(handle.table)
 
     def column_stats(self, handle: TableHandle):

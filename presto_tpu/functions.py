@@ -36,8 +36,8 @@ _ANALYZER_SCALARS = (
     "contains", "array_position", "array_min", "array_max",
     "array_join", "map", "row", "map_keys", "map_values",
     # lambda-taking functions
-    "transform", "reduce", "any_match", "all_match", "none_match",
-    "zip_with", "transform_values",
+    "transform", "filter", "reduce", "any_match", "all_match",
+    "none_match", "zip_with", "transform_values",
 )
 
 

@@ -51,6 +51,15 @@ class AggSpec:
 # cache across queries.
 #: log-depth tree merge of buffered per-batch partials (sort path)
 _jit_merge = jax.jit(hashagg.merge_partials, static_argnums=(1, 2))
+
+
+def merge_states(states, aggs, out_cap: int):
+    """merge_partials, one jitted dispatch. (A host-lexsort split was
+    measured here in round 5 and LOST: the eager np.asarray sync per
+    merge flushes the driver's async overlap, costing more than the
+    in-jit sort saves — the split only pays at operator points that
+    already sync, like the join build's finish().)"""
+    return _jit_merge(tuple(states), aggs, out_cap)
 #: buffered partials per merge round: each merge sorts FANIN x P rows,
 #: so the per-input-row sort cost stays ~(1 + 1/FANIN + ...) ~ 1.15x
 _MERGE_FANIN = 8
@@ -91,7 +100,7 @@ _AGG_STEP_CACHE_MAX = 256
 def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                          specs: Sequence["AggSpec"], mode: str,
                          domains: Optional[Tuple[int, ...]],
-                         input_dicts=None):
+                         input_dicts=None, presorted: bool = False):
     """Build (or fetch) the jitted (state, batch) -> state fold step.
 
     `input_dicts` is the (name, dictionary) token of the dict-encoded
@@ -109,7 +118,7 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
             # fingerprints, not raw IR: see operators/core.py — IR
             # hash/eq is exponential on lambda-produced DAGs
             from presto_tpu.expr.ir import fingerprint as _fp
-            key = (mode, domains, input_dicts,
+            key = (mode, domains, input_dicts, presorted,
                    tuple((_fp(ke.ir), ke.dictionary)
                          for ke in key_exprs),
                    tuple((s.out_name if mode == "final" else None,
@@ -169,11 +178,17 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
     else:
         # sort path: expression eval + per-batch compaction fused into
         # ONE dispatch; out_cap is static so one Python kernel serves
-        # every max_groups retry size
+        # every max_groups retry size. presorted=True (the streaming
+        # operator) swaps the variadic sort for boundary detection on
+        # the already-key-ordered rows.
+        group_fn = hashagg.presorted_aggregate if presorted \
+            else hashagg.batch_aggregate
+
         @functools.partial(jax.jit, static_argnums=(0,))
         def kernel(out_cap: int, batch: Batch):
-            key_cols, agg_inputs, agg_weights, merge = _batch_parts(batch)
-            return hashagg.batch_aggregate(
+            key_cols, agg_inputs, agg_weights, merge = \
+                _batch_parts(batch)
+            return group_fn(
                 batch.row_valid, key_cols, agg_inputs, agg_weights,
                 aggs, out_cap, merge)
 
@@ -371,7 +386,7 @@ class AggregationOperator(Operator):
             aggs = tuple(s.function for s in self.specs)
             states = tuple(s for s, _ in buf)
             lives = sum(l for _, l in buf)
-            merged = _jit_merge(states, aggs, self._live_cap(lives))
+            merged = merge_states(states, aggs, self._live_cap(lives))
             if self.ctx.driver_context.memory is not None:
                 self.ctx.driver_context.memory.free(
                     self.ctx.tag,
@@ -400,13 +415,13 @@ class AggregationOperator(Operator):
             while len(group) < _MERGE_FANIN:
                 group.append(
                     (hashagg.init_state(key_types, aggs, cap), 0))
-            merged = _jit_merge(tuple(s for s, _ in group), aggs,
-                                self._live_cap(lives))
+            merged = merge_states(tuple(s for s, _ in group), aggs,
+                                  self._live_cap(lives))
             level.append((merged, lives))
         level.sort(key=lambda e: self._state_cap(e[0]))
         while len(level) > 1:
             (sa, la), (sb, lb) = level.pop(0), level.pop(0)
-            m = _jit_merge((sa, sb), aggs, self._live_cap(la + lb))
+            m = merge_states((sa, sb), aggs, self._live_cap(la + lb))
             level.append((m, la + lb))
             level.sort(key=lambda e: self._state_cap(e[0]))
         return level[0][0]
@@ -452,7 +467,7 @@ class AggregationOperator(Operator):
             while len(work) > _MERGE_FANIN:
                 group = work[:_MERGE_FANIN]
                 lives = sum(l for _, l in group)
-                merged = _jit_merge(
+                merged = merge_states(
                     tuple(jax.device_put(s) for s, _ in group), aggs,
                     self._live_cap(lives))
                 work = work[_MERGE_FANIN:]
@@ -525,30 +540,72 @@ class AggregationOperator(Operator):
             self._host_spill = []
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+@functools.partial(jax.jit, static_argnums=(2,))
 def _stream_step(carry: "hashagg.GroupByState",
-                 partial: "hashagg.GroupByState",
-                 aggs, out_cap: int):
-    """One streaming-aggregation round: fold the carried boundary group
-    into this batch's packed partial, emit every COMPLETE group (all
-    but the last in key order — only the last can continue into the
-    next batch of a key-sorted stream), and slice the last group out as
-    the new carry. All on device; groups stay packed in ascending key
-    order, so emission preserves the input's sort order."""
-    merged = hashagg.merge_partials([carry, partial], aggs, out_cap)
-    ng = jnp.sum(merged.valid)
+                 partial: "hashagg.GroupByState", aggs):
+    """One streaming-aggregation round, all arithmetic — NO re-grouping
+    sort (the round-4 formulation merged carry+partial through the full
+    sort-based merge_partials: a second 1M-row variadic sort per batch).
+
+    The stream is globally key-sorted, so the carried boundary group
+    can only interact with the batch's FIRST packed group:
+      - same key  -> fold carry's states into slot 0 (masked .at[0] op)
+      - different -> the carry is COMPLETE: emit it as its own
+                     single-group state ahead of the batch's groups
+    Then every group but the last is complete (emit), and the last is
+    sliced out as the new carry. An empty batch (no groups) passes the
+    carry through untouched.
+
+    Returns (carry_emit[1], emit[cap], carry_out[1], emit_live)."""
+    ng = jnp.sum(partial.valid)
+    has_groups = ng > 0
+    has_carry = carry.valid[0]
+    same = has_carry & has_groups
+    for (cd, cm), (pd, pm) in zip(carry.keys, partial.keys):
+        eq = jnp.where(cm[0] & pm[0], cd[0] == pd[0],
+                       ~cm[0] & ~pm[0])
+        same = same & eq
+
+    # fold carry into slot 0, gated: the contribution is the reduce
+    # identity unless `same` (so no branch, no shift of the big arrays)
+    new_states = []
+    for cst, pst, agg in zip(carry.states, partial.states, aggs):
+        comps = []
+        for ca, pa, r, comp in zip(cst, pst, agg.reduces,
+                                   agg.state_dtypes):
+            c0 = jnp.where(same, ca[0],
+                           hashagg._ident_for(r, comp)).astype(pa.dtype)
+            if r == "sum":
+                comps.append(pa.at[0].add(c0))
+            elif r == "min":
+                comps.append(pa.at[0].min(c0))
+            else:
+                comps.append(pa.at[0].max(c0))
+        new_states.append(tuple(comps))
+
+    carry_emit = hashagg.GroupByState(
+        carry.keys, carry.states,
+        carry.valid & (has_carry & has_groups & ~same),
+        jnp.asarray(False))
+
     last = jnp.maximum(ng - 1, 0)
-    emit_valid = merged.valid & (jnp.arange(out_cap) < last)
-    emit = hashagg.GroupByState(merged.keys, merged.states, emit_valid,
-                                merged.overflow)
+    cap = partial.valid.shape[0]
+    emit_valid = partial.valid & (jnp.arange(cap) < last)
+    emit = hashagg.GroupByState(partial.keys, new_states, emit_valid,
+                                partial.overflow | carry.overflow)
 
     def slice1(a):
         return jax.lax.dynamic_slice_in_dim(a, last, 1, axis=0)
+
+    def keep1(new, old):
+        return jnp.where(has_groups, slice1(new), old)
     carry_out = hashagg.GroupByState(
-        [(slice1(d), slice1(m)) for d, m in merged.keys],
-        [tuple(slice1(a) for a in st) for st in merged.states],
-        slice1(merged.valid), jnp.asarray(False))
-    return emit, carry_out, last
+        [(keep1(d, od), keep1(m, om))
+         for (d, m), (od, om) in zip(partial.keys, carry.keys)],
+        [tuple(keep1(a, oa) for a, oa in zip(st, ost))
+         for st, ost in zip(new_states, carry.states)],
+        keep1(partial.valid, carry.valid), jnp.asarray(False))
+    return carry_emit, emit, carry_out, last
 
 
 class StreamingAggregationOperator(Operator):
@@ -573,14 +630,15 @@ class StreamingAggregationOperator(Operator):
         self.mode = mode  # "single" | "partial" (final merges shuffled
         # states, whose arrival order is not key-sorted)
         self._kernel = step_kernel if step_kernel is not None else \
-            make_agg_step_kernel(key_exprs, specs, mode, None)
+            make_agg_step_kernel(key_exprs, specs, mode, None,
+                                 presorted=True)
         self._carry = None
         self._pending: list = []  # [(emit_state, live_count_async)]
         self._finishing = False
         self._emitted_tail = False
 
     def needs_input(self) -> bool:
-        return not self._finishing and len(self._pending) < 2
+        return not self._finishing and len(self._pending) < 4
 
     def _finalize_kernel(self):
         key_types = tuple(k.type for k in self.key_exprs)
@@ -600,10 +658,12 @@ class StreamingAggregationOperator(Operator):
         if self._carry is None:
             key_types = [k.type for k in self.key_exprs]
             self._carry = hashagg.init_state(key_types, aggs, 1)
-        # distinct(carry ++ partial) <= batch rows + 1 <= 2 * c0:
-        # overflow is impossible by construction
-        emit, self._carry, live = _stream_step(
-            self._carry, partial, aggs, bucket_capacity(c0 + 1))
+        # a completed carry group (key change at the batch boundary)
+        # precedes this batch's groups in key order, so it goes out as
+        # its own 1-row batch ahead of the main emission
+        carry_emit, emit, self._carry, live = _stream_step(
+            self._carry, partial, aggs)
+        self._pending.append((carry_emit, None))
         self._pending.append((emit, start_async_copy(live)))
 
     def get_output(self) -> Optional[Batch]:
@@ -645,7 +705,7 @@ class StreamingAggregationOperatorFactory(OperatorFactory):
         self.specs = specs
         self.mode = mode
         self._step_kernel = make_agg_step_kernel(
-            key_exprs, specs, mode, None, input_dicts)
+            key_exprs, specs, mode, None, input_dicts, presorted=True)
 
     def create(self, driver_context: DriverContext) -> Operator:
         return StreamingAggregationOperator(

@@ -92,17 +92,20 @@ def partition_segments(batch: Batch, partition_keys: Tuple[str, ...],
     payloads = [batch.row_valid]
     for n in batch.names:
         payloads.extend(batch.columns[n].astuple())
-    out = jax.lax.sort((dest,) + tuple(payloads), num_keys=1,
-                       is_stable=True)
+    if common.cpu_backend():
+        perm = common.stable_argsort(dest)
+        out = [dest[perm]] + [p[perm] for p in payloads]
+    else:
+        out = jax.lax.sort((dest,) + tuple(payloads), num_keys=1,
+                           is_stable=True)
     cols = {}
     for i, n in enumerate(batch.names):
         c = batch.columns[n]
         cols[n] = Column(out[2 + 2 * i], out[3 + 2 * i], c.type,
                          c.dictionary)
-    bounds = jnp.searchsorted(out[0],
-                              jnp.arange(n_consumers + 1,
-                                         dtype=jnp.int32),
-                              side="left")
+    bounds = common.fast_searchsorted(
+        out[0], jnp.arange(n_consumers + 1, dtype=jnp.int32),
+        side="left")
     return Batch(cols, out[1]), bounds
 
 

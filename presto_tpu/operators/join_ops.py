@@ -73,7 +73,7 @@ class SpilledBuild:
             from presto_tpu.batch import empty_batch
             merged = _remap_keys(empty_batch(self.schema_cols),
                                  self.key_names, self.key_dicts)
-        return join_ops.build(merged, self.key_names)
+        return join_ops.build_for_backend(merged, self.key_names)
 
 
 def spill_batch_to_host(b: Batch, part_dev, parts_out: List[list],
@@ -271,7 +271,8 @@ class HashBuildOperator(Operator):
         else:
             raise RuntimeError("empty build side needs schema plumbing")
         self._publish_df(merged)
-        self.bridge.table = join_ops.build(merged, self.key_names)
+        self.bridge.table = join_ops.build_for_backend(
+            merged, self.key_names)
         self._batches = []
 
     def is_finished(self) -> bool:

@@ -98,6 +98,37 @@ class _Parser:
             parts.append(self.ident())
         return tuple(parts)
 
+    def _property_value(self):
+        """Table-property literal: string/number/boolean or
+        ARRAY['a', 'b'] (the shapes CREATE TABLE ... WITH uses)."""
+        t = self.cur
+        if t.kind == "string":
+            self.advance()
+            return t.value
+        if self.accept_op("-"):
+            v = self._property_value()
+            if not isinstance(v, (int, float)):
+                raise ParseError(f"cannot negate property value {v!r}")
+            return -v
+        if t.kind == "number":
+            self.advance()
+            return int(t.value) if t.value.isdigit() else float(t.value)
+        if self.accept_kw("true"):
+            return True
+        if self.accept_kw("false"):
+            return False
+        if self.accept_kw("array"):
+            self.expect_op("[")
+            vals = []
+            if not self.at_op("]"):
+                vals.append(self._property_value())
+                while self.accept_op(","):
+                    vals.append(self._property_value())
+            self.expect_op("]")
+            return vals
+        raise ParseError(f"expected a property literal, found "
+                         f"{t.value!r} at {t.pos}")
+
     # -- statements --------------------------------------------------------
 
     def statement(self) -> T.Node:
@@ -122,8 +153,20 @@ class _Parser:
                 self.expect_kw("exists")
                 if_not = True
             name = self.qualified_name()
+            props = None
+            if self.accept_kw("with"):
+                # WITH (format = 'orc', partitioned_by = ARRAY['c'])
+                self.expect_op("(")
+                props = {}
+                while True:
+                    key = self.ident().lower()
+                    self.expect_op("=")
+                    props[key] = self._property_value()
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
             self.expect_kw("as")
-            return T.CreateTableAs(name, self.query(), if_not)
+            return T.CreateTableAs(name, self.query(), if_not, props)
         if self.accept_kw("insert"):
             self.expect_kw("into")
             name = self.qualified_name()

@@ -4,7 +4,7 @@ we build the subset the analyzer consumes, growing toward parity)."""
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 class Node:
@@ -338,6 +338,9 @@ class CreateTableAs(Node):
     name: Tuple[str, ...]
     query: Query
     if_not_exists: bool = False
+    #: WITH (key = literal, ...) table properties (format,
+    #: partitioned_by, ...), keys lowercased
+    properties: Optional[Dict[str, object]] = None
 
 
 @dataclasses.dataclass

@@ -77,6 +77,23 @@ def _agg_arg_and_params(c, an):
                 raise AnalysisError(
                     f"approx_distinct error bound must be in "
                     f"[{HLL_MIN_ERROR}, {HLL_MAX_ERROR}]")
+            from presto_tpu.ops.hashagg import (
+                HLL_HONORED_MIN_ERROR,
+            )
+            if err < HLL_HONORED_MIN_ERROR:
+                # accepted-but-not-honored precision is a silent lie
+                # (advisor r4): the register table caps at 2^14 (the
+                # per-row one-hot contribution is [rows, m] — 2^16
+                # registers would put a multi-GB intermediate in every
+                # batch step), so bounds below ~0.82% are rejected
+                # with the deviation spelled out rather than clamped
+                raise AnalysisError(
+                    f"approx_distinct error bound {err} is below this "
+                    f"engine's honored minimum "
+                    f"{HLL_HONORED_MIN_ERROR:.6f} (register table "
+                    f"capped at 2^14; Presto accepts "
+                    f"{HLL_MIN_ERROR} but we refuse rather than "
+                    f"silently deliver less precision)")
         return fold_constants(an.analyze(c.args[0])), (err,)
     if len(c.args) != 1:
         raise AnalysisError(f"{c.name} takes one argument")

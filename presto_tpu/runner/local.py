@@ -466,7 +466,7 @@ class LocalRunner:
         schema = RelationSchema([
             ColumnSchema(n, f.type, f.dictionary)
             for n, f in zip(qplan.names, fields)])
-        sink.create_table(handle, schema)
+        sink.create_table(handle, schema, dict(stmt.properties or {}))
         column_sources = dict(zip(qplan.names, qplan.source_symbols))
         n = self._run_write(qplan, handle, sink, schema,
                             column_sources)

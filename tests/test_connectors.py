@@ -159,3 +159,29 @@ def test_pushdown_plan_and_results(runner):
     df = runner.catalogs.connector("tpch").table_pandas("tiny", "orders")
     sel = df[(df.orderdate >= 9496) & (df.orderkey > 100)]
     assert got == [(len(sel), int(sel.orderkey.sum()))]
+
+
+def test_declared_sort_orders_hold(runner):
+    """Every sorted_by declaration must match generator output — the
+    streaming-aggregation operator's carry protocol silently corrupts
+    groups on unsorted input (advisor r4: partsupp declared
+    [partkey, suppkey] while suppkey wraps modulo nsupp)."""
+    import numpy as np
+    conn = runner.catalogs.connector("tpch")
+    gen = conn._gens["tiny"]
+    md = conn.metadata
+    for table in ("orders", "lineitem", "customer", "part", "supplier",
+                  "nation", "region", "partsupp"):
+        handle = type("H", (), {"schema": "tiny", "table": table})()
+        order = md.sorted_by(handle)
+        assert order, table
+        data = gen.generate(table, 0, gen.rows(table))
+        cols = [np.asarray(data[c]) for c in order]
+        # lexicographic non-decreasing check across the declared keys
+        rank = np.zeros(len(cols[0]) - 1, dtype=bool)  # strictly-less seen
+        ok = np.ones(len(cols[0]) - 1, dtype=bool)
+        for c in cols:
+            a, b = c[:-1], c[1:]
+            ok &= rank | (a <= b)
+            rank = rank | (a < b)
+        assert ok.all(), f"{table} not sorted by {order}"

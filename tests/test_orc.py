@@ -229,3 +229,101 @@ def test_insert_into_orc_table_rewrites(orc_runner):
     got = r.execute(
         "select name from region where regionkey = 99").rows()
     assert got == [("NOWHERE",)]
+
+
+# ---------------------------------------------------------------------------
+# writer (round 5): clean-room ORC writer round-tripping with both our
+# reader and pyarrow (reference: orc/OrcWriter.java:96)
+
+
+@pytest.mark.parametrize("compression",
+                         [myorc.COMP_NONE, myorc.COMP_ZLIB])
+def test_writer_roundtrip_own_reader(tmp_path, compression):
+    path = str(tmp_path / "w.orc")
+    rng = np.random.default_rng(3)
+    n = 7000
+    a = rng.integers(-10**14, 10**14, n)
+    b = rng.random(n) * 1e6
+    s = [f"v{i % 57}".encode() for i in range(n)]
+    d = rng.integers(0, 20000, n)
+    f = rng.random(n) > 0.5
+    am = rng.random(n) > 0.15
+    cols = [("a", myorc.K_LONG), ("b", myorc.K_DOUBLE),
+            ("s", myorc.K_STRING), ("d", myorc.K_DATE),
+            ("f", myorc.K_BOOLEAN)]
+    myorc.write_table(path, cols,
+                      {"a": a, "b": b, "s": s, "d": d, "f": f},
+                      masks={"a": am}, stripe_rows=2000,
+                      compression=compression)
+    info = myorc.read_footer(path)
+    assert info.num_rows == n and len(info.stripes) == 4
+    va, ma = [], []
+    for st in info.stripes:
+        v, present = myorc.read_stripe_column(path, info, st, "a")
+        va.append(v)
+        ma.append(present)
+    np.testing.assert_array_equal(np.concatenate(ma), am)
+    np.testing.assert_array_equal(np.concatenate(va), a[am])
+    for name, ref in (("b", b), ("d", d), ("f", f)):
+        parts = [myorc.read_stripe_column(path, info, st, name)[0]
+                 for st in info.stripes]
+        got = np.concatenate(parts)
+        if name == "b":
+            np.testing.assert_allclose(got, ref)
+        else:
+            np.testing.assert_array_equal(got, ref)
+    sv = []
+    for st in info.stripes:
+        v, _ = myorc.read_stripe_column(path, info, st, "s")
+        sv.extend(v)
+    assert sv == s
+    # stripe stats present for pruning (int min/max of stripe 0)
+    assert info.stripes[0].stats[1] == (int(a[:2000][am[:2000]].min()),
+                                        int(a[:2000][am[:2000]].max()))
+
+
+def test_writer_interop_pyarrow(tmp_path):
+    path = str(tmp_path / "pa.orc")
+    n = 3000
+    rng = np.random.default_rng(4)
+    a = rng.integers(-1000, 1000, n)
+    am = rng.random(n) > 0.2
+    s = [f"x{i % 11}".encode() for i in range(n)]
+    myorc.write_table(path, [("a", myorc.K_LONG),
+                             ("s", myorc.K_STRING)],
+                      {"a": a, "s": s}, masks={"a": am},
+                      stripe_rows=1000)
+    t = pa_orc.ORCFile(path).read()
+    got = t.column("a").to_pylist()
+    assert got == [int(v) if k else None for v, k in zip(a, am)]
+    assert t.column("s").to_pylist() == [x.decode() for x in s]
+
+
+def test_ctas_orc_format_and_insert(orc_runner):
+    r, _ = orc_runner
+    r.execute(
+        "create table orc.tiny.ctas_orc with (format = 'orc') as "
+        "select nationkey, name, regionkey from nation")
+    got = r.execute(
+        "select nationkey, name from orc.tiny.ctas_orc "
+        "where regionkey = 1 order by nationkey").rows()
+    want = r.execute(
+        "select nationkey, name from nation where regionkey = 1 "
+        "order by nationkey").rows()
+    assert got == want and got
+    r.execute(
+        "insert into orc.tiny.ctas_orc "
+        "select nationkey + 100, name, regionkey from nation")
+    n = r.execute(
+        "select count(*) from orc.tiny.ctas_orc").rows()[0][0]
+    assert n == 50
+    r.execute("drop table orc.tiny.ctas_orc")
+
+
+def test_ctas_rejects_unknown_property(orc_runner):
+    r, _ = orc_runner
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises((QueryError, ValueError)):
+        r.execute(
+            "create table orc.tiny.bad_prop with (fmt = 'orc') as "
+            "select 1 as x")
