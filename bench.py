@@ -144,6 +144,8 @@ def _child_main() -> int:
     if subset:
         queries = {q: queries[q] for q in subset.split(",")
                    if q in queries}
+    import jax
+    backend = jax.default_backend()
     ok = True
     for name, sql in queries.items():
         try:
@@ -168,7 +170,8 @@ def _child_main() -> int:
             continue
         print(json.dumps({"q": name,
                           "rows_per_sec": round(rows_of[name] / best, 1),
-                          "wall_s": round(best, 3)}), flush=True)
+                          "wall_s": round(best, 3),
+                          "backend": backend}), flush=True)
     return 0 if ok else 1
 
 
@@ -310,7 +313,13 @@ def main() -> int:
                          min(QUERY_TIMEOUT_S, left))
             if r is not None:
                 per_query[qname] = r
-                platforms[qname] = name
+                # the platform label is the child's ACTUAL backend —
+                # never the attempt name (the "native" attempt runs on
+                # CPU when the environment forces JAX_PLATFORMS=cpu,
+                # and a mislabeled capture is an invented number)
+                be = r.get("backend", "")
+                platforms[qname] = "native" if be == "tpu" \
+                    else (be or name)
                 emit()
                 break
             if name == "native":

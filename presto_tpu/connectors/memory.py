@@ -51,7 +51,8 @@ class _MemorySplitManager(ConnectorSplitManager):
         self._tables = tables
 
     def get_splits(self, handle: TableHandle,
-                   target_splits: int) -> List[Split]:
+                   target_splits: int,
+                   constraint=None) -> List[Split]:
         t = self._tables[(handle.schema, handle.table)]
         n = max(len(t.batches), 1)
         # one split per stored-batch range so scans parallelize

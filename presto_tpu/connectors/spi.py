@@ -114,9 +114,15 @@ class ConnectorMetadata(abc.ABC):
 
 
 class ConnectorSplitManager(abc.ABC):
+    """`constraint` is the scan's pushed-down TupleDomain,
+    available BEFORE any split exists so connectors can prune whole
+    partitions/files (reference: HiveSplitManager partition pruning
+    ahead of split enumeration)."""
+
     @abc.abstractmethod
-    def get_splits(self, handle: TableHandle,
-                   target_splits: int) -> List[Split]: ...
+    def get_splits(self, handle: TableHandle, target_splits: int,
+                   constraint: Optional["TupleDomain"] = None
+                   ) -> List[Split]: ...
 
 
 class ConnectorPageSource(abc.ABC):

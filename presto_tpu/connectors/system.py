@@ -117,7 +117,8 @@ class _SystemMetadata(ConnectorMetadata):
 
 class _SystemSplitManager(ConnectorSplitManager):
     def get_splits(self, handle: TableHandle,
-                   target_splits: int) -> List[Split]:
+                   target_splits: int,
+                   constraint=None) -> List[Split]:
         return [Split(handle, None, partition=0)]
 
 

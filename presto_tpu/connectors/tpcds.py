@@ -1279,7 +1279,8 @@ class _TpcdsSplitManager(ConnectorSplitManager):
         self._gens = gens
 
     def get_splits(self, handle: TableHandle,
-                   target_splits: int) -> List[Split]:
+                   target_splits: int,
+                   constraint=None) -> List[Split]:
         n = self._gens[handle.schema].rows(handle.table)
         target = max(1, min(target_splits, math.ceil(n / 1024)))
         step = math.ceil(n / target)

@@ -590,7 +590,8 @@ class _TpchSplitManager(ConnectorSplitManager):
         self._gens = gens
 
     def get_splits(self, handle: TableHandle,
-                   target_splits: int) -> List[Split]:
+                   target_splits: int,
+                   constraint=None) -> List[Split]:
         gen = self._gens[handle.schema]
         n = gen.rows("orders" if handle.table == "lineitem"
                      else handle.table)

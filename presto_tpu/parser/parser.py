@@ -117,7 +117,9 @@ class _Parser:
             return True
         if self.accept_kw("false"):
             return False
-        if self.accept_kw("array"):
+        if self.accept_kw("array") or (
+                t.kind == "ident" and t.value.lower() == "array"
+                and self.advance()):
             self.expect_op("[")
             vals = []
             if not self.at_op("]"):

@@ -222,7 +222,7 @@ class LocalExecutionPlanner:
         def batch_iter():
             import jax as _jax
             splits = conn.split_manager.get_splits(
-                handle, max(target_splits, task.count))
+                handle, max(target_splits, task.count), constraint)
             if task.count > 1:
                 # round-robin split assignment to this fragment's tasks
                 # (reference: NodeScheduler.java:65 split placement)

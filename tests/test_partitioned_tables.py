@@ -1,0 +1,123 @@
+"""Partitioned tables in the file connector (reference:
+presto-hive HiveSplitManager partition pruning before split
+enumeration + HivePageSourceProvider partition-key constant columns).
+
+Layout under test: <root>/<schema>/<table>/<key>=<value>/part-*.fmt
+with a _metadata.json sidecar; CTAS WITH (partitioned_by=ARRAY[...]),
+INSERT appending new part files, and TupleDomain pruning that removes
+whole partitions before any split exists."""
+
+import math
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def prunner(tmp_path, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_FILE_ROOT", str(tmp_path / "cat"))
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", "tiny")
+
+
+@pytest.mark.parametrize("fmt", ["parquet", "orc"])
+def test_partitioned_ctas_roundtrip(prunner, fmt, tmp_path):
+    prunner.execute(
+        f"create table file.default.t with (format = '{fmt}', "
+        f"partitioned_by = array['orderstatus']) as "
+        f"select orderkey, totalprice, orderdate, orderstatus "
+        f"from orders")
+    root = str(tmp_path / "cat")
+    dirs = os.listdir(os.path.join(root, "default", "t"))
+    assert "_metadata.json" in dirs
+    assert any(d.startswith("orderstatus=") for d in dirs)
+    got = prunner.execute(
+        "select orderstatus, count(*) c from file.default.t "
+        "group by orderstatus order by 1").rows()
+    want = prunner.execute(
+        "select orderstatus, count(*) c from orders "
+        "group by orderstatus order by 1").rows()
+    assert got == want
+    g, w = (prunner.execute(
+        f"select count(*), sum(totalprice) from {t} "
+        f"where orderstatus = 'F'").rows()[0]
+        for t in ("file.default.t", "orders"))
+    assert g[0] == w[0] and math.isclose(g[1], w[1], rel_tol=1e-9)
+
+
+def test_partition_pruning_before_splits(prunner):
+    prunner.execute(
+        "create table file.default.p with "
+        "(partitioned_by = array['orderstatus']) as "
+        "select orderkey, totalprice, orderstatus from orders")
+    from presto_tpu.connectors.spi import (
+        Domain, TableHandle, TupleDomain,
+    )
+    conn = prunner.catalogs.connector("file")
+    h = TableHandle("file", "default", "p")
+    all_splits = conn.split_manager.get_splits(h, 4)
+    assert len(all_splits) == 3  # one per orderstatus value
+    dic = conn.metadata.get_table_schema(h).columns[-1].dictionary
+    code = dic.index("F")
+    pruned = conn.split_manager.get_splits(
+        h, 4, TupleDomain(domains=(
+            ("orderstatus", Domain(values=(code,))),)))
+    assert len(pruned) == 1
+
+
+def test_partitioned_insert_appends_files(prunner, tmp_path):
+    prunner.execute(
+        "create table file.default.i with "
+        "(partitioned_by = array['orderstatus']) as "
+        "select orderkey, totalprice, orderstatus from orders")
+    root = str(tmp_path / "cat")
+
+    def count_files():
+        return sum(len(fs) for _, _, fs in os.walk(
+            os.path.join(root, "default", "i"))) - 1  # - metadata
+    before = count_files()
+    prunner.execute(
+        "insert into file.default.i select orderkey + 1000000, "
+        "totalprice, orderstatus from orders where orderstatus = 'O'")
+    assert count_files() == before + 1  # ONE new part file, no rewrite
+    n = prunner.execute(
+        "select count(*) from file.default.i").rows()[0][0]
+    total = prunner.execute("select count(*) from orders").rows()[0][0]
+    o_rows = prunner.execute(
+        "select count(*) from orders "
+        "where orderstatus = 'O'").rows()[0][0]
+    assert n == total + o_rows
+
+
+def test_partitioned_int_key_and_drop(prunner):
+    prunner.execute(
+        "create table file.default.n with "
+        "(partitioned_by = array['regionkey']) as "
+        "select name, nationkey, regionkey from nation")
+    got = prunner.execute(
+        "select count(*) from file.default.n "
+        "where regionkey = 2").rows()
+    want = prunner.execute(
+        "select count(*) from nation where regionkey = 2").rows()
+    assert got == want
+    # pruning on the int key
+    from presto_tpu.connectors.spi import (
+        Domain, TableHandle, TupleDomain,
+    )
+    conn = prunner.catalogs.connector("file")
+    h = TableHandle("file", "default", "n")
+    assert len(conn.split_manager.get_splits(h, 4)) == 5
+    assert len(conn.split_manager.get_splits(
+        h, 4, TupleDomain(domains=(
+            ("regionkey", Domain(low=3)),)))) == 2
+    prunner.execute("drop table file.default.n")
+    assert "n" not in conn.metadata.list_tables("default")
+
+
+def test_partition_keys_must_be_last(prunner):
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises((QueryError, ValueError)):
+        prunner.execute(
+            "create table file.default.bad with "
+            "(partitioned_by = array['orderkey']) as "
+            "select orderkey, totalprice from orders")
