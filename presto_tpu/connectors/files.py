@@ -276,6 +276,18 @@ class _FileCatalog:
         return os.path.exists(os.path.join(self.table_dir(handle),
                                            "_metadata.json"))
 
+    def part_info_cached(self, handle: TableHandle) -> _PartTable:
+        """The last-built listing WITHOUT a freshness walk — for the
+        per-split scan path, where part_info's full re-walk would cost
+        O(files^2) stats per table scan. Writers evict on commit, so
+        within-process coherence holds; external writers are picked up
+        at the next planning-time part_info (same guarantee as the
+        dictionary cache)."""
+        hit = self._part_cache.get(self.table_dir(handle))
+        if hit is not None:
+            return hit[1]
+        return self.part_info(handle)
+
     def part_info(self, handle: TableHandle) -> _PartTable:
         """Load (and cache) a partitioned table: metadata sidecar +
         partition-directory walk + table-level string dictionaries.
@@ -583,7 +595,7 @@ class _FilePageSource(ConnectorPageSource):
         _, rel, values = split.info
         if not rel:  # empty table placeholder split
             return
-        pt = self._cat.part_info(split.table)
+        pt = self._cat.part_info_cached(split.table)
         path = os.path.join(self._cat.root, rel)
         view = self._cat._file_view(path)
         by_name = dict(view.columns)

@@ -101,9 +101,11 @@ class _MemoryPageSink(ConnectorPageSink):
     def append(self, handle: TableHandle, batch: Batch) -> None:
         t = self._tables[(handle.schema, handle.table)]
         key = (handle.schema, handle.table)
+        names = [p[0] for cs in t.schema.columns
+                 for p in cs.physical()]
         self._pending.setdefault(key, []).append(
-            Batch({cs.name: batch.columns[cs.name]
-                   for cs in t.schema.columns}, batch.row_valid))
+            Batch({n: batch.columns[n] for n in names},
+                  batch.row_valid))
 
     def finish(self, handle: TableHandle) -> None:
         key = (handle.schema, handle.table)
@@ -113,23 +115,29 @@ class _MemoryPageSink(ConnectorPageSink):
         t = self._tables[key]
         new_schema_cols = []
         for cs in t.schema.columns:
-            if cs.dictionary is None and all(
-                    b.columns[cs.name].dictionary is None
-                    for b in pending):
+            # string slots of a complex column (or the column itself)
+            # unify onto ONE merged dictionary
+            snames = [p[0] for p in cs.physical() if p[1].is_string]
+            if not snames or (cs.dictionary is None and all(
+                    b.columns[n].dictionary is None
+                    for b in pending for n in snames)):
                 new_schema_cols.append(cs)
                 continue
             merged = set(cs.dictionary or ())
             for b in pending:
-                merged |= set(b.columns[cs.name].dictionary or ())
+                for n in snames:
+                    merged |= set(b.columns[n].dictionary or ())
             merged = tuple(sorted(merged))
             if merged != cs.dictionary:
                 # one re-encode pass over stored + pending batches
                 for store in (t.batches, pending):
                     for i, old in enumerate(store):
                         oc = dict(old.columns)
-                        oc[cs.name] = remap_column(oc[cs.name], merged)
+                        for n in snames:
+                            oc[n] = remap_column(oc[n], merged)
                         store[i] = Batch(oc, old.row_valid)
-                cs = ColumnSchema(cs.name, cs.type, merged)
+                cs = ColumnSchema(cs.name, cs.type, merged,
+                                  form=cs.form)
             new_schema_cols.append(cs)
         t.schema = RelationSchema(new_schema_cols)
         for b in pending:

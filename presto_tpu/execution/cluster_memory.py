@@ -1,0 +1,88 @@
+"""Cluster memory manager (reference: memory/ClusterMemoryManager.java:96
++ memory/TotalReservationLowMemoryKiller.java).
+
+Tracks every RUNNING query's total reserved bytes against one shared
+cluster budget. When the sum exceeds the budget, the query with the
+LARGEST total reservation is marked for death (the reference's
+total-reservation policy); that query's next memory interaction
+raises QueryKilledByMemoryManager — a structured, user-visible error —
+while every other query proceeds untouched.
+
+Per-query `MemoryPool`s attach via `pool.attach_cluster(mgr, qid)`:
+every reserve/free forwards the query's running total here, and every
+reserve first checks the kill flag (the kill takes effect at the
+victim's next allocation, like the reference's per-node kill RPC
+landing between task allocations)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class QueryKilledByMemoryManager(Exception):
+    """The structured low-memory kill (reference:
+    CLUSTER_OUT_OF_MEMORY / the LowMemoryKiller's kill reason)."""
+
+    def __init__(self, query_id: str, reserved: int, total: int,
+                 budget: int):
+        super().__init__(
+            f"query {query_id} killed by the cluster memory manager: "
+            f"it reserved {reserved:,}B (largest of {total:,}B "
+            f"cluster-wide, budget {budget:,}B)")
+        self.query_id = query_id
+        self.reserved = reserved
+
+
+class ClusterMemoryManager:
+    """One per runner/coordinator process; thread-safe (queries run
+    concurrently on the server surface)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._reserved: Dict[str, int] = {}
+        self._kill: Dict[str, QueryKilledByMemoryManager] = {}
+        self.kills = 0
+
+    def register_query(self, query_id: str) -> None:
+        with self._lock:
+            self._reserved.setdefault(query_id, 0)
+
+    def finish_query(self, query_id: str) -> None:
+        with self._lock:
+            self._reserved.pop(query_id, None)
+            self._kill.pop(query_id, None)
+
+    def update(self, query_id: str, reserved_bytes: int) -> None:
+        """Refresh one query's total; on cluster-budget exhaustion,
+        flag the biggest RUNNING reservation for death."""
+        with self._lock:
+            self._reserved[query_id] = int(reserved_bytes)
+            total = sum(self._reserved.values())
+            if total <= self.budget:
+                return
+            if any(q in self._reserved for q in self._kill):
+                # one kill in flight: wait for the victim to actually
+                # release (finish_query) before condemning another
+                # (reference: ClusterMemoryManager's single
+                # outstanding kill + lastKillTarget wait)
+                return
+            victim = max(
+                (q for q in self._reserved if q not in self._kill),
+                key=lambda q: self._reserved[q], default=None)
+            if victim is None:
+                return
+            self._kill[victim] = QueryKilledByMemoryManager(
+                victim, self._reserved[victim], total, self.budget)
+            self.kills += 1
+
+    def check(self, query_id: str) -> None:
+        with self._lock:
+            err = self._kill.get(query_id)
+        if err is not None:
+            raise err
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._reserved)

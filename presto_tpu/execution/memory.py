@@ -54,6 +54,20 @@ class MemoryPool:
         #: tag -> () -> bytes freed; registered by spillable operators
         self._revocables: Dict[str, Callable[[], int]] = {}
         self.revocations = 0
+        #: cluster tier (reference: ClusterMemoryManager): when
+        #: attached, reservations roll up cross-query and the manager
+        #: may kill this query at its next allocation
+        self._cluster = None
+        self._cluster_qid = None
+
+    def attach_cluster(self, manager, query_id: str) -> None:
+        self._cluster = manager
+        self._cluster_qid = query_id
+        manager.register_query(query_id)
+
+    def _cluster_sync(self) -> None:
+        if self._cluster is not None:
+            self._cluster.update(self._cluster_qid, self.reserved)
 
     def register_revocable(self, tag: str,
                            spill: Callable[[], int]) -> None:
@@ -84,6 +98,9 @@ class MemoryPool:
     def reserve(self, tag: str, nbytes: int) -> None:
         if nbytes <= 0:
             return
+        if self._cluster is not None:
+            # the cluster kill lands at the victim's next allocation
+            self._cluster.check(self._cluster_qid)
         if self.budget is not None \
                 and self.reserved + nbytes > self.budget:
             if self._revocables:
@@ -96,12 +113,20 @@ class MemoryPool:
         self.peak = max(self.peak, self.reserved)
         self.peak_by_tag[tag] = max(self.peak_by_tag.get(tag, 0),
                                     self._by_tag[tag])
+        if self._cluster is not None:
+            self._cluster_sync()
+            # if THIS allocation pushed the cluster over and made this
+            # query the victim, die now — not at some later allocation
+            # that may never come
+            self._cluster.check(self._cluster_qid)
 
     def free(self, tag: str, nbytes: int) -> None:
         if nbytes <= 0:
             return
         self.reserved -= nbytes
         self._by_tag[tag] = self._by_tag.get(tag, 0) - nbytes
+        self._cluster_sync()
 
     def free_all(self, tag: str) -> None:
         self.reserved -= self._by_tag.pop(tag, 0)
+        self._cluster_sync()

@@ -38,7 +38,7 @@ AGG_FUNCTIONS = {
     "stddev_pop", "count_if", "bool_and", "bool_or", "every",
     "geometric_mean", "checksum", "arbitrary", "any_value",
     "approx_distinct", "approx_percentile", "skewness", "kurtosis",
-    "entropy",
+    "entropy", "array_agg", "map_agg",
 }
 
 
@@ -114,6 +114,11 @@ class ScopeField:
     symbol: str
     type: Type
     dictionary: Optional[tuple] = None
+    #: complex-typed fields: the ArrayValue/MapValue/RowValue over the
+    #: exploded slot columns (see nodes.Field.form)
+    form: Optional[object] = None
+    #: per-slot string dictionaries ({slot symbol -> dictionary})
+    form_dicts: Optional[dict] = None
 
 
 class Scope:
@@ -176,7 +181,8 @@ def plan_statement(stmt: T.Node, metadata, session) -> N.PlanNode:
 
 def plan_query_output(q: T.Query, ctx: PlannerContext) -> N.OutputNode:
     rp, names = plan_query(q, ctx, outer=None)
-    out_fields = tuple(N.Field(f.symbol, f.type, f.dictionary)
+    out_fields = tuple(N.Field(f.symbol, f.type, f.dictionary,
+                               form=f.form)
                        for f in rp.scope.fields)
     return N.OutputNode(rp.node, names,
                         [f.symbol for f in rp.scope.fields], out_fields)
@@ -227,8 +233,7 @@ def _apply_order_limit(rp: RelationPlan, names: List[str], q: T.Query,
             desc.append(item.descending)
             nf.append(item.nulls_first if item.nulls_first is not None
                       else item.descending)
-        out = tuple(N.Field(f.symbol, f.type, f.dictionary)
-                    for f in rp.scope.fields)
+        out = _physical_fields(rp.scope.fields, rp.node)
         if q.limit is not None:
             rp = RelationPlan(N.TopNNode(rp.node, q.limit, keys, desc, nf,
                                          out), rp.scope)
@@ -236,14 +241,30 @@ def _apply_order_limit(rp: RelationPlan, names: List[str], q: T.Query,
         rp = RelationPlan(N.SortNode(rp.node, keys, desc, nf, out),
                           rp.scope)
     if q.limit is not None:
-        out = tuple(N.Field(f.symbol, f.type, f.dictionary)
-                    for f in rp.scope.fields)
+        out = _physical_fields(rp.scope.fields, rp.node)
         rp = RelationPlan(N.LimitNode(rp.node, q.limit, out), rp.scope)
     return rp, names
 
 
 def _as_symbol(e: RowExpression) -> Optional[str]:
     return e.name if isinstance(e, InputRef) else None
+
+
+def _physical_fields(scope_fields, *sources: N.PlanNode):
+    """Pass-through node output schema: scope fields expanded to their
+    PHYSICAL columns — a complex-typed field contributes its slot
+    columns (looked up on the source(s) for type/dictionary), never
+    its column-less named symbol."""
+    by_sym = {f.symbol: f for src in sources for f in src.output}
+    out = []
+    for f in scope_fields:
+        if f.form is None:
+            out.append(N.Field(f.symbol, f.type, f.dictionary))
+        else:
+            for s in N.form_slot_symbols(f.form):
+                sf = by_sym[s]
+                out.append(N.Field(sf.symbol, sf.type, sf.dictionary))
+    return tuple(out)
 
 
 def _plan_values(v: T.ValuesRelation, ctx: PlannerContext):
@@ -554,6 +575,9 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
     # 5. SELECT projection (+ hidden sort columns)
     an = _Analyzer(rp.scope, ctx, rewrites)
     assignments: List[Tuple[str, RowExpression]] = []
+    #: N.Field per ASSIGNMENT (complex values explode to several slot
+    #: assignments, so this is not 1:1 with scope fields)
+    assign_fields: List[N.Field] = []
     fields: List[ScopeField] = []
     names: List[str] = []
     alias_to_symbol: Dict[str, str] = {}
@@ -561,18 +585,21 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
     for item in select_items:
         e = fold_constants(an.analyze(item.expr))
         from presto_tpu.expr.ir import ArrayValue, MapValue, RowValue
-        if isinstance(e, (ArrayValue, MapValue, RowValue)):
-            kind = {ArrayValue: "array", MapValue: "map",
-                    RowValue: "row"}[type(e)]
-            raise AnalysisError(
-                f"{kind} values cannot be projected as columns yet — "
-                "consume them with subscripts/element_at/cardinality/"
-                "map_keys/map_values/contains/array_join or UNNEST")
         name = item.alias or _derive_name(item.expr)
         sym = ctx.symbols.new(name)
-        assignments.append((sym, e))
-        dic = an.dictionary_of(e)
-        fields.append(ScopeField(None, name, sym, e.type, dic))
+        if isinstance(e, (ArrayValue, MapValue, RowValue)):
+            # project the complex value by EXPLODING it into scalar
+            # slot columns; the scope field carries the reassembled
+            # form over InputRefs (see nodes.Field.form)
+            form = _lower_complex_projection(
+                e, sym, an, assignments, assign_fields)
+            fields.append(ScopeField(None, name, sym, e.type, None,
+                                     form=form))
+        else:
+            assignments.append((sym, e))
+            dic = an.dictionary_of(e)
+            assign_fields.append(N.Field(sym, e.type, dic))
+            fields.append(ScopeField(None, name, sym, e.type, dic))
         names.append(name)
         if item.alias:
             alias_to_symbol[item.alias] = sym
@@ -587,9 +614,13 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
         e_ast = item.expr
         if isinstance(e_ast, T.NumberLit):  # ordinal
             idx = int(e_ast.text) - 1
-            if not (0 <= idx < len(assignments)):
+            if not (0 <= idx < len(fields)):
                 raise AnalysisError("ORDER BY ordinal out of range")
-            sort_keys.append(assignments[idx][0])
+            if fields[idx].form is not None:
+                raise AnalysisError(
+                    "ORDER BY on array/map/row values is not "
+                    "supported")
+            sort_keys.append(fields[idx].symbol)
         elif isinstance(e_ast, T.Identifier) and len(e_ast.parts) == 1 \
                 and e_ast.parts[0] in alias_to_symbol:
             sort_keys.append(alias_to_symbol[e_ast.parts[0]])
@@ -603,10 +634,14 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
         sort_desc.append(desc)
         sort_nf.append(nf)
 
+    form_syms = {f.symbol for f in fields if f.form is not None}
+    if form_syms & set(sort_keys):
+        raise AnalysisError(
+            "ORDER BY on array/map/row values is not supported")
+
     proj_assigns = assignments + [(s, e) for s, e, _ in hidden]
     proj_fields = tuple(
-        [N.Field(f.symbol, f.type, f.dictionary) for f in fields]
-        + [N.Field(s, e.type, d) for s, e, d in hidden])
+        assign_fields + [N.Field(s, e.type, d) for s, e, d in hidden])
     node = N.ProjectNode(rp.node, proj_assigns, proj_fields)
     scope = Scope(fields + [ScopeField(None, s, s, e.type, d)
                             for s, e, d in hidden])
@@ -617,6 +652,10 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
         if hidden:
             raise AnalysisError("SELECT DISTINCT with ORDER BY over "
                                 "non-output columns is not supported")
+        if form_syms:
+            raise AnalysisError(
+                "SELECT DISTINCT over array/map/row values is not "
+                "supported")
         rp = RelationPlan(N.DistinctNode(rp.node, proj_fields), rp.scope)
 
     # 7. ORDER BY / LIMIT
@@ -624,8 +663,7 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
     offset = q.offset if q is not None else None
     if offset:
         raise AnalysisError("OFFSET not yet supported")
-    out = tuple(N.Field(f.symbol, f.type, f.dictionary)
-                for f in rp.scope.fields)
+    out = _physical_fields(rp.scope.fields, rp.node)
     if sort_keys and limit is not None:
         rp = RelationPlan(N.TopNNode(rp.node, limit, sort_keys, sort_desc,
                                      sort_nf, out), rp.scope)
@@ -637,13 +675,13 @@ def _plan_query_spec(spec: T.QuerySpec, q: Optional[T.Query],
 
     # 8. drop hidden sort columns
     if hidden:
-        keep = [f for f in rp.scope.fields
-                if f.symbol in {a[0] for a in assignments}]
-        out2 = tuple(N.Field(f.symbol, f.type, f.dictionary)
-                     for f in keep)
+        select_syms = {a[0] for a in assignments} \
+            | {f.symbol for f in fields if f.form is not None}
+        keep = [f for f in rp.scope.fields if f.symbol in select_syms]
+        out2 = _physical_fields(keep, rp.node)
         node = N.ProjectNode(
             rp.node, [(f.symbol, InputRef(f.symbol, f.type))
-                      for f in keep], out2)
+                      for f in out2], out2)
         rp = RelationPlan(node, Scope(keep))
     return rp, names
 
@@ -702,6 +740,11 @@ def _collect_agg_calls(node, out: List[T.FunctionCall]):
 
 
 def _agg_output_type(fn: str, arg_type: Optional[Type]) -> Type:
+    if fn == "array_agg":
+        from presto_tpu.types import array_type
+        if arg_type is None:
+            raise AnalysisError("array_agg requires an argument")
+        return array_type(arg_type)
     if fn in ("count", "count_if", "checksum", "approx_distinct"):
         return BIGINT
     if fn in ("avg", "var_samp", "var_pop", "variance", "stddev",
@@ -1065,6 +1108,68 @@ def _collect_grouping_calls(node, out: List[T.FunctionCall]):
                         _collect_grouping_calls(x, out)
 
 
+def _collected_array_form(sym: str, atype, w: int):
+    """The value form of an array_agg output: W element slots plus a
+    length column, all produced by the ArrayAggOperator under the
+    <sym>__a{j}/<sym>__len naming convention."""
+    from presto_tpu.expr.ir import ArrayValue
+    elems = tuple(InputRef(f"{sym}__a{j}", atype.element)
+                  for j in range(w))
+    return ArrayValue(elems, InputRef(f"{sym}__len", BIGINT), atype)
+
+
+def _collected_map_form(sym: str, mtype, w: int,
+                        key_dic: Optional[tuple],
+                        val_dic: Optional[tuple]):
+    from presto_tpu.expr.ir import MapValue
+    keys = tuple(InputRef(f"{sym}__k{j}", mtype.key) for j in range(w))
+    vals = tuple(InputRef(f"{sym}__v{j}", mtype.value)
+                 for j in range(w))
+    form = MapValue(keys, vals, InputRef(f"{sym}__len", BIGINT), mtype)
+    dicts = {}
+    if key_dic is not None:
+        dicts.update({f"{sym}__k{j}": key_dic for j in range(w)})
+    if val_dic is not None:
+        dicts.update({f"{sym}__v{j}": val_dic for j in range(w)})
+    return form, dicts
+
+
+def _lower_complex_projection(e, sym: str, an, assignments,
+                              assign_fields):
+    """Explode an analysis-time complex value into scalar slot
+    assignments (<sym>__a0.., <sym>__len, ...) and return the same
+    value form rebuilt over InputRefs to those slots — the projected
+    column representation of ARRAY/MAP/ROW (see nodes.Field.form)."""
+    from presto_tpu.expr.ir import ArrayValue, MapValue, RowValue
+
+    def slot(sub, tag: str):
+        if isinstance(sub, (ArrayValue, MapValue, RowValue)):
+            raise AnalysisError(
+                "nested array/map/row projection is not supported")
+        ssym = f"{sym}__{tag}"
+        assignments.append((ssym, sub))
+        assign_fields.append(
+            N.Field(ssym, sub.type, an.dictionary_of(sub)))
+        return InputRef(ssym, sub.type)
+
+    def length_ref(length):
+        if length is None:
+            return None
+        return slot(_coerce_to(length, BIGINT), "len")
+
+    if isinstance(e, ArrayValue):
+        elems = tuple(slot(x, f"a{j}") for j, x in
+                      enumerate(e.elements))
+        return ArrayValue(elems, length_ref(e.length), e.type)
+    if isinstance(e, MapValue):
+        keys = tuple(slot(x, f"k{j}") for j, x in enumerate(e.keys))
+        vals = tuple(slot(x, f"v{j}") for j, x in enumerate(e.values))
+        return MapValue(keys, vals, length_ref(e.length), e.type)
+    flds = tuple((fname, slot(x, f"f{j}")) for j, (fname, x)
+                 in enumerate(e.fields))
+    return RowValue(flds, e.type)
+
+
 def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
                       rp: RelationPlan, ctx: PlannerContext):
     an = _Analyzer(rp.scope, ctx)
@@ -1177,6 +1282,7 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
 
     agg_nodes: List[N.AggCall] = []
     rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
+    agg_forms: Dict[str, object] = {}  # out symbol -> value form
     for c in calls:
         key = _ast_key(c)
         if key in rewrites:
@@ -1189,26 +1295,53 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
             filt = _coerce_to(fold_constants(an.analyze(c.filter)),
                               BOOLEAN)
         params: tuple = ()
-        if c.distinct:
+        arg2 = None
+        if c.name == "map_agg":
+            if c.distinct or len(c.args) != 2:
+                raise AnalysisError("map_agg takes (key, value)")
+            arg = fold_constants(an.analyze(c.args[0]))
+            arg2 = fold_constants(an.analyze(c.args[1]))
+            arg_t, dic = arg.type, an.dictionary_of(arg)
+        elif c.distinct:
             arg, arg_t, dic = InputRef(dsym, d_t), d_t, d_dic
         elif c.is_star or not c.args:
             arg, arg_t, dic = None, None, None
         else:
             arg, params = _agg_arg_and_params(c, an)
             arg_t, dic = arg.type, an.dictionary_of(arg)
-        out_t = _agg_output_type(c.name, arg_t)
+        if c.name == "map_agg":
+            from presto_tpu.types import map_type
+            out_t = map_type(arg_t, arg2.type)
+        else:
+            out_t = _agg_output_type(c.name, arg_t)
         sym = ctx.symbols.new(c.name)
         agg_nodes.append(N.AggCall(sym, c.name, arg, False, out_t,
-                                   params=params, filter=filt))
+                                   params=params, filter=filt,
+                                   argument2=arg2))
         out_dic = dic if c.name in ("min", "max", "arbitrary",
                                     "any_value") else None
+        if c.name in ("array_agg", "map_agg"):
+            from presto_tpu.session_properties import get_property
+            w = int(get_property(ctx.session.properties,
+                                 "array_agg_width"))
+            if c.name == "array_agg":
+                agg_forms[sym] = (
+                    _collected_array_form(sym, out_t, w), None)
+                out_dic = dic  # slot columns share the element dict
+            else:
+                agg_forms[sym] = _collected_map_form(
+                    sym, out_t, w, dic, an.dictionary_of(arg2))
+                out_dic = dic
         rewrites[key] = (sym, out_t, out_dic)
 
     out_fields = tuple(
         [N.Field(s, e.type, d) for s, e, d, _ in keys]
         + [N.Field(a.out_symbol, a.output_type,
                    rewrites[_ast_key_for_sym(rewrites, a.out_symbol)][2]
-                   if _ast_key_for_sym(rewrites, a.out_symbol) else None)
+                   if _ast_key_for_sym(rewrites, a.out_symbol) else None,
+                   form=agg_forms.get(a.out_symbol, (None,))[0],
+                   form_dicts=agg_forms.get(a.out_symbol,
+                                            (None, None))[1])
            for a in agg_nodes])
     node = N.AggregationNode(
         rp.node, [(s, e) for s, e, _, _ in keys], agg_nodes, "single",
@@ -1217,8 +1350,11 @@ def _plan_aggregation(spec: T.QuerySpec, select_items, order_items,
     fields = [ScopeField(None, s, s, e.type, d)
               for s, e, d, _ in keys]
     for a, f in zip(agg_nodes, out_fields[len(keys):]):
-        fields.append(ScopeField(None, a.out_symbol, a.out_symbol,
-                                 a.output_type, f.dictionary))
+        fields.append(ScopeField(
+            None, a.out_symbol, a.out_symbol, a.output_type,
+            f.dictionary,
+            form=agg_forms.get(a.out_symbol, (None,))[0],
+            form_dicts=agg_forms.get(a.out_symbol, (None, None))[1]))
     new_scope = Scope(fields, rp.scope.parent)
     # rewrites for outer expressions: group-key ASTs and agg-call ASTs
     final_rewrites: Dict[tuple, Tuple[str, Type, Optional[tuple]]] = {}
@@ -1477,12 +1613,16 @@ def _plan_relation(rel: T.Node, ctx: PlannerContext,
                 if i >= len(rel.column_aliases):
                     raise AnalysisError("too few column aliases")
                 name = rel.column_aliases[i]
-            fields.append(ScopeField(rel.alias, name, f.symbol, f.type,
-                                     f.dictionary))
+            fields.append(ScopeField(
+                rel.alias, name, f.symbol, f.type, f.dictionary,
+                form=f.form,
+                form_dicts=getattr(f, "form_dicts", None)))
         return RelationPlan(inner.node, Scope(fields, outer))
     if isinstance(rel, T.SubqueryRelation):
         rp, names = plan_query(rel.query, ctx, outer)
-        fields = [ScopeField(None, n, f.symbol, f.type, f.dictionary)
+        fields = [ScopeField(None, n, f.symbol, f.type, f.dictionary,
+                             form=f.form,
+                             form_dicts=getattr(f, "form_dicts", None))
                   for n, f in zip(names, rp.scope.fields)]
         return RelationPlan(rp.node, Scope(fields, outer))
     if isinstance(rel, T.Join):
@@ -1608,6 +1748,24 @@ def _plan_table(rel: T.Table, ctx: PlannerContext,
     handle, schema = ctx.metadata.resolve_table(parts, ctx.session)
     fields, assigns, out_fields = [], {}, []
     for col in schema.columns:
+        if getattr(col, "form", None) is not None:
+            # complex stored column: scan its physical slots under
+            # fresh symbols and rebuild the value form over them
+            slot_syms = {}
+            form_dicts = {}
+            for pname, ptype, pdic in col.physical():
+                s = ctx.symbols.new(pname)
+                assigns[s] = pname
+                out_fields.append(N.Field(s, ptype, pdic))
+                slot_syms[pname] = s
+                if pdic is not None:
+                    form_dicts[s] = pdic
+            vsym = ctx.symbols.new(col.name)
+            fields.append(ScopeField(
+                parts[-1], col.name, vsym, col.type, col.dictionary,
+                form=_rebind_form(col.form, slot_syms),
+                form_dicts=form_dicts))
+            continue
         sym = ctx.symbols.new(col.name)
         assigns[sym] = col.name
         fields.append(ScopeField(parts[-1], col.name, sym, col.type,
@@ -1615,6 +1773,28 @@ def _plan_table(rel: T.Table, ctx: PlannerContext,
         out_fields.append(N.Field(sym, col.type, col.dictionary))
     node = N.TableScanNode(handle, assigns, tuple(out_fields))
     return RelationPlan(node, Scope(fields, outer))
+
+
+def _rebind_form(form, name_map: Dict[str, str]):
+    """Rebuild a value form with its InputRef leaves renamed through
+    `name_map` (stored column name -> scan symbol)."""
+    from presto_tpu.expr.ir import ArrayValue, MapValue
+
+    def ren(x):
+        return InputRef(name_map[x.name], x.type)
+
+    if isinstance(form, ArrayValue):
+        return ArrayValue(tuple(ren(e) for e in form.elements),
+                          ren(form.length)
+                          if form.length is not None else None,
+                          form.type)
+    if isinstance(form, MapValue):
+        return MapValue(tuple(ren(e) for e in form.keys),
+                        tuple(ren(e) for e in form.values),
+                        ren(form.length)
+                        if form.length is not None else None,
+                        form.type)
+    raise AnalysisError("row-typed stored columns are not supported")
 
 
 def _split_conjuncts(e: T.Node) -> List[T.Node]:
@@ -1635,8 +1815,8 @@ def _plan_join(rel: T.Join, ctx: PlannerContext,
         return _plan_unnest(un, left, ctx, outer, un_alias, un_cols)
     right = _plan_relation(rel.right, ctx, outer)
     combined = Scope(left.scope.fields + right.scope.fields, outer)
-    out_fields = tuple(N.Field(f.symbol, f.type, f.dictionary)
-                       for f in combined.fields)
+    out_fields = _physical_fields(combined.fields, left.node,
+                                  right.node)
     jt = rel.join_type
     if jt == "cross" and rel.on is None and rel.using is None:
         node = N.JoinNode("cross", left.node, right.node, [], out_fields)
@@ -1684,18 +1864,16 @@ def _plan_join(rel: T.Join, ctx: PlannerContext,
         pred = left_pre[0]
         for p in left_pre[1:]:
             pred = SpecialForm("and", (pred, p), BOOLEAN)
-        ln = N.FilterNode(ln, fold_constants(pred), tuple(
-            N.Field(f.symbol, f.type, f.dictionary)
-            for f in left.scope.fields))
+        ln = N.FilterNode(ln, fold_constants(pred),
+                          _physical_fields(left.scope.fields, ln))
     elif left_pre:
         mixed.extend(left_pre)  # preserved-side condition
     if right_pre and jt in ("inner", "cross", "left"):
         pred = right_pre[0]
         for p in right_pre[1:]:
             pred = SpecialForm("and", (pred, p), BOOLEAN)
-        rn = N.FilterNode(rn, fold_constants(pred), tuple(
-            N.Field(f.symbol, f.type, f.dictionary)
-            for f in right.scope.fields))
+        rn = N.FilterNode(rn, fold_constants(pred),
+                          _physical_fields(right.scope.fields, rn))
     elif right_pre:
         mixed.extend(right_pre)
     res_expr = None
@@ -1787,8 +1965,7 @@ def _filter_on(rp: RelationPlan, conjs: List[T.Node],
         pred_ast = T.BinaryOp("and", pred_ast, c)
     an = _Analyzer(rp.scope, ctx)
     pred = _coerce_to(an.analyze(pred_ast), BOOLEAN)
-    out = tuple(N.Field(f.symbol, f.type, f.dictionary)
-                for f in rp.scope.fields)
+    out = _physical_fields(rp.scope.fields, rp.node)
     return RelationPlan(
         N.FilterNode(rp.node, fold_constants(pred), out), rp.scope)
 
@@ -2282,6 +2459,9 @@ class _Analyzer:
         if key in self.rewrites:
             sym, typ, dic = self.rewrites[key]
             self._dicts.setdefault(sym, dic)
+            form = self._form_by_symbol(sym)
+            if form is not None:
+                return form
             return InputRef(sym, typ)
         meth = getattr(self, f"_an_{type(ast).__name__}", None)
         if meth is None:
@@ -2326,6 +2506,33 @@ class _Analyzer:
               "second": 1000}[unit] * v
         return Literal(ms, INTERVAL_DAY)
 
+    def _form_by_symbol(self, sym: str):
+        """The complex value form of a scope field, or None. A form
+        field's named symbol has no physical column; referencing it
+        yields the ArrayValue/MapValue/RowValue over its slots."""
+        sc = self.scope
+        while sc is not None:
+            for f in sc.fields:
+                if f.symbol == sym and f.form is not None:
+                    self._register_form_dicts(f)
+                    return f.form
+            sc = sc.parent
+        return None
+
+    def _register_form_dicts(self, f) -> None:
+        """Make a form field's slot dictionaries resolvable through
+        dictionary_of(InputRef(slot)) — the field's own dictionary
+        attr covers array element slots, form_dicts covers per-slot
+        maps (map keys and values differ)."""
+        for s, d in (getattr(f, "form_dicts", None) or {}).items():
+            self._dicts.setdefault(s, d)
+        if f.dictionary is not None and f.form is not None:
+            from presto_tpu.expr.ir import ArrayValue
+            if isinstance(f.form, ArrayValue):
+                for x in f.form.elements:
+                    if isinstance(x, InputRef):
+                        self._dicts.setdefault(x.name, f.dictionary)
+
     def _an_Identifier(self, a: T.Identifier):
         if len(a.parts) == 1 and a.parts[0] in self._lambda_bindings:
             return self._lambda_bindings[a.parts[0]]
@@ -2334,6 +2541,9 @@ class _Analyzer:
             raise AnalysisError(
                 f"correlated reference {'.'.join(a.parts)!r} is not "
                 f"supported in this position")
+        if f.form is not None:
+            self._register_form_dicts(f)
+            return f.form
         self._dicts.setdefault(f.symbol, f.dictionary)
         return InputRef(f.symbol, f.type)
 

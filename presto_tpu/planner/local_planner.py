@@ -81,8 +81,30 @@ def _schema_dicts(schema: Dict[str, ColumnSchema]
 
 
 def _schema_of(node: N.PlanNode) -> Dict[str, ColumnSchema]:
-    return {f.symbol: ColumnSchema(f.symbol, f.type, f.dictionary)
-            for f in node.output}
+    out = {}
+    for f in node.output:
+        form = getattr(f, "form", None)
+        if form is None:
+            out[f.symbol] = ColumnSchema(f.symbol, f.type,
+                                         f.dictionary)
+            continue
+        # complex-typed field: expose its SLOT columns
+        from presto_tpu.expr.ir import InputRef as _IR
+        form_dicts = getattr(f, "form_dicts", None) or {}
+        for leaf_sym in N.form_slot_symbols(form):
+            t = next(
+                (x.type for x in _form_leaves(form)
+                 if isinstance(x, _IR) and x.name == leaf_sym),
+                f.type)
+            dic = form_dicts.get(leaf_sym) if t.is_string \
+                else None
+            if dic is None and t.is_string:
+                dic = f.dictionary
+            out[leaf_sym] = ColumnSchema(leaf_sym, t, dic)
+    return out
+
+
+_form_leaves = N.form_leaves
 
 
 def _trace_scan_column(node: N.PlanNode, symbol: str, shared=frozenset()):
@@ -138,14 +160,17 @@ class LocalExecutionPlanner:
         sink: List[Batch] = []
         pipeline: List = []
         self._visit(root.source, pipeline)
-        # final projection to output order
+        # final projection to output order; complex-typed fields
+        # project their exploded SLOT columns (the named symbol has no
+        # physical column — see nodes.Field.form)
         src_schema = _schema_of(root.source)
         projections = []
-        for sym in root.source_symbols:
-            cs = src_schema[sym]
-            projections.append(
-                (sym, compile_expression(InputRef(sym, cs.type),
-                                         src_schema)))
+        for f in root.output:
+            for sym in field_symbols(f):
+                cs = src_schema[sym]
+                projections.append(
+                    (sym, compile_expression(InputRef(sym, cs.type),
+                                             src_schema)))
         pipeline.append(FilterProjectOperatorFactory(
             self._next_id(), None, projections,
             _schema_dicts(src_schema)))
@@ -310,6 +335,31 @@ class LocalExecutionPlanner:
         schema = _schema_of(node.source)
         key_names = [s for s, _ in node.keys]
         key_exprs = [compile_expression(e, schema) for _, e in node.keys]
+        collecting = [a for a in node.aggregates
+                      if a.function in ("array_agg", "map_agg")]
+        if collecting:
+            if len(collecting) != len(node.aggregates):
+                raise LocalPlanningError(
+                    "array_agg/map_agg cannot be combined with other "
+                    "aggregates in one GROUP BY yet — split the query")
+            from presto_tpu.operators.array_agg import (
+                ArrayAggOperatorFactory, CollectSpec,
+            )
+            cspecs = []
+            for a in collecting:
+                mask_ce = compile_expression(a.filter, schema) \
+                    if a.filter is not None else None
+                cspecs.append(CollectSpec(
+                    a.out_symbol,
+                    compile_expression(a.argument, schema),
+                    compile_expression(a.argument2, schema)
+                    if a.argument2 is not None else None,
+                    mask_ce))
+            width = int(get_property(self.session.properties,
+                                     "array_agg_width"))
+            pipe.append(ArrayAggOperatorFactory(
+                self._next_id(), key_names, key_exprs, cspecs, width))
+            return
         specs = []
         for a in node.aggregates:
             arg_ce = None
@@ -773,7 +823,8 @@ _VARIANCE_CANON = {"variance": "var_samp", "stddev_samp": "stddev"}
 #: aggregates whose state has no intermediate column representation —
 #: the planner co-locates whole groups (like DISTINCT aggs) instead of
 #: splitting partial/final across an exchange
-NO_SPLIT_AGGS = {"approx_percentile", "approx_distinct"}
+NO_SPLIT_AGGS = {"approx_percentile", "approx_distinct",
+                 "array_agg", "map_agg"}
 
 
 def agg_function_for(name: str, input_type: Optional[Type],
@@ -852,6 +903,16 @@ def _shared_nodes(root: N.PlanNode) -> set:
     return {nid for nid, c in _parent_counts(root).items() if c > 1}
 
 
+def field_symbols(f: "N.Field") -> List[str]:
+    """Physical column symbols of an output field: the symbol itself,
+    or — for complex-typed fields — the slot symbols its form
+    references (the named symbol has no column)."""
+    form = getattr(f, "form", None)
+    if form is None:
+        return [f.symbol]
+    return N.form_slot_symbols(form)
+
+
 def prune_unused_columns(root: N.PlanNode) -> None:
     """Demand-driven column pruning, top-down (reference:
     PruneUnreferencedOutputs): each node narrows its output to what its
@@ -868,7 +929,8 @@ def prune_unused_columns(root: N.PlanNode) -> None:
 
     # pass 1: propagate demand top-down, processing a node only once all
     # of its parents have contributed
-    demands: Dict[int, set] = {id(root): {f.symbol for f in root.output}}
+    demands: Dict[int, set] = {id(root): {
+        s for f in root.output for s in field_symbols(f)}}
     order: List[N.PlanNode] = []
     queue: List[N.PlanNode] = [root]
     while queue:
@@ -912,9 +974,11 @@ def _child_demand(node: N.PlanNode, demand: set
         for _, e in node.keys:
             _refs(e, child)
         for a in node.aggregates:
-            if a.out_symbol in demand:
+            if _agg_demanded(a, demand):
                 if a.argument is not None:
                     _refs(a.argument, child)
+                if a.argument2 is not None:
+                    _refs(a.argument2, child)
                 if a.filter is not None:
                     _refs(a.filter, child)
         return [(node.source, child)]
@@ -970,9 +1034,22 @@ def _child_demand(node: N.PlanNode, demand: set
             out.append((inp, set(m2.values())))
         return out
     if isinstance(node, N.OutputNode):
-        return [(node.source, set(node.source_symbols))]
+        # complex-typed outputs demand their SLOT columns, not the
+        # (column-less) named symbol
+        return [(node.source,
+                 {s for f in node.output for s in field_symbols(f)})]
     raise LocalPlanningError(
         f"prune: unhandled node {type(node).__name__}")
+
+
+def _agg_demanded(a: "N.AggCall", demand: set) -> bool:
+    """A collection aggregate (array_agg/map_agg) is demanded through
+    its SLOT symbols (<out>__a0, <out>__len, ...), never the
+    column-less out symbol itself."""
+    if a.out_symbol in demand:
+        return True
+    prefix = a.out_symbol + "__"
+    return any(d.startswith(prefix) for d in demand)
 
 
 def _apply_prune(node: N.PlanNode, demand: set) -> None:
@@ -998,7 +1075,7 @@ def _apply_prune(node: N.PlanNode, demand: set) -> None:
         node.output = narrowed()
     elif isinstance(node, N.AggregationNode):
         node.aggregates = [a for a in node.aggregates
-                           if a.out_symbol in demand]
+                           if _agg_demanded(a, demand)]
         keep = {s for s, _ in node.keys} | \
             {a.out_symbol for a in node.aggregates}
         node.output = tuple(f for f in node.output if f.symbol in keep)

@@ -22,6 +22,44 @@ class Field:
     symbol: str
     type: Type
     dictionary: Optional[Tuple[str, ...]] = None
+    #: complex-typed fields (array/map/row) carry their VALUE FORM: an
+    #: ir.ArrayValue/MapValue/RowValue whose leaves are InputRefs to
+    #: the exploded slot columns actually present in batches (arrays
+    #: live as <sym>__a0..<sym>__a{W-1} + <sym>__len scalar columns —
+    #: reference: common/type/ArrayType's offsets+child block,
+    #: re-shaped static for XLA). The named symbol itself has no
+    #: physical column.
+    form: Optional[object] = None
+    #: per-slot string dictionaries for form fields ({slot symbol ->
+    #: dictionary}; map keys and values may differ)
+    form_dicts: Optional[Dict[str, Tuple[str, ...]]] = None
+
+
+def form_leaves(form) -> List[Any]:
+    """The leaf expressions of a complex value form, in canonical
+    order (elements/keys+values/fields, then the length expression).
+    THE one enumeration every consumer shares — slot symbols, schema
+    expansion, renames all derive from this order."""
+    from presto_tpu.expr.ir import ArrayValue, MapValue
+    if isinstance(form, ArrayValue):
+        leaves = list(form.elements)
+        if form.length is not None:
+            leaves.append(form.length)
+        return leaves
+    if isinstance(form, MapValue):
+        leaves = list(form.keys + form.values)
+        if form.length is not None:
+            leaves.append(form.length)
+        return leaves
+    return [x for _, x in form.fields]  # RowValue
+
+
+def form_slot_symbols(form) -> List[str]:
+    """InputRef slot symbols referenced by a complex value form (the
+    physical columns behind an array/map/row field)."""
+    from presto_tpu.expr.ir import InputRef
+    return [x.name for x in form_leaves(form)
+            if isinstance(x, InputRef)]
 
 
 class PlanNode:
@@ -90,6 +128,8 @@ class AggCall:
     # FILTER (WHERE ...) predicate gating contributions; applied at
     # the PARTIAL step only under a distributed split
     filter: Optional[RowExpression] = None
+    # map_agg's VALUE expression (argument carries the key)
+    argument2: Optional[RowExpression] = None
 
 
 @dataclasses.dataclass

@@ -89,9 +89,24 @@ def _push_scan_constraints(node: N.PlanNode,
 def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
     from presto_tpu.connectors.spi import Domain, TupleDomain
     sym_to_col = dict(scan.assignments)
-    # only physical-value comparisons push down (strings are
-    # dictionary-coded per batch, so their codes are not stable)
     ok_types = {"bigint", "integer", "double", "date", "boolean"}
+    # varchar comparisons push down as CODES into the scan's TABLE
+    # dictionary (stable at plan time — the per-batch instability only
+    # affects expression-derived strings); an equality literal absent
+    # from the dictionary prunes everything via an empty IN-set
+    dict_of = {f.symbol: f.dictionary for f in scan.output
+               if f.dictionary is not None}
+
+    def encode(sym: str, value):
+        """string literal -> dictionary code; None = not encodable,
+        () = provably matches nothing."""
+        dic = dict_of.get(sym)
+        if dic is None:
+            return None
+        try:
+            return dic.index(value)
+        except ValueError:
+            return ()
     doms: Dict[str, Dict[str, object]] = {}
 
     def note(sym: str, kind: str, value):
@@ -113,10 +128,16 @@ def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
     for c in _split_conjuncts(pred):
         if isinstance(c, SpecialForm) and c.form == "in":
             v, *items = c.args
-            if isinstance(v, InputRef) and v.type.name in ok_types \
+            if not (isinstance(v, InputRef)
                     and all(isinstance(i, Literal)
-                            and i.value is not None for i in items):
+                            and i.value is not None for i in items)):
+                continue
+            if v.type.name in ok_types:
                 note(v.name, "in", [i.value for i in items])
+            elif v.type.is_string and v.name in dict_of:
+                codes = [encode(v.name, i.value) for i in items]
+                note(v.name, "in",
+                     [x for x in codes if x not in (None, ())])
             continue
         if isinstance(c, Call) and len(c.args) == 2:
             from presto_tpu.expr.ir import FLIP_COMPARISON
@@ -130,8 +151,21 @@ def _extract_domains(pred: RowExpression, scan: N.TableScanNode):
             else:
                 name = c.name
             if not (isinstance(a, InputRef) and isinstance(b, Literal)
-                    and a.type.name in ok_types
                     and b.value is not None):
+                continue
+            if a.type.is_string and a.name in dict_of:
+                # equality only: enough for partition pruning and
+                # remote-SQL pushdown (ranges would also be sound —
+                # dictionaries sort ascending — just not needed yet)
+                if name != "equal":
+                    continue
+                code = encode(a.name, b.value)
+                if code == ():
+                    note(a.name, "in", [])
+                elif code is not None:
+                    note(a.name, "in", [code])
+                continue
+            if a.type.name not in ok_types:
                 continue
             v = b.value
             if name == "equal":

@@ -32,6 +32,7 @@ class QueryError(Exception):
 #: session's tables into another). Guarded: concurrent first loads
 #: must not exec plugin modules twice.
 _PLUGIN_REGISTRY_CACHE: Dict[str, Any] = {}
+import itertools as _itertools
 import threading as _threading
 _PLUGIN_CACHE_LOCK = _threading.Lock()
 
@@ -109,6 +110,82 @@ class CatalogManager:
         return handle, schema
 
 
+def _rename_form_slots(form, plan_sym: str, stored_name: str):
+    """Rebuild a plan-symbol form over STORED column names (the
+    <stored_name>__suffix convention), returning (stored form,
+    {stored name -> plan slot symbol})."""
+    from presto_tpu.expr.ir import ArrayValue, InputRef, MapValue
+
+    src_map: Dict[str, Optional[str]] = {}
+
+    def ren(x):
+        if not isinstance(x, InputRef):
+            raise QueryError(
+                "cannot write a complex column whose form is not "
+                "slot-backed")
+        assert x.name.startswith(plan_sym + "__"), x.name
+        stored = stored_name + x.name[len(plan_sym):]
+        src_map[stored] = x.name
+        return InputRef(stored, x.type)
+
+    if isinstance(form, ArrayValue):
+        out = ArrayValue(tuple(ren(e) for e in form.elements),
+                         ren(form.length)
+                         if form.length is not None else None,
+                         form.type)
+    elif isinstance(form, MapValue):
+        out = MapValue(tuple(ren(e) for e in form.keys),
+                       tuple(ren(e) for e in form.values),
+                       ren(form.length)
+                       if form.length is not None else None,
+                       form.type)
+    else:
+        raise QueryError("cannot write row-typed columns yet")
+    return out, src_map
+
+
+def _assemble_form(form, cols: Dict[str, list], nrows: int) -> list:
+    """Per-row python values of a complex field from its slot-column
+    pylists. Leaves are InputRefs into `cols` or Literals."""
+    from presto_tpu.expr.ir import (
+        ArrayValue, InputRef, Literal, MapValue, RowValue,
+    )
+
+    def leaf(e) -> list:
+        if isinstance(e, InputRef):
+            return cols[e.name]
+        if isinstance(e, Literal):
+            return [e.value] * nrows
+        raise QueryError(
+            "complex output columns must be slot references "
+            f"(got {type(e).__name__})")
+
+    if isinstance(form, ArrayValue):
+        elem_cols = [leaf(x) for x in form.elements]
+        lens = leaf(form.length) if form.length is not None \
+            else [len(elem_cols)] * nrows
+        return [
+            None if lens[i] is None else
+            [c[i] for c in elem_cols[:int(lens[i])]]
+            for i in range(nrows)
+        ]
+    if isinstance(form, MapValue):
+        kc = [leaf(x) for x in form.keys]
+        vc = [leaf(x) for x in form.values]
+        lens = leaf(form.length) if form.length is not None \
+            else [len(kc)] * nrows
+        return [
+            None if lens[i] is None else
+            {k[i]: v[i] for k, v in
+             zip(kc[:int(lens[i])], vc[:int(lens[i])])}
+            for i in range(nrows)
+        ]
+    if isinstance(form, RowValue):
+        fc = [leaf(x) for _, x in form.fields]
+        return [tuple(c[i] for c in fc) for i in range(nrows)]
+    raise QueryError(f"unsupported output form {type(form).__name__}")
+
+
 class MaterializedResult:
     def __init__(self, names: List[str], batches: List[Batch],
                  fields: Tuple[N.Field, ...]):
@@ -121,13 +198,36 @@ class MaterializedResult:
         return sum(b.num_valid() for b in self.batches)
 
     def rows(self) -> List[Tuple]:
-        out: List[Tuple] = []
+        forms = [getattr(f, "form", None) for f in self.fields] \
+            if self.fields else []
+        if not any(f is not None for f in forms):
+            out: List[Tuple] = []
+            for b in self.batches:
+                out.extend(b.to_pylist())
+            return out
+        # complex-typed outputs: assemble array/map/row python values
+        # from their exploded slot columns (see nodes.Field.form)
+        out = []
         for b in self.batches:
-            out.extend(b.to_pylist())
+            cols = b.to_pydict()  # keyed by symbol
+            nrows = len(next(iter(cols.values()))) if cols else 0
+            per_field = []
+            for f, form in zip(self.fields, forms):
+                if form is None:
+                    per_field.append(cols[f.symbol])
+                else:
+                    per_field.append(
+                        _assemble_form(form, cols, nrows))
+            out.extend(zip(*per_field))
         return out
 
     def to_pandas(self):
         import pandas as pd
+        if any(getattr(f, "form", None) is not None
+               for f in (self.fields or ())):
+            # complex-typed columns: assemble through the form-aware
+            # row path (the raw batches hold W+1 slot columns each)
+            return pd.DataFrame(self.rows(), columns=self.names)
         if not self.batches:
             return pd.DataFrame(columns=self.names)
         frames = [b.to_pandas() for b in self.batches]
@@ -159,6 +259,7 @@ class LocalRunner:
         from presto_tpu.connectors.system import runner_system_connector
         self.query_history: List[Dict[str, Any]] = []
         self.catalogs.register("system", runner_system_connector(self))
+        self._session_tl = _threading.local()
         self.session = Session(catalog, schema, dict(properties or {}),
                                user=user)
         self.catalogs.access_control = access_control
@@ -207,6 +308,68 @@ class LocalRunner:
 
     # ------------------------------------------------------------------
 
+    _cluster_mgr_lock = _threading.Lock()
+    #: process-wide query-id mint for cluster-memory tracking
+    #: (itertools.count.__next__ is atomic under the GIL)
+    _cm_qid_mint = _itertools.count()
+
+    def _cluster_memory(self, session):
+        """The shared cross-query memory arbiter, when the session
+        sets cluster_memory_bytes (reference: ClusterMemoryManager —
+        one per coordinator process). Creation is locked: two
+        concurrent queries must attach to ONE manager or the budget
+        silently splits."""
+        from presto_tpu.session_properties import get_property
+        budget = get_property(session.properties,
+                              "cluster_memory_bytes")
+        if not budget:
+            return None
+        with self._cluster_mgr_lock:
+            cm = getattr(self, "_cluster_mgr", None)
+            if cm is None or cm.budget != int(budget):
+                from presto_tpu.execution.cluster_memory import (
+                    ClusterMemoryManager,
+                )
+                cm = ClusterMemoryManager(int(budget))
+                self._cluster_mgr = cm
+            return cm
+
+    @property
+    def session(self) -> Session:
+        """The effective session: a THREAD-LOCAL override (set by the
+        width-retry loop) or the runner's base session. Concurrent
+        queries on one runner must not see each other's in-flight
+        retry overrides."""
+        o = getattr(self._session_tl, "override", None)
+        return o if o is not None else self._session
+
+    @session.setter
+    def session(self, value: Session) -> None:
+        self._session = value
+
+    def _with_width_retry(self, fn):
+        """Re-plan + re-run on array_agg width overflow: the element
+        capacity is baked into the plan's value forms at ANALYSIS
+        time, so unlike max_groups this retry must rebuild the plan.
+        The bumped session rides a thread-local override — other
+        threads' statements keep planning at the base width."""
+        from presto_tpu.operators.array_agg import ArrayAggWidthExceeded
+        try:
+            while True:
+                try:
+                    return fn()
+                except ArrayAggWidthExceeded as e:
+                    if e.suggested > 1 << 14:
+                        raise QueryError(
+                            "array_agg exceeds the supported element "
+                            "count") from e
+                    self._session_tl.override = dataclasses.replace(
+                        self.session, properties={
+                            **self.session.properties,
+                            "array_agg_width": e.suggested})
+        finally:
+            self._session_tl.override = None
+
     def execute(self, sql: str) -> MaterializedResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, T.Explain):
@@ -229,9 +392,11 @@ class LocalRunner:
             self.session.properties.pop(stmt.name, None)
             return self._text_result("result", ["RESET SESSION"])
         if isinstance(stmt, T.CreateTableAs):
-            return self._create_table_as(stmt)
+            return self._with_width_retry(
+                lambda: self._create_table_as(stmt))
         if isinstance(stmt, T.InsertInto):
-            return self._insert_into(stmt)
+            return self._with_width_retry(
+                lambda: self._insert_into(stmt))
         if isinstance(stmt, T.DropTable):
             return self._drop_table(stmt)
         if not isinstance(stmt, T.Query):
@@ -245,13 +410,18 @@ class LocalRunner:
         del self.query_history[:-1000]  # bounded history
         t0 = _time.perf_counter()
         try:
-            try:
-                plan = plan_statement(stmt, self.catalogs, self.session)
-            except AnalysisError as e:
-                raise QueryError(str(e)) from e
-            from presto_tpu.planner.optimizer import optimize
-            plan = optimize(plan, self.catalogs)
-            result = self._run_plan(plan)
+            def plan_and_run():
+                try:
+                    plan = plan_statement(stmt, self.catalogs,
+                                          self.session)
+                except AnalysisError as e:
+                    raise QueryError(str(e)) from e
+                from presto_tpu.planner.optimizer import optimize
+                return self._run_plan(optimize(plan, self.catalogs))
+            # array_agg width overflow must RE-PLAN (the width is
+            # baked into the plan's value forms), unlike the
+            # operator-level overflow retries inside _run_plan
+            result = self._with_width_retry(plan_and_run)
             entry["state"] = "FINISHED"
             # row count resolves lazily when system.runtime.queries is
             # read — counting here would put device syncs on the timed
@@ -292,11 +462,25 @@ class LocalRunner:
             budget = get_property(session.properties,
                                   "hbm_budget_bytes")
             pool = MemoryPool(int(budget) if budget else None)
+            cm = self._cluster_memory(session)
+            cm_qid = None
+            if cm is not None:
+                cm_qid = f"cmq{next(self._cm_qid_mint)}"
+                pool.attach_cluster(cm, cm_qid)
+            from presto_tpu.execution.cluster_memory import (
+                QueryKilledByMemoryManager,
+            )
             from presto_tpu.execution.memory import MemoryLimitExceeded
             try:
-                drivers = self.drive_pipelines(lplan.pipelines,
-                                               profile=profile,
-                                               pool=pool)
+                try:
+                    drivers = self.drive_pipelines(lplan.pipelines,
+                                                   profile=profile,
+                                                   pool=pool)
+                finally:
+                    if cm is not None:
+                        cm.finish_query(cm_qid)
+            except QueryKilledByMemoryManager as e:
+                raise QueryError(str(e)) from e
             except MemoryLimitExceeded as e:
                 raise QueryError(
                     f"{e} — raise hbm_budget_bytes or run on a "
@@ -425,8 +609,7 @@ class LocalRunner:
         let the retry duplicate committed rows). Overflow retries drop
         uncommitted appends first (ConnectorPageSink.abort)."""
         from presto_tpu.types import BIGINT
-        schema_cols = [(c.name, c.type, c.dictionary)
-                       for c in schema.columns]
+        schema_cols = [p for c in schema.columns for p in c.physical()]
         wsym, fsym = "__write_rows__", "__commit_rows__"
         writer = N.TableWriterNode(
             qplan.source, handle, dict(column_sources), schema_cols,
@@ -435,8 +618,15 @@ class LocalRunner:
             writer, handle,
             (N.Field(fsym, writer.output[0].type),))
         out = N.OutputNode(finish, ["rows"], [fsym], finish.output)
-        result = self._run_plan(out,
-                                on_retry=lambda: sink.abort(handle))
+        try:
+            result = self._run_plan(
+                out, on_retry=lambda: sink.abort(handle))
+        except Exception:
+            # a width-overflow retry restarts the whole write
+            # statement — uncommitted appends must not survive into
+            # the rerun
+            sink.abort(handle)
+            raise
         n = int(result.rows()[0][0])
         sink.finish(handle)  # THE commit point
         return n
@@ -462,14 +652,36 @@ class LocalRunner:
             raise QueryError(
                 "CREATE TABLE AS query has duplicate column names; "
                 "alias them")
-        fields = [qplan.source.field(s) for s in qplan.source_symbols]
-        schema = RelationSchema([
-            ColumnSchema(n, f.type, f.dictionary)
-            for n, f in zip(qplan.names, fields)])
+        fields = [next(f for f in qplan.output if f.symbol == s)
+                  for s in qplan.source_symbols]
+        cols = []
+        column_sources: Dict[str, Optional[str]] = {}
+        for n, f in zip(qplan.names, fields):
+            form = getattr(f, "form", None)
+            if form is None:
+                cols.append(ColumnSchema(n, f.type, f.dictionary))
+                column_sources[n] = f.symbol
+                continue
+            # complex column: store its SLOT columns under
+            # <name>__a{j}/<name>__len and record the stored-name form
+            stored, src_map = _rename_form_slots(form, f.symbol, n)
+            cols.append(ColumnSchema(n, f.type, f.dictionary,
+                                     form=stored))
+            column_sources.update(src_map)
+        schema = RelationSchema(cols)
+        from presto_tpu.operators.array_agg import ArrayAggWidthExceeded
         sink.create_table(handle, schema, dict(stmt.properties or {}))
-        column_sources = dict(zip(qplan.names, qplan.source_symbols))
-        n = self._run_write(qplan, handle, sink, schema,
-                            column_sources)
+        try:
+            n = self._run_write(qplan, handle, sink, schema,
+                                column_sources)
+        except ArrayAggWidthExceeded:
+            # the width retry re-runs the whole CTAS (the schema's
+            # stored forms are width-dependent): un-create first
+            try:
+                sink.drop_table(handle)
+            except Exception:
+                pass
+            raise
         return self._text_result(
             "result", [f"CREATE TABLE: {n} rows"])
 
@@ -491,27 +703,46 @@ class LocalRunner:
         if len(set(target_cols)) != len(target_cols):
             raise QueryError("INSERT target columns must be distinct")
         qplan = self._plan_for_write(stmt.query)
-        fields = [qplan.source.field(s) for s in qplan.source_symbols]
+        fields = [next(f for f in qplan.output if f.symbol == s)
+                  for s in qplan.source_symbols]
         if len(fields) != len(target_cols):
             raise QueryError(
                 f"INSERT has {len(fields)} columns but "
                 f"{len(target_cols)} targets")
         # INSERT matches by POSITION (duplicate query names are fine):
-        # target column name -> source symbol
-        by_target = dict(zip(target_cols,
-                             (f.symbol for f in fields)))
-        field_of = {f.symbol: f for f in fields}
+        # target column name -> source field
+        by_target = dict(zip(target_cols, fields))
+        column_sources: Dict[str, Optional[str]] = {}
         for cs in schema.columns:
-            src = by_target.get(cs.name)
-            if src is None:
+            ft = by_target.get(cs.name)
+            if ft is None:
+                for pname, _t, _d in cs.physical():
+                    column_sources[pname] = None
                 continue
-            ft = field_of[src]
             if ft.type.name != cs.type.name:
                 raise QueryError(
                     f"INSERT type mismatch on {cs.name}: "
                     f"{ft.type.display()} vs {cs.type.display()}")
-        column_sources = {cs.name: by_target.get(cs.name)
-                          for cs in schema.columns}
+            if cs.form is not None:
+                # complex target: map each STORED slot to the source
+                # field's corresponding slot (widths must agree — the
+                # stored layout is fixed)
+                sform = getattr(ft, "form", None)
+                if sform is None:
+                    raise QueryError(
+                        f"INSERT into complex column {cs.name} "
+                        "requires a matching array/map value")
+                stored = [p[0] for p in cs.physical()]
+                src_slots = N.form_slot_symbols(sform)
+                if len(stored) != len(src_slots):
+                    raise QueryError(
+                        f"INSERT into {cs.name}: stored element "
+                        f"capacity {len(stored)} != query value's "
+                        f"{len(src_slots)} (set array_agg_width to "
+                        "the table's width)")
+                column_sources.update(zip(stored, src_slots))
+                continue
+            column_sources[cs.name] = ft.symbol
         n = self._run_write(qplan, handle, sink, schema,
                             column_sources)
         return self._text_result("result", [f"INSERT: {n} rows"])

@@ -19,6 +19,23 @@ class ColumnSchema:
     name: str
     type: Type
     dictionary: Optional[Tuple[str, ...]] = None  # sorted, for string types
+    #: complex-typed columns (array/map): the value form over
+    #: InputRefs to the STORED physical column names
+    #: (<name>__a{j} + <name>__len — see nodes.Field.form); such a
+    #: column has no single physical column of its own
+    form: Optional[object] = None
+
+    def physical(self) -> list:
+        """[(stored name, type, dictionary)] — one entry for plain
+        columns, the slot columns for form columns."""
+        if self.form is None:
+            return [(self.name, self.type, self.dictionary)]
+        from presto_tpu.planner.nodes import form_leaves
+        from presto_tpu.expr.ir import InputRef
+        return [(x.name, x.type,
+                 self.dictionary if x.type.is_string else None)
+                for x in form_leaves(self.form)
+                if isinstance(x, InputRef)]
 
 
 @dataclasses.dataclass(frozen=True)

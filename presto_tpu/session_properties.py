@@ -116,6 +116,19 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "(reference: per-section retries, max_stage_retries)",
         _non_negative),
     PropertyDef(
+        "cluster_memory_bytes", "bigint", None,
+        "Shared memory budget across ALL concurrently running queries "
+        "of this runner/coordinator; on exhaustion the largest "
+        "reservation is killed with a structured error (reference: "
+        "ClusterMemoryManager + TotalReservationLowMemoryKiller)",
+        _positive),
+    PropertyDef(
+        "array_agg_width", "bigint", 64,
+        "Static element capacity of array_agg/map_agg results (the "
+        "TPU build's fixed-width array representation); a group "
+        "collecting more elements retries the query with 4x "
+        "(deviation: Presto arrays are unbounded)", _positive),
+    PropertyDef(
         "target_splits", "bigint", 4,
         "Scan splits requested per table (parallel scan fan-out; "
         "reference: initial-splits-per-node)", _positive),

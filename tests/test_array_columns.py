@@ -1,0 +1,198 @@
+"""ARRAY/MAP column storage + array_agg/map_agg (reference:
+operator/aggregation/ArrayAggregationFunction.java +
+MapAggregationFunction + common/type/ArrayType.java).
+
+The TPU-native representation explodes complex values into scalar
+SLOT columns (<sym>__a{j} + <sym>__len) with a value form on the
+field (nodes.Field.form); these tests pin projection, consumption,
+aggregation, storage, shuffles, and the width-overflow replan."""
+
+from collections import defaultdict
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", "tiny")
+
+
+def test_project_array_literal(runner):
+    assert runner.execute(
+        "select array[1, 2, 3] a, 7 x").rows() == [([1, 2, 3], 7)]
+
+
+def test_project_map_and_row(runner):
+    assert runner.execute(
+        "select map(array['a','b'], array[1,2]) m").rows() \
+        == [({"a": 1, "b": 2},)]
+    assert runner.execute("select row(1, 'x') r").rows() \
+        == [((1, "x"),)]
+
+
+def test_array_field_through_subquery(runner):
+    assert runner.execute(
+        "select cardinality(a), a[2] from "
+        "(select array[10,20,30] a) t").rows() == [(3, 20)]
+    assert runner.execute(
+        "select x from (select array[1,2] a) t "
+        "cross join unnest(t.a) u(x) order by x").rows() \
+        == [(1,), (2,)]
+
+
+def test_array_agg_matches_python_oracle(runner):
+    got = runner.execute(
+        "select regionkey, array_agg(nationkey) a from nation "
+        "group by regionkey order by regionkey").rows()
+    rows = runner.execute(
+        "select regionkey, nationkey from nation").rows()
+    exp = defaultdict(list)
+    for rk, nk in rows:
+        exp[rk].append(nk)
+    assert {k: sorted(a) for k, a in got} \
+        == {k: sorted(v) for k, v in exp.items()}
+
+
+def test_array_agg_varchar_elements(runner):
+    got = runner.execute(
+        "select regionkey, array_agg(name) nm from nation "
+        "where nationkey < 4 group by regionkey "
+        "order by regionkey").rows()
+    assert got[0][1] == ["ALGERIA"]
+    assert sorted(got[1][1]) == ["ARGENTINA", "BRAZIL", "CANADA"]
+
+
+def test_map_agg(runner):
+    got = runner.execute(
+        "select regionkey, map_agg(nationkey, name) m from nation "
+        "where nationkey < 6 group by regionkey "
+        "order by regionkey").rows()
+    by_region = dict((k, m) for k, m in got)
+    assert by_region[1] == {1: "ARGENTINA", 2: "BRAZIL", 3: "CANADA"}
+
+
+def test_array_agg_filter_clause(runner):
+    got = runner.execute(
+        "select regionkey, array_agg(nationkey) "
+        "filter (where nationkey > 10) a from nation "
+        "group by regionkey order by regionkey").rows()
+    rows = runner.execute(
+        "select regionkey, nationkey from nation "
+        "where nationkey > 10").rows()
+    exp = defaultdict(list)
+    for rk, nk in rows:
+        exp[rk].append(nk)
+    for k, a in got:
+        assert sorted(a) == sorted(exp.get(k, []))
+
+
+def test_consume_array_agg_inline(runner):
+    got = runner.execute(
+        "select regionkey, cardinality(array_agg(nationkey)) c "
+        "from nation group by regionkey order by regionkey").rows()
+    assert got == [(i, 5) for i in range(5)]
+
+
+def test_width_overflow_replans(runner):
+    from presto_tpu.runner import LocalRunner
+    small = LocalRunner("tpch", "tiny", {"array_agg_width": 2})
+    got = small.execute(
+        "select regionkey, array_agg(nationkey) a from nation "
+        "group by regionkey order by regionkey").rows()
+    assert all(len(a) == 5 for _, a in got)
+    # the session's own width setting is untouched after the retry
+    assert small.session.properties["array_agg_width"] == 2
+
+
+def test_memory_connector_stores_arrays(runner):
+    runner.execute(
+        "create table memory.default.arrstore as "
+        "select regionkey, array_agg(nationkey) a, array_agg(name) nm "
+        "from nation group by regionkey")
+    got = runner.execute(
+        "select regionkey, a, nm from memory.default.arrstore "
+        "order by regionkey").rows()
+    assert len(got) == 5 and all(len(a) == 5 for _, a, _nm in got)
+    assert sorted(got[0][2]) == sorted(
+        ["ALGERIA", "ETHIOPIA", "KENYA", "MOROCCO", "MOZAMBIQUE"])
+    # scan-back consumption: cardinality + unnest over the stored col
+    assert runner.execute(
+        "select cardinality(a) from memory.default.arrstore"
+        ).rows() == [(5,)] * 5
+    u = runner.execute(
+        "select x from memory.default.arrstore t "
+        "cross join unnest(t.a) u(x) where t.regionkey = 1 "
+        "order by x").rows()
+    assert [x for x, in u] == [1, 2, 3, 17, 24]
+    runner.execute("drop table memory.default.arrstore")
+
+
+def test_order_by_array_rejected(runner):
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises(QueryError):
+        runner.execute(
+            "select array_agg(nationkey) a from nation "
+            "group by regionkey order by a")
+
+
+def test_mixed_collect_and_scalar_agg_rejected(runner):
+    with pytest.raises(Exception):
+        runner.execute(
+            "select regionkey, array_agg(nationkey), count(*) "
+            "from nation group by regionkey")
+
+
+# -- mesh: slot columns ride shuffles like any scalar -----------------
+
+@pytest.fixture(scope="module")
+def mesh_runner():
+    from presto_tpu.runner.mesh import MeshRunner
+    return MeshRunner("tpch", "tiny", n_workers=4)
+
+
+def test_mesh_array_agg_repartition(mesh_runner):
+    got = mesh_runner.execute(
+        "select regionkey, array_agg(nationkey) a from nation "
+        "group by regionkey order by regionkey").rows()
+    rows = mesh_runner.execute(
+        "select regionkey, nationkey from nation").rows()
+    exp = defaultdict(list)
+    for rk, nk in rows:
+        exp[rk].append(nk)
+    assert {k: sorted(a) for k, a in got} \
+        == {k: sorted(v) for k, v in exp.items()}
+
+
+def test_mesh_array_survives_join_shuffle(mesh_runner):
+    got = mesh_runner.execute(
+        "select n.nationkey, cardinality(t.a) c from "
+        "(select regionkey rk, array_agg(nationkey) a from nation "
+        " group by regionkey) t "
+        "join nation n on n.regionkey = t.rk "
+        "where n.nationkey < 5 order by 1").rows()
+    assert got == [(i, 5) for i in range(5)]
+
+
+def test_insert_into_array_column_table(runner):
+    runner.execute(
+        "create table memory.default.arrins as "
+        "select regionkey, array_agg(nationkey) a from nation "
+        "group by regionkey")
+    runner.execute(
+        "insert into memory.default.arrins "
+        "select regionkey + 10, array_agg(nationkey + 100) a "
+        "from nation group by regionkey")
+    got = runner.execute(
+        "select regionkey, cardinality(a) from memory.default.arrins "
+        "order by regionkey").rows()
+    assert len(got) == 10 and all(c == 5 for _, c in got)
+    runner.execute("drop table memory.default.arrins")
+
+
+def test_to_pandas_with_array_column(runner):
+    df = runner.execute(
+        "select regionkey, array_agg(nationkey) a from nation "
+        "group by regionkey order by regionkey").to_pandas()
+    assert list(df.columns) == ["regionkey", "a"]
+    assert sorted(df["a"][0]) == [0, 5, 14, 15, 16]

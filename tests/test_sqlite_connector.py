@@ -1,0 +1,121 @@
+"""SQLite connector: the SPI proven against a real EXTERNAL system
+(reference: presto-base-jdbc — JdbcMetadata/JdbcSplitManager/
+JdbcRecordSetProvider/QueryBuilder pushdown)."""
+
+import sqlite3
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def sq_runner(tmp_path_factory):
+    """A LocalRunner with catalog `db` = a sqlite file preloaded with
+    the TPC-H tiny nation/region/customer tables (written by sqlite3
+    directly — the file is a genuinely external artifact)."""
+    from presto_tpu.connectors.sqlite import SqliteConnector
+    from presto_tpu.runner import LocalRunner
+    path = str(tmp_path_factory.mktemp("sq") / "t.db")
+    src = LocalRunner("tpch", "tiny")
+    con = sqlite3.connect(path)
+    for table, cols in (
+            ("nation", "nationkey, name, regionkey"),
+            ("region", "regionkey, name"),
+            ("customer", "custkey, name, nationkey, acctbal")):
+        rows = src.execute(f"select {cols} from {table}").rows()
+        names = [c.strip() for c in cols.split(",")]
+        decls = ", ".join(
+            f"{n} {'TEXT' if n == 'name' else 'INTEGER' if n != 'acctbal' else 'REAL'}"
+            for n in names)
+        con.execute(f"CREATE TABLE {table} ({decls})")
+        con.executemany(
+            f"INSERT INTO {table} VALUES ({','.join('?' * len(names))})",
+            rows)
+    con.commit()
+    con.close()
+    r = LocalRunner("tpch", "tiny")
+    r.register_connector("db", SqliteConnector(path))
+    return r, src
+
+
+def test_scan_parity(sq_runner):
+    r, src = sq_runner
+    got = r.execute("select nationkey, name, regionkey "
+                    "from db.main.nation order by nationkey").rows()
+    want = src.execute("select nationkey, name, regionkey "
+                       "from nation order by nationkey").rows()
+    assert got == want
+
+
+def test_join_and_aggregate_parity(sq_runner):
+    r, src = sq_runner
+    q = ("select r.name, count(*) c, sum(cu.acctbal) s "
+         "from {cu} cu join {n} n on cu.nationkey = n.nationkey "
+         "join {r} r on n.regionkey = r.regionkey "
+         "group by r.name order by r.name")
+    got = r.execute(q.format(cu="db.main.customer", n="db.main.nation",
+                             r="db.main.region")).rows()
+    want = src.execute(q.format(cu="customer", n="nation",
+                                r="region")).rows()
+    assert [(a, b) for a, b, _ in got] == [(a, b) for a, b, _ in want]
+    for (_, _, g), (_, _, w) in zip(got, want):
+        assert abs(g - w) < 1e-6 * max(abs(w), 1)
+
+
+def test_predicate_pushdown_reaches_remote_sql(sq_runner):
+    r, _ = sq_runner
+    conn = r.catalogs.connector("db")
+    conn.remote_log.clear()
+    got = r.execute("select count(*) from db.main.customer "
+                    "where nationkey >= 10").rows()
+    assert got[0][0] > 0
+    pushed = [s for s in conn.remote_log
+              if "FROM \"customer\"" in s and ">=" in s]
+    assert pushed, f"no pushdown in remote log: {conn.remote_log}"
+
+
+def test_varchar_pushdown_translates_codes(sq_runner):
+    r, src = sq_runner
+    conn = r.catalogs.connector("db")
+    conn.remote_log.clear()
+    got = r.execute("select nationkey from db.main.nation "
+                    "where name = 'CANADA'").rows()
+    assert got == src.execute("select nationkey from nation "
+                              "where name = 'CANADA'").rows()
+    assert any("IN (" in s or "=" in s or ">=" in s
+               for s in conn.remote_log if "nation" in s)
+
+
+def test_parallel_rowid_splits(sq_runner):
+    r, _ = sq_runner
+    from presto_tpu.connectors.spi import TableHandle
+    conn = r.catalogs.connector("db")
+    splits = conn.split_manager.get_splits(
+        TableHandle("db", "main", "customer"), 4)
+    assert len(splits) >= 2  # rowid ranges parallelize the scan
+
+
+def test_ctas_and_insert_roundtrip(sq_runner):
+    r, _ = sq_runner
+    r.execute("create table db.main.nat2 as "
+              "select nationkey, name from db.main.nation "
+              "where nationkey < 10")
+    n = r.execute("select count(*) from db.main.nat2").rows()[0][0]
+    assert n == 10
+    r.execute("insert into db.main.nat2 "
+              "select nationkey + 100, name from db.main.nation "
+              "where nationkey < 5")
+    n2 = r.execute("select count(*) from db.main.nat2").rows()[0][0]
+    assert n2 == 15
+    # the rows are really in sqlite (read back with raw sqlite3)
+    raw = sqlite3.connect(r.catalogs.connector("db").path)
+    assert raw.execute(
+        "SELECT count(*) FROM nat2").fetchone()[0] == 15
+    raw.close()
+    r.execute("drop table db.main.nat2")
+
+
+def test_show_tables_lists_sqlite(sq_runner):
+    r, _ = sq_runner
+    rows = r.execute("show tables from db.main").rows()
+    names = {t for t, in rows}
+    assert {"nation", "region", "customer"} <= names

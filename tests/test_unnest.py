@@ -152,12 +152,10 @@ def test_dynamic_array_length_guards(runner):
         "array_max(array[length(csv), 10]) hi "
         "from memory.default.csvg order by id")
     assert r2.rows() == [(1, 3, 10), (2, 5, 10)]
-    # arrays are expression-level values; projecting one as a column
-    # is a clear error, not a crash
-    import pytest as _pytest
-    from presto_tpu.runner import QueryError
-    with _pytest.raises(QueryError, match="[Aa]rray"):
-        runner.execute("select array[1, 2] a from memory.default.csvg")
+    # round 5: arrays project as columns (one list per source row)
+    got = runner.execute(
+        "select array[1, 2] a from memory.default.csvg").rows()
+    assert all(v == ([1, 2],) for v in got) and got
     runner.execute("drop table memory.default.csvg")
 
 
