@@ -23,6 +23,7 @@ KEYWORDS = {
     "for", "year", "month", "day", "hour", "minute", "second", "quarter",
     "over", "partition", "range", "unbounded", "preceding", "following",
     "current", "exclude", "ties", "no", "others", "semi", "anti",
+    "prepare", "execute", "deallocate", "input", "output",
 }
 
 MULTI_OPS = ["<>", "!=", ">=", "<=", "||", "->"]

@@ -31,6 +31,8 @@ class _Parser:
     def __init__(self, tokens: List[Token]):
         self.toks = tokens
         self.i = 0
+        #: `?` placeholders seen so far (prepared statements)
+        self.param_count = 0
 
     # -- token helpers -----------------------------------------------------
 
@@ -86,7 +88,10 @@ class _Parser:
                 "year", "month", "day", "hour", "minute", "second",
                 "date", "time", "timestamp", "tables", "schemas",
                 "catalogs", "columns", "row", "rows", "first", "last",
-                "session", "values", "range", "current", "no"):
+                "session", "values", "range", "current", "no",
+                # prepared-statement words stay usable as identifiers
+                # (the reference keeps them non-reserved)
+                "prepare", "execute", "deallocate", "input", "output"):
             self.advance()
             return t.value
         raise ParseError(f"expected identifier, found {t.value!r} "
@@ -188,7 +193,33 @@ class _Parser:
                 if_exists = True
             return T.DropTable(self.qualified_name(), if_exists)
         if self.accept_kw("describe"):
+            # DESCRIBE INPUT/OUTPUT <prepared>; plain DESCRIBE <table>
+            # stays the SHOW COLUMNS shorthand. Lookahead: a table
+            # NAMED input/output (non-reserved) is still describable —
+            # only `DESCRIBE INPUT <name>` takes the prepared form
+            nxt = self.toks[self.i + 1]
+            if self.at_kw("input", "output") \
+                    and nxt.kind in ("ident", "qident", "keyword"):
+                if self.accept_kw("input"):
+                    return T.DescribeInput(self.ident())
+                self.expect_kw("output")
+                return T.DescribeOutput(self.ident())
             return T.ShowColumns(self.qualified_name())
+        if self.accept_kw("prepare"):
+            name = self.ident()
+            self.expect_kw("from")
+            return T.Prepare(name, self.statement())
+        if self.accept_kw("execute"):
+            name = self.ident()
+            using: list = []
+            if self.accept_kw("using"):
+                using.append(self.expr())
+                while self.accept_op(","):
+                    using.append(self.expr())
+            return T.ExecutePrepared(name, using)
+        if self.accept_kw("deallocate"):
+            self.expect_kw("prepare")
+            return T.Deallocate(self.ident())
         return self.query()
 
     def _peek_is_column_list(self) -> bool:
@@ -252,6 +283,10 @@ class _Parser:
             self.accept_kw("rows") or self.accept_kw("row")
         if self.accept_kw("limit"):
             t = self.advance()
+            if t.value == "?":
+                raise ParseError(
+                    "parameterized LIMIT (`LIMIT ?`) is not "
+                    "supported yet — inline the value")
             limit = None if t.value == "all" else int(t.value)
         elif self.accept_kw("fetch"):
             self.accept_kw("first") or self.accept_kw("next")
@@ -714,6 +749,10 @@ class _Parser:
                     items.append(self.expr())
                 self.expect_op("]")
             return T.ArrayConstructor(items)
+        if t.kind == "op" and t.value == "?":
+            self.advance()
+            self.param_count += 1
+            return T.Parameter(self.param_count - 1)
         if t.kind == "number":
             self.advance()
             return T.NumberLit(t.value)
@@ -799,7 +838,9 @@ class _Parser:
         if t.kind in ("ident", "qident") or (
                 t.kind == "keyword" and t.value in (
                     "year", "month", "day", "hour", "minute", "second",
-                    "left", "right", "if", "quarter")):
+                    "left", "right", "if", "quarter",
+                    "prepare", "execute", "deallocate", "input",
+                    "output")):
             name = self.ident() if t.kind != "keyword" else \
                 self.advance().value
             if self.at_op("("):

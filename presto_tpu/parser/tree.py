@@ -354,3 +354,38 @@ class InsertInto(Node):
 class DropTable(Node):
     name: Tuple[str, ...]
     if_exists: bool = False
+
+
+@dataclasses.dataclass
+class Parameter(Node):
+    """A `?` placeholder in a prepared statement (reference:
+    sql/tree/Parameter.java); EXECUTE ... USING substitutes the k-th
+    argument expression for the k-th placeholder."""
+    index: int
+
+
+@dataclasses.dataclass
+class Prepare(Node):
+    name: str
+    statement: Node      # the prepared statement's AST
+
+
+@dataclasses.dataclass
+class ExecutePrepared(Node):
+    name: str
+    using: List[Node]    # argument expression ASTs
+
+
+@dataclasses.dataclass
+class Deallocate(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class DescribeInput(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class DescribeOutput(Node):
+    name: str

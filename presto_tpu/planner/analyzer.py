@@ -2483,6 +2483,11 @@ class _Analyzer:
     def _an_BoolLit(self, a: T.BoolLit):
         return Literal(a.value, BOOLEAN)
 
+    def _an_Parameter(self, a: T.Parameter):
+        raise AnalysisError(
+            f"unbound parameter ?{a.index + 1}: `?` placeholders are "
+            "only valid inside PREPARE, bound by EXECUTE ... USING")
+
     def _an_NullLit(self, a: T.NullLit):
         return Literal(None, UNKNOWN)
 

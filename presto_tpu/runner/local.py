@@ -144,6 +144,58 @@ def _rename_form_slots(form, plan_sym: str, stored_name: str):
     return out, src_map
 
 
+def _count_params(node) -> int:
+    """Number of `?` placeholders in a statement AST (their indexes
+    are assigned in parse order, so count == max index + 1)."""
+    n = 0
+    for sub in _walk_ast(node):
+        if isinstance(sub, T.Parameter):
+            n = max(n, sub.index + 1)
+    return n
+
+
+def _walk_ast(node):
+    import dataclasses as _dc
+    if isinstance(node, T.Node):
+        yield node
+        if _dc.is_dataclass(node):
+            for f in _dc.fields(node):
+                yield from _walk_ast(getattr(node, f.name))
+    elif isinstance(node, (list, tuple)):
+        for x in node:
+            yield from _walk_ast(x)
+
+
+def _substitute_params(node, args):
+    """Rebuild a prepared statement's AST with each `?` replaced by
+    the corresponding USING argument expression (reference:
+    sql/ParameterRewriter)."""
+    import dataclasses as _dc
+    if isinstance(node, T.Parameter):
+        return args[node.index]
+    if isinstance(node, T.Node) and _dc.is_dataclass(node):
+        changes = {}
+        for f in _dc.fields(node):
+            v = getattr(node, f.name)
+            nv = _sub_val(v, args)
+            if nv is not v:
+                changes[f.name] = nv
+        return _dc.replace(node, **changes) if changes else node
+    return node
+
+
+def _sub_val(v, args):
+    if isinstance(v, T.Node):
+        return _substitute_params(v, args)
+    if isinstance(v, list):
+        out = [_sub_val(x, args) for x in v]
+        return out if any(a is not b for a, b in zip(out, v)) else v
+    if isinstance(v, tuple):
+        out = tuple(_sub_val(x, args) for x in v)
+        return out if any(a is not b for a, b in zip(out, v)) else v
+    return v
+
+
 def _assemble_form(form, cols: Dict[str, list], nrows: int) -> list:
     """Per-row python values of a complex field from its slot-column
     pylists. Leaves are InputRefs into `cols` or Literals."""
@@ -371,7 +423,70 @@ class LocalRunner:
             self._session_tl.override = None
 
     def execute(self, sql: str) -> MaterializedResult:
-        stmt = parse_statement(sql)
+        return self._execute_stmt(parse_statement(sql), sql)
+
+    # -- prepared statements (reference: PREPARE/EXECUTE/DEALLOCATE +
+    # DESCRIBE INPUT/OUTPUT, sql/tree/Prepare.java; the reference
+    # carries these per-session via client-protocol headers — here the
+    # registry lives on the runner's session surface)
+
+    def _prepared_registry(self) -> Dict[str, T.Node]:
+        reg = getattr(self, "_prepared", None)
+        if reg is None:
+            reg = self._prepared = {}
+        return reg
+
+    def _execute_stmt(self, stmt: T.Node,
+                      sql: str) -> MaterializedResult:
+        if isinstance(stmt, T.Prepare):
+            self._prepared_registry()[stmt.name] = stmt.statement
+            return self._text_result("result", ["PREPARE"])
+        if isinstance(stmt, T.Deallocate):
+            if self._prepared_registry().pop(stmt.name, None) is None:
+                raise QueryError(
+                    f"prepared statement {stmt.name!r} not found")
+            return self._text_result("result", ["DEALLOCATE"])
+        if isinstance(stmt, T.ExecutePrepared):
+            prepared = self._prepared_registry().get(stmt.name)
+            if prepared is None:
+                raise QueryError(
+                    f"prepared statement {stmt.name!r} not found")
+            need = _count_params(prepared)
+            if len(stmt.using) != need:
+                raise QueryError(
+                    f"EXECUTE {stmt.name}: statement has {need} "
+                    f"parameters, USING supplied {len(stmt.using)}")
+            bound = _substitute_params(prepared, stmt.using)
+            return self._execute_stmt(bound, sql)
+        if isinstance(stmt, T.DescribeInput):
+            prepared = self._prepared_registry().get(stmt.name)
+            if prepared is None:
+                raise QueryError(
+                    f"prepared statement {stmt.name!r} not found")
+            n = _count_params(prepared)
+            from presto_tpu.types import BIGINT, VARCHAR
+            rows = [(i, "unknown") for i in range(n)]
+            return self._rows_result(
+                ["Position", "Type"], rows, (BIGINT, VARCHAR))
+        if isinstance(stmt, T.DescribeOutput):
+            prepared = self._prepared_registry().get(stmt.name)
+            if prepared is None:
+                raise QueryError(
+                    f"prepared statement {stmt.name!r} not found")
+            if not isinstance(prepared, T.Query):
+                raise QueryError("DESCRIBE OUTPUT expects a query")
+            nulls = [T.NullLit()] * _count_params(prepared)
+            bound = _substitute_params(prepared, nulls)
+            try:
+                plan = plan_statement(bound, self.catalogs,
+                                      self.session)
+            except AnalysisError as e:
+                raise QueryError(str(e)) from e
+            from presto_tpu.types import VARCHAR
+            rows = [(cn, f.type.display())
+                    for cn, f in zip(plan.names, plan.output)]
+            return self._rows_result(
+                ["Column Name", "Type"], rows, (VARCHAR, VARCHAR))
         if isinstance(stmt, T.Explain):
             return self._explain(stmt)
         if isinstance(stmt, (T.ShowTables, T.ShowSchemas, T.ShowCatalogs,
@@ -904,3 +1019,12 @@ class LocalRunner:
         b = Batch.from_pydict({name: (list(lines), VARCHAR)})
         return MaterializedResult([name], [b],
                                   (N.Field(name, VARCHAR),))
+
+    def _rows_result(self, names: List[str], rows: List[tuple],
+                     types: tuple) -> MaterializedResult:
+        cols = {n: ([r[i] for r in rows], t)
+                for i, (n, t) in enumerate(zip(names, types))}
+        b = Batch.from_pydict(cols)
+        return MaterializedResult(
+            list(names), [b],
+            tuple(N.Field(n, t) for n, t in zip(names, types)))

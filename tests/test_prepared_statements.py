@@ -1,0 +1,89 @@
+"""PREPARE / EXECUTE ... USING / DEALLOCATE PREPARE + DESCRIBE
+INPUT/OUTPUT (reference: sql/tree/Prepare.java + ParameterRewriter +
+QueryPreparer; the reference carries these per-session via client
+headers — here the registry lives on the runner session)."""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", "tiny")
+
+
+def test_prepare_execute_roundtrip(runner):
+    runner.execute(
+        "prepare pq from select name, nationkey from nation "
+        "where regionkey = ? and nationkey < ? order by nationkey")
+    got = runner.execute("execute pq using 1, 5").rows()
+    want = runner.execute(
+        "select name, nationkey from nation "
+        "where regionkey = 1 and nationkey < 5 "
+        "order by nationkey").rows()
+    assert got == want and got
+    # different bindings, same prepared plan source
+    got2 = runner.execute("execute pq using 2, 25").rows()
+    want2 = runner.execute(
+        "select name, nationkey from nation "
+        "where regionkey = 2 and nationkey < 25 "
+        "order by nationkey").rows()
+    assert got2 == want2
+
+
+def test_describe_input_output(runner):
+    runner.execute(
+        "prepare pd from select name n, nationkey * 2 d from nation "
+        "where regionkey = ?")
+    assert runner.execute("describe input pd").rows() \
+        == [(0, "unknown")]
+    assert runner.execute("describe output pd").rows() \
+        == [("n", "varchar"), ("d", "bigint")]
+
+
+def test_execute_arity_checked(runner):
+    runner.execute("prepare pa from select ? + ?")
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises(QueryError, match="2 parameters"):
+        runner.execute("execute pa using 1")
+    assert runner.execute("execute pa using 1, 2").rows() == [(3,)]
+
+
+def test_expression_arguments(runner):
+    runner.execute("prepare pe from select ? * 10")
+    assert runner.execute("execute pe using 2 + 3").rows() == [(50,)]
+
+
+def test_deallocate(runner):
+    from presto_tpu.runner.local import QueryError
+    runner.execute("prepare px from select 1")
+    runner.execute("deallocate prepare px")
+    with pytest.raises(QueryError, match="not found"):
+        runner.execute("execute px")
+    with pytest.raises(QueryError, match="not found"):
+        runner.execute("deallocate prepare px")
+
+
+def test_unbound_parameter_rejected(runner):
+    from presto_tpu.runner.local import QueryError
+    with pytest.raises(QueryError, match="unbound parameter"):
+        runner.execute("select ? + 1")
+
+
+def test_prepared_write_statement(runner):
+    runner.execute(
+        "prepare pw from insert into memory.default.pt "
+        "select nationkey, name from nation where nationkey < ?")
+    runner.execute("create table memory.default.pt as "
+                   "select nationkey, name from nation "
+                   "where nationkey < 0")
+    runner.execute("execute pw using 3")
+    n = runner.execute(
+        "select count(*) from memory.default.pt").rows()[0][0]
+    assert n == 3
+    runner.execute("drop table memory.default.pt")
+
+
+def test_describe_table_shorthand_still_works(runner):
+    rows = runner.execute("describe region").rows()
+    assert any("regionkey" in str(r) for r in rows)
