@@ -865,11 +865,17 @@ def _slot_reduce(contrib: jnp.ndarray, gid: jnp.ndarray, num_slots: int,
                  reduce: str, dtype) -> jnp.ndarray:
     """Reduce per-row contributions into `num_slots` slots (drop slot
     `num_slots` discarded). gid is int32 in [0, num_slots]. contrib may
-    be [rows] or [rows, K] (vector state component)."""
+    be [rows] or [rows, K] (vector state component).
+
+    Platform fork (trace-time): the masked one-hot reduce streams on
+    the TPU VPU where scatter serializes, but on XLA:CPU it multiplies
+    memory traffic by `num_slots` while the scatter-lowered segment
+    ops run a fast linear pass — Q1's 12-slot direct aggregation paid
+    ~5s/6M rows through the one-hot form on CPU."""
     c = contrib.astype(dtype)
     # 2-D non-sum one-hot would materialize [rows, slots, K]; the
     # segment path below keeps it at [rows, K] (HLL's max-merge)
-    if num_slots <= _ONEHOT_SLOT_LIMIT \
+    if num_slots <= _ONEHOT_SLOT_LIMIT and not common.cpu_backend() \
             and (c.ndim == 1 or reduce == "sum"):
         oh = gid[:, None] == jnp.arange(num_slots, dtype=gid.dtype)[None, :]
         if c.ndim == 2:
