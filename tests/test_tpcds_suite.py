@@ -13,7 +13,7 @@ import sqlite3
 import pytest
 
 from test_tpch_suite import assert_rows_equal, normalize, to_sqlite
-from tpcds_queries import QUERIES
+from tpcds_queries import ORACLE_OVERRIDES, QUERIES
 
 SCHEMA = "tiny"
 EPOCH = datetime.date(1970, 1, 1)
@@ -30,7 +30,8 @@ TABLES = ["date_dim", "time_dim", "item", "customer",
           "household_demographics", "store", "warehouse", "promotion",
           "ship_mode", "reason", "web_site", "call_center",
           "store_sales", "store_returns", "catalog_sales",
-          "catalog_returns", "web_sales", "inventory"]
+          "catalog_returns", "web_sales", "inventory",
+          "income_band", "web_returns", "web_page", "catalog_page"]
 
 
 @pytest.fixture(scope="module")
@@ -56,13 +57,29 @@ def oracle(runner):
 #: queries whose final ORDER BY fully determines row order at tiny scale
 FULLY_ORDERED = {7, 22, 26, 62, 96, 101}
 
+_ran = [0]
+
+
+@pytest.fixture(autouse=True)
+def _periodic_cache_clear():
+    """XLA:CPU segfaults once a process accumulates too many live
+    compiled executables (see conftest's between-module clearing); 40
+    distinct TPC-DS queries in ONE module crosses the line, so clear
+    every few queries at the cost of some recompiles."""
+    yield
+    _ran[0] += 1
+    if _ran[0] % 6 == 0:
+        import jax
+        jax.clear_caches()
+
 
 @pytest.mark.parametrize("qn", sorted(QUERIES))
 def test_tpcds_query(qn, runner, oracle):
     res = runner.execute(QUERIES[qn])
     types = [f.type.name for f in res.fields]
     got = normalize(res.rows(), types)
-    cur = oracle.execute(to_sqlite(QUERIES[qn]))
+    cur = oracle.execute(to_sqlite(
+        ORACLE_OVERRIDES.get(qn, QUERIES[qn])))
     exp = [tuple(r) for r in cur.fetchall()]
     assert len(exp) > 0 or qn in (19,), f"oracle empty for q{qn}"
     assert_rows_equal(got, exp, qn, qn in FULLY_ORDERED)
@@ -76,7 +93,29 @@ def test_tpcds_mesh_sample():
     from presto_tpu.runner import LocalRunner, MeshRunner
     local = LocalRunner("tpcds", "tiny")
     mesh = MeshRunner("tpcds", "tiny", {"target_splits": 8})
+    import math
+
+    def canon(rows):
+        # float sums associate differently across the mesh's shuffle
+        # order; NULLs don't sort against ints — key on stringified
+        # rows, compare floats with a real tolerance
+        return sorted(rows, key=lambda r: tuple(map(str, r)))
+
+    def rows_close(a, b):
+        if len(a) != len(b):
+            return False
+        for ra, rb in zip(a, b):
+            if len(ra) != len(rb):
+                return False
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float) and isinstance(vb, float):
+                    if not math.isclose(va, vb, rel_tol=1e-6,
+                                        abs_tol=1e-6):
+                        return False
+                elif va != vb:
+                    return False
+        return True
     for n in sorted(QUERIES)[:4]:
-        a = sorted(map(str, local.execute(QUERIES[n]).rows()))
-        b = sorted(map(str, mesh.execute(QUERIES[n]).rows()))
-        assert a == b, (n, a[:2], b[:2])
+        a = canon(local.execute(QUERIES[n]).rows())
+        b = canon(mesh.execute(QUERIES[n]).rows())
+        assert rows_close(a, b), (n, a[:2], b[:2])

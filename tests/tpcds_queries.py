@@ -246,3 +246,475 @@ where rk <= 3
 order by i_category, rk, i_class
 """,
 }
+
+# ---------------------------------------------------------------------------
+# round-5 widening: 24 more spec-shaped queries (battery = 41)
+
+QUERIES.update({
+    # q1: customers returning more than 1.2x their store's average
+    1: """
+with customer_total_return as (
+  select sr_customer_sk ctr_customer_sk, sr_store_sk ctr_store_sk,
+         sum(sr_return_amt) ctr_total_return
+  from store_returns, date_dim
+  where sr_returned_date_sk = d_date_sk and d_year = 1999
+  group by sr_customer_sk, sr_store_sk)
+select c_customer_id
+from customer_total_return ctr1, store, customer
+where ctr1.ctr_total_return >
+      (select avg(ctr_total_return) * 1.2
+       from customer_total_return ctr2
+       where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and s_store_sk = ctr1.ctr_store_sk
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100
+""",
+    # q12: web item revenue + class-revenue ratio for a category set
+    12: """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) itemrevenue,
+       sum(ws_ext_sales_price) * 100.0 /
+         sum(sum(ws_ext_sales_price))
+            over (partition by i_class) revenueratio
+from web_sales, item, date_dim
+where ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+  and d_year = 2000
+group by i_item_id, i_item_desc, i_category, i_class,
+         i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+""",
+    # q15: catalog revenue by customer zip for a quarter
+    15: """
+select ca_zip, sum(cs_sales_price) total
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and cs_sold_date_sk = d_date_sk
+  and (ca_state in ('CA', 'WA', 'GA') or cs_sales_price > 500)
+  and d_qoy = 2 and d_year = 2000
+group by ca_zip
+order by ca_zip
+limit 100
+""",
+    # q18-shape (rollup): catalog averages over a demographic cut
+    18: """
+select i_item_id, ca_state, avg(cs_quantity) agg1,
+       avg(cs_list_price) agg2, avg(cs_coupon_amt) agg3
+from catalog_sales, customer_demographics, customer,
+     customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd_gender = 'F' and d_year = 2000
+  and c_current_addr_sk = ca_address_sk
+group by rollup (i_item_id, ca_state)
+order by i_item_id, ca_state
+limit 100
+""",
+    # q20: catalog revenue ratio by class
+    20: """
+select i_item_id, i_item_desc, i_category, i_class,
+       i_current_price, sum(cs_ext_sales_price) itemrevenue,
+       sum(cs_ext_sales_price) * 100.0 /
+         sum(sum(cs_ext_sales_price))
+            over (partition by i_class) revenueratio
+from catalog_sales, item, date_dim
+where cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+  and d_year = 1999 and d_moy between 2 and 3
+group by i_item_id, i_item_desc, i_category, i_class,
+         i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+""",
+    # q25: store sales later returned then re-bought on catalog
+    25: """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) store_sales_profit,
+       sum(sr_net_loss) store_returns_loss
+from store_sales, store_returns, store, item, date_dim d1, date_dim d2
+where d1.d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d1.d_year = 1999 and d2.d_year between 1999 and 2001
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+""",
+    # q32: catalog discounts above 1.3x the item's average
+    32: """
+select sum(cs_ext_discount_amt) excess_discount
+from catalog_sales cs1, item, date_dim
+where i_item_sk = cs1.cs_item_sk and d_date_sk = cs1.cs_sold_date_sk
+  and d_year = 2000
+  and cs1.cs_ext_discount_amt >
+      (select 1.3 * avg(cs_ext_discount_amt)
+       from catalog_sales cs2
+       where cs2.cs_item_sk = cs1.cs_item_sk)
+""",
+    # q33-shape: manufacturer revenue for one category over channels
+    33: """
+with ss as (
+  select i_manufact_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, item
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and i_category = 'Books' and d_year = 2000
+  group by i_manufact_id),
+ cs as (
+  select i_manufact_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, item
+  where cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    and i_category = 'Books' and d_year = 2000
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs) tmp1
+group by i_manufact_id
+order by total_sales desc, i_manufact_id
+limit 100
+""",
+    # q36-shape (rollup): gross margin by category/class hierarchy
+    36: """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+       i_category, i_class
+from store_sales, date_dim, item, store
+where d_date_sk = ss_sold_date_sk and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk and d_year = 2000
+group by rollup (i_category, i_class)
+order by i_category, i_class
+limit 100
+""",
+    # q37: items in a price band with on-hand inventory
+    37: """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 20 and 50
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_year = 2000
+  and i_manufact_id between 100 and 600
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+""",
+    # q40: catalog value shipped by warehouse/state around a pivot date
+    40: """
+select w_state, i_item_id,
+       sum(case when d_date < date '2000-01-01' then cs_sales_price
+                else 0e0 end) sales_before,
+       sum(case when d_date >= date '2000-01-01' then cs_sales_price
+                else 0e0 end) sales_after
+from catalog_sales, warehouse, item, date_dim
+where i_item_sk = cs_item_sk and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_year between 1999 and 2001
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100
+""",
+    # q43: store sales by weekday
+    43: """
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday'
+                then ss_sales_price else null end) sun_sales,
+       sum(case when d_day_name = 'Monday'
+                then ss_sales_price else null end) mon_sales,
+       sum(case when d_day_name = 'Friday'
+                then ss_sales_price else null end) fri_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+  and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100
+""",
+    # q45: web revenue by zip for listed zips or a customer-sk band
+    45: """
+select ca_zip, ca_city, sum(ws_sales_price) total
+from web_sales, customer, customer_address, date_dim
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_sold_date_sk = d_date_sk
+  and (substring(ca_zip, 1, 2) in ('85', '86', '88')
+       or c_customer_sk between 1 and 500)
+  and d_qoy = 2 and d_year = 2000
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+""",
+    # q48: store quantity for demographic/price bands
+    48: """
+select sum(ss_quantity) q
+from store_sales, store, customer_demographics, customer_address,
+     date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2000
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_marital_status = 'M'
+  and ss_addr_sk = ca_address_sk
+  and ss_net_profit between 0 and 2000
+""",
+    # q50-shape: store return latency buckets by store
+    50: """
+select s_store_name, s_store_id,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk <= 30)
+                then 1 else 0 end) d30,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 30)
+                 and (sr_returned_date_sk - ss_sold_date_sk <= 90)
+                then 1 else 0 end) d31_90,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 90)
+                then 1 else 0 end) d90_plus
+from store_sales, store_returns, store, date_dim
+where ss_ticket_number = sr_ticket_number
+  and ss_item_sk = sr_item_sk and ss_customer_sk = sr_customer_sk
+  and sr_returned_date_sk = d_date_sk and d_year between 1999 and 2002
+  and ss_store_sk = s_store_sk
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100
+""",
+    # q82: store items in a price band with inventory
+    82: """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 30 and 60
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_year = 1999
+  and i_manufact_id between 200 and 700
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+""",
+    # q84: customers in an income band through household demographics
+    84: """
+select c_customer_id customer_id, c_last_name, c_first_name
+from customer, customer_address, customer_demographics,
+     household_demographics, income_band
+where ca_address_sk = c_current_addr_sk
+  and ca_gmt_offset = -6.0
+  and ib_lower_bound >= 20000 and ib_upper_bound <= 80000
+  and ib_income_band_sk = hd_income_band_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+order by customer_id, c_last_name, c_first_name
+limit 100
+""",
+    # q85-shape: web return reasons with demographic quantity averages
+    85: """
+select r_reason_desc, avg(wr_return_quantity) q,
+       avg(wr_refunded_cash) refunded
+from web_returns, reason, customer_demographics, date_dim, web_sales
+where wr_reason_sk = r_reason_sk
+  and wr_refunded_cdemo_sk = cd_demo_sk
+  and cd_marital_status in ('M', 'S')
+  and wr_returned_date_sk = d_date_sk
+  and d_year between 1999 and 2002
+  and ws_item_sk = wr_item_sk and ws_order_number = wr_order_number
+group by r_reason_desc
+order by r_reason_desc
+limit 100
+""",
+    # q88-shape: store counts in consecutive time buckets
+    88: """
+select h9, h10, h11
+from (select count(*) h9 from store_sales, time_dim
+      where ss_sold_time_sk = t_time_sk and t_hour = 9) s1,
+     (select count(*) h10 from store_sales, time_dim
+      where ss_sold_time_sk = t_time_sk and t_hour = 10) s2,
+     (select count(*) h11 from store_sales, time_dim
+      where ss_sold_time_sk = t_time_sk and t_hour = 11) s3
+""",
+    # q90-shape: web am/pm sales count ratio
+    90: """
+select cast(amc as double) / pmc am_pm_ratio
+from (select count(*) amc from web_sales, time_dim
+      where ws_sold_time_sk = t_time_sk
+        and t_hour between 7 and 12) at_,
+     (select count(*) pmc from web_sales, time_dim
+      where ws_sold_time_sk = t_time_sk
+        and t_hour between 13 and 18) pt_
+""",
+    # q93-shape: customer net store spend after returns
+    93: """
+select ss_customer_sk,
+       sum(case when sr_return_quantity is not null
+                then (ss_quantity - sr_return_quantity)
+                     * ss_sales_price
+                else ss_quantity * ss_sales_price end) sumsales
+from store_sales left join store_returns
+     on ss_item_sk = sr_item_sk
+    and ss_ticket_number = sr_ticket_number
+group by ss_customer_sk
+order by sumsales desc, ss_customer_sk
+limit 100
+""",
+    # q99-shape: catalog shipping latency buckets
+    99: """
+select w_warehouse_name, sm_type, cc_name,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk <= 30
+                then 1 else 0 end) d30,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 30
+                 and cs_ship_date_sk - cs_sold_date_sk <= 60
+                then 1 else 0 end) d31_60,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 60
+                then 1 else 0 end) d61_plus
+from catalog_sales, warehouse, ship_mode, call_center, date_dim
+where d_year = 2000 and cs_ship_date_sk = d_date_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by w_warehouse_name, sm_type, cc_name
+order by w_warehouse_name, sm_type, cc_name
+limit 100
+""",
+    # q27-shape (rollup): store averages over a demographic cut
+    27: """
+select i_item_id, s_state, avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2, avg(ss_sales_price) agg3
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'F' and d_year = 2000
+group by rollup (i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+""",
+    # q60-shape: item revenue for a category across channels
+    60: """
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, item
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and i_category = 'Music' and d_year = 1999
+  group by i_item_id),
+ ws as (
+  select i_item_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, item
+  where ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+    and i_category = 'Music' and d_year = 1999
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss union all select * from ws) tmp1
+group by i_item_id
+order by i_item_id, total_sales
+limit 100
+""",
+    # q97-shape: store/catalog purchase overlap by customer-item
+    97: """
+with ssci as (
+  select ss_customer_sk customer_sk, ss_item_sk item_sk
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk and d_year = 2000
+  group by ss_customer_sk, ss_item_sk),
+ csci as (
+  select cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk and d_year = 2000
+  group by cs_bill_customer_sk, cs_item_sk)
+select sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is null
+                then 1 else 0 end) store_only,
+       sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is not null
+                then 1 else 0 end) store_and_catalog
+from ssci full outer join csci
+  on ssci.customer_sk = csci.customer_sk
+ and ssci.item_sk = csci.item_sk
+""",
+})
+
+#: sqlite-dialect oracle text for queries whose engine SQL uses
+#: features sqlite lacks (GROUP BY ROLLUP -> UNION ALL of the
+#: grouping sets)
+ORACLE_OVERRIDES = {
+    18: """
+select i_item_id, ca_state, avg(cs_quantity) agg1,
+       avg(cs_list_price) agg2, avg(cs_coupon_amt) agg3
+from catalog_sales, customer_demographics, customer,
+     customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd_gender = 'F' and d_year = 2000
+  and c_current_addr_sk = ca_address_sk
+group by i_item_id, ca_state
+union all
+select i_item_id, null, avg(cs_quantity), avg(cs_list_price),
+       avg(cs_coupon_amt)
+from catalog_sales, customer_demographics, customer,
+     customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd_gender = 'F' and d_year = 2000
+  and c_current_addr_sk = ca_address_sk
+group by i_item_id
+union all
+select null, null, avg(cs_quantity), avg(cs_list_price),
+       avg(cs_coupon_amt)
+from catalog_sales, customer_demographics, customer,
+     customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd_gender = 'F' and d_year = 2000
+  and c_current_addr_sk = ca_address_sk
+order by i_item_id nulls last, ca_state nulls last
+limit 100
+""",
+    27: """
+select i_item_id, s_state, avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2, avg(ss_sales_price) agg3
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'F' and d_year = 2000
+group by i_item_id, s_state
+union all
+select i_item_id, null, avg(ss_quantity), avg(ss_list_price),
+       avg(ss_sales_price)
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'F' and d_year = 2000
+group by i_item_id
+union all
+select null, null, avg(ss_quantity), avg(ss_list_price),
+       avg(ss_sales_price)
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'F' and d_year = 2000
+order by i_item_id nulls last, s_state nulls last
+limit 100
+""",
+    36: """
+select sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price)
+         gross_margin,
+       i_category, i_class
+from store_sales, date_dim, item, store
+where d_date_sk = ss_sold_date_sk and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk and d_year = 2000
+group by i_category, i_class
+union all
+select sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price),
+       i_category, null
+from store_sales, date_dim, item, store
+where d_date_sk = ss_sold_date_sk and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk and d_year = 2000
+group by i_category
+union all
+select sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price),
+       null, null
+from store_sales, date_dim, item, store
+where d_date_sk = ss_sold_date_sk and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk and d_year = 2000
+order by i_category nulls last, i_class nulls last
+limit 100
+""",
+}
