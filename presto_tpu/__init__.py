@@ -24,15 +24,26 @@ jax.config.update("jax_enable_x64", True)
 # Persistent XLA compilation cache: every engine process (bench children,
 # wedge retries, worker agents) reuses compiled kernels from disk, so a
 # retry after a TPU-tunnel wedge repays ~0 compile time (cold Q18 was
-# 53.8s vs 30.5s warm in round 4 — mostly compiles). NOT enabled when
-# JAX_PLATFORMS=cpu: XLA:CPU's persistent entries are AOT executables
+# 53.8s vs 30.5s warm in round 4 — mostly compiles). NOT enabled on
+# CPU backends: XLA:CPU's persistent entries are AOT executables
 # stamped with synthetic machine features (+prefer-no-scatter) that
 # fail the loader's host check on reload (SIGILL-risk error spam, no
-# speedup) — and CPU compiles are cheap anyway. Opt in/out explicitly
-# with PRESTO_TPU_COMPILE_CACHE=<dir>/0; default-on otherwise (TPU).
+# speedup) — and CPU compiles are cheap anyway. The gate checks the
+# ACTUAL initialized backend, not the JAX_PLATFORMS spelling: a
+# CPU-only host with no env var set must not default into the cache.
+# Opt in/out explicitly with PRESTO_TPU_COMPILE_CACHE=<dir>/0;
+# default-on otherwise when the backend really is TPU.
 _cc = _os.environ.get("PRESTO_TPU_COMPILE_CACHE", "")
-if _cc != "0" and (_cc or
-                   "cpu" not in _os.environ.get("JAX_PLATFORMS", "")):
+
+
+def _tpu_backend() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend init failure surfaces at first use
+        return False
+
+
+if _cc != "0" and (_cc or _tpu_backend()):
     if not _cc:
         _cc = _os.path.join(_os.path.expanduser("~"), ".cache",
                             "presto_tpu_xla")

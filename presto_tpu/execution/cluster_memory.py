@@ -56,8 +56,16 @@ class ClusterMemoryManager:
 
     def update(self, query_id: str, reserved_bytes: int) -> None:
         """Refresh one query's total; on cluster-budget exhaustion,
-        flag the biggest RUNNING reservation for death."""
+        flag the biggest RUNNING reservation for death.
+
+        Updates for query ids no longer registered are IGNORED: a
+        late free()/free_all() from an operator draining after
+        finish_query() would otherwise re-register the finished query
+        with its residual reservation forever — phantom bytes that
+        permanently shrink the budget left for live queries."""
         with self._lock:
+            if query_id not in self._reserved:
+                return
             self._reserved[query_id] = int(reserved_bytes)
             total = sum(self._reserved.values())
             if total <= self.budget:

@@ -111,18 +111,23 @@ def _collect_kernel(batch: Batch, key_syms: Tuple[str, ...],
             contributing.astype(jnp.int64), gid.astype(jnp.int32),
             num_segments=out_cap + 1)[:out_cap]
         overflow = overflow | (jnp.max(lens) > width)
-        posc = jnp.clip(pos, 0, width - 1)
-        gidc = jnp.clip(gid, 0, out_cap - 1)
         put = contributing & (pos < width)
+        # non-contributing rows (FILTER-excluded, NULL map keys, dead
+        # lanes) share their predecessor's `pos`; clipping them into
+        # range would scatter onto LIVE slots — and XLA scatter order
+        # is unspecified, so an excluded row FOLLOWING a contributor
+        # in the same group could clobber it. Route them out of
+        # bounds instead: mode="drop" discards them entirely.
+        posc = jnp.where(put, jnp.clip(pos, 0, width - 1), width)
+        gidc = jnp.where(put, jnp.clip(gid, 0, out_cap - 1), out_cap)
 
         def scatter(col):
             d = col.data[order]
             m = col.mask[order]
             block = jnp.zeros((out_cap, width), d.dtype)
             bmask = jnp.zeros((out_cap, width), bool)
-            block = block.at[gidc, posc].set(
-                jnp.where(put, d, 0), mode="drop")
-            bmask = bmask.at[gidc, posc].set(m & put, mode="drop")
+            block = block.at[gidc, posc].set(d, mode="drop")
+            bmask = bmask.at[gidc, posc].set(m, mode="drop")
             return block, bmask
         vblock, vmask = scatter(vcol)
         if msym is not None:

@@ -9,12 +9,15 @@ searchsorted probe kernel."""
 
 from __future__ import annotations
 
+import collections
+import functools
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from presto_tpu.batch import Batch, bucket_capacity, remap_column
+from presto_tpu.batch import Batch, Column, bucket_capacity, remap_column
 from presto_tpu.operators.base import (
     DriverContext, Operator, OperatorContext, OperatorFactory,
 )
@@ -288,6 +291,118 @@ class HashBuildOperator(Operator):
         self.bridge.spilled = None
 
 
+#: probe-kernel LRU cache keyed by the join shape + fused-expression
+#: fingerprints, so re-running a query (or another query with the same
+#: join + projection forest) reuses the compiled XLA program — the
+#: same contract as core._FP_KERNEL_CACHE.
+_PROBE_KERNEL_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_PROBE_KERNEL_CACHE_MAX = 256
+
+
+def make_probe_kernel(key_names: Tuple[str, ...], join_type: str,
+                      probe_output: Tuple[str, ...],
+                      build_output: Tuple[str, ...],
+                      build_keys: Tuple[str, ...],
+                      build_rename: Optional[dict] = None,
+                      fused_filter=None,
+                      fused_projections=None,
+                      input_dicts=None,
+                      verify: str = "hash"):
+    """Build the jitted fused probe->project kernel:
+
+        kernel(table, batch, matched, out_capacity[static])
+            -> (Batch, overflow, live, matched)
+
+    The candidate search, row expansion, build-side rename, and the
+    DOWNSTREAM filter/projection forest all trace into ONE dispatch, so
+    expanded join rows are materialized once — not gathered at the
+    probe and then re-read by a separate FilterProject pass over the
+    same out_capacity-wide arrays. `matched` is the FULL join's
+    per-build-row flag array (pass None otherwise; it passes through
+    untouched)."""
+    rename = tuple(sorted((build_rename or {}).items()))
+    fused_projections = tuple(fused_projections or ())
+    exprs = ([fused_filter] if fused_filter is not None else []) \
+        + [ce for _, ce in fused_projections]
+    key = None
+    if all(ce.ir is not None for ce in exprs):
+        try:
+            from presto_tpu.expr.ir import fingerprint
+            key = (key_names, join_type, probe_output, build_output,
+                   build_keys, rename, verify, input_dicts,
+                   fingerprint(fused_filter.ir)
+                   if fused_filter is not None else None,
+                   tuple((n, fingerprint(ce.ir), ce.dictionary)
+                         for n, ce in fused_projections))
+            cached = _PROBE_KERNEL_CACHE.get(key)
+            if cached is not None:
+                _PROBE_KERNEL_CACHE.move_to_end(key)
+                return cached
+        except TypeError:  # unhashable literal — just don't cache
+            key = None
+
+    rn_map = dict(rename)
+
+    def _project(out: Batch):
+        """Rename + fused filter/projections over the expanded batch
+        (traced INSIDE the expand dispatch, so join output rows
+        materialize once). Returns (batch, live count)."""
+        cols = {rn_map.get(n, n): c for n, c in out.columns.items()} \
+            if rename else dict(out.columns)
+        rv = out.row_valid
+        if fused_filter is not None or fused_projections:
+            cap = rv.shape[0]
+            env = {n: (c.data, c.mask) for n, c in cols.items()}
+            if fused_filter is not None:
+                d, m = fused_filter.fn(env)
+                rv = rv & jnp.broadcast_to(d & m, (cap,))
+            if fused_projections:
+                cols = {}
+                for name, ce in fused_projections:
+                    d, m = ce.fn(env)
+                    d = jnp.broadcast_to(
+                        jnp.asarray(d, ce.type.np_dtype), (cap,))
+                    cols[name] = Column(d, jnp.broadcast_to(m, (cap,)),
+                                        ce.type, ce.dictionary)
+        out = Batch(cols, rv)
+        return out, jnp.sum(rv)
+
+    def _expand_project(table, batch, lo_enc, h2, matched,
+                        out_capacity: int):
+        out, overflow, _, matched = join_ops._expand_from_enc(
+            table, batch, key_names, lo_enc, matched, out_capacity,
+            join_type, probe_output, build_output, build_keys, verify,
+            h2=h2)
+        out, live = _project(out)
+        return out, overflow, live, matched
+
+    if ops_common.cpu_backend():
+        # two dispatches: the candidate search materializes ONCE (see
+        # ops/join.py on XLA:CPU fusion re-materialization); the probe
+        # hash2 rides across the boundary so expand needn't rehash
+        stage2 = functools.partial(jax.jit, static_argnums=(5,))(
+            _expand_project)
+
+        def kernel(table, batch, matched, out_capacity: int):
+            h, h2 = join_ops._hash_jit(batch, key_names)
+            lo_enc = join_ops._search_jit(table, h, h2, verify)
+            return stage2(table, batch, lo_enc, h2, matched,
+                          out_capacity)
+    else:
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def kernel(table, batch, matched, out_capacity: int):
+            lo_enc = join_ops._candidates_enc(table, batch, key_names,
+                                              verify)
+            return _expand_project(table, batch, lo_enc, None, matched,
+                                   out_capacity)
+
+    if key is not None:
+        _PROBE_KERNEL_CACHE[key] = kernel
+        while len(_PROBE_KERNEL_CACHE) > _PROBE_KERNEL_CACHE_MAX:
+            _PROBE_KERNEL_CACHE.popitem(last=False)
+    return kernel
+
+
 class LookupJoinOperator(Operator):
     """Probe side (reference: LookupJoinOperator.java:53, processProbe:392).
 
@@ -306,7 +421,8 @@ class LookupJoinOperator(Operator):
                  build_keys: Optional[Tuple[str, ...]] = None,
                  key_dicts: Optional[List[Optional[tuple]]] = None,
                  expansion_factor: int = 1,
-                 probe_schema: Optional[Sequence[tuple]] = None):
+                 probe_schema: Optional[Sequence[tuple]] = None,
+                 probe_kernel=None, tail_kernel=None):
         super().__init__(ctx)
         self.bridge = bridge
         self.key_names = key_names
@@ -317,6 +433,17 @@ class LookupJoinOperator(Operator):
         self.build_output = tuple(build_output)
         self.build_rename = build_rename or {}
         self.expansion_factor = max(1, int(expansion_factor))
+        # fused probe->project kernel (built by the factory; a bare
+        # operator constructed without one gets the unfused default)
+        self._kernel = probe_kernel if probe_kernel is not None else \
+            make_probe_kernel(
+                tuple(key_names), join_type, self.probe_output,
+                self.build_output,
+                tuple(build_keys) if build_keys else tuple(key_names),
+                self.build_rename)
+        # FULL OUTER tail projection: the fused filter/projections must
+        # also apply to the unmatched-build batch (None = identity)
+        self._tail_kernel = tail_kernel
         # FULL OUTER state: per-build-row matched flags (device array,
         # scatter-updated by every probe dispatch) and the NULL probe
         # side's schema. Key columns take the planner's unified
@@ -363,23 +490,15 @@ class LookupJoinOperator(Operator):
 
     def _probe(self, table, batch: Batch) -> Batch:
         cap = bucket_capacity(batch.capacity * self.expansion_factor)
-        bkeys = self.build_keys if self.build_keys is not None \
-            else self.key_names
+        if self.join_type == "full" and self._matched is None:
+            self._matched = jnp.zeros(table.sorted_hash.shape[0],
+                                      dtype=bool)
+        out, ovf, total, matched = self._kernel(
+            table, batch, self._matched, cap)
         if self.join_type == "full":
-            if self._matched is None:
-                self._matched = jnp.zeros(table.sorted_hash.shape[0],
-                                          dtype=bool)
-            out, ovf, total, self._matched = join_ops.probe_join_full(
-                table, batch, self.key_names, self._matched, cap,
-                self.probe_output, self.build_output, bkeys)
-        else:
-            out, ovf, total = join_ops.probe_join(
-                table, batch, self.key_names, cap, self.join_type,
-                self.probe_output, self.build_output, bkeys)
+            self._matched = matched
         self._overflow = ovf if self._overflow is None \
             else self._overflow | ovf
-        if self.build_rename:
-            out = out.rename(self.build_rename)
         # selective joins emit few rows into a fat capacity; left
         # uncompacted that padding would ride every downstream
         # exchange/pad/spool. The probe kernel already computed the
@@ -434,6 +553,11 @@ class LookupJoinOperator(Operator):
             table, matched, self.probe_schema, self.build_output)
         if self.build_rename:
             out = out.rename(self.build_rename)
+        if self._tail_kernel is not None:
+            # once per query: route the outer tail through the same
+            # filter/projections the probe kernel fused
+            out = self._tail_kernel(out)
+            total = jnp.sum(out.row_valid)
         self._outer_emitted = True
         b, tok = begin_deferred_compact(out, total)
         return end_deferred_compact(b, tok)
@@ -587,14 +711,55 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self.build_rename = build_rename
         self.expansion_factor = expansion_factor
         self.probe_schema = probe_schema
+        self._fused_filter = None
+        self._fused_projections = None
+        self._fused_dicts = None
+        self._kernels = None
+
+    @property
+    def fused(self) -> bool:
+        return self._fused_filter is not None \
+            or self._fused_projections is not None
+
+    def fuse(self, filter_expr, projections, input_dicts=None) -> None:
+        """Planner peephole: absorb the FilterProject that would
+        otherwise follow this join, so the expression forest evaluates
+        inside the probe dispatch (expanded rows materialize ONCE).
+        Only legal before the first create()."""
+        assert self._kernels is None, "fuse() after create()"
+        assert not self.fused, "join already fused a projection"
+        self._fused_filter = filter_expr
+        self._fused_projections = list(projections) if projections \
+            else None
+        self._fused_dicts = input_dicts
+
+    def _build_kernels(self):
+        probe_kernel = make_probe_kernel(
+            self.key_names, self.join_type, tuple(self.probe_output),
+            tuple(self.build_output),
+            self.build_keys if self.build_keys else self.key_names,
+            self.build_rename, self._fused_filter,
+            self._fused_projections, self._fused_dicts)
+        tail_kernel = None
+        if self.join_type == "full" and self.fused:
+            from presto_tpu.operators.core import (
+                make_filter_project_kernel,
+            )
+            tail_kernel = make_filter_project_kernel(
+                self._fused_filter, self._fused_projections or [],
+                self._fused_dicts)
+        return probe_kernel, tail_kernel
 
     def create(self, driver_context: DriverContext) -> Operator:
+        if self._kernels is None:
+            self._kernels = self._build_kernels()
+        probe_kernel, tail_kernel = self._kernels
         return LookupJoinOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.bridge, self.key_names, self.join_type,
             self.probe_output, self.build_output, self.build_rename,
             self.build_keys, self.key_dicts, self.expansion_factor,
-            self.probe_schema)
+            self.probe_schema, probe_kernel, tail_kernel)
 
 
 class SemiJoinOperatorFactory(OperatorFactory):

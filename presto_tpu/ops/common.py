@@ -65,6 +65,38 @@ def fast_searchsorted(a: jnp.ndarray, v: jnp.ndarray,
     return lo
 
 
+def bounded_searchsorted(a: jnp.ndarray, v: jnp.ndarray,
+                         lo: jnp.ndarray, hi: jnp.ndarray,
+                         iters: int, side: str = "left") -> jnp.ndarray:
+    """Vectorized binary search with PER-QUERY initial bounds
+    [lo, hi) — the radix-partitioned probe's workhorse: each query
+    searches only its hash partition, so `iters` is log2(max partition
+    size) instead of log2(n). `iters` must cover the largest bound
+    span or the result is undefined (the build chooses it from the
+    measured max partition, see ops/join.py). Works identically on
+    CPU and TPU: the level-by-level gather+compare form vectorizes on
+    both, and the partition bounds make jnp.searchsorted's whole-table
+    log depth unnecessary."""
+    n = a.shape[0]
+    lo = lo.astype(jnp.int64)
+    hi = hi.astype(jnp.int64)
+    for _ in range(iters):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        mv = a[jnp.clip(mid, 0, n - 1)]
+        go_left = (mv >= v) if side == "left" else (mv > v)
+        hi = jnp.where(active & go_left, mid, hi)
+        lo = jnp.where(active & ~go_left, mid + 1, lo)
+    return lo
+
+
+def search_iters(max_span: int) -> int:
+    """Iterations bounded_searchsorted needs to converge over spans of
+    at most `max_span` (mirrors fast_searchsorted's count)."""
+    import math
+    return int(math.ceil(math.log2(max(int(max_span), 2)))) + 1
+
+
 def lex_perm(sort_ops: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """Stable permutation ordering rows by `sort_ops` (most-significant
     first): one lax.sort carrying only iota (payloads then move by
@@ -113,12 +145,43 @@ def hash64(data: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(x, jnp.int64)
 
 
+def hash64b(data: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """SECOND avalanche hash, independent of hash64: murmur3's fmix64
+    constants instead of splitmix's, and a different NULL lane. Used
+    by the join probe's verify-elision — a candidate whose 64-bit
+    search hash already matches is confirmed by comparing this hash
+    instead of gathering every key column (see docs/JOIN_KERNEL.md
+    for the collision argument)."""
+    if data.dtype in (jnp.float32, jnp.float64):
+        x = jax.lax.bitcast_convert_type(data.astype(jnp.float64), jnp.int64)
+    else:
+        x = data.astype(jnp.int64)
+    x = jnp.where(mask, x, jnp.int64(0x2545F4914F6CDD1D))
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
+    x = (x ^ (x >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
+    x = x ^ (x >> 33)
+    return jax.lax.bitcast_convert_type(x, jnp.int64)
+
+
 def row_hash(cols: Sequence[CVal]) -> jnp.ndarray:
     """Combined hash of several key columns (for shuffle + group-by)."""
     h = None
     for data, mask in cols:
         hi = hash64(data, mask)
         h = hi if h is None else h * jnp.int64(31) + hi
+    assert h is not None
+    return h
+
+
+def row_hash2(cols: Sequence[CVal]) -> jnp.ndarray:
+    """Combined SECOND hash (hash64b-based, different combine
+    multiplier) — independent of row_hash, so the pair behaves as a
+    128-bit fingerprint."""
+    h = None
+    for data, mask in cols:
+        hi = hash64b(data, mask)
+        h = hi if h is None else h * jnp.int64(37) + hi
     assert h is not None
     return h
 
@@ -224,13 +287,22 @@ def _negate_for_desc(key: jnp.ndarray) -> jnp.ndarray:
 
 
 def boundaries(sorted_keys: Sequence[CVal],
-               sorted_valid: jnp.ndarray) -> jnp.ndarray:
+               sorted_valid: jnp.ndarray,
+               hashes: Optional[Sequence[jnp.ndarray]] = None
+               ) -> jnp.ndarray:
     """True where a new group starts (first valid row or key change),
-    over rows already in lex order. NULLs compare equal for grouping
-    (SQL GROUP BY treats NULLs as one group)."""
+    over rows already in group order. NULLs compare equal for grouping
+    (SQL GROUP BY treats NULLs as one group).
+
+    `hashes` (already in the same sorted order) extends the adjacent
+    compare for HASH-ordered grouping: rows are grouped by (hashes,
+    keys), so equal-key adjacency only needs the hash sort, not a full
+    lexicographic key sort (see hashagg._group_reduce's CPU path)."""
     n = sorted_valid.shape[0]
     first = jnp.zeros(n, bool).at[0].set(True)
     change = first
+    for h in (hashes or ()):
+        change = change | (h != jnp.roll(h, 1))
     for data, mask in sorted_keys:
         prev_d = jnp.roll(data, 1)
         prev_m = jnp.roll(mask, 1)

@@ -578,7 +578,10 @@ def _group_reduce(keys: Sequence[CVal], valid: jnp.ndarray,
     Vector (2-D) contributions ride via one sorted row-index payload.
 
     Groups beyond out_cap are dropped and the overflow flag set (the
-    caller's retry protocol). Output groups land packed in key order."""
+    caller's retry protocol). Output groups land packed, in a
+    backend-dependent order: key order on the TPU sort path, (h1, h2)
+    hash order on the CPU radix path — callers must not rely on it
+    (the final ORDER BY / merge regroups by key)."""
     if not keys:
         # global aggregation: ONE group, no sort at all — a straight
         # axis-0 reduction per state component. Contributions of
@@ -612,13 +615,30 @@ def _group_reduce(keys: Sequence[CVal], valid: jnp.ndarray,
                 flat1d.append(arr)
     n = valid.shape[0]
     extra = [jnp.arange(n)] if have_2d else []
-    skeys, svalid, spay = common.sort_rows(
-        keys, valid=valid, payloads=flat1d + extra)
-    if keys:
-        bnd = common.boundaries(skeys, svalid)
+    if common.cpu_backend():
+        # RADIX grouping (the join kernel's trick applied to the sort
+        # fold): grouping needs equal keys ADJACENT, not a total key
+        # order, so ONE two-operand (h1, h2) hash sort replaces the
+        # (1 + 2k)-operand lexicographic sort — Q18's five-key 1.5M-
+        # group aggregation sorts two int64 columns instead of eleven
+        # operands, and each hash run is a small bucket the boundary
+        # scan resolves with the same adjacent compares. Boundaries
+        # still compare the actual keys, so a (h1, h2) double
+        # collision between distinct keys can only SPLIT a group
+        # (handled by the next merge level), never merge two keys.
+        h1 = jnp.where(valid, common.row_hash(keys),
+                       jnp.iinfo(jnp.int64).max)
+        h2 = common.row_hash2(keys)
+        perm = common.lex_perm([h1, h2])
+        skeys = [(d[perm], m[perm]) for d, m in keys]
+        svalid = valid[perm]
+        spay = [p[perm] for p in flat1d + extra]
+        bnd = common.boundaries(skeys, svalid,
+                                hashes=(h1[perm], h2[perm]))
     else:
-        # global aggregation: a single group holds every valid row
-        bnd = jnp.zeros_like(svalid).at[0].set(True)
+        skeys, svalid, spay = common.sort_rows(
+            keys, valid=valid, payloads=flat1d + extra)
+        bnd = common.boundaries(skeys, svalid)
     gid = jnp.cumsum(bnd) - 1
     num_groups = jnp.sum(bnd)
     # invalid rows -> overflow segment out_cap (sliced away)

@@ -1,29 +1,70 @@
-"""Equi-join kernels (reference: HashBuilderOperator.java:51,
-LookupJoinOperator.java:53 probing a generated PagesHashStrategy over
-PagesIndex.java:75).
+"""Equi-join kernels with a RADIX-PARTITIONED probe (reference:
+HashBuilderOperator.java:51, LookupJoinOperator.java:53 probing a
+generated PagesHashStrategy over PagesIndex.java:75; partitioning
+design after Balkesen et al., "Main-Memory Hash Joins on Multi-Core
+CPUs", ICDE 2013).
 
 TPU-native design: no pointer-chasing hash table. The build side is
-*sorted by key hash* once; each probe row finds its candidate run with
-two `searchsorted` calls (binary search vectorizes cleanly on TPU and
-XLA lowers it to a while-free form). Row expansion (a probe row matching
-k build rows) is resolved by a prefix-sum + searchsorted "expand" pattern
-with a host-chosen output capacity, then candidates are verified against
-the actual key columns so hash collisions only cost masked-out lanes.
+*sorted by key hash* once; `build_for_backend` then records, per
+top-`radix_bits` hash prefix, where that bucket starts in the sorted
+order (`part_starts`, one bucket per ~build row), the length of every
+equal-hash run (`run_len`), and a SECOND independent 64-bit hash
+(`hash2`). A probe row:
+
+1. computes its 64-bit key hash; the top `radix_bits` bits name its
+   bucket, whose [start, end) bounds are two O(1) gathers;
+2. binary-searches ONLY that bucket (`bounded_searchsorted`, depth =
+   log2(max bucket) measured at build — ~5 levels for a 256k-row
+   build instead of 2 x 19 whole-table levels, and ONE search: the
+   run length read from `run_len[lo]` replaces the side="right"
+   search);
+3. verifies the candidate by comparing `hash2` instead of gathering
+   every key column — with the search hash that is a 128-bit
+   fingerprint, and a false match needs a simultaneous collision in
+   two independent avalanche functions (see docs/JOIN_KERNEL.md).
+   The full-key compare survives behind `verify="full"` as the
+   collision fallback and the oracle the radix tests compare against.
+
+Expansion is layout-specialized (all switches STATIC — they ride the
+BuildTable pytree aux data or the call signature, so each shape
+compiles once):
+
+- ALIGNED: when every build hash run has length 1 (`unique_runs` —
+  any unique-key/FK->PK build) and the output capacity equals the
+  probe capacity, output slot i IS probe row i: probe columns pass
+  through untouched, the build side is two gathers, and inner misses
+  just mask their slot dead. No prefix sum, no scatter, no
+  expand-by-counts — the deferred-compact protocol downstream packs
+  the survivors once per batch.
+- GENERAL: duplicate-key builds (or caller-grown capacities) take the
+  prefix-sum + expand-by-counts path with a host-chosen capacity and
+  the on-device overflow flag.
+
+On XLA:CPU the probe runs as TWO dispatches (search, then expand):
+its fusion emitter re-materializes a fused producer chain once per
+consumer, so feeding the bounded search into a multi-output expand
+re-runs the whole search per output column (measured ~2x on the
+round-6 host). The dispatch boundary materializes `lo` exactly once;
+TPU keeps the single fused dispatch.
 
 Join types: inner, left, full, semi (IN/EXISTS), anti (NOT IN/NOT
 EXISTS); right joins are planned as flipped left joins. FULL OUTER
 (reference: LookupJoinOperator + LookupOuterOperator.java:42) probes
 like a left join while scatter-accumulating a per-build-row matched
 flag on device; after the probe side is exhausted the operator emits
-the never-matched build rows with a NULL probe side — the analog of
-the reference's OuterPositionIterator, minus the shared-partition
-tracker (each task owns its hash partition of the build outright).
+the never-matched build rows with a NULL probe side.
+
+The bucket-contiguous layout is exactly what the ICI all_to_all
+shuffle wants on a real TPU mesh: each device owns a contiguous span
+of hash buckets, and per-bucket probes are small vectorized searches
+instead of whole-table binary search.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -35,119 +76,335 @@ from presto_tpu.ops import common
 
 CVal = Tuple[jnp.ndarray, jnp.ndarray]
 
+#: bucket-per-row radix: k ~ log2(build size), so buckets average ~1
+#: row and the bounded search runs ~log2(max bucket) ~ 5 levels.
+#: part_starts costs 8 bytes per bucket — at most 2^MAX_RADIX_BITS+1
+#: entries (2 MB), the same order as the build itself.
+MAX_RADIX_BITS = 18
+#: builds at or below this size skip partitioning entirely (the
+#: whole-table search is already that shallow)
+MIN_RADIX_ROWS = 1024
+
+#: verify modes: "hash" elides the per-candidate full-key compare via
+#: the second independent hash; "full" gathers and compares every key
+#: column (the pre-radix behavior — collision fallback + test oracle).
+VERIFY_MODES = ("hash", "full")
+
 
 @dataclasses.dataclass
 class BuildTable:
-    """Sorted-by-hash build side, ready for probing. A pytree.
+    """Sorted-by-hash build side, ready for probing. A pytree whose
+    AUX DATA carries the static search/layout parameters.
     `batch` rows are IN sorted-hash order (the variadic build sort
     carries every column as payload), so a probe candidate at sorted
     slot s reads batch row s directly — no index indirection."""
     sorted_hash: jnp.ndarray          # [n] int64, invalid rows at +inf end
+    hash2: jnp.ndarray                # [n] int64 second hash (verify)
+    part_starts: jnp.ndarray          # [2^k + 1] int64 bucket offsets
+    run_len: jnp.ndarray              # [n] int64: run length AT run starts
     valid_count: jnp.ndarray          # scalar: live build rows
     batch: Batch                      # build rows, sorted by key hash
+    radix_bits: int = 0               # STATIC: k (0 = whole-table)
+    search_depth: int = 64            # STATIC: bounded-search iterations
+    unique_runs: bool = False         # STATIC: every valid run has len 1
 
 
 jax.tree_util.register_pytree_node(
     BuildTable,
-    lambda t: ((t.sorted_hash, t.valid_count, t.batch), None),
-    lambda _, c: BuildTable(*c),
+    lambda t: ((t.sorted_hash, t.hash2, t.part_starts, t.run_len,
+                t.valid_count, t.batch),
+               (t.radix_bits, t.search_depth, t.unique_runs)),
+    lambda aux, c: BuildTable(*c, radix_bits=aux[0], search_depth=aux[1],
+                              unique_runs=aux[2]),
 )
 
+#: int64 sentinel pushing NULL-key/invalid build rows to the sorted end
+_H_INVALID = jnp.iinfo(jnp.int64).max
+#: hash2 sentinel for those rows — can never equal a valid probe hash2
+#: except by a 2^-64 accident (the old full-key path had the same
+#: residual odds through an unmasked key column)
+_H2_INVALID = jnp.iinfo(jnp.int64).min
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def build(batch: Batch, key_names: Tuple[str, ...]) -> BuildTable:
-    """Index the build side: hash keys, sort ROWS by hash in one
-    variadic sort (columns ride as payloads — no argsort + per-column
-    gather). Probe-time candidate gathers then read nearly-contiguous
-    sorted rows instead of chasing a permutation.
 
-    Rows with any NULL key never match an equi-join; they are pushed to
-    the end by giving them the maximum hash and marking them invalid.
-    """
+def choose_radix_bits(capacity: int) -> int:
+    """k from the build size, on HOST: one bucket per expected row,
+    capped so part_starts stays bounded."""
+    if capacity <= MIN_RADIX_ROWS:
+        return 0
+    return max(1, min(int(math.ceil(math.log2(capacity))),
+                      MAX_RADIX_BITS))
+
+
+@functools.lru_cache(maxsize=None)
+def _partition_bounds_np(k: int) -> np.ndarray:
+    """The 2^k signed-int64 bucket boundary values (bucket p = top-k
+    bits of the SIGNED hash, offset to [0, 2^k)). Vectorized + cached:
+    the signed value (p - half) << (64-k) has the two's-complement
+    bit pattern ((p XOR half) << (64-k)), so the whole table is one
+    uint64 shift reinterpreted as int64."""
+    half = np.uint64(1 << (k - 1))
+    p = np.arange(1 << k, dtype=np.uint64)
+    return ((p ^ half) << np.uint64(64 - k)).view(np.int64)
+
+
+def _partition_of(h: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Top-k-bit bucket id in [0, 2^k) — arithmetic shift keeps the
+    signed sort order aligned with the bucket order."""
+    return (h >> jnp.int64(64 - k)) + jnp.int64(1 << (k - 1))
+
+
+def _hash_batch(batch: Batch, key_names: Tuple[str, ...]):
     keys = [batch.columns[k].astuple() for k in key_names]
     valid = batch.row_valid
     for _, m in keys:
         valid = valid & m
     h = common.row_hash(keys)
-    h = jnp.where(valid, h, jnp.iinfo(jnp.int64).max)
-    payloads = [batch.row_valid]
+    h2 = common.row_hash2(keys)
+    h = jnp.where(valid, h, _H_INVALID)
+    h2 = jnp.where(valid, h2, _H2_INVALID)
+    return h, h2, valid
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _build_sorted(batch: Batch, key_names: Tuple[str, ...], k: int):
+    """Device build: hash keys, sort ROWS by hash in one variadic sort
+    (columns ride as payloads — no argsort + per-column gather), then
+    derive the radix metadata from the sorted hashes. Returns the
+    BuildTable fields plus (max bucket span, max valid run length) for
+    the host's static search-depth/layout choice."""
+    h, h2, valid = _hash_batch(batch, key_names)
+    payloads = [h2, batch.row_valid]
     for n in batch.names:
         payloads.extend(batch.columns[n].astuple())
     out = jax.lax.sort((h,) + tuple(payloads), num_keys=1,
                        is_stable=True)
-    # (identical keys need not be adjacent within a hash run: expand()
-    #  scans the whole run and verifies actual keys per candidate)
+    sh = out[0]
     cols = {}
     for i, n in enumerate(batch.names):
         c = batch.columns[n]
-        cols[n] = Column(out[2 + 2 * i], out[3 + 2 * i], c.type,
+        cols[n] = Column(out[3 + 2 * i], out[4 + 2 * i], c.type,
                          c.dictionary)
-    return BuildTable(
-        sorted_hash=out[0],
-        valid_count=jnp.sum(valid),
-        batch=Batch(cols, out[1]),
-    )
+    sbatch = Batch(cols, out[2])
+    n = sh.shape[0]
+    first_inv = jnp.searchsorted(sh, _H_INVALID, side="left")
+    if k > 0:
+        bounds = jnp.asarray(_partition_bounds_np(k))
+        starts = jnp.searchsorted(sh, bounds, side="left")
+        part_starts = jnp.concatenate(
+            [starts, jnp.asarray([n], starts.dtype)]).astype(jnp.int64)
+    else:
+        part_starts = jnp.asarray([0, n], jnp.int64)
+    # invalid rows sit in one giant sentinel run at the end; they can
+    # never match (hash2 sentinel), so clipping every bucket at the
+    # first invalid row keeps them out of all search spans — without
+    # this, a half-padded build would blow the measured max span (and
+    # with it the static search depth) up to the padding size
+    part_starts = jnp.minimum(part_starts, first_inv)
+    max_span = jnp.max(jnp.diff(part_starts))
+    idx = jnp.arange(n)
+    run_end = jnp.searchsorted(sh, sh, side="right")
+    run_len = (run_end - idx).astype(jnp.int64)
+    max_run = jnp.max(jnp.where(idx < first_inv,
+                                jnp.minimum(run_end, first_inv) - idx,
+                                0))
+    return sh, out[1], part_starts, run_len, jnp.sum(valid), sbatch, \
+        jnp.stack([max_span.astype(jnp.int64),
+                   max_run.astype(jnp.int64)])
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def _build_hash(batch: Batch, key_names: Tuple[str, ...]):
-    keys = [batch.columns[k].astuple() for k in key_names]
-    valid = batch.row_valid
-    for _, m in keys:
-        valid = valid & m
-    h = common.row_hash(keys)
-    return jnp.where(valid, h, jnp.iinfo(jnp.int64).max), \
-        jnp.sum(valid)
+    h, h2, _ = _hash_batch(batch, key_names)
+    return h, h2
 
 
 @jax.jit
-def _build_apply_perm(batch: Batch, h: jnp.ndarray,
-                      valid_count: jnp.ndarray,
-                      perm: jnp.ndarray) -> BuildTable:
+def _build_apply_perm(batch: Batch, h: jnp.ndarray, h2: jnp.ndarray,
+                      perm: jnp.ndarray):
     cols = {
         n: Column(c.data[perm], c.mask[perm], c.type, c.dictionary)
         for n, c in batch.columns.items()
     }
-    return BuildTable(sorted_hash=h[perm], valid_count=valid_count,
-                      batch=Batch(cols, batch.row_valid[perm]))
+    return h[perm], h2[perm], Batch(cols, batch.row_valid[perm])
 
 
-def build_for_backend(batch: Batch,
-                      key_names: Tuple[str, ...]) -> BuildTable:
-    """build(), with the sort done where it is cheapest. On CPU the
-    hash order comes from a HOST numpy argsort between two jitted
-    kernels (XLA:CPU's sort runs ~600ns/element; numpy is ~4x faster
-    and the build runs at operator level where an eager host step is
-    legal — pure_callback inside jit deadlocks against the driver's
-    blocking reads, see ops/common.py). On TPU: the one-dispatch
-    variadic sort."""
+def build_for_backend(batch: Batch, key_names: Tuple[str, ...],
+                      radix_bits: Optional[int] = None) -> BuildTable:
+    """Index the build side, with the sort done where it is cheapest
+    and the radix metadata measured on the way out.
+
+    On CPU the hash order comes from a HOST numpy argsort between two
+    jitted kernels (XLA:CPU's sort runs ~600ns/element; numpy is ~4x
+    faster and the build runs at operator level where an eager host
+    step is legal — pure_callback inside jit deadlocks against the
+    driver's blocking reads, see ops/common.py), and the bucket
+    offsets/run lengths are linear numpy passes. On TPU: the
+    one-dispatch variadic sort plus one tiny fetch (max bucket span +
+    max run length) — legal here for the same operator-level reason.
+
+    `radix_bits` overrides the size-derived k (0 forces the
+    whole-table search — the pre-radix shape)."""
+    k = choose_radix_bits(batch.capacity) if radix_bits is None \
+        else max(0, min(int(radix_bits), MAX_RADIX_BITS))
     if not common.cpu_backend():
-        return build(batch, key_names)
-    h, vc = _build_hash(batch, key_names)
-    perm = jnp.asarray(np.argsort(np.asarray(h), kind="stable"))
-    return _build_apply_perm(batch, h, vc, perm)
+        sh, h2, part_starts, run_len, vc, sbatch, spans = \
+            _build_sorted(batch, key_names, k)
+        max_span, max_run = (int(x) for x in np.asarray(spans))
+        return BuildTable(sh, h2, part_starts, run_len, vc, sbatch,
+                          radix_bits=k,
+                          search_depth=common.search_iters(max_span),
+                          unique_runs=max_run <= 1)
+    h, h2 = _build_hash(batch, key_names)
+    hn = np.asarray(h)
+    perm = np.argsort(hn, kind="stable")
+    sh_np = hn[perm]
+    n = sh_np.shape[0]
+    first_inv = int(np.searchsorted(sh_np, np.iinfo(np.int64).max,
+                                    side="left"))
+    # live rows = everything before the sentinel run (a valid row
+    # hashing to exactly int64.max miscounts here at 2^-64 odds; the
+    # count only feeds diagnostics)
+    vc = jnp.asarray(first_inv, jnp.int64)
+    if k > 0:
+        # O(n) bucket histogram instead of 2^k binary searches
+        bucket = (sh_np >> np.int64(64 - k)) + np.int64(1 << (k - 1))
+        counts = np.bincount(bucket, minlength=1 << k)
+        part_starts = np.empty((1 << k) + 1, np.int64)
+        part_starts[0] = 0
+        np.cumsum(counts, out=part_starts[1:])
+    else:
+        part_starts = np.asarray([0, n], np.int64)
+    np.minimum(part_starts, first_inv, out=part_starts)
+    max_span = int(np.max(np.diff(part_starts))) if n else 0
+    # run lengths via run starts (linear passes, no n-wide search)
+    run_len = np.zeros(n, np.int64)
+    max_run = 0
+    if n:
+        head = np.empty(n, bool)
+        head[0] = True
+        np.not_equal(sh_np[1:], sh_np[:-1], out=head[1:])
+        starts_idx = np.flatnonzero(head)
+        lens = np.diff(np.append(starts_idx, n))
+        run_len[starts_idx] = lens
+        vstarts = starts_idx < first_inv
+        if vstarts.any():
+            vlens = np.minimum(starts_idx + lens, first_inv) - starts_idx
+            max_run = int(vlens[vstarts].max())
+    sh, sh2, sbatch = _build_apply_perm(batch, h, h2,
+                                        jnp.asarray(perm))
+    return BuildTable(sh, sh2, jnp.asarray(part_starts),
+                      jnp.asarray(run_len), vc, sbatch,
+                      radix_bits=k,
+                      search_depth=common.search_iters(max_span),
+                      unique_runs=max_run <= 1)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def probe_counts(table: BuildTable, probe: Batch,
-                 probe_keys: Tuple[str, ...]):
-    """Per-probe-row candidate run [lo, hi) in the sorted build, plus the
-    verified match count (collision-free). `probe_keys` name the probe
-    batch's key columns (build key names may differ — symbols are
-    per-side in the planner)."""
+def build(batch: Batch, key_names: Tuple[str, ...],
+          radix_bits: Optional[int] = None) -> BuildTable:
+    """Operator-level build entry point (alias kept for tests/callers
+    of the pre-radix API)."""
+    return build_for_backend(batch, key_names, radix_bits)
+
+
+# ---------------------------------------------------------------------------
+# Probe stage 1: candidate search. On CPU it runs as TWO dispatches
+# (hash, then search) each with ONE expensive output, so XLA:CPU's
+# fusion emitter cannot re-materialize the hash chain into every
+# search level or the search chain into every expand output.
+
+
+def _probe_hashes(probe: Batch, probe_keys: Tuple[str, ...]):
+    """(h, h2) for the probe keys, with the INVALID sentinels folded
+    in: a NULL-key/dead probe row carries (_H_INVALID, _H2_INVALID),
+    which cannot match any build row — its hash-MAX candidates were
+    clipped out of every search span at build time, so downstream
+    stages need no separate validity mask."""
     keys = [probe.columns[k].astuple() for k in probe_keys]
     valid = probe.row_valid
     for _, m in keys:
         valid = valid & m
-    h = common.row_hash(keys)
-    lo = common.fast_searchsorted(table.sorted_hash, h, side="left")
-    hi = common.fast_searchsorted(table.sorted_hash, h, side="right")
-    lo = jnp.where(valid, lo, 0)
-    hi = jnp.where(valid, hi, 0)
-    # candidate counts include collisions; exact verification happens in
-    # expand(), but totals for capacity use hi-lo (an upper bound).
-    counts = hi - lo
-    return lo, hi, counts, valid
+    h = jnp.where(valid, common.row_hash(keys), _H_INVALID)
+    h2 = jnp.where(valid, common.row_hash2(keys), _H2_INVALID)
+    return h, h2
+
+
+_hash_jit = jax.jit(_probe_hashes, static_argnums=(1,))
+
+
+def _search_enc(table: BuildTable, h: jnp.ndarray, h2: jnp.ndarray,
+                verify: str) -> jnp.ndarray:
+    """Per probe row: the build slot of its candidate run start, or -1
+    when there is none. For unique-run builds the second-hash
+    verification folds in here — the single candidate is confirmed or
+    rejected on the spot, so the expand stage needs no per-slot
+    verify at all (verify="full" defers to the expand stage, which
+    owns the build-side key names)."""
+    n = table.sorted_hash.shape[0]
+    k = table.radix_bits
+    if k > 0:
+        pid = _partition_of(h, k)
+        lo0 = table.part_starts[pid]
+        hi0 = table.part_starts[pid + 1]
+    else:
+        # whole-table mode still honors the invalid-tail clip baked
+        # into part_starts ([0, first_invalid)) — the measured search
+        # depth covers exactly that span
+        lo0 = jnp.zeros(h.shape, jnp.int64)
+        hi0 = jnp.broadcast_to(table.part_starts[-1], h.shape)
+    lo = common.bounded_searchsorted(table.sorted_hash, h, lo0, hi0,
+                                     table.search_depth, side="left")
+    loc = jnp.clip(lo, 0, n - 1)
+    found = (lo < hi0) & (table.sorted_hash[loc] == h)
+    if table.unique_runs and verify == "hash":
+        found = found & (table.hash2[loc] == h2)
+    return jnp.where(found, lo, jnp.int64(-1))
+
+
+_search_jit = jax.jit(_search_enc, static_argnums=(3,))
+
+
+def _candidates_enc(table: BuildTable, probe: Batch,
+                    probe_keys: Tuple[str, ...],
+                    verify: str = "hash") -> jnp.ndarray:
+    """Traceable single-region composition (the TPU fused path)."""
+    h, h2 = _probe_hashes(probe, probe_keys)
+    return _search_enc(table, h, h2, verify)
+
+
+def _candidates_cpu(table: BuildTable, probe: Batch,
+                    probe_keys: Tuple[str, ...],
+                    verify: str = "hash") -> jnp.ndarray:
+    """Two-dispatch composition (the CPU path) — still zero host
+    syncs, the stages just materialize their one hot output each."""
+    h, h2 = _hash_jit(probe, probe_keys)
+    return _search_jit(table, h, h2, verify)
+
+
+def probe_counts(table: BuildTable, probe: Batch,
+                 probe_keys: Tuple[str, ...]):
+    """Per-probe-row candidate run [lo, hi) in the sorted build, plus
+    the candidate count (collisions included; exact verification
+    happens in expand — totals for capacity use hi-lo, an upper
+    bound). `probe_keys` name the probe batch's key columns (build key
+    names may differ — symbols are per-side in the planner).
+
+    Compat surface for tests/operators that stage the probe manually;
+    the fused probe_join path never materializes hi."""
+    lo_enc = _candidates_cpu(table, probe, probe_keys, "full")
+    return _counts_jit(table, probe, probe_keys, lo_enc)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _counts_jit(table, probe, probe_keys, lo_enc):
+    keys = [probe.columns[k].astuple() for k in probe_keys]
+    valid = probe.row_valid
+    for _, m in keys:
+        valid = valid & m
+    found = lo_enc >= 0
+    lo = jnp.maximum(lo_enc, 0)
+    counts = jnp.where(found, table.run_len[lo], 0)
+    lo = jnp.where(found, lo, 0)
+    return lo, lo + counts, counts, valid
 
 
 def expand(table: BuildTable, probe: Batch, key_names,
@@ -156,116 +413,188 @@ def expand(table: BuildTable, probe: Batch, key_names,
            probe_prefix: str = "", build_prefix: str = "",
            build_output: Optional[Sequence[str]] = None,
            probe_output: Optional[Sequence[str]] = None,
-           build_keys: Optional[Sequence[str]] = None) -> Batch:
-    """Materialize join output rows with a static `out_capacity`.
+           build_keys: Optional[Sequence[str]] = None,
+           verify: str = "full") -> Batch:
+    """Materialize join output rows with a static `out_capacity`
+    (compat surface over the general expand path).
 
     Output slot j belongs to probe row p(j) = searchsorted(cum, j) where
     cum is the exclusive prefix sum of per-probe output counts; its build
     candidate is build_slot = lo[p] + (j - cum[p]). Collision candidates
-    are masked out by comparing actual keys.
-    """
+    are masked out by the second-hash compare (or the full-key compare
+    under verify="full")."""
     if build_keys is not None:
         assert len(build_keys) == len(key_names), \
             "probe/build key lists must have equal length"
-    out, _ = _expand(table, probe, tuple(key_names), lo, hi, counts,
-                     probe_key_valid, out_capacity, join_type,
-                     tuple(probe_output if probe_output is not None
-                           else probe.names),
-                     tuple(build_output if build_output is not None
-                           else table.batch.names),
-                     probe_prefix, build_prefix,
-                     tuple(build_keys) if build_keys is not None
-                     else tuple(key_names))
+    out, _ = _expand_general_jit(
+        table, probe, tuple(key_names), lo, counts, probe_key_valid,
+        out_capacity, join_type,
+        tuple(probe_output if probe_output is not None
+              else probe.names),
+        tuple(build_output if build_output is not None
+              else table.batch.names),
+        probe_prefix, build_prefix,
+        tuple(build_keys) if build_keys is not None
+        else tuple(key_names), verify)
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+@functools.partial(jax.jit, static_argnums=(2, 6, 7, 8, 9, 10, 11, 12,
+                                            13))
+def _expand_general_jit(table, probe, key_names, lo, counts,
+                        probe_key_valid, out_capacity, join_type,
+                        probe_output, build_output, probe_prefix,
+                        build_prefix, build_keys, verify):
+    out, overflow, _, _ = _expand_general(
+        table, probe, key_names, lo, counts, out_capacity, join_type,
+        probe_output, build_output, probe_prefix, build_prefix,
+        build_keys, verify)
+    return out, overflow
+
+
 def probe_join(table: BuildTable, probe: Batch,
                key_names: Tuple[str, ...], out_capacity: int,
                join_type: str, probe_output: Tuple[str, ...],
                build_output: Tuple[str, ...],
-               build_keys: Tuple[str, ...]
+               build_keys: Tuple[str, ...], verify: str = "hash"
                ) -> Tuple[Batch, jnp.ndarray, jnp.ndarray]:
-    """Fused probe: candidate runs + expansion in ONE dispatch, with NO
-    host sync — the output capacity is chosen by the CALLER (typically
-    probe capacity x an expansion factor). Returns (output batch,
-    overflow flag, live output rows), all on device:
+    """Fused probe with NO host sync — the output capacity is chosen
+    by the CALLER (typically probe capacity x an expansion factor).
+    One dispatch on TPU; two on CPU (see module docstring). Returns
+    (output batch, overflow flag, live output rows), all on device:
 
     - `overflow` records whether the true output exceeded out_capacity;
       the operator accumulates it across batches and the runner checks
       ONCE per query, retrying with a larger factor (the same sync-free
-      protocol as GroupLimitExceeded — reference analog:
-      LookupJoinOperator.java:392's per-page yield loop, minus the
-      pointer-chased page builder).
+      protocol as GroupLimitExceeded). The aligned layout cannot
+      overflow — it returns a constant False.
     - the live-row count backs the operator's one-round-delayed
       output compaction (its d2h copy starts immediately, so the read
       a driver round later is normally a cache hit)."""
-    lo, hi, counts, pkv = probe_counts(table, probe, key_names)
-    out, overflow = _expand(table, probe, key_names, lo, hi, counts,
-                            pkv, out_capacity, join_type, probe_output,
-                            build_output, "", "", build_keys)
-    return out, overflow, jnp.sum(out.row_valid)
+    if common.cpu_backend():
+        h, h2 = _hash_jit(probe, key_names)
+        lo_enc = _search_jit(table, h, h2, verify)
+        out, overflow, total, _ = _expand_dispatch(
+            table, probe, key_names, lo_enc, h2, None, out_capacity,
+            join_type, probe_output, build_output, build_keys, verify)
+        return out, overflow, total
+    out, overflow, total, _ = _probe_join_fused(
+        table, probe, key_names, None, out_capacity, join_type,
+        probe_output, build_output, build_keys, verify)
+    return out, overflow, total
 
 
-@functools.partial(jax.jit, static_argnums=(2, 4, 5, 6, 7))
 def probe_join_full(table: BuildTable, probe: Batch,
                     key_names: Tuple[str, ...], matched: jnp.ndarray,
                     out_capacity: int, probe_output: Tuple[str, ...],
                     build_output: Tuple[str, ...],
-                    build_keys: Tuple[str, ...]):
+                    build_keys: Tuple[str, ...], verify: str = "hash"):
     """FULL OUTER probe step: identical to a left-join probe (unmatched
     probe rows emit one NULL-build row), plus a scatter-max that folds
     this batch's verified matches into the running per-build-row
-    `matched` flags — still one dispatch, zero host syncs (reference:
+    `matched` flags — no host syncs (reference:
     LookupJoinOperator.java:392 + the joinPositionsVisited bitmap
     behind LookupOuterOperator.java:42)."""
-    lo, hi, counts, pkv = probe_counts(table, probe, key_names)
-    out, overflow, brow, verified = _expand_core(
-        table, probe, key_names, lo, hi, counts, pkv, out_capacity,
-        "full", probe_output, build_output, "", "", build_keys)
-    matched = matched.at[brow].max(verified)
+    if common.cpu_backend():
+        h, h2 = _hash_jit(probe, key_names)
+        lo_enc = _search_jit(table, h, h2, verify)
+        out, overflow, total, matched = _expand_dispatch(
+            table, probe, key_names, lo_enc, h2, matched, out_capacity,
+            "full", probe_output, build_output, build_keys, verify)
+        return out, overflow, total, matched
+    return _probe_join_fused(table, probe, key_names, matched,
+                             out_capacity, "full", probe_output,
+                             build_output, build_keys, verify)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 4, 5, 6, 7, 8, 9))
+def _probe_join_fused(table, probe, key_names, matched, out_capacity,
+                      join_type, probe_output, build_output, build_keys,
+                      verify):
+    lo_enc = _candidates_enc(table, probe, key_names, verify)
+    return _expand_from_enc(table, probe, key_names, lo_enc, matched,
+                            out_capacity, join_type, probe_output,
+                            build_output, build_keys, verify)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 6, 7, 8, 9, 10, 11))
+def _expand_dispatch(table, probe, key_names, lo_enc, h2, matched,
+                     out_capacity, join_type, probe_output,
+                     build_output, build_keys, verify):
+    return _expand_from_enc(table, probe, key_names, lo_enc, matched,
+                            out_capacity, join_type, probe_output,
+                            build_output, build_keys, verify, h2=h2)
+
+
+def _expand_from_enc(table, probe, key_names, lo_enc, matched,
+                     out_capacity, join_type, probe_output,
+                     build_output, build_keys, verify, h2=None):
+    """Traceable expand stage: picks the aligned or general layout (a
+    STATIC choice) and folds the FULL join's matched-flag update.
+    `h2` carries stage 1's probe hash2 across the CPU dispatch
+    boundary so the hash-verify doesn't rehash the key columns (None
+    on the fused TPU path, where XLA CSEs the recompute away)."""
+    aligned = (
+        table.unique_runs
+        and join_type in ("inner", "left", "full")
+        and out_capacity == probe.row_valid.shape[0]
+    )
+    if aligned:
+        out, overflow, brow, verified = _expand_aligned(
+            table, probe, key_names, lo_enc, join_type, probe_output,
+            build_output, build_keys, verify)
+    else:
+        found = lo_enc >= 0
+        lo = jnp.maximum(lo_enc, 0)
+        counts = jnp.where(found, table.run_len[lo], 0)
+        out, overflow, brow, verified = _expand_general(
+            table, probe, key_names, lo, counts, out_capacity,
+            join_type, probe_output, build_output, "", "", build_keys,
+            verify, h2=h2)
+    if join_type == "full" and matched is not None:
+        matched = matched.at[brow].max(verified, mode="drop")
     return out, overflow, jnp.sum(out.row_valid), matched
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def unmatched_build(table: BuildTable, matched: jnp.ndarray,
-                    probe_schema: Tuple[Tuple, ...],
-                    build_output: Tuple[str, ...]):
-    """The FULL join's final batch: build rows no probe row ever
-    matched, probe side all-NULL (reference: LookupOuterOperator's
-    appendTo loop). `probe_schema` is ((name, type, dictionary), ...)
-    for the NULL probe columns. Returns (batch, live_count)."""
-    live = table.batch.row_valid & ~matched
-    n = matched.shape[0]
+def _expand_aligned(table, probe, key_names, lo_enc, join_type,
+                    probe_output, build_output, build_keys, verify):
+    """Output slot i == probe row i (unique-run build, capacity
+    match). Probe columns pass through with a narrowed mask; the
+    build side is one gather per column pair. An inner miss is a dead
+    slot; a left/full miss keeps the probe side with a NULL build
+    side. Total output never exceeds probe rows, so overflow is
+    impossible."""
+    verified = lo_enc >= 0
+    brow = jnp.maximum(lo_enc, 0)
+    if verify == "full" and table.unique_runs:
+        # collision-fallback oracle: one candidate per row, compare
+        # the actual key columns (stage 1 verified nothing)
+        for kn, bn in zip(key_names, build_keys):
+            pd, pm = probe.columns[kn].astuple()
+            bd, bm = table.batch.columns[bn].astuple()
+            verified = verified & (pd == bd[brow]) & pm & bm[brow]
+    live = probe.row_valid if join_type in ("left", "full") \
+        else verified
     cols: Dict[str, Column] = {}
-    for name, typ, dic in probe_schema:
-        cols[name] = Column(jnp.zeros(n, dtype=typ.np_dtype),
-                            jnp.zeros(n, dtype=bool), typ, dic)
+    for name in probe_output:
+        c = probe.columns[name]
+        cols[name] = Column(c.data, c.mask & live, c.type,
+                            c.dictionary)
     for name in build_output:
         c = table.batch.columns[name]
-        cols[name] = Column(c.data, c.mask & live, c.type, c.dictionary)
-    return Batch(cols, live), jnp.sum(live)
+        cols[name] = Column(c.data[brow], c.mask[brow] & verified,
+                            c.type, c.dictionary)
+    return Batch(cols, live), jnp.asarray(False), brow, verified
 
 
-@functools.partial(jax.jit, static_argnums=(2, 7, 8, 9, 10, 11, 12, 13))
-def _expand(table: BuildTable, probe: Batch, key_names, lo, hi, counts,
-            probe_key_valid, out_capacity: int, join_type: str,
-            probe_output, build_output, probe_prefix, build_prefix,
-            build_keys) -> Tuple[Batch, jnp.ndarray]:
-    out, overflow, _, _ = _expand_core(
-        table, probe, key_names, lo, hi, counts, probe_key_valid,
-        out_capacity, join_type, probe_output, build_output,
-        probe_prefix, build_prefix, build_keys)
-    return out, overflow
-
-
-def _expand_core(table: BuildTable, probe: Batch, key_names, lo, hi,
-                 counts, probe_key_valid, out_capacity: int,
-                 join_type: str, probe_output, build_output,
-                 probe_prefix, build_prefix, build_keys):
-    """Expansion body; additionally returns (brow, verified) — the
-    per-output-slot build row index and verified-match flag — so the
-    FULL-join wrapper can scatter-accumulate build-side match state."""
+def _expand_general(table, probe, key_names, lo, counts, out_capacity,
+                    join_type, probe_output, build_output, probe_prefix,
+                    build_prefix, build_keys, verify, h2=None):
+    """Prefix-sum expansion for duplicate-key builds: output slot j
+    belongs to probe row p(j), candidate build_slot = lo[p] + (j -
+    cum[p]). Returns (batch, overflow, brow, verified) — brow/verified
+    feed the FULL join's matched-flag scatter."""
+    assert verify in VERIFY_MODES, f"unknown verify mode {verify!r}"
     left_join = join_type in ("left", "full")
     # per-probe emitted rows: matches, or 1 unmatched row for LEFT
     emit = counts
@@ -279,8 +608,7 @@ def _expand_core(table: BuildTable, probe: Batch, key_names, lo, hi,
     # which probe row does output slot j come from? TPU: binary search
     # on the monotone prefix. CPU: expand-by-counts — scatter a 1 at
     # each probe's run start and prefix-sum (two linear passes instead
-    # of log2(cap) full-width gather rounds; the probe kernel's
-    # dominant cost on XLA:CPU at 1M-row batches)
+    # of log2(cap) full-width gather rounds)
     if common.cpu_backend():
         heads = jnp.zeros(out_capacity + 1, jnp.int64).at[
             jnp.clip(cum, 0, out_capacity)].add(1, mode="drop")
@@ -295,13 +623,22 @@ def _expand_core(table: BuildTable, probe: Batch, key_names, lo, hi,
     # the row index (near-contiguous gathers within each hash run)
     brow = jnp.clip(lo[pid] + k, 0, table.sorted_hash.shape[0] - 1)
 
-    # verify actual keys (hash collisions -> mask out)
-    verified = is_match
-    for kn, bn in zip(key_names, build_keys):
-        pd, pm = probe.columns[kn].astuple()
-        bd, bm = table.batch.columns[bn].astuple()
-        same = (pd[pid] == bd[brow]) & pm[pid] & bm[brow]
-        verified = verified & same
+    # verify candidates. "hash": the search hash already matched
+    # (candidates come from the probe hash's own run), so one compare
+    # of the second independent hash confirms the key — 2 gathers
+    # total instead of 4 per key column. "full": the pre-radix
+    # per-key-column compare (collision fallback / test oracle).
+    if verify == "hash":
+        h2p = h2 if h2 is not None else common.row_hash2(
+            [probe.columns[kn].astuple() for kn in key_names])
+        verified = is_match & (h2p[pid] == table.hash2[brow])
+    else:
+        verified = is_match
+        for kn, bn in zip(key_names, build_keys):
+            pd, pm = probe.columns[kn].astuple()
+            bd, bm = table.batch.columns[bn].astuple()
+            same = (pd[pid] == bd[brow]) & pm[pid] & bm[brow]
+            verified = verified & same
 
     if left_join:
         # a probe row with zero *verified* matches must still emit one
@@ -330,36 +667,117 @@ def _expand_core(table: BuildTable, probe: Batch, key_names, lo, hi,
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
-def semi_mark(table: BuildTable, probe: Batch, key_names: Tuple[str, ...],
-              build_keys: Optional[Tuple[str, ...]] = None):
-    """For each probe row: does any build row share its key? EXACT for
-    every run length (reference: HashSemiJoinOperator is always exact):
-    the first UNROLL candidates are verified with straight-line gathers
-    (covers almost all runs — duplicates in a semi build are rare), and
-    any still-unresolved longer runs are scanned to their true end by an
-    on-device `lax.while_loop` — no host sync, no hash-equality
-    shortcut, so engineered 64-bit hash collisions cannot produce a
-    false IN/EXISTS match."""
+def unmatched_build(table: BuildTable, matched: jnp.ndarray,
+                    probe_schema: Tuple[Tuple, ...],
+                    build_output: Tuple[str, ...]):
+    """The FULL join's final batch: build rows no probe row ever
+    matched, probe side all-NULL (reference: LookupOuterOperator's
+    appendTo loop). `probe_schema` is ((name, type, dictionary), ...)
+    for the NULL probe columns. Returns (batch, live_count)."""
+    live = table.batch.row_valid & ~matched
+    n = matched.shape[0]
+    cols: Dict[str, Column] = {}
+    for name, typ, dic in probe_schema:
+        cols[name] = Column(jnp.zeros(n, dtype=typ.np_dtype),
+                            jnp.zeros(n, dtype=bool), typ, dic)
+    for name in build_output:
+        c = table.batch.columns[name]
+        cols[name] = Column(c.data, c.mask & live, c.type, c.dictionary)
+    return Batch(cols, live), jnp.sum(live)
+
+
+def semi_mark(table: BuildTable, probe: Batch,
+              key_names: Tuple[str, ...],
+              build_keys: Optional[Tuple[str, ...]] = None,
+              verify: str = "hash"):
+    """For each probe row: does any build row share its key? One
+    bounded search into the row's radix bucket finds the candidate
+    run. Unique-run builds are fully resolved by that search (the
+    verification folded into stage 1); duplicate-run builds confirm
+    the first UNROLL candidates with straight-line second-hash
+    gathers and scan any longer runs with an on-device
+    `lax.while_loop` — no host sync. Under verify="hash" a false
+    IN/EXISTS match needs a SIMULTANEOUS collision in two independent
+    64-bit hashes (see docs/JOIN_KERNEL.md); verify="full" keeps the
+    exact per-key-column compare of the pre-radix kernel."""
+    assert verify in VERIFY_MODES, f"unknown verify mode {verify!r}"
     build_keys = build_keys or key_names
     assert len(build_keys) == len(key_names), \
         "probe/build key lists must have equal length"
+    if table.unique_runs and verify == "hash":
+        if common.cpu_backend():
+            lo_enc = _candidates_cpu(table, probe, key_names, verify)
+            return _semi_from_enc(probe, key_names, lo_enc)
+        return _semi_unique_fused(table, probe, key_names)
+    if common.cpu_backend():
+        lo_enc = _candidates_cpu(table, probe, key_names, "full")
+        return _semi_scan_jit(table, probe, key_names, lo_enc,
+                              tuple(build_keys), verify)
+    return _semi_fused(table, probe, key_names, tuple(build_keys),
+                       verify)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _semi_unique_fused(table: BuildTable, probe: Batch, key_names):
+    """Unique-run membership in ONE dispatch (TPU): the search stage's
+    folded second-hash verification fully resolves each probe row."""
+    lo_enc = _candidates_enc(table, probe, key_names, "hash")
+    return _semi_resolve(probe, key_names, lo_enc)
+
+
+def _semi_resolve(probe: Batch, key_names, lo_enc):
     keys = [probe.columns[k].astuple() for k in key_names]
     valid = probe.row_valid
     for _, m in keys:
         valid = valid & m
-    h = common.row_hash(keys)
-    lo = common.fast_searchsorted(table.sorted_hash, h, side="left")
-    hi = common.fast_searchsorted(table.sorted_hash, h, side="right")
-    bcols = [table.batch.columns[bn].astuple() for bn in build_keys]
+    return (lo_enc >= 0) & valid, valid
+
+
+_semi_from_enc = jax.jit(_semi_resolve, static_argnums=(1,))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _semi_fused(table, probe, key_names, build_keys, verify):
+    lo_enc = _candidates_enc(table, probe, key_names, verify)
+    return _semi_scan(table, probe, key_names, lo_enc, build_keys,
+                      verify)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 4, 5))
+def _semi_scan_jit(table, probe, key_names, lo_enc, build_keys,
+                   verify):
+    return _semi_scan(table, probe, key_names, lo_enc, build_keys,
+                      verify)
+
+
+def _semi_scan(table, probe, key_names, lo_enc, build_keys, verify):
+    """Exact membership over duplicate-hash runs: scan each probe
+    row's candidate run until a verified match or the run ends."""
+    keys = [probe.columns[k].astuple() for k in key_names]
+    valid = probe.row_valid
+    for _, m in keys:
+        valid = valid & m
+    found0 = lo_enc >= 0
+    lo = jnp.maximum(lo_enc, 0)
+    counts = jnp.where(found0, table.run_len[lo], 0)
+    hi = lo + counts
     nbuild = table.sorted_hash.shape[0]
+    if verify == "hash":
+        h2p = common.row_hash2(keys)
+        bcols = None
+    else:
+        bcols = [table.batch.columns[bn].astuple() for bn in build_keys]
 
     def check_at(i, found):
         """found |= (probe key == build key at run offset i)."""
         brow = jnp.clip(lo + i, 0, nbuild - 1)
         in_run = (lo + i) < hi
         same = in_run & valid
-        for (pd, pm), (bd, bm) in zip(keys, bcols):
-            same = same & (pd == bd[brow]) & pm & bm[brow]
+        if verify == "hash":
+            same = same & (table.hash2[brow] == h2p)
+        else:
+            for (pd, pm), (bd, bm) in zip(keys, bcols):
+                same = same & (pd == bd[brow]) & pm & bm[brow]
         return found | same
 
     UNROLL = 4

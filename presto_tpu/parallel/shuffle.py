@@ -32,6 +32,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated out of jax.experimental in newer releases;
+# support both spellings so the engine runs on the container's pinned
+# jax as well as current ones.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from presto_tpu.batch import Batch, Column, bucket_capacity
 from presto_tpu.ops import common
 from presto_tpu.parallel.mesh import worker_axis
@@ -170,7 +178,7 @@ def hash_repartition(sb: ShardedBatch, key_names: Sequence[str]
 
     body = functools.partial(_shuffle_core, w, axis)
     spec = P(axis)
-    fn = jax.shard_map(
+    fn = _shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 5,
         out_specs=(spec, spec, spec))
@@ -219,7 +227,7 @@ def _wave_program(mesh: Mesh, axis: str, w: int, n_keys: int,
         count = jnp.sum(valid).reshape(1)
         return out_datas, out_masks, out_valid, count
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 5,
         out_specs=(spec, spec, spec, spec)))

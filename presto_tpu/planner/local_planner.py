@@ -311,6 +311,20 @@ class LocalExecutionPlanner:
         batch = Batch(cols, jnp.asarray(rv))
         pipe.append(ValuesOperatorFactory(self._next_id(), [batch]))
 
+    def _append_filter_project(self, pipe: List, filter_expr,
+                               projections, input_dicts) -> None:
+        """Append a FilterProject — or FUSE it into a lookup join it
+        directly follows, so the expression forest evaluates inside
+        the probe dispatch and expanded join rows materialize once
+        (the probe->project fusion of the radix-join redesign)."""
+        tail = pipe[-1] if pipe else None
+        if isinstance(tail, LookupJoinOperatorFactory) \
+                and not tail.fused:
+            tail.fuse(filter_expr, projections, input_dicts)
+            return
+        pipe.append(FilterProjectOperatorFactory(
+            self._next_id(), filter_expr, projections, input_dicts))
+
     def _visit_FilterNode(self, node: N.FilterNode, pipe: List):
         self._visit(node.source, pipe)
         schema = _schema_of(node.source)
@@ -319,16 +333,16 @@ class LocalExecutionPlanner:
             (f.symbol, compile_expression(InputRef(f.symbol, f.type),
                                           schema))
             for f in node.output]
-        pipe.append(FilterProjectOperatorFactory(
-            self._next_id(), pred, projections, _schema_dicts(schema)))
+        self._append_filter_project(pipe, pred, projections,
+                                    _schema_dicts(schema))
 
     def _visit_ProjectNode(self, node: N.ProjectNode, pipe: List):
         self._visit(node.source, pipe)
         schema = _schema_of(node.source)
         projections = [(sym, compile_expression(e, schema))
                        for sym, e in node.assignments]
-        pipe.append(FilterProjectOperatorFactory(
-            self._next_id(), None, projections, _schema_dicts(schema)))
+        self._append_filter_project(pipe, None, projections,
+                                    _schema_dicts(schema))
 
     def _visit_AggregationNode(self, node: N.AggregationNode, pipe: List):
         self._visit(node.source, pipe)
@@ -576,9 +590,8 @@ class LocalExecutionPlanner:
                 (f.symbol, compile_expression(
                     InputRef(f.symbol, f.type), schema))
                 for f in node.output]
-            pipe.append(FilterProjectOperatorFactory(
-                self._next_id(), pred, projections,
-                _schema_dicts(schema)))
+            self._append_filter_project(pipe, pred, projections,
+                                        _schema_dicts(schema))
 
     def _cross_df_publish(self, node) -> List[tuple]:
         """Cross-fragment publications this join owes the query-wide

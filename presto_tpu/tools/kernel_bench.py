@@ -25,6 +25,11 @@ from typing import Callable, Dict
 import numpy as np
 
 
+#: kernels every BENCH_KERNELS.json must carry (null on failure) — the
+#: regression tracker's stable contract.
+HEADLINE_KERNELS = ("join_probe", "semi_mark", "agg_hash_random")
+
+
 def _bench(fn: Callable, block, warmup: int = 2, runs: int = 5) -> float:
     """Best wall seconds of `runs` timed calls (after `warmup`)."""
     for _ in range(warmup):
@@ -213,6 +218,14 @@ def main(argv=None) -> int:
         except Exception as e:  # keep the suite going
             results[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"{name:18s} FAILED: {e}", file=sys.stderr)
+    # STABLE shape for CI/regression tracking: the headline kernels are
+    # always present (rows_per_sec: null on failure), so a tracker can
+    # `jq .kernels.join_probe.rows_per_sec` across every round without
+    # guarding against missing keys.
+    for name in HEADLINE_KERNELS:
+        entry = results.setdefault(name, {})
+        entry.setdefault("ms", None)
+        entry.setdefault("rows_per_sec", None)
     out = {
         "platform": jax.default_backend(),
         "rows": args.rows,
@@ -221,6 +234,10 @@ def main(argv=None) -> int:
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
+    # one grep-stable summary line for the headline kernels
+    print("KERNELS " + " ".join(
+        f"{n}_rows_per_sec={results[n].get('rows_per_sec')}"
+        for n in HEADLINE_KERNELS), file=sys.stderr)
     print(json.dumps(out))
     return 0
 

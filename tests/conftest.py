@@ -55,3 +55,10 @@ def _clear_xla_caches_between_modules(request):
         jax.clear_caches()
     _last_module[0] = mod
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy battery members excluded from the tier-1 fast "
+        "lane (run them with -m slow)")

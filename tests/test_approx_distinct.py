@@ -28,6 +28,7 @@ def _estimate_chunks(fn, chunks):
     return int(np.asarray(d)[0])
 
 
+@pytest.mark.slow
 def test_ten_million_distinct_bounded_state():
     """10M distinct keys: <= 2.5% error, state size independent of N."""
     fn = hashagg.make_approx_distinct(BIGINT)
@@ -53,6 +54,7 @@ def test_merge_order_independent():
     assert a == b
 
 
+@pytest.mark.slow
 def test_error_parameter_scales_registers():
     m_loose = hashagg.hll_registers_for_error(0.26)
     m_default = hashagg.hll_registers_for_error(0.023)

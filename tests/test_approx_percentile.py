@@ -75,6 +75,7 @@ def test_percentile_validation(runner):
             "from lineitem")
 
 
+@pytest.mark.slow
 def test_distributed_colocated(lineitem):
     """On the mesh the sketch cannot split partial/final (its state
     has no column form) — groups co-locate and each worker runs a

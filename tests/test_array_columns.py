@@ -87,6 +87,33 @@ def test_array_agg_filter_clause(runner):
         assert sorted(a) == sorted(exp.get(k, []))
 
 
+def test_array_agg_excluded_row_after_contributor(runner):
+    """Regression (scatter collision): a FILTER-excluded row FOLLOWING
+    a contributing row in the same group shares that contributor's
+    within-group position. The kernel must route non-contributing rows
+    out of bounds (mode='drop'), not clip them onto the live slot —
+    XLA scatter order is unspecified, so the clipped write could land
+    after the contributor's and clobber it."""
+    got = runner.execute(
+        "select g, array_agg(v) filter (where keep) a from (values "
+        "(1, 10, true), (1, 11, false), (1, 12, true), "
+        "(1, 13, false), (2, 20, false), (2, 21, true)) "
+        "t(g, v, keep) group by g order by g").rows()
+    assert [(g, sorted(a)) for g, a in got] \
+        == [(1, [10, 12]), (2, [21])]
+
+
+def test_map_agg_null_key_after_contributor(runner):
+    """Same collision through the map_agg NULL-key drop path: the
+    NULL-key row follows a live pair in its group and must vanish
+    without disturbing it."""
+    got = runner.execute(
+        "select g, map_agg(nullif(k, 0), v) m from (values "
+        "(1, 7, 70), (1, 0, 99), (1, 8, 80)) "
+        "t(g, k, v) group by g").rows()
+    assert got == [(1, {7: 70, 8: 80})]
+
+
 def test_consume_array_agg_inline(runner):
     got = runner.execute(
         "select regionkey, cardinality(array_agg(nationkey)) c "
@@ -151,6 +178,7 @@ def mesh_runner():
     return MeshRunner("tpch", "tiny", n_workers=4)
 
 
+@pytest.mark.slow
 def test_mesh_array_agg_repartition(mesh_runner):
     got = mesh_runner.execute(
         "select regionkey, array_agg(nationkey) a from nation "
@@ -164,6 +192,7 @@ def test_mesh_array_agg_repartition(mesh_runner):
         == {k: sorted(v) for k, v in exp.items()}
 
 
+@pytest.mark.slow
 def test_mesh_array_survives_join_shuffle(mesh_runner):
     got = mesh_runner.execute(
         "select n.nationkey, cardinality(t.a) c from "

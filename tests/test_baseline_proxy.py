@@ -62,6 +62,7 @@ def test_q1(runner, proxy):
     _check(runner.execute(QUERIES[1]).rows(), prox)
 
 
+@pytest.mark.slow
 def test_q3(runner, proxy):
     gen, tables = proxy
     res = baseline_proxy.q3(tables, gen)
@@ -86,6 +87,7 @@ def test_q6(runner, proxy):
     _check(runner.execute(QUERIES[6]).rows(), prox)
 
 
+@pytest.mark.slow
 def test_q18(runner, proxy):
     gen, tables = proxy
     res = baseline_proxy.q18(tables, gen)

@@ -46,6 +46,30 @@ def test_kill_frees_budget_for_survivor():
     assert cm.snapshot() == {"qa": 450}
 
 
+def test_late_free_after_finish_does_not_reregister():
+    """Regression: a free()/free_all() from an operator draining AFTER
+    finish_query() must not re-register the finished query — the
+    phantom residual bytes would permanently shrink the budget left
+    for every later query."""
+    cm = ClusterMemoryManager(1000)
+    a = MemoryPool()
+    a.attach_cluster(cm, "qa")
+    a.reserve("op", 600)
+    a.reserve("op2", 300)
+    cm.finish_query("qa")
+    a.free("op2", 300)  # late drain still forwards 600 residual bytes
+    assert cm.snapshot() == {}
+    a.free_all("op")
+    assert cm.snapshot() == {}
+    # the FULL budget is available to the next query (pre-fix the
+    # phantom 600B re-registered and this reserve killed qb)
+    b = MemoryPool()
+    b.attach_cluster(cm, "qb")
+    b.reserve("op", 950)
+    assert cm.snapshot() == {"qb": 950}
+    cm.finish_query("qb")
+
+
 def test_two_queries_contend_end_to_end():
     """The verdict-r4 'done' shape: two CONCURRENT queries on one
     runner with a capped cluster pool — the hungrier one dies with the

@@ -61,6 +61,7 @@ def local_rows():
     return run
 
 
+@pytest.mark.slow
 def test_q1_through_cluster(cluster, local_rows):
     """TPC-H Q1 via 1 coordinator + 2 worker processes: partial agg on
     the workers, shuffle over HTTP, final merge + sort on the
@@ -133,6 +134,7 @@ def test_query_resources_released(cluster):
     assert seen > 0  # the workers really did run tasks
 
 
+@pytest.mark.slow
 def test_query_retries_on_dead_worker(local_rows):
     """Elastic recovery (P8 analog): a worker dying fails the attempt;
     the coordinator re-probes membership and reruns the query on the

@@ -53,6 +53,7 @@ def test_filter_vs_oracle(runner, oracle):
     assert got[0][2] is None and got[0][4] > 0
 
 
+@pytest.mark.slow
 def test_filter_distributed(runner):
     from presto_tpu.runner import MeshRunner
     assert MeshRunner("tpch", "tiny").execute(SQL).rows() \

@@ -172,6 +172,7 @@ def test_cube_cross_product_capped(runner):
             "cube(suppkey, partkey, orderkey, linenumber)")
 
 
+@pytest.mark.slow
 def test_rollup_distributed():
     """Rollup through the mesh path (partial/final split with the
     group-id as an ordinary aggregation key)."""

@@ -1,0 +1,255 @@
+"""Radix-partitioned probe vs the whole-table reference probe.
+
+Every case runs the SAME join twice over randomized batches:
+
+- radix: build_for_backend's auto-chosen bucket bits + the default
+  second-hash verification (the production path — aligned layout when
+  the build has unique hash runs);
+- reference: radix_bits=0 (whole-table bounded search) + verify="full"
+  (per-key-column compare) through the general expand layout — the
+  pre-radix kernel, shape for shape.
+
+Outputs must match as row multisets (physical slot layout is
+explicitly NOT part of the contract: the aligned layout parks rows at
+probe-aligned slots and the deferred-compact protocol packs them
+downstream). The skew case drives every probe row into ONE hash run
+(the general expand + the semi scan loop); the collision case builds
+keys engineered to share one 64-bit row_hash so the second-hash /
+full-key fallback actually decides matches.
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.batch import Batch, bucket_capacity
+from presto_tpu.ops import join
+from presto_tpu.types import BIGINT
+
+_M64 = 1 << 64
+_C1 = 0xBF58476D1CE4E5B9
+_C2 = 0x94D049BB133111EB
+
+
+def _hash64_py(v: int) -> int:
+    x = v % _M64
+    x = (x ^ (x >> 30)) * _C1 % _M64
+    x = (x ^ (x >> 27)) * _C2 % _M64
+    return x ^ (x >> 31)
+
+
+def _hash64_inv(h: int) -> int:
+    def unshift(y, k):
+        x = y
+        for _ in range(0, 64, k):
+            x = y ^ (x >> k)
+        return x % _M64
+    x = unshift(h % _M64, 31)
+    x = x * pow(_C2, -1, _M64) % _M64
+    x = unshift(x, 27)
+    x = x * pow(_C1, -1, _M64) % _M64
+    return unshift(x, 30)
+
+
+def _collision_rows(n: int):
+    """n distinct TWO-COLUMN rows sharing one row_hash (see
+    test_kernels for the derivation)."""
+    T = 0xDEAD_BEEF_CAFE_F00D
+    rows = []
+    for i in range(n):
+        a = i + 1
+        hb = (T - 31 * _hash64_py(a)) % _M64
+        b = _hash64_inv(hb)
+        rows.append((a, b - _M64 if b >= 1 << 63 else b))
+    return rows
+
+
+def _rows_of(batch):
+    return sorted(batch.to_pylist(), key=lambda t: tuple(
+        (v is None, v) for v in t))
+
+
+def _mk_batch(cols):
+    return Batch.from_pydict({n: (v, BIGINT) for n, v in cols.items()})
+
+
+def _dataset(kind: str, rng):
+    """(build cols, probe cols) for one scenario."""
+    if kind == "random":
+        bn, pn = 300, 500
+        return (
+            {"k": rng.integers(0, 80, bn).tolist(),
+             "bv": list(range(bn))},
+            {"k": [None if i % 11 == 0 else int(v) for i, v in
+                   enumerate(rng.integers(0, 100, pn))],
+             "pv": list(range(pn))},
+        )
+    if kind == "unique_fkpk":
+        bn, pn = 400, 700
+        return (
+            {"k": list(range(bn)), "bv": list(range(bn))},
+            {"k": rng.integers(0, bn + 50, pn).tolist(),
+             "pv": list(range(pn))},
+        )
+    if kind == "skew_one_hot":
+        # every build row shares ONE key: probe rows matching it expand
+        # by the whole build side (maximal run length)
+        bn, pn = 40, 120
+        return (
+            {"k": [7] * bn, "bv": list(range(bn))},
+            {"k": [7 if i % 3 else 13 for i in range(pn)],
+             "pv": list(range(pn))},
+        )
+    if kind == "collision":
+        rows = _collision_rows(6)
+        build = [rows[0], rows[0], rows[1], rows[2]]
+        probe = [rows[0], rows[3], rows[4], (42, 43), rows[2]]
+        return (
+            {"k": [a for a, _ in build], "k2": [b for _, b in build],
+             "bv": list(range(len(build)))},
+            {"k": [a for a, _ in probe], "k2": [b for _, b in probe],
+             "pv": list(range(len(probe)))},
+        )
+    raise AssertionError(kind)
+
+
+def _keys_for(kind):
+    return ("k", "k2") if kind == "collision" else ("k",)
+
+
+DATASETS = ("random", "unique_fkpk", "skew_one_hot", "collision")
+
+
+@pytest.mark.parametrize("kind", DATASETS)
+@pytest.mark.parametrize("join_type", ("inner", "left"))
+def test_probe_join_matches_reference(kind, join_type):
+    rng = np.random.default_rng(42)
+    bcols, pcols = _dataset(kind, rng)
+    keys = _keys_for(kind)
+    bb, pb = _mk_batch(bcols), _mk_batch(pcols)
+    pout = tuple(pcols.keys())
+    bout = ("bv",)
+    cap = bucket_capacity(pb.capacity * max(len(bcols["bv"]), 1))
+
+    radix = join.build_for_backend(bb, keys)
+    ref = join.build_for_backend(bb, keys, radix_bits=0)
+    got, ovf_g, live_g = join.probe_join(
+        radix, pb, keys, cap, join_type, pout, bout, keys)
+    exp, ovf_e, live_e = join.probe_join(
+        ref, pb, keys, cap, join_type, pout, bout, keys, "full")
+    assert _rows_of(got) == _rows_of(exp)
+    assert int(live_g) == int(live_e)
+    assert not bool(ovf_g) and not bool(ovf_e)
+
+    # aligned layout (capacity == probe capacity) must agree too when
+    # the build qualifies
+    got2, ovf2, live2 = join.probe_join(
+        radix, pb, keys, pb.capacity, join_type, pout, bout, keys)
+    if radix.unique_runs:
+        assert _rows_of(got2) == _rows_of(exp)
+        assert not bool(ovf2)
+
+
+@pytest.mark.parametrize("kind", DATASETS)
+def test_full_join_matches_reference(kind):
+    rng = np.random.default_rng(43)
+    bcols, pcols = _dataset(kind, rng)
+    keys = _keys_for(kind)
+    bb, pb = _mk_batch(bcols), _mk_batch(pcols)
+    pout = tuple(pcols.keys())
+    bout = ("bv",)
+    cap = bucket_capacity(pb.capacity * max(len(bcols["bv"]), 1))
+    schema = tuple((f, BIGINT, None) for f in pout)
+
+    outs = {}
+    for label, table, verify in (
+            ("radix", join.build_for_backend(bb, keys), "hash"),
+            ("ref", join.build_for_backend(bb, keys, radix_bits=0),
+             "full")):
+        import jax.numpy as jnp
+        matched = jnp.zeros(table.sorted_hash.shape[0], bool)
+        out, ovf, live, matched = join.probe_join_full(
+            table, pb, keys, matched, cap, pout, bout, keys, verify)
+        tail, tlive = join.unmatched_build(table, matched, schema,
+                                           bout)
+        outs[label] = _rows_of(out) + _rows_of(tail)
+        assert not bool(ovf)
+    assert outs["radix"] == outs["ref"]
+
+
+@pytest.mark.parametrize("kind", DATASETS)
+@pytest.mark.parametrize("negate", (False, True), ids=("semi", "anti"))
+def test_semi_anti_matches_reference(kind, negate):
+    rng = np.random.default_rng(44)
+    bcols, pcols = _dataset(kind, rng)
+    keys = _keys_for(kind)
+    bb, pb = _mk_batch(bcols), _mk_batch(pcols)
+
+    radix = join.build_for_backend(bb, keys)
+    ref = join.build_for_backend(bb, keys, radix_bits=0)
+    got, gvalid = join.semi_mark(radix, pb, keys)
+    exp, evalid = join.semi_mark(ref, pb, keys, verify="full")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+    np.testing.assert_array_equal(np.asarray(gvalid),
+                                  np.asarray(evalid))
+    # anti-join view: NOT IN keeps non-members among valid rows only
+    keep_g = np.asarray(~got & gvalid) if negate else np.asarray(got)
+    keep_e = np.asarray(~exp & evalid) if negate else np.asarray(exp)
+    np.testing.assert_array_equal(keep_g, keep_e)
+
+
+def test_collision_outsider_never_matches():
+    """A probe key sharing a member's 64-bit row_hash but differing in
+    value must NOT join under either verify mode (the second hash —
+    engineered against the FIRST hash only — differs, which IS the
+    collision fallback)."""
+    rows = _collision_rows(5)
+    build = [rows[0], rows[1]]
+    probe = [rows[0], rows[2], rows[3]]  # member, two colliding outsiders
+    bb = _mk_batch({"k": [a for a, _ in build],
+                    "k2": [b for _, b in build],
+                    "bv": [0, 1]})
+    pb = _mk_batch({"k": [a for a, _ in probe],
+                    "k2": [b for _, b in probe],
+                    "pv": [0, 1, 2]})
+    keys = ("k", "k2")
+    for radix_bits in (None, 0):
+        table = join.build_for_backend(bb, keys, radix_bits=radix_bits)
+        for verify in ("hash", "full"):
+            out, _, live = join.probe_join(
+                table, pb, keys, pb.capacity * 4, "inner",
+                ("pv",), ("bv",), keys, verify)
+            assert _rows_of(out) == [(0, 0)], (radix_bits, verify)
+            found, _ = join.semi_mark(table, pb, keys, verify=verify)
+            assert np.asarray(found)[:3].tolist() == \
+                [True, False, False], (radix_bits, verify)
+
+
+def test_overflow_flag_still_trips():
+    """The general layout must still report capacity overflow (the
+    aligned layout never can — its output is bounded by probe rows)."""
+    bb = _mk_batch({"k": [1] * 20, "bv": list(range(20))})
+    pb = _mk_batch({"k": [1, 1], "pv": [0, 1]})
+    table = join.build_for_backend(bb, ("k",))
+    out, ovf, live = join.probe_join(
+        table, pb, ("k",), 8, "inner", ("k", "pv"), ("bv",), ("k",))
+    assert bool(ovf)
+
+
+def test_build_metadata_shapes():
+    """Radix metadata invariants: bucket offsets monotone, clipped at
+    the invalid tail, run lengths exact at run starts."""
+    rng = np.random.default_rng(45)
+    vals = [None if i % 7 == 0 else int(v) for i, v in
+            enumerate(rng.integers(0, 50, 200))]
+    bb = _mk_batch({"k": vals, "bv": list(range(200))})
+    t = join.build_for_backend(bb, ("k",))
+    ps = np.asarray(t.part_starts)
+    sh = np.asarray(t.sorted_hash)
+    assert (np.diff(ps) >= 0).all()
+    first_inv = int(np.searchsorted(sh, np.iinfo(np.int64).max))
+    assert ps[-1] == first_inv
+    rl = np.asarray(t.run_len)
+    starts = np.flatnonzero(np.concatenate(
+        [[True], sh[1:] != sh[:-1]]))
+    lens = np.diff(np.append(starts, sh.shape[0]))
+    assert (rl[starts] == lens).all()

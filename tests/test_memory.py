@@ -45,6 +45,7 @@ def test_accounting_in_explain_analyze():
     assert "peak reserved device memory:" in text
 
 
+@pytest.mark.slow
 def test_grouped_execution_under_budget():
     """A partitioned-join query whose shuffled working set exceeds the
     budget re-runs bucket-wise (lifespans) and still matches the
@@ -73,6 +74,7 @@ def test_grouped_execution_under_budget():
     jax.clear_caches()
 
 
+@pytest.mark.slow
 def test_spool_spills_to_disk():
     """With a zero host-spool budget every later-lifespan batch takes
     the disk tier (compressed pages via the native codec; reference:
@@ -104,6 +106,7 @@ def test_spool_spills_to_disk():
     jax.clear_caches()
 
 
+@pytest.mark.slow
 def test_manual_lifespans_match():
     """Explicit lifespans (no budget pressure) produce identical
     results — the bucket split is a pure partition of the hash space."""

@@ -216,6 +216,7 @@ def test_streaming_bounded_state():
     assert got == exp
 
 
+@pytest.mark.slow
 def test_streaming_partial_on_mesh():
     """The PARTIAL step streams over declared-sorted scans too (the
     reference's streaming-for-partial-aggregation): mesh plans show

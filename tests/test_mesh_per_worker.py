@@ -80,6 +80,7 @@ def test_workers_announce_devices(mesh_cluster):
         mesh_cluster.worker_urls) == [4, 4]
 
 
+@pytest.mark.slow
 def test_q1_partial_final_over_8_global_tasks(mesh_cluster,
                                               local_rows):
     sys.path.insert(0, "/root/repo/tests")
@@ -88,6 +89,7 @@ def test_q1_partial_final_over_8_global_tasks(mesh_cluster,
                  local_rows(QUERIES[1]))
 
 
+@pytest.mark.slow
 def test_repartitioned_join_across_worker_devices(mesh_cluster,
                                                   local_rows):
     # force the repartition path (no broadcast): same keys must meet
@@ -98,6 +100,7 @@ def test_repartitioned_join_across_worker_devices(mesh_cluster,
     _assert_rows(mesh_cluster.execute(sql).rows(), local_rows(sql))
 
 
+@pytest.mark.slow
 def test_broadcast_join_and_topn(mesh_cluster, local_rows):
     sql = ("select n.name, count(*) c from customer c "
            "join nation n on c.nationkey = n.nationkey "
@@ -105,6 +108,7 @@ def test_broadcast_join_and_topn(mesh_cluster, local_rows):
     _assert_rows(mesh_cluster.execute(sql).rows(), local_rows(sql))
 
 
+@pytest.mark.slow
 def test_semi_join_and_order_by(mesh_cluster, local_rows):
     sql = ("select custkey, acctbal from customer "
            "where custkey in (select custkey from orders "

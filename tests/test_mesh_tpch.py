@@ -53,7 +53,15 @@ def mesh_runner():
     }, n_workers=8)
 
 
-@pytest.mark.parametrize("qn", sorted(QUERIES))
+#: fast-tier smoke subset: one broadcast-join query (Q3) and one cheap
+#: filter (Q6); the full battery runs in the slow tier (`-m slow`) —
+#: each mesh query costs 7-30s of SPMD compiles on the 2-core host.
+MESH_SMOKE = {3, 6}
+
+
+@pytest.mark.parametrize("qn", [
+    qn if qn in MESH_SMOKE else pytest.param(qn, marks=pytest.mark.slow)
+    for qn in sorted(QUERIES)])
 def test_mesh_tpch_query(qn, mesh_runner, oracle):  # noqa: F811
     res = mesh_runner.execute(QUERIES[qn])
     types = [f.type.name for f in res.fields]
@@ -63,7 +71,8 @@ def test_mesh_tpch_query(qn, mesh_runner, oracle):  # noqa: F811
     assert_rows_equal(got, exp, qn, qn in FULLY_ORDERED)
 
 
-@pytest.mark.parametrize("qn", [3, 5, 10, 18])
+@pytest.mark.parametrize("qn", [
+    3] + [pytest.param(q, marks=pytest.mark.slow) for q in (5, 10, 18)])
 def test_mesh_tpch_all_partitioned(qn, oracle):  # noqa: F811
     """Join-heavy queries with broadcast disabled entirely."""
     from presto_tpu.runner import MeshRunner

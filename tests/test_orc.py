@@ -165,7 +165,10 @@ def orc_runner(tmp_path_factory):
     return r, src
 
 
-TPCH_SUBSET = [1, 3, 5, 6, 10, 12, 14, 19]
+TPCH_SUBSET = [1, 3, 6,
+               pytest.param(5, marks=pytest.mark.slow),
+               pytest.param(10, marks=pytest.mark.slow),
+               12, 14, 19]
 
 
 @pytest.mark.parametrize("qn", TPCH_SUBSET)

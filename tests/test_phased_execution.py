@@ -61,6 +61,7 @@ def test_no_self_or_cyclic_deps():
             assert not reaches(d, fid, set()), (fid, d)
 
 
+@pytest.mark.slow
 def test_mesh_results_unchanged_by_phasing():
     from presto_tpu.runner import LocalRunner, MeshRunner
     sql = ("select s.name, count(*) c from lineitem l "

@@ -42,6 +42,7 @@ def _inject_once(monkeypatch, state):
                         add_input)
 
 
+@pytest.mark.slow
 def test_bucket_retry_recovers(monkeypatch):
     from presto_tpu.runner import LocalRunner, MeshRunner
     want = sorted(LocalRunner("tpch", "tiny").execute(SQL).rows())

@@ -3,6 +3,8 @@ AbstractVerification checksum comparison; presto-benchmark suite)."""
 
 import json
 
+import pytest
+
 from presto_tpu.tools.verifier import (
     result_checksum, row_checksum, verify_queries,
 )
@@ -42,6 +44,7 @@ def test_verify_error_recorded():
     assert "nope" in results[0].detail
 
 
+@pytest.mark.slow
 def test_verifier_local_vs_mesh_cli(capsys):
     """End-to-end: a 3-query slice of the TPC-H suite verified
     local vs mesh through the CLI entry point."""
@@ -61,6 +64,7 @@ def test_verifier_local_vs_mesh_cli(capsys):
     assert out.count("match") == 3
 
 
+@pytest.mark.slow
 def test_benchmark_suite(tmp_path):
     from presto_tpu.tools import benchmark
     out = tmp_path / "bench.json"

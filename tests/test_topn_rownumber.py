@@ -71,6 +71,7 @@ def test_no_fusion_without_bound(runner):
     assert runner.execute(sql).rows()[0][1] == 6
 
 
+@pytest.mark.slow
 def test_distributed_partial(runner):
     """On the mesh: partial TopNRowNumber on every worker before the
     repartition, exact final after; rows match local execution."""

@@ -95,7 +95,11 @@ def assert_rows_equal(got, exp, qn, ordered):
 FULLY_ORDERED = {1, 4, 5, 7, 8, 9, 12, 15, 16, 22}
 
 
-@pytest.mark.parametrize("qn", sorted(QUERIES))
+#: Q2 and Q21 are the battery's two heaviest compiles (~8-10s each on
+#: the 2-core host); they ride the slow tier, the other 20 stay fast.
+@pytest.mark.parametrize("qn", [
+    qn if qn not in (2, 21) else pytest.param(qn, marks=pytest.mark.slow)
+    for qn in sorted(QUERIES)])
 def test_tpch_query(qn, runner, oracle):
     res = runner.execute(QUERIES[qn])
     types = [f.type.name for f in res.fields]

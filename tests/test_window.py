@@ -185,6 +185,7 @@ def test_window_query(name, runner, oracle):  # noqa: F811
     assert_rows_equal(got, exp, name, ordered=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["row_number", "running_sum_range",
                                   "window_over_aggregation"])
 def test_window_on_mesh(name, oracle):  # noqa: F811
