@@ -137,6 +137,15 @@ def _child_main() -> int:
 
     runner = LocalRunner("tpch", SCHEMA)
     runner.session.properties["batch_rows"] = BATCH_ROWS
+    # this bench measures KERNEL EXECUTION throughput: the plan and
+    # fragment-result caches would make warm runs replay stored
+    # batches instead of executing anything. The page-source cache
+    # stays ON — it is the successor of the tpch connector's internal
+    # device-batch scan cache this methodology always relied on
+    # ("warm runs exclude data generation"; serving-path throughput
+    # is serving_bench's metric, not this one)
+    runner.session.properties["plan_cache_enabled"] = False
+    runner.session.properties["fragment_result_cache_enabled"] = False
     rows_of = _scanned_rows(runner.catalogs.connector("tpch")._gens[SCHEMA])
 
     subset = os.environ.get("PRESTO_TPU_BENCH_QUERIES")

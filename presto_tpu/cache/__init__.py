@@ -1,0 +1,11 @@
+"""Query-serving cache hierarchy: plan / fragment-result / page-source
+levels (see manager.py for the architecture note and docs/CACHING.md
+for keys, invalidation protocol, and session properties)."""
+
+from presto_tpu.cache.fingerprint import (  # noqa: F401
+    fragment_fingerprint, normalize_sql, split_token, table_cache_key,
+)
+from presto_tpu.cache.manager import (  # noqa: F401
+    CacheManager, CacheStats, PlanCache, ResultCache,
+    get_cache_manager, reset_cache_manager,
+)

@@ -1,0 +1,223 @@
+"""Canonical cache keys: table identity+version tokens, normalized SQL
+text, and structural fingerprints of deterministic leaf plan fragments
+(reference: presto-main's FragmentCacheStats + the canonical plan
+hashing of operator/FragmentResultCacheManager — CanonicalPlanFragment
+keyed by plan shape + split identity).
+
+Everything here is PURE key derivation — no storage, no eviction. A
+return of None always means "do not cache", never an error: callers
+fall through to uncached execution.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from presto_tpu.planner import nodes as N
+
+#: functions whose result depends on more than their arguments; a
+#: fragment containing one must never be served from cache (the engine
+#: registers none today — the list is the forward guard)
+NONDETERMINISTIC_FUNCTIONS = frozenset({
+    "random", "rand", "uuid", "now", "current_timestamp", "shuffle",
+})
+
+
+def normalize_sql(sql: str) -> str:
+    """Whitespace-insensitive statement text: runs of whitespace
+    OUTSIDE quotes collapse to one space, trailing semicolons drop.
+    Quote-aware — bytes inside '...' literals and "..." identifiers
+    are preserved verbatim (('' and \"\" escapes included): collapsing
+    whitespace inside a literal would alias two queries with different
+    answers, the one failure a plan cache must never produce. No case
+    folding for the same reason. Mis-lexing only ever PRESERVES more
+    bytes (e.g. an apostrophe in a -- comment), which costs a false
+    miss, never a false hit."""
+    out = []
+    i, n = 0, len(sql)
+    pending_ws = False
+    while i < n:
+        c = sql[i]
+        if c in ("'", '"'):
+            if pending_ws and out:
+                out.append(" ")
+            pending_ws = False
+            j = i + 1
+            while j < n:
+                if sql[j] == c:
+                    if j + 1 < n and sql[j + 1] == c:
+                        j += 2  # doubled-quote escape
+                        continue
+                    break
+                j += 1
+            out.append(sql[i:j + 1])
+            i = j + 1
+            continue
+        if c.isspace():
+            pending_ws = True
+            i += 1
+            continue
+        if pending_ws and out:
+            out.append(" ")
+        pending_ws = False
+        out.append(c)
+        i += 1
+    s = "".join(out)
+    while s.endswith(";"):
+        s = s[:-1].rstrip()
+    return s
+
+
+def table_cache_key(catalogs, handle) -> Optional[Tuple[Any, int]]:
+    """(connector cache token, table version) — the pair that makes a
+    cached entry safe to serve: the token separates same-named tables
+    of different connector INSTANCES (every test builds its own
+    MemoryConnector with its own `memory.default.t`), the version
+    separates generations of one table. None = volatile/unversioned
+    table (system.runtime...) — never cache."""
+    try:
+        conn = catalogs.connector(handle.catalog)
+        version = conn.metadata.table_version(handle)
+    except Exception:  # noqa: BLE001 — missing table/catalog
+        return None
+    if version is None:
+        return None
+    return (conn.cache_token(), version)
+
+
+def split_token(split) -> Optional[Any]:
+    """Hashable identity of one split. Falls back to repr for
+    connector-private info payloads that are not hashable."""
+    try:
+        hash(split.info)
+        return (split.info, split.partition)
+    except TypeError:
+        return (repr(split.info), split.partition)
+
+
+# ---------------------------------------------------------------------------
+# fragment fingerprints
+
+
+#: plan nodes a cacheable leaf fragment may consist of — deterministic,
+#: single-pipeline operators only (joins/unions/windows spawn dependent
+#: pipelines and bridges; exchanges cross task boundaries)
+_ELIGIBLE = (N.TableScanNode, N.FilterNode, N.ProjectNode,
+             N.AggregationNode, N.SortNode, N.TopNNode, N.LimitNode,
+             N.DistinctNode)
+
+
+def _expr_deterministic(e) -> bool:
+    from presto_tpu.expr.ir import Call, walk
+    for x in walk(e):
+        if isinstance(x, Call) and x.name in NONDETERMINISTIC_FUNCTIONS:
+            return False
+    return True
+
+
+def _hash_expr(h, e) -> bool:
+    """Mix an expression IR into the digest; False = not cacheable."""
+    if e is None:
+        h.update(b"~")
+        return True
+    if not _expr_deterministic(e):
+        return False
+    from presto_tpu.expr.ir import fingerprint
+    try:
+        h.update(fingerprint(e))
+    except Exception:  # noqa: BLE001 — unhashable literal etc.
+        return False
+    return True
+
+
+def _hash_fields(h, fields) -> None:
+    for f in fields:
+        h.update(repr((f.symbol, f.type.name, f.dictionary)).encode())
+        form = getattr(f, "form", None)
+        if form is not None:
+            h.update(repr(form).encode())
+
+
+def fragment_fingerprint(node: N.PlanNode, catalogs,
+                         shared_ids: frozenset,
+                         df_scan_ids: frozenset,
+                         ) -> Optional[Tuple[str, List, int]]:
+    """(key, table deps, scan count) for a deterministic leaf fragment
+    rooted at `node`, or None when any part of the subtree is not
+    cacheable. The key covers plan shape, expressions, output schema,
+    and every scanned table's (token, version) — so a write anywhere
+    below simply produces a different key (version-keyed invalidation,
+    the FragmentResultCacheManager contract)."""
+    h = hashlib.blake2b(digest_size=16)
+    deps: List = []
+    scans = 0
+
+    def visit(n) -> bool:
+        nonlocal scans
+        if not isinstance(n, _ELIGIBLE):
+            return False
+        if id(n) in shared_ids and n is not node:
+            # an interior spooled subtree feeds consumers outside this
+            # fragment; replaying around it would strand the spool
+            return False
+        h.update(type(n).__name__.encode())
+        _hash_fields(h, n.output)
+        if isinstance(n, N.TableScanNode):
+            if id(n) in df_scan_ids:
+                # dynamic-filter-narrowed scans emit a join-dependent
+                # subset; correct for THIS join but not a fragment
+                return False
+            tv = table_cache_key(catalogs, n.handle)
+            if tv is None:
+                return False
+            scans += 1
+            deps.append((n.handle, tv))
+            h.update(repr((n.handle.catalog, n.handle.schema,
+                           n.handle.table, tv,
+                           sorted(n.assignments.items()))).encode())
+            h.update(repr(n.constraint).encode())
+            return True
+        if isinstance(n, N.FilterNode):
+            if not _hash_expr(h, n.predicate):
+                return False
+        elif isinstance(n, N.ProjectNode):
+            for sym, e in n.assignments:
+                h.update(sym.encode())
+                if not _hash_expr(h, e):
+                    return False
+        elif isinstance(n, N.AggregationNode):
+            h.update(n.step.encode())
+            for sym, e in n.keys:
+                h.update(sym.encode())
+                if not _hash_expr(h, e):
+                    return False
+            for a in n.aggregates:
+                h.update(repr((a.out_symbol, a.function, a.distinct,
+                               a.params,
+                               a.output_type.name if a.output_type
+                               else None,
+                               a.input_type.name if a.input_type
+                               else None)).encode())
+                if not _hash_expr(h, a.argument):
+                    return False
+                if not _hash_expr(h, getattr(a, "argument2", None)):
+                    return False
+                if not _hash_expr(h, a.filter):
+                    return False
+        elif isinstance(n, (N.SortNode, N.TopNNode)):
+            h.update(repr((getattr(n, "n", None), n.keys,
+                           n.descending, n.nulls_first)).encode())
+        elif isinstance(n, N.LimitNode):
+            h.update(repr(n.n).encode())
+        # DistinctNode: shape + output fields already mixed in
+        for s in n.sources():
+            if not visit(s):
+                return False
+        return True
+
+    if not visit(node):
+        return None
+    if scans == 0:
+        return None  # pure VALUES/constant fragments are not worth it
+    return ("frag:" + h.hexdigest(), deps, scans)

@@ -1,0 +1,362 @@
+"""The query-serving cache hierarchy (reference: presto-main
+FragmentResultCacheManager + FragmentCacheStats for the result tier,
+and the metadata/plan reuse called out in both Presto papers for the
+plan tier).
+
+One process-wide CacheManager owns three levels:
+
+  plan      — normalized SQL (+ session fingerprint) -> optimized
+              logical plan; skips parse/analyze/optimize
+  fragment  — canonical leaf-fragment fingerprint -> output Batches;
+              skips scan+filter+project(+agg/sort/limit) execution
+  page      — (table version, split, columns) -> scanned Batches;
+              skips the connector read/generate + decode path
+
+Result levels share ONE byte budget charged to a tagged MemoryPool
+(tags `cache:fragment` / `cache:page`), evict LRU-first, and key every
+entry on the owning tables' (cache token, version) pairs — a write
+bumps the version, so stale entries become unreachable immediately and
+are dropped eagerly by `invalidate_table`. Each level is individually
+toggleable per session (session_properties: plan_cache_enabled,
+fragment_result_cache_enabled, page_source_cache_enabled) and exposes
+hit/miss/eviction/bytes counters through EXPLAIN ANALYZE and
+system.runtime.caches."""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from presto_tpu.execution.memory import MemoryPool, batch_bytes
+
+
+@dataclasses.dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    inserts: int = 0
+    #: put() refusals: entry over the per-entry cap, or no room even
+    #: after eviction — distinguishes "too big to cache" from a miss
+    rejected: int = 0
+
+    def snapshot(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "deps")
+
+    def __init__(self, value, nbytes: int, deps):
+        self.value = value
+        self.nbytes = nbytes
+        # [(catalog, schema, table)] for eager invalidation
+        self.deps = tuple(deps or ())
+
+
+class ResultCache:
+    """LRU batch cache, bytes charged to the shared pool under `tag`.
+    Values are lists of Batches (immutable device arrays); callers
+    must not mutate them. Thread-safe — the serving path hits this
+    from every client thread."""
+
+    #: one entry may take at most budget/<this>; bigger results stream
+    #: through uncached instead of wiping the cache (overridable per
+    #: level — page entries are whole splits and get a looser cap)
+    MAX_ENTRY_FRACTION = 8
+    #: hard entry-count cap: zero-byte entries (empty results) never
+    #: trip the byte budget, and distinct keys must not grow forever
+    MAX_ENTRIES = 4096
+
+    def __init__(self, tag: str, pool: MemoryPool, lock: threading.Lock,
+                 max_entry_fraction: Optional[int] = None):
+        self.tag = tag
+        if max_entry_fraction is not None:
+            self.MAX_ENTRY_FRACTION = max_entry_fraction
+        self.pool = pool
+        self.stats = CacheStats()
+        self.bytes = 0
+        self._lock = lock
+        #: sibling levels sharing the pool budget (set by the
+        #: manager); evicted from, LRU-first, once this level's own
+        #: entries are exhausted — otherwise one level could fill the
+        #: shared budget and permanently starve the other
+        self.peers: List["ResultCache"] = []
+        self._entries: "collections.OrderedDict[Any, _Entry]" = \
+            collections.OrderedDict()
+
+    def __len__(self):
+        return len(self._entries)
+
+    def entry_byte_cap(self) -> Optional[int]:
+        if self.pool.budget is None:
+            return None
+        return self.pool.budget // self.MAX_ENTRY_FRACTION
+
+    def get(self, key):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.stats.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.stats.hits += 1
+            return e.value
+
+    def put(self, key, batches: List, deps=None) -> bool:
+        nbytes = sum(batch_bytes(b) for b in batches)
+        cap = self.entry_byte_cap()
+        if cap is not None and nbytes > cap:
+            self.stats.rejected += 1
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._drop_locked(old)
+            budget = self.pool.budget
+            if budget is not None:
+                # evict OWN entries LRU-first; only once this level is
+                # empty does pressure spill onto its peers (all levels
+                # share one lock, so cross-evicting is safe)
+                victims = [self] + self.peers
+                for level in victims:
+                    while level._entries \
+                            and self.pool.reserved + nbytes > budget:
+                        _, ev = level._entries.popitem(last=False)
+                        level._drop_locked(ev)
+                        level.stats.evictions += 1
+                if self.pool.reserved + nbytes > budget:
+                    self.stats.rejected += 1
+                    return False
+            self.pool.reserve(self.tag, nbytes)
+            self.bytes += nbytes
+            self._entries[key] = _Entry(list(batches), nbytes, deps)
+            self.stats.inserts += 1
+            while len(self._entries) > self.MAX_ENTRIES:
+                _, ev = self._entries.popitem(last=False)
+                self._drop_locked(ev)
+                self.stats.evictions += 1
+            return True
+
+    def _drop_locked(self, e: _Entry) -> None:
+        self.pool.free(self.tag, e.nbytes)
+        self.bytes -= e.nbytes
+
+    def invalidate_table(self, triple: Tuple[str, str, str]) -> None:
+        with self._lock:
+            dead = [k for k, e in self._entries.items()
+                    if triple in e.deps]
+            for k in dead:
+                self._drop_locked(self._entries.pop(k))
+                self.stats.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            for e in self._entries.values():
+                self._drop_locked(e)
+            self._entries.clear()
+
+
+class PlanCache:
+    """Optimized-plan cache (entry-capped, not byte-accounted: plans
+    are small object graphs). Every candidate carries the (token,
+    version) of each table the plan scans; a lookup re-resolves them
+    through the CALLING runner's catalogs and serves a plan only on an
+    exact match. Each key holds a small BUCKET of candidates: two
+    coexisting runners whose same-named tables collide on one key
+    (different connector instances = different tokens) then each keep
+    their own entry instead of overwriting each other's on every
+    miss."""
+
+    BUCKET_WIDTH = 4
+
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self.stats = CacheStats()
+        self._lock = threading.Lock()
+        #: key -> [(plan, [(handle, (token, version))]), ...] newest last
+        self._entries: "collections.OrderedDict[Any, list]" = \
+            collections.OrderedDict()
+
+    def __len__(self):
+        return len(self._entries)
+
+    def contains(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    @staticmethod
+    def _match(deps, catalogs) -> Optional[bool]:
+        """True = serve; False = STALE for its own connector (same
+        token, version moved — drop it); None = foreign (another
+        instance's table: not ours to touch)."""
+        from presto_tpu.cache.fingerprint import table_cache_key
+        foreign = False
+        for handle, tv in deps:
+            cur = table_cache_key(catalogs, handle)
+            if cur == tv:
+                continue
+            if cur is not None and cur[0] == tv[0]:
+                return False
+            foreign = True
+        return None if foreign else True
+
+    def get(self, key, catalogs):
+        with self._lock:
+            bucket = self._entries.get(key)
+            if bucket is None:
+                self.stats.misses += 1
+                return None
+            for i in range(len(bucket) - 1, -1, -1):
+                plan, deps = bucket[i]
+                verdict = self._match(deps, catalogs)
+                if verdict is True:
+                    # freshen: candidate to bucket tail, key to LRU end
+                    bucket.append(bucket.pop(i))
+                    self._entries.move_to_end(key)
+                    self.stats.hits += 1
+                    return plan
+                if verdict is False:
+                    del bucket[i]
+                    self.stats.evictions += 1
+            if not bucket:
+                self._entries.pop(key, None)
+            self.stats.misses += 1
+            return None
+
+    def put(self, key, plan, catalogs) -> bool:
+        from presto_tpu.cache.fingerprint import table_cache_key
+        from presto_tpu.planner import nodes as N
+        deps = []
+        stack = [plan]
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            if isinstance(n, N.TableScanNode):
+                tv = table_cache_key(catalogs, n.handle)
+                if tv is None:
+                    return False  # volatile table -> never cache
+                deps.append((n.handle, tv))
+            stack.extend(n.sources())
+        with self._lock:
+            bucket = self._entries.setdefault(key, [])
+            bucket.append((plan, deps))
+            del bucket[:-self.BUCKET_WIDTH]
+            self._entries.move_to_end(key)
+            self.stats.inserts += 1
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.stats.evictions += 1
+        return True
+
+    def invalidate_table(self, triple: Tuple[str, str, str]) -> None:
+        with self._lock:
+            for k in list(self._entries):
+                bucket = self._entries[k]
+                bucket[:] = [
+                    (plan, deps) for plan, deps in bucket
+                    if not any((h.catalog, h.schema, h.table) == triple
+                               for h, _ in deps)]
+                if not bucket:
+                    self._entries.pop(k)
+                    self.stats.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+class CacheManager:
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self.pool = MemoryPool(budget_bytes)
+        lock = threading.Lock()
+        self.plan = PlanCache()
+        self.fragment = ResultCache("cache:fragment", self.pool, lock)
+        # page entries are whole splits (the successor of the tpch
+        # connector's private scan cache, which admitted multi-GB
+        # entries): a looser per-entry cap keeps large-scale warm
+        # scans cacheable without letting one split wipe everything
+        self.page = ResultCache("cache:page", self.pool, lock,
+                                max_entry_fraction=2)
+        self.fragment.peers = [self.page]
+        self.page.peers = [self.fragment]
+
+    def set_budget(self, budget_bytes: Optional[int]) -> None:
+        self.pool.budget = budget_bytes
+        if budget_bytes is not None:
+            # shrink to fit, oldest first, fragment before page
+            for level in (self.fragment, self.page):
+                with level._lock:
+                    while level._entries \
+                            and self.pool.reserved > budget_bytes:
+                        _, ev = level._entries.popitem(last=False)
+                        level._drop_locked(ev)
+                        level.stats.evictions += 1
+
+    def invalidate_table(self, handle) -> None:
+        triple = (handle.catalog, handle.schema, handle.table)
+        self.plan.invalidate_table(triple)
+        self.fragment.invalidate_table(triple)
+        self.page.invalidate_table(triple)
+
+    def clear(self) -> None:
+        self.plan.clear()
+        self.fragment.clear()
+        self.page.clear()
+
+    def snapshot_rows(self) -> List[tuple]:
+        """(level, hits, misses, evictions, entries, bytes) rows for
+        system.runtime.caches."""
+        out = []
+        for name, level in (("plan", self.plan),
+                            ("fragment", self.fragment),
+                            ("page", self.page)):
+            s = level.stats
+            out.append((name, s.hits, s.misses, s.evictions,
+                        len(level), getattr(level, "bytes", 0)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the process-wide instance (reference: FragmentResultCacheManager is
+# per-server; queries of every session share one cache + one budget)
+
+_MANAGER: Optional[CacheManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def get_cache_manager(properties: Optional[Dict[str, Any]] = None,
+                      create: bool = True) -> Optional[CacheManager]:
+    """The singleton, sized from `cache_memory_bytes` at first use. A
+    session that sets the property EXPLICITLY resizes the shared
+    budget (SET SESSION cache_memory_bytes must be effective — the
+    strict-config discipline of session_properties)."""
+    global _MANAGER
+    from presto_tpu.session_properties import get_property
+    with _MANAGER_LOCK:
+        if _MANAGER is None:
+            if not create:
+                return None
+            budget = get_property(dict(properties or {}),
+                                  "cache_memory_bytes")
+            _MANAGER = CacheManager(
+                int(budget) if budget else None)
+        elif properties and "cache_memory_bytes" in properties:
+            want = int(properties["cache_memory_bytes"])
+            if _MANAGER.pool.budget != want:
+                _MANAGER.set_budget(want)
+    return _MANAGER
+
+
+def reset_cache_manager() -> None:
+    """Drop the singleton (tests; releases every cached batch)."""
+    global _MANAGER
+    with _MANAGER_LOCK:
+        if _MANAGER is not None:
+            _MANAGER.clear()
+        _MANAGER = None

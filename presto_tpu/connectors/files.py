@@ -225,6 +225,11 @@ class _FileCatalog:
 
     def __init__(self, root: str):
         self.root = root
+        #: commit generation for the engine cache hierarchy: bumped at
+        #: every evict() (= every in-process write commit), mixed with
+        #: file mtimes into table_version so both in-process rewrites
+        #: and external file swaps change the version
+        self.generation = 0
         self._cache: Dict[str, Tuple[float, _TableView,
                                      Dict[str, tuple]]] = {}
         # string -> code reverse indexes, one entry per path replaced
@@ -240,6 +245,7 @@ class _FileCatalog:
     def evict(self, path: str) -> None:
         """Commit-point invalidation for a rewritten/removed file —
         mtime alone can miss a same-tick rewrite."""
+        self.generation += 1
         self._cache.pop(path, None)
         self._indexes.pop(path, None)
         self._part_cache.pop(path, None)
@@ -438,6 +444,23 @@ class _FileMetadata(ConnectorMetadata):
         return RelationSchema.of(*[
             ColumnSchema(name, typ, dicts.get(name))
             for name, typ in view.columns])
+
+    def table_version(self, handle: TableHandle) -> Optional[int]:
+        try:
+            if self._cat.is_partitioned(handle):
+                # the full (file, mtime) listing signature — part_info
+                # re-walks it on every call anyway, and the sidecar's
+                # mtime alone would miss an externally swapped or
+                # appended part file
+                self._cat.part_info(handle)
+                sig = self._cat._part_cache[
+                    self._cat.table_dir(handle)][0]
+                token: object = sig
+            else:
+                token = os.stat(self._cat.path(handle)).st_mtime_ns
+        except (OSError, KeyError):
+            return None
+        return hash((self._cat.generation, token)) & ((1 << 62) - 1)
 
     def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
         try:

@@ -10,6 +10,7 @@ and joins see one consistent code space.
 
 from __future__ import annotations
 
+import itertools
 import math
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -21,11 +22,21 @@ from presto_tpu.connectors.spi import (
 from presto_tpu.schema import ColumnSchema, RelationSchema
 
 
+#: process-wide version mint: versions must stay MONOTONIC across a
+#: DROP + recreate under one connector cache token — a fresh table
+#: restarting at 0 would revive the dropped table's cache keys
+_VERSION_MINT = itertools.count(1)
+
+
 class _Table:
     def __init__(self, schema: RelationSchema):
         self.schema = schema
         self.batches: List[Batch] = []
         self.row_count = 0
+        #: data version for the engine's cache hierarchy; reassigned
+        #: from the mint at every committed write (spi
+        #: ConnectorMetadata.table_version)
+        self.version = next(_VERSION_MINT)
 
 
 class _MemoryMetadata(ConnectorMetadata):
@@ -44,6 +55,10 @@ class _MemoryMetadata(ConnectorMetadata):
     def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
         t = self._tables.get((handle.schema, handle.table))
         return t.row_count if t is not None else None
+
+    def table_version(self, handle: TableHandle) -> Optional[int]:
+        t = self._tables.get((handle.schema, handle.table))
+        return t.version if t is not None else None
 
 
 class _MemorySplitManager(ConnectorSplitManager):
@@ -143,6 +158,11 @@ class _MemoryPageSink(ConnectorPageSink):
         for b in pending:
             t.batches.append(b)
             t.row_count += b.num_valid()
+        # version moves LAST: a concurrent scan racing this commit may
+        # cache the old contents, but only under the old version —
+        # bumping before the mutation would let pre-commit data be
+        # cached under the post-commit version (permanently stale)
+        t.version = next(_VERSION_MINT)
 
     def abort(self, handle: TableHandle) -> None:
         # the created table (schema registration) survives; only the

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import itertools
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from presto_tpu.batch import Batch
@@ -102,6 +103,15 @@ class ConnectorMetadata(abc.ABC):
         Missing columns fall back to dictionary-derived NDVs."""
         return {}
 
+    def table_version(self, handle: TableHandle) -> Optional[int]:
+        """Monotonic data version of the table, bumped at every commit
+        that changes its contents or schema (INSERT/CTAS/DROP). The
+        engine's cache hierarchy keys plans, fragment results, and
+        scanned pages on (cache token, version) — see presto_tpu/cache.
+        None (the default) marks the table VOLATILE or unversioned:
+        nothing derived from it is ever cached."""
+        return None
+
     def sorted_by(self, handle: TableHandle) -> Optional[List[str]]:
         """Physical sort order of the table's rows, as column names in
         significance order (ascending, nulls last), or None. A declared
@@ -172,8 +182,26 @@ class ConnectorPageSink(abc.ABC):
         raise NotImplementedError
 
 
+#: process-wide mint for per-instance cache tokens (never reused,
+#: unlike id(); a GC'd connector's token must not alias a new one)
+_CACHE_TOKENS = itertools.count()
+
+
 class Connector(abc.ABC):
     name: str
+
+    def cache_token(self) -> Any:
+        """Identity under which this connector's data may be cached
+        across runners. The default is a UNIQUE per-instance token, so
+        two connector instances never share cache entries even when
+        their catalog/schema/table names collide (every LocalRunner
+        builds its own MemoryConnector). Connectors whose data is a
+        pure function of their configuration (tpch/tpcds generators)
+        override this with a stable token to share warmed caches."""
+        t = getattr(self, "_cache_token", None)
+        if t is None:
+            t = self._cache_token = ("conn", next(_CACHE_TOKENS))
+        return t
 
     @property
     @abc.abstractmethod

@@ -124,6 +124,12 @@ class _SqliteMetadata(ConnectorMetadata):
             self._db._dicts[key] = hit
         return hit
 
+    def table_version(self, handle: TableHandle) -> Optional[int]:
+        # the connector-wide commit counter: coarser than per-table
+        # (any commit bumps every table) but always safe — a cached
+        # entry can only go unreachable too early, never stale
+        return self._db.version
+
     def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
         key = (self._db.version, handle.table)
         hit = self._db._counts.get(key)
@@ -283,7 +289,14 @@ class _SqlitePageSink(ConnectorPageSink):
                 per_col.append([dic[int(v)] if k else None
                                 for v, k in zip(d, m)])
             elif cs.type.is_string:
-                per_col.append([None] * int(rv.sum()))
+                # a dictionary-less varchar batch has codes but no
+                # strings to decode them with — writing would store
+                # NULL for every row (silent data loss on CTAS/INSERT)
+                from presto_tpu.runner.local import QueryError
+                raise QueryError(
+                    f"cannot write varchar column {cs.name!r} to "
+                    f"sqlite table {handle.table!r}: the value batch "
+                    "carries no dictionary to decode its codes")
             else:
                 py = d.tolist()
                 per_col.append([v if k else None

@@ -36,6 +36,9 @@ _TABLES: Dict[str, List] = {
     "runtime.queries": [("query_id", BIGINT), ("state", VARCHAR),
                         ("query", VARCHAR), ("output_rows", BIGINT),
                         ("elapsed_ms", DOUBLE)],
+    "runtime.caches": [("level", VARCHAR), ("hits", BIGINT),
+                       ("misses", BIGINT), ("evictions", BIGINT),
+                       ("entries", BIGINT), ("bytes", BIGINT)],
     "metadata.catalogs": [("catalog_name", VARCHAR)],
     "metadata.tables": [("table_catalog", VARCHAR),
                         ("table_schema", VARCHAR),
@@ -169,6 +172,16 @@ def runner_system_connector(runner) -> SystemConnector:
     def catalogs():
         return [(c,) for c in runner.catalogs.catalogs()]
 
+    def caches():
+        # the process-wide cache hierarchy's live counters; stable
+        # zeroed rows when no manager exists yet (caches never used)
+        from presto_tpu.cache import get_cache_manager
+        mgr = get_cache_manager(create=False)
+        if mgr is None:
+            return [(level, 0, 0, 0, 0, 0)
+                    for level in ("plan", "fragment", "page")]
+        return mgr.snapshot_rows()
+
     def tables():
         out = []
         for cat in runner.catalogs.catalogs():
@@ -189,6 +202,7 @@ def runner_system_connector(runner) -> SystemConnector:
     return SystemConnector({
         "runtime.nodes": nodes,
         "runtime.queries": queries,
+        "runtime.caches": caches,
         "metadata.catalogs": catalogs,
         "metadata.tables": tables,
     })

@@ -1226,6 +1226,9 @@ class _TpcdsMetadata(ConnectorMetadata):
     def estimate_row_count(self, handle: TableHandle) -> int:
         return self._gens[handle.schema].rows(handle.table)
 
+    def table_version(self, handle: TableHandle) -> int:
+        return 0  # generated data: immutable by construction
+
     def column_stats(self, handle: TableHandle):
         """Stats derived from the generation spec itself: fk columns
         have the target table's cardinality, numeric columns their
@@ -1289,33 +1292,17 @@ class _TpcdsSplitManager(ConnectorSplitManager):
 
 
 class _TpcdsPageSource(ConnectorPageSource):
-    """Same cached-generation design as the tpch page source (immutable
-    deterministic data -> device batches cached per split+columns)."""
-
-    _CACHE_BYTES_MAX = 2 << 30
+    """Immutable deterministic data (table_version 0, stable cache
+    token) — repeat scans are served by the engine's page-source cache
+    (presto_tpu/cache), which replaced the private per-connector LRU
+    this class used to carry (same move as the tpch page source)."""
 
     def __init__(self, gens: Dict[str, TpcdsGenerator]):
         self._gens = gens
-        self._cache: "collections.OrderedDict[tuple, List[Batch]]" = \
-            collections.OrderedDict()
-        self._cache_bytes = 0
-
-    @staticmethod
-    def _batch_bytes(b: Batch) -> int:
-        return sum(c.data.nbytes + c.mask.nbytes
-                   for c in b.columns.values()) + b.row_valid.nbytes
 
     def batches(self, split: Split, columns: Sequence[str],
                 batch_rows: int,
                 constraint=None) -> Iterator[Batch]:
-        key = (split.table.schema, split.table.table, split.info,
-               tuple(columns), batch_rows, constraint)
-        cached = self._cache.get(key)
-        if cached is not None:
-            self._cache.move_to_end(key)
-            yield from cached
-            return
-        out: List[Batch] = []
         gen = self._gens[split.table.schema]
         schema = gen.schema(split.table.table)
         lo, hi = split.info
@@ -1341,18 +1328,8 @@ class _TpcdsPageSource(ConnectorPageSource):
             dicts = {c: schema.column(c).dictionary for c in columns
                      if schema.column(c).dictionary is not None}
             bmasks = {c: masks[c] for c in columns if c in masks}
-            batch = Batch.from_numpy(arrays, types, masks=bmasks,
-                                     dictionaries=dicts)
-            out.append(batch)
-            yield batch
-        total = sum(self._batch_bytes(b) for b in out)
-        if total <= self._CACHE_BYTES_MAX and key not in self._cache:
-            while self._cache_bytes + total > self._CACHE_BYTES_MAX:
-                _, ev = self._cache.popitem(last=False)
-                self._cache_bytes -= sum(self._batch_bytes(b)
-                                         for b in ev)
-            self._cache[key] = out
-            self._cache_bytes += total
+            yield Batch.from_numpy(arrays, types, masks=bmasks,
+                                   dictionaries=dicts)
 
 
 class TpcdsConnector(Connector):
@@ -1363,6 +1340,9 @@ class TpcdsConnector(Connector):
     SCHEMAS = {"tiny": 0.001, "sf0_01": 0.01, "sf0_1": 0.1,
                "sf1": 1.0, "sf10": 10.0, "sf100": 100.0,
                "sf1000": 1000.0}
+
+    def cache_token(self):
+        return "tpcds:static"  # deterministic generators — shareable
 
     def __init__(self):
         self._gens = {s: TpcdsGenerator(sf)

@@ -17,7 +17,6 @@ factor (strings live host-side by design — see batch.py).
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import math
 import zlib
@@ -498,6 +497,9 @@ class _TpchMetadata(ConnectorMetadata):
             return gen.rows("orders") * 4  # ~4 lines per order
         return gen.rows(handle.table)
 
+    def table_version(self, handle: TableHandle) -> int:
+        return 0  # generated data: immutable by construction
+
     def sorted_by(self, handle: TableHandle):
         """The generator emits rows in primary-key order and split
         ranges ascend, so scans are physically key-sorted — declared
@@ -609,37 +611,18 @@ class _TpchSplitManager(ConnectorSplitManager):
 
 
 class _TpchPageSource(ConnectorPageSource):
-    """tpch data is deterministic and immutable, so generated device
-    batches are cached per (schema, table, range, columns, batch_rows):
-    repeat scans of the same split skip host generation AND the
-    host->device transfer — the analog of the reference's worker-side
-    FragmentResultCacheManager keyed by canonical plan + split
-    (operator/FileFragmentResultCacheManager.java)."""
-
-    _CACHE_BYTES_MAX = 4 << 30  # well under half of one chip's HBM
+    """tpch data is deterministic and immutable (table_version 0, a
+    STABLE connector cache token), so repeat scans are served by the
+    engine's page-source cache (presto_tpu/cache) — which replaced the
+    private per-connector LRU this class used to carry: one shared
+    byte budget, one stats surface, one invalidation protocol."""
 
     def __init__(self, gens: Dict[str, TpchGenerator]):
         self._gens = gens
-        # LRU: ordered dict, most-recently-used last, evicted front-first
-        self._cache: "collections.OrderedDict[tuple, List[Batch]]" = \
-            collections.OrderedDict()
-        self._cache_bytes = 0
-
-    def _batch_bytes(self, b: Batch) -> int:
-        return sum(c.data.nbytes + c.mask.nbytes
-                   for c in b.columns.values()) + b.row_valid.nbytes
 
     def batches(self, split: Split, columns: Sequence[str],
                 batch_rows: int,
                 constraint=None) -> Iterator[Batch]:
-        key = (split.table.schema, split.table.table, split.info,
-               tuple(columns), batch_rows, constraint)
-        cached = self._cache.get(key)
-        if cached is not None:
-            self._cache.move_to_end(key)
-            yield from cached
-            return
-        out: List[Batch] = []
         gen = self._gens[split.table.schema]
         schema = gen.schema(split.table.table)
         lo, hi = split.info
@@ -669,19 +652,7 @@ class _TpchPageSource(ConnectorPageSource):
             types = {c: schema.column(c).type for c in columns}
             dicts = {c: schema.column(c).dictionary for c in columns
                      if schema.column(c).dictionary is not None}
-            batch = Batch.from_numpy(arrays, types, dictionaries=dicts)
-            out.append(batch)
-            yield batch
-        total = sum(self._batch_bytes(b) for b in out)
-        if total <= self._CACHE_BYTES_MAX and key not in self._cache:
-            # (interleaved scans of the same split can both reach here;
-            # only count the key once)
-            while self._cache_bytes + total > self._CACHE_BYTES_MAX:
-                _, evicted = self._cache.popitem(last=False)
-                self._cache_bytes -= sum(self._batch_bytes(b)
-                                         for b in evicted)
-            self._cache[key] = out
-            self._cache_bytes += total
+            yield Batch.from_numpy(arrays, types, dictionaries=dicts)
 
 
 class TpchConnector(Connector):
@@ -691,6 +662,11 @@ class TpchConnector(Connector):
 
     SCHEMAS = {"tiny": 0.001, "sf0_01": 0.01, "sf0_1": 0.1, "sf1": 1.0,
                "sf10": 10.0, "sf100": 100.0}
+
+    def cache_token(self):
+        # every instance generates identical data (counter-based
+        # Philox streams) — share cache entries across runners
+        return "tpch:static"
 
     def __init__(self):
         self._gens = {s: TpchGenerator(sf) for s, sf in

@@ -39,6 +39,10 @@ class OperatorStats:
     #: operator-state spill (memory revocation) counters
     spilled_batches: int = 0
     spilled_bytes: int = 0
+    #: cache-hierarchy counters (page-source hits/misses on scans,
+    #: fragment replays/recordings) — rendered by EXPLAIN ANALYZE
+    cache_hits: int = 0
+    cache_misses: int = 0
     input_rows_dev: Any = None
     output_rows_dev: Any = None
 

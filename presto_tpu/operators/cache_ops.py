@@ -1,0 +1,134 @@
+"""Fragment-result cache operators (reference: presto-main's
+FragmentResultCacheManager wired through ScanFilterAndProjectOperator —
+on hit the driver serves stored pages, on miss it tees the fragment's
+output into the cache).
+
+Two factories, inserted by the LocalExecutionPlanner around a
+deterministic leaf fragment's operator chain:
+
+  FragmentReplayOperatorFactory  — cache HIT: a source operator that
+      replays the stored batches; the whole fragment sub-pipeline
+      (scan included) is never built.
+  FragmentRecordOperatorFactory  — cache MISS: a pass-through tee that
+      accumulates the fragment's output and commits it at close().
+
+Commit happens at close() and only after a NATURAL finish: the driver
+closes operators only after the drive loop's deferred overflow checks
+pass, and finish() only propagates to the recorder when its upstream
+drained completely — so a query killed by a deferred
+GroupLimitExceeded, or a downstream LIMIT that stopped pulling
+mid-fragment, never commits a truncated or poisoned recording."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from presto_tpu.batch import Batch
+from presto_tpu.operators.base import (
+    DriverContext, Operator, OperatorContext, OperatorFactory,
+)
+
+
+class FragmentReplayOperator(Operator):
+    def __init__(self, ctx: OperatorContext, batches: List[Batch]):
+        super().__init__(ctx)
+        self._batches = batches  # owned by the cache — never mutate
+        self._pos = 0
+        ctx.stats.cache_hits = 1
+
+    def needs_input(self) -> bool:
+        return False
+
+    def add_input(self, batch: Batch) -> None:
+        raise RuntimeError("fragment_replay takes no input")
+
+    def get_output(self) -> Optional[Batch]:
+        if self._pos < len(self._batches):
+            b = self._batches[self._pos]
+            self._pos += 1
+            return self._count_out(b)
+        return None
+
+    def finish(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        return self._pos >= len(self._batches)
+
+
+class FragmentReplayOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, batches: List[Batch]):
+        super().__init__(operator_id, "fragment_replay")
+        self._batches = batches
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return FragmentReplayOperator(
+            OperatorContext(self.operator_id, self.name,
+                            driver_context),
+            self._batches)
+
+
+class FragmentRecordOperator(Operator):
+    def __init__(self, ctx: OperatorContext, cache, key, deps):
+        super().__init__(ctx)
+        self._cache = cache
+        self._key = key
+        self._deps = deps
+        self._recorded: Optional[List[Batch]] = []
+        self._recorded_bytes = 0
+        #: same per-entry cap the cache enforces at put(): once the
+        #: recording exceeds it, stop pinning batches — put() would
+        #: reject the oversized entry anyway, and holding every output
+        #: batch of a huge fragment doubles the query's peak memory
+        self._cap = cache.entry_byte_cap()
+        self._pending: Optional[Batch] = None
+        self._finishing = False
+        self._committed = False
+        ctx.stats.cache_misses = 1
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        if self._recorded is not None:
+            from presto_tpu.execution.memory import batch_bytes
+            self._recorded_bytes += batch_bytes(batch)
+            if self._cap is not None \
+                    and self._recorded_bytes > self._cap:
+                self._recorded = None  # too big — pass through only
+            else:
+                self._recorded.append(batch)
+        self._pending = batch
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return self._count_out(out)
+
+    def finish(self) -> None:
+        # only reached when the upstream fragment drained completely
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+    def close(self) -> None:
+        if self._finishing and self._pending is None \
+                and not self._committed and self._recorded is not None:
+            self._committed = True
+            self._cache.put(self._key, self._recorded, self._deps)
+        self._recorded = []
+
+
+class FragmentRecordOperatorFactory(OperatorFactory):
+    def __init__(self, operator_id: int, cache, key, deps):
+        super().__init__(operator_id, "fragment_record")
+        self._cache = cache
+        self._key = key
+        self._deps = deps
+
+    def create(self, driver_context: DriverContext) -> Operator:
+        return FragmentRecordOperator(
+            OperatorContext(self.operator_id, self.name,
+                            driver_context),
+            self._cache, self._key, self._deps)

@@ -77,10 +77,14 @@ class TableScanOperator(SourceOperator):
     this scan is ever pulled (see execution/dynamic_filters.py)."""
 
     def __init__(self, ctx: OperatorContext,
-                 batch_iter: Iterator[Batch], df_specs=None):
+                 batch_iter: Iterator[Batch], df_specs=None,
+                 cache_box=None):
         super().__init__(ctx)
         self._iter = batch_iter
         self._df_specs = df_specs or []
+        #: {"hits": n, "misses": n} shared with the page-source-cache
+        #: wrapper around the split loop (planner batch_iter closure)
+        self._cache_box = cache_box
         self._finished = False
 
     def get_output(self) -> Optional[Batch]:
@@ -91,6 +95,10 @@ class TableScanOperator(SourceOperator):
         except StopIteration:
             self._finished = True
             return None
+        finally:
+            if self._cache_box is not None:
+                self.ctx.stats.cache_hits = self._cache_box["hits"]
+                self.ctx.stats.cache_misses = self._cache_box["misses"]
         for col, df_id, reg in self._df_specs:
             f = reg.get(df_id)
             if f is not None:
@@ -110,15 +118,16 @@ class TableScanOperator(SourceOperator):
 class TableScanOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, name: str,
                  batch_iter_factory: Callable[[], Iterator[Batch]],
-                 df_specs=None):
+                 df_specs=None, cache_box=None):
         super().__init__(operator_id, name)
         self._factory = batch_iter_factory
         self._df_specs = df_specs
+        self._cache_box = cache_box
 
     def create(self, driver_context: DriverContext) -> Operator:
         return TableScanOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
-            self._factory(), self._df_specs)
+            self._factory(), self._df_specs, self._cache_box)
 
 
 #: jit-kernel LRU cache keyed by the (hashable) expression IR so re-running

@@ -149,6 +149,9 @@ class LocalExecutionPlanner:
         )
         self._df_registry = DynamicFilterRegistry()
         self._df_scans: Dict[int, List] = {}
+        #: planning inside a recorded fragment: nested eligible
+        #: subtrees must not wrap again (the outermost wins)
+        self._in_fragment = False
 
     def _next_id(self) -> int:
         self._op_id += 1
@@ -229,7 +232,65 @@ class LocalExecutionPlanner:
         if m is None:
             raise LocalPlanningError(
                 f"no local planning for {type(node).__name__}")
-        m(node, pipe)
+        probe = self._fragment_cache_probe(node)
+        if probe is None:
+            m(node, pipe)
+            return
+        cache, key, deps = probe
+        hit = cache.get(key)
+        if hit is not None:
+            from presto_tpu.operators.cache_ops import (
+                FragmentReplayOperatorFactory,
+            )
+            pipe.append(FragmentReplayOperatorFactory(
+                self._next_id(), hit))
+            return
+        from presto_tpu.operators.cache_ops import (
+            FragmentRecordOperatorFactory,
+        )
+        self._in_fragment = True
+        try:
+            m(node, pipe)
+        finally:
+            self._in_fragment = False
+        pipe.append(FragmentRecordOperatorFactory(
+            self._next_id(), cache, key, deps))
+
+    def _fragment_cache_probe(self, node: N.PlanNode):
+        """(cache, key, deps) when `node` roots a cacheable leaf
+        fragment for THIS task, else None. Local single-task plans
+        only: mesh/worker tasks slice splits per task and route
+        through exchanges — their partial outputs are not a fragment's
+        canonical result."""
+        if self._in_fragment or self.task.count != 1 \
+                or self.task.device is not None or self.task.exchanges \
+                or self.task.df_service is not None:
+            return None
+        if not bool(get_property(self.session.properties,
+                                 "fragment_result_cache_enabled")):
+            return None
+        from presto_tpu.cache import (
+            fragment_fingerprint, get_cache_manager,
+        )
+        fp = fragment_fingerprint(
+            node, self.catalogs, frozenset(self._shared),
+            frozenset(self._df_scans))
+        if fp is None:
+            return None
+        key, deps, _scans = fp
+        # session properties are part of the key: several change the
+        # fragment's OUTPUT beyond its plan shape (streaming vs hash
+        # aggregation emit different row orders, max_groups changes
+        # packing, array_agg_width changes value forms) — replaying
+        # across property changes would not be byte-identical
+        from presto_tpu.session_properties import effective
+        props = tuple(sorted(
+            (k, v) for k, v in effective(
+                self.session.properties).items()
+            if isinstance(v, (int, float, str, bool, type(None)))))
+        mgr = get_cache_manager(self.session.properties)
+        triples = [(h.catalog, h.schema, h.table) for h, _ in deps]
+        return mgr.fragment, (key, props), triples
 
     def _visit_TableScanNode(self, node: N.TableScanNode, pipe: List):
         conn = self.catalogs.connector(node.handle.catalog)
@@ -244,21 +305,76 @@ class LocalExecutionPlanner:
         task = self.task
         constraint = node.constraint
 
+        # page-source cache (presto_tpu/cache level 3): raw connector
+        # output per (table version, split, columns, constraint),
+        # cached BEFORE the per-query rename and device placement so
+        # every query shape can share the entry
+        page_cache = None
+        tv = None
+        cache_box = {"hits": 0, "misses": 0}
+        if bool(get_property(self.session.properties,
+                             "page_source_cache_enabled")):
+            from presto_tpu.cache import (
+                get_cache_manager, table_cache_key,
+            )
+            tv = table_cache_key(self.catalogs, handle)
+            if tv is not None:
+                page_cache = get_cache_manager(
+                    self.session.properties).page
+
         def batch_iter():
             import jax as _jax
+            from presto_tpu.cache import split_token
+            from presto_tpu.execution.memory import batch_bytes
             splits = conn.split_manager.get_splits(
                 handle, max(target_splits, task.count), constraint)
             if task.count > 1:
                 # round-robin split assignment to this fragment's tasks
                 # (reference: NodeScheduler.java:65 split placement)
                 splits = splits[task.index::task.count]
+            dep = [(handle.catalog, handle.schema, handle.table)]
+            entry_cap = page_cache.entry_byte_cap() \
+                if page_cache is not None else None
             for s in splits:
-                for b in conn.page_source.batches(s, columns, batch_rows,
-                                                  constraint):
-                    b = b.rename(rename)
+                key = None
+                if page_cache is not None:
+                    try:
+                        key = ("page", tv, handle.catalog,
+                               handle.schema, handle.table,
+                               split_token(s), tuple(columns),
+                               batch_rows, constraint)
+                        hash(key)
+                    except TypeError:
+                        key = None  # unhashable constraint payload
+                raw = page_cache.get(key) \
+                    if key is not None else None
+                if raw is not None:
+                    cache_box["hits"] += 1
+                    acc = None
+                else:
+                    if key is not None:
+                        cache_box["misses"] += 1
+                    raw = conn.page_source.batches(
+                        s, columns, batch_rows, constraint)
+                    acc = [] if key is not None else None
+                acc_bytes = 0
+                for b in raw:
+                    if acc is not None:
+                        acc_bytes += batch_bytes(b)
+                        if entry_cap is not None \
+                                and acc_bytes > entry_cap:
+                            acc = None  # too big — stream uncached
+                        else:
+                            acc.append(b)
+                    out = b.rename(rename)
                     if task.device is not None:
-                        b = _jax.device_put(b, task.device)
-                    yield b
+                        out = _jax.device_put(out, task.device)
+                    yield out
+                else:
+                    # natural exhaustion only: an abandoned iterator
+                    # (downstream LIMIT) must not commit a partial split
+                    if acc is not None:
+                        page_cache.put(key, acc, dep)
         df_specs = list(self._df_scans.get(id(node), []))
         if self.task.df_service is not None \
                 and self.task.cross_df is not None:
@@ -268,7 +384,8 @@ class LocalExecutionPlanner:
                 in self.task.cross_df.scans.get(id(node), [])]
         pipe.append(TableScanOperatorFactory(
             self._next_id(), f"scan:{handle.table}", batch_iter,
-            df_specs=df_specs or None))
+            df_specs=df_specs or None,
+            cache_box=cache_box if page_cache is not None else None))
 
     def _visit_RemoteSourceNode(self, node, pipe: List):
         from presto_tpu.operators.exchange_ops import (

@@ -35,6 +35,9 @@ _PLUGIN_REGISTRY_CACHE: Dict[str, Any] = {}
 import itertools as _itertools
 import threading as _threading
 _PLUGIN_CACHE_LOCK = _threading.Lock()
+#: unhashable access-control objects referenced by plan-cache keys via
+#: id() — pinned so a GC'd policy's address cannot alias a new one
+_AC_KEY_PINS: List[Any] = []
 
 
 @dataclasses.dataclass
@@ -312,6 +315,7 @@ class LocalRunner:
         self.query_history: List[Dict[str, Any]] = []
         self.catalogs.register("system", runner_system_connector(self))
         self._session_tl = _threading.local()
+        self._query_id_mint = _itertools.count()
         self.session = Session(catalog, schema, dict(properties or {}),
                                user=user)
         self.catalogs.access_control = access_control
@@ -422,8 +426,121 @@ class LocalRunner:
         finally:
             self._session_tl.override = None
 
+    def execute_as(self, sql: str, user: str) -> MaterializedResult:
+        """Execute with a per-request identity (the single-node
+        coordinator's path: many users share one runner). The user
+        rides the THREAD-LOCAL session override, so analysis-time
+        access checks — and the plan-cache key, which includes the
+        user — see the caller, not the runner's default identity."""
+        if user == getattr(self._session, "user", ""):
+            return self.execute(sql)
+        self._session_tl.override = dataclasses.replace(
+            self._session, user=user)
+        try:
+            return self.execute(sql)
+        finally:
+            self._session_tl.override = None
+
     def execute(self, sql: str) -> MaterializedResult:
+        pc = self._plan_cache()
+        if pc is not None:
+            from presto_tpu.cache import normalize_sql
+            key = ("sql", normalize_sql(sql),
+                   self._session_cache_key())
+            if pc.contains(key):
+                # a repeat statement: skip the parser entirely — the
+                # key can only have been inserted by a T.Query path
+                return self._run_query_statement(None, sql)
         return self._execute_stmt(parse_statement(sql), sql)
+
+    # -- plan cache (presto_tpu/cache level 1) -------------------------
+
+    def _plan_cache(self):
+        from presto_tpu.session_properties import get_property
+        if not bool(get_property(self.session.properties,
+                                 "plan_cache_enabled")):
+            return None
+        from presto_tpu.cache import get_cache_manager
+        return get_cache_manager(self.session.properties).plan
+
+    def _session_cache_key(self):
+        """Everything session-side a plan may depend on: catalog +
+        schema defaults (name resolution), user AND the access-control
+        instance (checks run at analysis — a cached plan skips them,
+        so two runners with different policies must never share
+        entries), and the full effective property set (analysis and
+        optimization both read properties)."""
+        from presto_tpu.session_properties import effective
+        s = self.session
+        props = tuple(sorted(
+            (k, v) for k, v in effective(s.properties).items()
+            if isinstance(v, (int, float, str, bool, type(None)))))
+        ac = self.catalogs.access_control
+        if ac is not None:
+            try:
+                hash(ac)  # held in the key: no GC-reuse aliasing
+            except TypeError:
+                # unhashable policy: key on its id, and PIN the object
+                # so the address can never be recycled by a different
+                # policy while cached plans reference it
+                if not any(x is ac for x in _AC_KEY_PINS):
+                    _AC_KEY_PINS.append(ac)
+                ac = ("ac-id", id(ac))
+        return (s.catalog, s.schema, getattr(s, "user", ""), ac,
+                props)
+
+    def _plan_query(self, stmt: Optional[T.Node], sql: str,
+                    cache_text: Optional[str] = None) -> N.OutputNode:
+        """SELECT text/AST -> OPTIMIZED plan, through the process-wide
+        plan cache. Looked up fresh on every (re)execution so the
+        width-retry loop — which bumps a session property and thereby
+        changes the key — re-plans instead of replaying a stale plan."""
+        pc = self._plan_cache()
+        key = None
+        if pc is not None:
+            from presto_tpu.cache import normalize_sql
+            key = ("sql", cache_text or normalize_sql(sql),
+                   self._session_cache_key())
+            plan = pc.get(key, self.catalogs)
+            if plan is not None:
+                return plan
+        if stmt is None:
+            stmt = parse_statement(sql)
+        if not isinstance(stmt, T.Query):
+            raise QueryError(
+                f"unsupported statement {type(stmt).__name__}")
+        try:
+            plan = plan_statement(stmt, self.catalogs, self.session)
+        except AnalysisError as e:
+            raise QueryError(str(e)) from e
+        from presto_tpu.planner.optimizer import optimize
+        plan = optimize(plan, self.catalogs)
+        if key is not None:
+            # prune BEFORE publishing: every later execution's
+            # planner re-prunes the shared graph, and pruning an
+            # already-pruned plan writes values equal to what is
+            # there — so concurrent consumers only ever race on
+            # identical-value writes, never on the wide->narrow
+            # first transition
+            from presto_tpu.planner.local_planner import (
+                prune_unused_columns,
+            )
+            prune_unused_columns(plan)
+            pc.put(key, plan, self.catalogs)
+        return plan
+
+    def _invalidate_caches(self, parts: Tuple[str, ...]) -> None:
+        """Eager cross-level invalidation at a DDL/DML commit point
+        (version bumps already make stale entries unreachable; this
+        frees their memory immediately)."""
+        from presto_tpu.cache import get_cache_manager
+        mgr = get_cache_manager(create=False)
+        if mgr is None:
+            return
+        try:
+            mgr.invalidate_table(self._handle_for(parts))
+        except Exception:  # noqa: BLE001 — invalid names etc.
+            pass
 
     # -- prepared statements (reference: PREPARE/EXECUTE/DEALLOCATE +
     # DESCRIBE INPUT/OUTPUT, sql/tree/Prepare.java; the reference
@@ -457,6 +574,16 @@ class LocalRunner:
                     f"EXECUTE {stmt.name}: statement has {need} "
                     f"parameters, USING supplied {len(stmt.using)}")
             bound = _substitute_params(prepared, stmt.using)
+            if isinstance(bound, T.Query):
+                # content-addressed plan-cache key: prepared name +
+                # the bound AST (statement body AND argument values),
+                # so re-PREPAREs under the same name can never collide
+                import hashlib
+                digest = hashlib.blake2b(
+                    repr(bound).encode(), digest_size=16).hexdigest()
+                return self._run_query_statement(
+                    bound, sql,
+                    cache_text=f"prep:{stmt.name}:{digest}")
             return self._execute_stmt(bound, sql)
         if isinstance(stmt, T.DescribeInput):
             prepared = self._prepared_registry().get(stmt.name)
@@ -507,35 +634,52 @@ class LocalRunner:
             self.session.properties.pop(stmt.name, None)
             return self._text_result("result", ["RESET SESSION"])
         if isinstance(stmt, T.CreateTableAs):
-            return self._with_width_retry(
-                lambda: self._create_table_as(stmt))
+            try:
+                return self._with_width_retry(
+                    lambda: self._create_table_as(stmt))
+            finally:
+                self._invalidate_caches(stmt.name)
         if isinstance(stmt, T.InsertInto):
-            return self._with_width_retry(
-                lambda: self._insert_into(stmt))
+            try:
+                return self._with_width_retry(
+                    lambda: self._insert_into(stmt))
+            finally:
+                self._invalidate_caches(stmt.name)
         if isinstance(stmt, T.DropTable):
-            return self._drop_table(stmt)
+            try:
+                return self._drop_table(stmt)
+            finally:
+                self._invalidate_caches(stmt.name)
         if not isinstance(stmt, T.Query):
             raise QueryError(
                 f"unsupported statement {type(stmt).__name__}")
+        return self._run_query_statement(stmt, sql)
+
+    def _run_query_statement(self, stmt: Optional[T.Node], sql: str,
+                             cache_text: Optional[str] = None
+                             ) -> MaterializedResult:
+        """Run a SELECT (parsed or cache-resolvable) with history
+        bookkeeping. `stmt` None = the caller verified a plan-cache
+        entry exists for this text (parse is skipped; a lost race
+        re-parses inside _plan_query)."""
         import time as _time
-        self._query_seq = getattr(self, "_query_seq", -1) + 1
-        entry = {"id": self._query_seq, "sql": sql.strip(),
+        # itertools.count.__next__ is atomic under the GIL — the
+        # single-node coordinator drives one shared runner from many
+        # client threads, and a read-modify-write here would mint
+        # duplicate query ids
+        entry = {"id": next(self._query_id_mint), "sql": sql.strip(),
                  "state": "RUNNING", "rows": 0, "elapsed_ms": 0.0}
         self.query_history.append(entry)
         del self.query_history[:-1000]  # bounded history
         t0 = _time.perf_counter()
         try:
             def plan_and_run():
-                try:
-                    plan = plan_statement(stmt, self.catalogs,
-                                          self.session)
-                except AnalysisError as e:
-                    raise QueryError(str(e)) from e
-                from presto_tpu.planner.optimizer import optimize
-                return self._run_plan(optimize(plan, self.catalogs))
-            # array_agg width overflow must RE-PLAN (the width is
-            # baked into the plan's value forms), unlike the
-            # operator-level overflow retries inside _run_plan
+                # array_agg width overflow must RE-PLAN (the width is
+                # baked into the plan's value forms) — _plan_query
+                # re-keys on the bumped session property, so the retry
+                # misses the cache and rebuilds the plan
+                return self._run_plan(
+                    self._plan_query(stmt, sql, cache_text))
             result = self._with_width_retry(plan_and_run)
             entry["state"] = "FINISHED"
             # row count resolves lazily when system.runtime.queries is
@@ -925,13 +1069,16 @@ class LocalRunner:
                 spill_s = (f"  spilled: {s.spilled_batches} batches/"
                            f"{s.spilled_bytes / 1e6:.1f}MB"
                            if s.spilled_batches else "")
+                cache_s = (f"  cache: {s.cache_hits} hits/"
+                           f"{s.cache_misses} misses"
+                           if s.cache_hits or s.cache_misses else "")
                 lines.append(
                     f"  {name} [id={op_id}]  "
                     f"rows: {s.input_rows:,} -> {s.output_rows:,}  "
                     f"batches: {s.input_batches} -> "
                     f"{s.output_batches}  "
                     f"busy: {s.busy_seconds * 1e3:.1f}ms{mem_s}"
-                    f"{spill_s}")
+                    f"{spill_s}{cache_s}")
         lines.append(f"wall: {wall * 1e3:.1f}ms, "
                      f"operator busy sum: {busy_total * 1e3:.1f}ms")
         if pool is not None and pool.peak:

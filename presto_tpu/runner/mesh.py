@@ -46,6 +46,15 @@ class MeshRunner(LocalRunner):
 
     # ------------------------------------------------------------------
 
+    def _plan_cache(self):
+        """Mesh plans are NOT plan-cache eligible: add_exchanges and
+        the fragmenter mutate the plan tree in place, so a shared
+        cached plan would be poisoned for every other consumer (and
+        re-exchanging an exchanged plan is not idempotent). The mesh
+        path keeps the page-source cache only; serving-path reuse is
+        the single-node coordinator's job."""
+        return None
+
     def _run_plan(self, plan: N.OutputNode,
                   profile: bool = False,
                   on_retry=None) -> MaterializedResult:

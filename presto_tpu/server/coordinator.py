@@ -92,12 +92,22 @@ class Coordinator(Node):
                  max_concurrent_queries: int = 4,
                  max_queued_queries: int = 100,
                  resource_groups=None, selectors=None,
-                 access_control=None):
+                 access_control=None, single_node: bool = False):
         from presto_tpu.execution.resource_groups import (
             GroupSpec, ResourceGroupManager,
         )
         super().__init__(host, port)
         self.worker_urls = list(worker_urls)
+        #: single-node serving mode: no workers — every query runs on
+        #: ONE shared in-process LocalRunner behind the same HTTP
+        #: client protocol + resource-group admission. This is the
+        #: serving-bench topology: the shared runner is what lets the
+        #: plan/fragment/page cache hierarchy serve repeat traffic
+        #: (a per-query runner would still warm the process-wide
+        #: caches, but session state like PREPARE would not stick).
+        self.single_node = single_node
+        self._embedded_runner = None
+        self._embedded_lock = threading.Lock()
         self.catalog = catalog
         self.schema = schema
         self.properties = dict(properties or {})
@@ -391,6 +401,14 @@ th{{background:#222}}
         `on_columns` fires once the output schema is known (before any
         result rows exist — the client protocol's early-columns)."""
         from presto_tpu.session_properties import get_property
+        if self.single_node:
+            runner = self._runner()
+            result = runner.execute_as(sql, user)
+            if on_columns is not None:
+                on_columns([
+                    {"name": n, "type": f.type.display()}
+                    for n, f in zip(result.names, result.fields)])
+            return result
         retries = int(get_property(self.properties,
                                    "query_retries"))
         workers = list(self.worker_urls)
@@ -433,6 +451,18 @@ th{{background:#222}}
                     raise
                 workers = alive
                 continue
+
+    def _runner(self):
+        """The shared single-node runner (lazy; LocalRunner.execute is
+        concurrency-safe — per-query pools, thread-local session
+        overrides)."""
+        with self._embedded_lock:
+            if self._embedded_runner is None:
+                from presto_tpu.runner.local import LocalRunner
+                self._embedded_runner = LocalRunner(
+                    self.catalog, self.schema, dict(self.properties),
+                    access_control=self.access_control)
+            return self._embedded_runner
 
     def _worker_devices(self, worker_urls: List[str]) -> List[int]:
         """Per-worker device counts (mesh-per-worker: a worker's tasks

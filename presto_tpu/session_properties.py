@@ -132,6 +132,29 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "target_splits", "bigint", 4,
         "Scan splits requested per table (parallel scan fan-out; "
         "reference: initial-splits-per-node)", _positive),
+    PropertyDef(
+        "plan_cache_enabled", "boolean", True,
+        "Serve repeat statements from the process-wide logical-plan "
+        "cache (normalized SQL + session fingerprint + table versions "
+        "-> optimized plan), skipping parse/analyze/optimize "
+        "(reference: the metadata/plan reuse of the Presto papers)"),
+    PropertyDef(
+        "fragment_result_cache_enabled", "boolean", True,
+        "Serve deterministic leaf plan fragments (scan/filter/project/"
+        "aggregation chains) from cached output batches, keyed on a "
+        "canonical fragment fingerprint + table versions (reference: "
+        "FragmentResultCacheManager)"),
+    PropertyDef(
+        "page_source_cache_enabled", "boolean", True,
+        "Cache connector scan output per (table version, split, "
+        "columns, constraint) so repeat scans skip the read/generate "
+        "+ decode path (reference: the hive connector's data cache)"),
+    PropertyDef(
+        "cache_memory_bytes", "bigint", 4 << 30,
+        "Shared byte budget of the fragment-result + page-source "
+        "caches, charged to the cache manager's tagged MemoryPool; "
+        "LRU entries evict when a new insert would exceed it",
+        _positive),
 ]}
 
 

@@ -792,7 +792,12 @@ def write_table(path: str, columns: Sequence[Tuple[str, int]],
                 stream_msgs.append(sm)
                 stripe_data += framed
             e = _PBWriter()
-            e.uint(1, E_DIRECT_V2)
+            # per the ORC spec, only integer/string/date columns carry
+            # RLEv2 DIRECT_V2; double/float/boolean/byte streams are
+            # not run-length-v2 encoded and must declare plain DIRECT
+            e.uint(1, E_DIRECT if kind in (K_FLOAT, K_DOUBLE,
+                                           K_BOOLEAN, K_BYTE)
+                   else E_DIRECT_V2)
             encodings.append(e)
             col_stats.append(stats)
         for sm in stream_msgs:

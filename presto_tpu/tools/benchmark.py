@@ -68,9 +68,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--out", default=None)
     p.add_argument("--queries", default=None,
                    help="comma-separated subset, e.g. q1,q6,q14")
+    p.add_argument("--serving-caches", action="store_true",
+                   help="keep the plan/fragment caches ON: warm runs "
+                   "then measure the serving path (cache replay), not "
+                   "kernel execution — the default disables them so "
+                   "numbers stay comparable across rounds")
     args = p.parse_args(argv)
     run = _runner_fn(args.runner, args.catalog or args.suite,
                      args.schema)
+    if not args.serving_caches:
+        for prop in ("plan_cache_enabled",
+                     "fragment_result_cache_enabled"):
+            try:
+                run(f"set session {prop} = false")
+            except Exception:  # noqa: BLE001 — e.g. stateless http
+                break
     suite = load_suite(args.suite)
     if args.queries:
         want = set(args.queries.split(","))

@@ -1,0 +1,245 @@
+"""Concurrent serving benchmark: N clients through the coordinator on
+a repeated dashboard-style TPC-H mix, cold vs warm (reference: the
+serving posture of both Presto papers — repeat dashboard traffic is
+the workload the cache hierarchy exists for; the existing tools/
+benchmark.py measures single-query latency, this measures QPS and tail
+latency under concurrency).
+
+Topology: one single-node Coordinator (shared LocalRunner + the
+process-wide plan/fragment/page cache hierarchy) behind the real HTTP
+client protocol; N StatementClient threads.
+
+Protocol:
+  cold  — caches cleared; the mix's queries run once, spread across
+          the clients (first-arrival latency, jit compile included —
+          that IS the cold serving experience)
+  warm  — every client runs the full mix `warm_rounds` times
+  off   — (optional) the mix once more against a coordinator with
+          every cache level disabled, for the equivalence oracle
+
+Every phase checksums each query's result rows; the run fails loudly
+if warm results are not byte-identical to cold and to caches-off.
+
+Usage:
+    python -m presto_tpu.tools.serving_bench --clients 4 \
+        --schema sf0_1 --mix q1,q3,q6,q13 --warm-rounds 3 \
+        --out BENCH_SERVING_r07.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: default dashboard mix: an aggregation-heavy repeat workload (scan+
+#: agg q1/q6, a 3-way join q3, a join+group q13) — the shape a BI
+#: dashboard refresh sends at a serving cluster
+DEFAULT_MIX = ("q1", "q3", "q6", "q13")
+
+
+def _percentile(xs: Sequence[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    i = min(int(round(p * (len(s) - 1))), len(s) - 1)
+    return s[i]
+
+
+def _checksum(rows: List[list]) -> str:
+    """ORDER-SENSITIVE row digest: the byte-identity oracle must see a
+    replay that returns right values in the wrong order (the mix's
+    queries all end in ORDER BY, so order is part of the answer)."""
+    h = hashlib.blake2b(digest_size=16)
+    for r in rows:
+        h.update(repr(r).encode())
+    return h.hexdigest()
+
+
+def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
+               ) -> Tuple[dict, Dict[str, set]]:
+    """Run each client's (name, sql) list on its own thread through
+    the HTTP client protocol. Returns (phase stats, {query name ->
+    set of checksums over EVERY execution} — a single transient bad
+    read anywhere in the phase widens the set and fails the oracle)."""
+    from presto_tpu.server.coordinator import StatementClient
+    latencies: List[float] = []
+    checks: Dict[str, set] = {}
+    errors: List[str] = []
+    lock = threading.Lock()
+    # count only clients with work: an empty assignment spawns no
+    # thread, and a barrier party that never arrives would hang the
+    # whole bench (e.g. --clients 5 with the default 4-query mix)
+    assignments = [w for w in assignments if w]
+    start = threading.Barrier(len(assignments) + 1)
+
+    def client(idx: int, work: List[Tuple[str, str]]) -> None:
+        c = StatementClient(url, user=f"bench-{idx}",
+                            source="serving_bench")
+        start.wait()
+        for name, sql in work:
+            t0 = time.perf_counter()
+            try:
+                _, data = c.execute(sql)
+            except Exception as e:  # noqa: BLE001 — recorded, fatal
+                with lock:
+                    errors.append(f"{name}: {type(e).__name__}: {e}")
+                return
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+                checks.setdefault(name, set()).add(_checksum(data))
+
+    threads = [threading.Thread(target=client, args=(i, work))
+               for i, work in enumerate(assignments)]
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError("serving bench query failed: "
+                           + "; ".join(errors))
+    n = len(latencies)
+    stats = {
+        "queries": n,
+        "wall_s": round(wall, 3),
+        "qps": round(n / wall, 3) if wall > 0 else None,
+        "p50_ms": round(_percentile(latencies, 0.50) * 1e3, 1),
+        "p95_ms": round(_percentile(latencies, 0.95) * 1e3, 1),
+    }
+    return stats, checks
+
+
+def _load_mix(mix: Sequence[str]) -> Dict[str, str]:
+    from presto_tpu.tools.verifier import load_suite
+    suite = load_suite("tpch")
+    missing = [m for m in mix if m not in suite]
+    if missing:
+        raise ValueError(f"unknown mix queries {missing}")
+    return {m: suite[m] for m in mix}
+
+
+def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
+                      mix: Sequence[str] = DEFAULT_MIX,
+                      warm_rounds: int = 3,
+                      verify_off: bool = True,
+                      host: str = "127.0.0.1") -> dict:
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.server.coordinator import Coordinator
+    sqls = _load_mix(mix)
+    work = list(sqls.items())
+
+    mgr = get_cache_manager()
+    mgr.clear()
+    coord = Coordinator([], "tpch", schema, host=host, port=0,
+                        max_concurrent_queries=clients,
+                        single_node=True)
+    coord.start()
+    try:
+        # cold: each query exactly once, spread over the clients
+        cold_assign = [work[i::clients] for i in range(clients)]
+        cold, cold_checks = _run_phase(coord.url, cold_assign)
+        # warm: every client hammers the full mix
+        warm_assign = [list(work) * warm_rounds
+                       for _ in range(clients)]
+        warm, warm_checks = _run_phase(coord.url, warm_assign)
+    finally:
+        coord.stop()
+
+    def _consistent(*phases: Dict[str, set]) -> bool:
+        """One checksum per query per phase, identical across phases
+        — every repetition of every phase participates."""
+        for name in {n for p in phases for n in p}:
+            union = set()
+            for p in phases:
+                sums = p.get(name)
+                if not sums or len(sums) != 1:
+                    return False
+                union |= sums
+            if len(union) != 1:
+                return False
+        return True
+
+    identical = _consistent(cold_checks, warm_checks)
+    off = None
+    if verify_off:
+        off_coord = Coordinator(
+            [], "tpch", schema, host=host, port=0,
+            max_concurrent_queries=clients, single_node=True,
+            properties={"plan_cache_enabled": False,
+                        "fragment_result_cache_enabled": False,
+                        "page_source_cache_enabled": False})
+        off_coord.start()
+        try:
+            off, off_checks = _run_phase(
+                off_coord.url, [work[i::clients]
+                                for i in range(clients)])
+        finally:
+            off_coord.stop()
+        identical = identical and _consistent(cold_checks, off_checks)
+
+    cache_stats = {name: level.stats.snapshot() for name, level in
+                   (("plan", mgr.plan), ("fragment", mgr.fragment),
+                    ("page", mgr.page))}
+    doc = {
+        # STABLE headline shape (CI greps these five keys — see
+        # kernel_bench): metric/value/unit/platform/vs
+        "metric": "tpch_serving_warm_qps",
+        "value": warm["qps"],
+        "unit": "qps",
+        "platform": _backend(),
+        "speedup_warm_vs_cold": round(warm["qps"] / cold["qps"], 2)
+        if cold["qps"] else None,
+        "clients": clients,
+        "schema": schema,
+        "mix": list(mix),
+        "warm_rounds": warm_rounds,
+        "cold": cold,
+        "warm": warm,
+        "caches_off": off,
+        "results_identical": identical,
+        "cache": cache_stats,
+    }
+    if not identical:
+        raise RuntimeError(
+            "serving bench results differ between phases: "
+            + json.dumps(doc, indent=1))
+    return doc
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Concurrent serving benchmark (cold vs warm QPS)")
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--schema", default="sf0_1")
+    p.add_argument("--mix", default=",".join(DEFAULT_MIX))
+    p.add_argument("--warm-rounds", type=int, default=3)
+    p.add_argument("--skip-off", action="store_true",
+                   help="skip the caches-disabled equivalence phase")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+    doc = run_serving_bench(
+        clients=args.clients, schema=args.schema,
+        mix=[m.strip() for m in args.mix.split(",") if m.strip()],
+        warm_rounds=args.warm_rounds, verify_off=not args.skip_off)
+    text = json.dumps(doc, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

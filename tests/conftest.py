@@ -53,6 +53,13 @@ def _clear_xla_caches_between_modules(request):
     mod = request.module.__name__
     if _last_module[0] is not None and _last_module[0] != mod:
         jax.clear_caches()
+        # the query-serving cache hierarchy is process-wide by design
+        # (one budget per server); between test MODULES it resets so a
+        # module asserting scan-level behavior (EXPLAIN ANALYZE rows,
+        # connector remote logs) never observes another module's warm
+        # entries — mirrors the compiled-executable cache handling
+        from presto_tpu.cache import reset_cache_manager
+        reset_cache_manager()
     _last_module[0] = mod
     yield
 

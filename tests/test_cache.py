@@ -1,0 +1,354 @@
+"""Cache hierarchy: invalidation correctness (the stale-read oracle),
+LRU eviction under memory pressure, caches-off equivalence, version
+counters through the connector SPI, and the observability surfaces
+(EXPLAIN ANALYZE + system.runtime.caches). See docs/CACHING.md."""
+
+import pytest
+
+
+@pytest.fixture()
+def fresh_caches():
+    from presto_tpu.cache import reset_cache_manager
+    reset_cache_manager()
+    yield
+    reset_cache_manager()
+
+
+@pytest.fixture()
+def runner(fresh_caches):
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("memory", "default")
+
+
+# ---------------------------------------------------------------------------
+# stale-read oracle: write -> repeat query must reflect the write
+
+
+def test_insert_invalidates_repeat_query(runner):
+    runner.execute("create table t as select 1 a, 10 b")
+    q = "select sum(b) from t"
+    assert runner.execute(q).rows() == [(10,)]
+    assert runner.execute(q).rows() == [(10,)]  # warm the caches
+    runner.execute("insert into t values (2, 32)")
+    assert runner.execute(q).rows() == [(42,)]
+
+
+def test_ctas_after_drop_invalidates(runner):
+    runner.execute("create table t as select 5 x")
+    q = "select x from t"
+    assert runner.execute(q).rows() == [(5,)]
+    assert runner.execute(q).rows() == [(5,)]
+    runner.execute("drop table t")
+    runner.execute("create table t as select 7 x")
+    assert runner.execute(q).rows() == [(7,)]
+
+
+def test_drop_evicts_dependent_entries(runner):
+    from presto_tpu.cache import get_cache_manager
+    runner.execute("create table t as select 1 x")
+    runner.execute("select x from t")
+    runner.execute("select x from t")
+    mgr = get_cache_manager()
+    assert len(mgr.plan) > 0
+    runner.execute("drop table t")
+    # eager cross-level invalidation at the DDL commit point
+    assert all(("memory", "default", "t") not in
+               getattr(e, "deps", ())
+               for e in mgr.fragment._entries.values())
+    assert runner.execute(
+        "select count(*) from system.runtime.caches").rows() == [(3,)]
+
+
+def test_table_version_bumps_on_writes(runner):
+    handle_md = runner.catalogs.connector("memory").metadata
+    from presto_tpu.connectors.spi import TableHandle
+    h = TableHandle("memory", "default", "t")
+    runner.execute("create table t as select 1 a")
+    v0 = handle_md.table_version(h)
+    runner.execute("insert into t values (2)")
+    v1 = handle_md.table_version(h)
+    assert v1 > v0
+    runner.execute("drop table t")
+    assert handle_md.table_version(h) is None
+
+
+def test_sqlite_version_and_stale_read(tmp_path, fresh_caches):
+    from presto_tpu.connectors.sqlite import SqliteConnector
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    conn = SqliteConnector(str(tmp_path / "c.db"))
+    r.register_connector("db", conn)
+    r.execute("create table db.main.t as select 1 a, 2 b")
+    q = "select sum(b) from db.main.t"
+    assert r.execute(q).rows() == [(2,)]
+    v0 = conn.metadata.table_version(
+        __import__("presto_tpu.connectors.spi",
+                   fromlist=["TableHandle"]).TableHandle(
+            "db", "main", "t"))
+    r.execute("insert into db.main.t values (3, 40)")
+    assert r.execute(q).rows() == [(42,)]
+    assert conn.metadata.table_version(
+        __import__("presto_tpu.connectors.spi",
+                   fromlist=["TableHandle"]).TableHandle(
+            "db", "main", "t")) > v0
+
+
+def test_file_connector_stale_read(tmp_path, monkeypatch,
+                                   fresh_caches):
+    monkeypatch.setenv("PRESTO_TPU_FILE_ROOT", str(tmp_path))
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    r.execute("create table file.lake.t as "
+              "select nationkey, name from nation")
+    q = "select count(*) from file.lake.t"
+    assert r.execute(q).rows() == [(25,)]
+    assert r.execute(q).rows() == [(25,)]
+    r.execute("insert into file.lake.t values (99, 'X')")
+    assert r.execute(q).rows() == [(26,)]
+
+
+# ---------------------------------------------------------------------------
+# caches-off equivalence: every cached result byte-identical
+
+
+TPCH_EQUIV = [
+    "select returnflag, linestatus, sum(quantity) q, "
+    "count(*) c from lineitem group by returnflag, linestatus "
+    "order by returnflag, linestatus",
+    "select count(*) from orders where orderkey < 1000",
+    "select n.name, count(*) c from nation n "
+    "join customer cu on cu.nationkey = n.nationkey "
+    "group by n.name order by n.name",
+]
+
+
+def test_caches_off_equivalence(fresh_caches):
+    from presto_tpu.runner import LocalRunner
+    on = LocalRunner("tpch", "tiny")
+    off = LocalRunner("tpch", "tiny", {
+        "plan_cache_enabled": False,
+        "fragment_result_cache_enabled": False,
+        "page_source_cache_enabled": False})
+    for sql in TPCH_EQUIV:
+        cold = on.execute(sql).rows()
+        warm = on.execute(sql).rows()   # plan+fragment+page hits
+        warm2 = on.execute(sql).rows()
+        plain = off.execute(sql).rows()
+        assert cold == warm == warm2 == plain, sql
+
+
+def test_disabled_levels_take_no_entries(fresh_caches):
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny", {
+        "plan_cache_enabled": False,
+        "fragment_result_cache_enabled": False,
+        "page_source_cache_enabled": False})
+    r.execute("select count(*) from region")
+    r.execute("select count(*) from region")
+    mgr = get_cache_manager(create=False)
+    if mgr is not None:
+        assert len(mgr.plan) == 0
+        assert len(mgr.fragment) == 0
+        assert len(mgr.page) == 0
+
+
+# ---------------------------------------------------------------------------
+# LRU eviction under memory pressure
+
+
+def test_lru_eviction_under_memory_pressure(fresh_caches):
+    from presto_tpu.batch import Batch
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.types import BIGINT
+    mgr = get_cache_manager({"cache_memory_bytes": 1 << 20})
+    from presto_tpu.execution.memory import batch_bytes
+    b = Batch.from_pydict({"x": (list(range(4096)), BIGINT)})
+    per = batch_bytes(b)
+    n = (1 << 20) // per + 4  # guaranteed past the budget
+    for i in range(n):
+        assert mgr.page.put(("k", i), [b], [("c", "s", "t")])
+    assert mgr.page.stats.evictions > 0
+    assert mgr.pool.reserved <= 1 << 20
+    assert len(mgr.page) < n
+    # LRU order: the newest entries survive, the oldest went first
+    assert mgr.page.get(("k", n - 1)) is not None
+    assert mgr.page.get(("k", 0)) is None
+
+
+def test_query_path_respects_budget(fresh_caches):
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.runner import LocalRunner
+    budget = 256 << 10
+    r = LocalRunner("tpch", "tiny",
+                    {"cache_memory_bytes": budget})
+    for _ in range(2):
+        r.execute("select sum(quantity) from lineitem")
+        r.execute("select sum(extendedprice) from lineitem")
+        r.execute("select count(*) from orders where orderkey > 0")
+    mgr = get_cache_manager()
+    assert mgr.pool.budget == budget
+    assert mgr.pool.reserved <= budget
+    # correctness survives the pressure
+    assert r.execute("select sum(quantity) from lineitem").rows() == \
+        LocalRunner("tpch", "tiny", {
+            "page_source_cache_enabled": False,
+            "fragment_result_cache_enabled": False,
+        }).execute("select sum(quantity) from lineitem").rows()
+
+
+def test_oversized_entry_not_cached(fresh_caches):
+    from presto_tpu.cache import get_cache_manager
+    mgr = get_cache_manager({"cache_memory_bytes": 1 << 20})
+    import numpy as np
+    from presto_tpu.batch import Batch
+    from presto_tpu.types import BIGINT
+    big = Batch.from_pydict(
+        {"x": (list(range(100_000)), BIGINT)})
+    assert mgr.fragment.put("k", [big], []) is False
+    assert len(mgr.fragment) == 0
+
+
+# ---------------------------------------------------------------------------
+# isolation: same-named tables of DIFFERENT connector instances
+
+
+def test_no_cross_runner_collision(fresh_caches):
+    from presto_tpu.runner import LocalRunner
+    a = LocalRunner("memory", "default")
+    b = LocalRunner("memory", "default")
+    a.execute("create table t as select 1 x")
+    b.execute("create table t as select 2 x")
+    assert a.execute("select x from t").rows() == [(1,)]
+    assert b.execute("select x from t").rows() == [(2,)]
+    # warm both, then again — still isolated
+    assert a.execute("select x from t").rows() == [(1,)]
+    assert b.execute("select x from t").rows() == [(2,)]
+
+
+def test_volatile_system_tables_never_cached(fresh_caches):
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    q = "select count(*) from system.runtime.queries"
+    n0 = r.execute(q).rows()[0][0]
+    n1 = r.execute(q).rows()[0][0]
+    assert n1 == n0 + 1  # each execution observes the previous one
+
+
+# ---------------------------------------------------------------------------
+# observability + toggles
+
+
+def test_explain_analyze_shows_cache_counters(fresh_caches):
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    sql = ("select regionkey, count(*) from nation "
+           "group by regionkey order by regionkey")
+    r.execute(sql)  # record
+    res = r.execute("explain analyze " + sql)
+    text = "\n".join(row[0] for row in res.rows())
+    assert "fragment_replay" in text
+    assert "cache: 1 hits" in text
+
+
+def test_system_runtime_caches_counters(fresh_caches):
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    sql = "select count(*) from supplier"
+    r.execute(sql)
+    r.execute(sql)
+    rows = r.execute(
+        "select level, hits, misses from system.runtime.caches "
+        "order by level").rows()
+    by_level = {lvl: (h, m) for lvl, h, m in rows}
+    assert set(by_level) == {"plan", "fragment", "page"}
+    assert by_level["plan"][0] >= 1
+    assert by_level["fragment"][0] >= 1
+
+
+def test_set_session_toggles_levels(fresh_caches):
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    sql = "select count(*) from part"
+    r.execute(sql)
+    r.execute("set session plan_cache_enabled = false")
+    r.execute("set session fragment_result_cache_enabled = false")
+    r.execute("set session page_source_cache_enabled = false")
+    mgr = get_cache_manager()
+    h0 = (mgr.plan.stats.hits, mgr.fragment.stats.hits,
+          mgr.page.stats.hits)
+    r.execute(sql)
+    assert (mgr.plan.stats.hits, mgr.fragment.stats.hits,
+            mgr.page.stats.hits) == h0
+
+
+def test_prepared_statement_plan_cache(fresh_caches):
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    r.execute("prepare p1 from select count(*) from nation "
+              "where regionkey = ?")
+    assert r.execute("execute p1 using 1").rows() == [(5,)]
+    assert r.execute("execute p1 using 1").rows() == [(5,)]
+    mgr = get_cache_manager()
+    assert mgr.plan.stats.hits >= 1
+    # re-PREPARE under the same name must not serve the old plan
+    r.execute("deallocate prepare p1")
+    r.execute("prepare p1 from select count(*) from nation "
+              "where regionkey <> ?")
+    assert r.execute("execute p1 using 1").rows() == [(20,)]
+
+
+def test_width_retry_replans_through_cache(fresh_caches):
+    """array_agg width overflow bumps a session property — the retry
+    must MISS the plan cache (the width is baked into plan forms)."""
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny", {"array_agg_width": 2})
+    rows = r.execute(
+        "select regionkey, array_agg(nationkey) a from nation "
+        "group by regionkey order by regionkey").rows()
+    assert len(rows) == 5
+    assert sorted(rows[0][1]) == [0, 5, 14, 15, 16]
+
+
+def test_plan_cache_preserves_literal_whitespace(fresh_caches):
+    """normalize_sql must NOT collapse whitespace inside string
+    literals — two queries differing only there have different
+    answers, and aliasing them would serve wrong results."""
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    assert r.execute("select 'x  y' v").rows() == [("x  y",)]
+    assert r.execute("select 'x y' v").rows() == [("x y",)]
+    assert r.execute("select 'x  y' v").rows() == [("x  y",)]
+    # outside-literal whitespace still normalizes to one key
+    from presto_tpu.cache import normalize_sql
+    assert normalize_sql("select  1 ;") == normalize_sql("select 1")
+    assert normalize_sql("select 'a  b'") != normalize_sql(
+        "select 'a b'")
+    assert normalize_sql('select "c  d" from t') != normalize_sql(
+        'select "c d" from t')
+    assert normalize_sql("select 'it''s  ok'") != normalize_sql(
+        "select 'it''s ok'")
+
+
+def test_plan_cache_no_cross_runner_eviction_pingpong(fresh_caches):
+    """Two runners' same-named memory tables (different connector
+    instances) must coexist in the plan cache as distinct misses —
+    token mismatch is NOT staleness and must not evict the peer."""
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.runner import LocalRunner
+    a = LocalRunner("memory", "default")
+    b = LocalRunner("memory", "default")
+    a.execute("create table t as select 1 x")
+    b.execute("create table t as select 2 x")
+    a.execute("select x from t")
+    b.execute("select x from t")
+    mgr = get_cache_manager()
+    ev0 = mgr.plan.stats.evictions
+    # alternate lookups: both runners must HIT their own entries
+    h0 = mgr.plan.stats.hits
+    assert a.execute("select x from t").rows() == [(1,)]
+    assert b.execute("select x from t").rows() == [(2,)]
+    assert mgr.plan.stats.evictions == ev0
+    assert mgr.plan.stats.hits >= h0 + 2

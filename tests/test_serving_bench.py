@@ -1,0 +1,78 @@
+"""Serving-bench smoke: the concurrent serving path (single-node
+coordinator + HTTP clients + cache hierarchy) must produce its stable
+headline-JSON shape with a warm hit-rate > 0 — so the serving path
+cannot silently rot. The full capture (sf0_1, 4 clients) is the slow
+lane / BENCH_SERVING_r07.json."""
+
+import pytest
+
+
+def test_serving_bench_smoke():
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.tools.serving_bench import run_serving_bench
+    reset_cache_manager()
+    doc = run_serving_bench(clients=2, schema="tiny",
+                            mix=("q6", "q1"), warm_rounds=1)
+    # stable headline schema (CI greps these keys)
+    for key in ("metric", "value", "unit", "platform", "clients",
+                "schema", "mix", "warm_rounds", "cold", "warm",
+                "caches_off", "speedup_warm_vs_cold",
+                "results_identical", "cache"):
+        assert key in doc, key
+    assert doc["metric"] == "tpch_serving_warm_qps"
+    assert doc["unit"] == "qps"
+    for phase in ("cold", "warm", "caches_off"):
+        for key in ("queries", "wall_s", "qps", "p50_ms", "p95_ms"):
+            assert key in doc[phase], (phase, key)
+    # the warm phase repeated the cold mix: plan + fragment levels
+    # must both have served hits, and every phase's rows matched
+    assert doc["results_identical"] is True
+    assert doc["cache"]["plan"]["hits"] > 0
+    assert doc["cache"]["fragment"]["hits"] > 0
+    assert doc["warm"]["qps"] > 0 and doc["cold"]["qps"] > 0
+
+
+@pytest.mark.slow
+def test_serving_bench_full_capture_shape():
+    """The committed-capture configuration end to end (small scale)."""
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.tools.serving_bench import run_serving_bench
+    reset_cache_manager()
+    doc = run_serving_bench(clients=4, schema="sf0_01",
+                            warm_rounds=2)
+    assert doc["results_identical"] is True
+    assert doc["speedup_warm_vs_cold"] >= 5.0
+
+
+def test_single_node_coordinator_enforces_per_user_access():
+    """The shared single-node runner must evaluate access control as
+    the REQUESTING user (X-Presto-User), not the runner's default
+    identity — and the plan cache must not leak an allowed user's
+    plan to a denied one."""
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.execution.access_control import (
+        AccessControlManager, AccessRule,
+    )
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+    reset_cache_manager()
+    ac = AccessControlManager([
+        AccessRule(user="intruder", table="nation",
+                   allow_select=False),
+        AccessRule(),
+    ])
+    coord = Coordinator([], "tpch", "tiny", single_node=True,
+                        access_control=ac)
+    coord.start()
+    try:
+        sql = "select count(*) from nation"
+        ok = StatementClient(coord.url, user="analyst")
+        assert ok.execute(sql)[1] == [[25]]
+        assert ok.execute(sql)[1] == [[25]]  # warm the plan cache
+        denied = StatementClient(coord.url, user="intruder")
+        with pytest.raises(RuntimeError, match="cannot select"):
+            denied.execute(sql)
+    finally:
+        coord.stop()
+    reset_cache_manager()

@@ -119,3 +119,27 @@ def test_show_tables_lists_sqlite(sq_runner):
     rows = r.execute("show tables from db.main").rows()
     names = {t for t, in rows}
     assert {"nation", "region", "customer"} <= names
+
+
+def test_varchar_without_dictionary_rejected(tmp_path):
+    """A dictionary-less varchar batch has no strings to decode its
+    codes with — append must FAIL LOUDLY instead of silently writing
+    NULL for every row (data loss on CTAS/INSERT)."""
+    import numpy as np
+
+    from presto_tpu.batch import Batch, Column
+    from presto_tpu.connectors.spi import TableHandle
+    from presto_tpu.connectors.sqlite import SqliteConnector
+    from presto_tpu.runner.local import QueryError
+    from presto_tpu.schema import ColumnSchema, RelationSchema
+    from presto_tpu.types import VARCHAR
+
+    conn = SqliteConnector(str(tmp_path / "nd.db"))
+    h = TableHandle("db", "main", "t")
+    schema = RelationSchema.of(ColumnSchema("s", VARCHAR, None))
+    conn.page_sink.create_table(h, schema)
+    col = Column.from_numpy(np.zeros(4, np.int32),
+                            np.ones(4, bool), VARCHAR, 4, None)
+    batch = Batch({"s": col}, np.ones(4, bool))
+    with pytest.raises(QueryError, match="dictionary"):
+        conn.page_sink.append(h, batch)
