@@ -12,8 +12,10 @@ fall through to uncached execution.
 from __future__ import annotations
 
 import hashlib
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
+from presto_tpu.parser.lexer import LexError, tokenize
 from presto_tpu.planner import nodes as N
 
 #: functions whose result depends on more than their arguments; a
@@ -25,48 +27,26 @@ NONDETERMINISTIC_FUNCTIONS = frozenset({
 
 
 def normalize_sql(sql: str) -> str:
-    """Whitespace-insensitive statement text: runs of whitespace
-    OUTSIDE quotes collapse to one space, trailing semicolons drop.
-    Quote-aware — bytes inside '...' literals and "..." identifiers
-    are preserved verbatim (('' and \"\" escapes included): collapsing
-    whitespace inside a literal would alias two queries with different
-    answers, the one failure a plan cache must never produce. No case
-    folding for the same reason. Mis-lexing only ever PRESERVES more
-    bytes (e.g. an apostrophe in a -- comment), which costs a false
-    miss, never a false hit."""
-    out = []
-    i, n = 0, len(sql)
-    pending_ws = False
-    while i < n:
-        c = sql[i]
-        if c in ("'", '"'):
-            if pending_ws and out:
-                out.append(" ")
-            pending_ws = False
-            j = i + 1
-            while j < n:
-                if sql[j] == c:
-                    if j + 1 < n and sql[j + 1] == c:
-                        j += 2  # doubled-quote escape
-                        continue
-                    break
-                j += 1
-            out.append(sql[i:j + 1])
-            i = j + 1
-            continue
-        if c.isspace():
-            pending_ws = True
-            i += 1
-            continue
-        if pending_ws and out:
-            out.append(" ")
-        pending_ws = False
-        out.append(c)
-        i += 1
-    s = "".join(out)
-    while s.endswith(";"):
-        s = s[:-1].rstrip()
-    return s
+    """Statement text -> plan-cache key text, derived from the
+    lexer's OWN token stream: two texts share a key iff the parser
+    sees identical tokens, so key identity IS parse identity by
+    construction — whitespace, `--`/`/*...*/` comments, and
+    keyword/identifier case normalize away, while string-literal and
+    quoted-identifier content stays verbatim inside its token (the
+    one failure a plan cache must never produce is aliasing two
+    queries with different answers). At most ONE trailing `;` drops —
+    exactly what the grammar accepts, so `select 1;;` (a parse error)
+    can't ride `select 1`'s cached plan. Text that does not lex keys
+    on its own bytes under a distinct prefix: it can never alias a
+    lexable statement."""
+    try:
+        toks = tokenize(sql)
+    except LexError:
+        return "raw:" + sql
+    if len(toks) >= 2 and toks[-2].kind == "op" \
+            and toks[-2].value == ";":
+        del toks[-2]
+    return "tok:" + repr([(t.kind, t.value) for t in toks[:-1]])
 
 
 def table_cache_key(catalogs, handle) -> Optional[Tuple[Any, int]]:
@@ -86,14 +66,26 @@ def table_cache_key(catalogs, handle) -> Optional[Tuple[Any, int]]:
     return (conn.cache_token(), version)
 
 
+#: a default object.__repr__ embeds the instance address — unstable
+#: across runs (false misses) and reusable after GC (false HITS)
+_ADDR_REPR = re.compile(r" at 0x[0-9a-fA-F]+>")
+
+
 def split_token(split) -> Optional[Any]:
-    """Hashable identity of one split. Falls back to repr for
-    connector-private info payloads that are not hashable."""
+    """Hashable identity of one split, or None = uncacheable. Falls
+    back to repr for connector-private info payloads that are not
+    hashable — but ONLY when the repr is a real value rendering: a
+    default object.__repr__ (anywhere in the payload, containers
+    included) identifies by address, which a GC-reused allocation can
+    alias to a DIFFERENT split."""
     try:
         hash(split.info)
         return (split.info, split.partition)
     except TypeError:
-        return (repr(split.info), split.partition)
+        r = repr(split.info)
+        if _ADDR_REPR.search(r):
+            return None
+        return (r, split.partition)
 
 
 # ---------------------------------------------------------------------------

@@ -29,7 +29,9 @@ import dataclasses
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from presto_tpu.execution.memory import MemoryPool, batch_bytes
+from presto_tpu.execution.memory import (
+    MemoryLimitExceeded, MemoryPool, batch_bytes,
+)
 
 
 @dataclasses.dataclass
@@ -130,7 +132,15 @@ class ResultCache:
                 if self.pool.reserved + nbytes > budget:
                     self.stats.rejected += 1
                     return False
-            self.pool.reserve(self.tag, nbytes)
+            try:
+                self.pool.reserve(self.tag, nbytes)
+            except MemoryLimitExceeded:
+                # a concurrent SET SESSION cache_memory_bytes shrank
+                # the budget between the fit check and the reserve: a
+                # best-effort insert must never fail the caller's
+                # query
+                self.stats.rejected += 1
+                return False
             self.bytes += nbytes
             self._entries[key] = _Entry(list(batches), nbytes, deps)
             self.stats.inserts += 1
@@ -287,11 +297,15 @@ class CacheManager:
         self.page.peers = [self.fragment]
 
     def set_budget(self, budget_bytes: Optional[int]) -> None:
-        self.pool.budget = budget_bytes
-        if budget_bytes is not None:
-            # shrink to fit, oldest first, fragment before page
-            for level in (self.fragment, self.page):
-                with level._lock:
+        # the levels share one lock: the budget write and the shrink
+        # evictions are atomic w.r.t. an in-flight put()'s fit check
+        # (an unlocked write let put() pass its check against the old
+        # budget and then blow up inside pool.reserve on the new one)
+        with self.fragment._lock:
+            self.pool.budget = budget_bytes
+            if budget_bytes is not None:
+                # shrink to fit, oldest first, fragment before page
+                for level in (self.fragment, self.page):
                     while level._entries \
                             and self.pool.reserved > budget_bytes:
                         _, ev = level._entries.popitem(last=False)

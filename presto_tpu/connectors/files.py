@@ -225,11 +225,14 @@ class _FileCatalog:
 
     def __init__(self, root: str):
         self.root = root
-        #: commit generation for the engine cache hierarchy: bumped at
-        #: every evict() (= every in-process write commit), mixed with
-        #: file mtimes into table_version so both in-process rewrites
-        #: and external file swaps change the version
-        self.generation = 0
+        #: per-path commit generations for the engine cache
+        #: hierarchy: bumped at evict(path) (= an in-process write
+        #: commit of THAT file/table dir), mixed with file mtimes
+        #: into table_version so both in-process rewrites and
+        #: external file swaps change the version. Per-path, not
+        #: catalog-wide: a write to table A must not invalidate every
+        #: other table's warm cache entries
+        self.generations: Dict[str, int] = {}
         self._cache: Dict[str, Tuple[float, _TableView,
                                      Dict[str, tuple]]] = {}
         # string -> code reverse indexes, one entry per path replaced
@@ -245,7 +248,7 @@ class _FileCatalog:
     def evict(self, path: str) -> None:
         """Commit-point invalidation for a rewritten/removed file —
         mtime alone can miss a same-tick rewrite."""
-        self.generation += 1
+        self.generations[path] = self.generations.get(path, 0) + 1
         self._cache.pop(path, None)
         self._indexes.pop(path, None)
         self._part_cache.pop(path, None)
@@ -452,15 +455,20 @@ class _FileMetadata(ConnectorMetadata):
                 # re-walks it on every call anyway, and the sidecar's
                 # mtime alone would miss an externally swapped or
                 # appended part file
+                key = self._cat.table_dir(handle)
                 self._cat.part_info(handle)
-                sig = self._cat._part_cache[
-                    self._cat.table_dir(handle)][0]
+                sig = self._cat._part_cache[key][0]
                 token: object = sig
             else:
-                token = os.stat(self._cat.path(handle)).st_mtime_ns
+                key = self._cat.path(handle)
+                token = os.stat(key).st_mtime_ns
         except (OSError, KeyError):
             return None
-        return hash((self._cat.generation, token)) & ((1 << 62) - 1)
+        # THIS table's commit generation only (evict() keys on the
+        # same path/dir) — a write elsewhere in the catalog leaves
+        # this version, and its warm cache entries, alone
+        gen = self._cat.generations.get(key, 0)
+        return hash((gen, token)) & ((1 << 62) - 1)
 
     def estimate_row_count(self, handle: TableHandle) -> Optional[int]:
         try:

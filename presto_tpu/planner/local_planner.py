@@ -338,14 +338,16 @@ class LocalExecutionPlanner:
             for s in splits:
                 key = None
                 if page_cache is not None:
-                    try:
-                        key = ("page", tv, handle.catalog,
-                               handle.schema, handle.table,
-                               split_token(s), tuple(columns),
-                               batch_rows, constraint)
-                        hash(key)
-                    except TypeError:
-                        key = None  # unhashable constraint payload
+                    st = split_token(s)  # None = no stable identity
+                    if st is not None:
+                        try:
+                            key = ("page", tv, handle.catalog,
+                                   handle.schema, handle.table,
+                                   st, tuple(columns),
+                                   batch_rows, constraint)
+                            hash(key)
+                        except TypeError:
+                            key = None  # unhashable constraint payload
                 raw = page_cache.get(key) \
                     if key is not None else None
                 if raw is not None:

@@ -35,9 +35,12 @@ _PLUGIN_REGISTRY_CACHE: Dict[str, Any] = {}
 import itertools as _itertools
 import threading as _threading
 _PLUGIN_CACHE_LOCK = _threading.Lock()
-#: unhashable access-control objects referenced by plan-cache keys via
-#: id() — pinned so a GC'd policy's address cannot alias a new one
-_AC_KEY_PINS: List[Any] = []
+#: identity tokens minted for unhashable access-control objects and
+#: STAMPED onto them (like Connector.cache_token) — the token dies
+#: with the policy, so nothing is pinned and a recycled address can
+#: never alias a different policy's cached plans
+_AC_TOKEN_MINT = _itertools.count()
+_AC_TOKEN_LOCK = _threading.Lock()
 
 
 @dataclasses.dataclass
@@ -46,6 +49,10 @@ class Session:
     schema: str = "tiny"
     properties: Dict[str, Any] = dataclasses.field(default_factory=dict)
     user: str = ""  # identity for access control + resource groups
+    #: True on the per-request override minted by execute_as: its
+    #: properties dict is a request-scoped copy, so SET/RESET SESSION
+    #: would silently evaporate — those statements reject instead
+    request_scoped: bool = False
 
 
 class CatalogManager:
@@ -408,8 +415,12 @@ class LocalRunner:
         capacity is baked into the plan's value forms at ANALYSIS
         time, so unlike max_groups this retry must rebuild the plan.
         The bumped session rides a thread-local override — other
-        threads' statements keep planning at the base width."""
+        threads' statements keep planning at the base width. The
+        PREVIOUS override (execute_as's per-request identity) is
+        restored, not cleared: dropping it would hand the rest of the
+        request the runner's default identity."""
         from presto_tpu.operators.array_agg import ArrayAggWidthExceeded
+        prev = getattr(self._session_tl, "override", None)
         try:
             while True:
                 try:
@@ -424,34 +435,60 @@ class LocalRunner:
                             **self.session.properties,
                             "array_agg_width": e.suggested})
         finally:
-            self._session_tl.override = None
+            self._session_tl.override = prev
 
     def execute_as(self, sql: str, user: str) -> MaterializedResult:
         """Execute with a per-request identity (the single-node
         coordinator's path: many users share one runner). The user
         rides the THREAD-LOCAL session override, so analysis-time
         access checks — and the plan-cache key, which includes the
-        user — see the caller, not the runner's default identity."""
-        if user == getattr(self._session, "user", ""):
-            return self.execute(sql)
+        user — see the caller, not the runner's default identity.
+        The override gets its OWN properties dict — a shared dict
+        would let one HTTP client resize caches or flip planner
+        behavior mid-flight for every other user of the shared
+        runner — and is marked request_scoped so SET/RESET SESSION
+        reject loudly instead of silently evaporating with the
+        copy."""
         self._session_tl.override = dataclasses.replace(
-            self._session, user=user)
+            self._session, user=user,
+            properties=dict(self._session.properties),
+            request_scoped=True)
         try:
             return self.execute(sql)
         finally:
             self._session_tl.override = None
 
+    def _reject_request_scoped_mutation(self) -> None:
+        """SET/RESET SESSION on a request-scoped session would mutate
+        a copy that dies with the request — a success row followed by
+        no effect. Servers that want durable per-client properties
+        must pass them at Coordinator construction."""
+        if getattr(self.session, "request_scoped", False):
+            raise QueryError(
+                "SET/RESET SESSION is not supported over the "
+                "single-node coordinator: sessions are per-request; "
+                "configure properties on the Coordinator instead")
+
     def execute(self, sql: str) -> MaterializedResult:
         pc = self._plan_cache()
-        if pc is not None:
+        skey = self._session_cache_key() if pc is not None else None
+        ntext = None
+        if pc is not None and skey is not None:
             from presto_tpu.cache import normalize_sql
-            key = ("sql", normalize_sql(sql),
-                   self._session_cache_key())
-            if pc.contains(key):
+            ntext = normalize_sql(sql)
+            if pc.contains(("sql", ntext, skey)):
                 # a repeat statement: skip the parser entirely — the
-                # key can only have been inserted by a T.Query path
-                return self._run_query_statement(None, sql)
-        return self._execute_stmt(parse_statement(sql), sql)
+                # key can only have been inserted by a T.Query path.
+                # The normalized text rides along so _plan_query's
+                # get() doesn't re-walk the statement (the session
+                # key is NOT forwarded: _plan_query must re-derive it
+                # per execution for the width-retry re-key)
+                return self._run_query_statement(None, sql,
+                                                 cache_text=ntext)
+        # forward the normalized text on the miss path too: without
+        # it a cold SELECT lexes three times (key, parse, put-key)
+        return self._execute_stmt(parse_statement(sql), sql,
+                                  cache_text=ntext)
 
     # -- plan cache (presto_tpu/cache level 1) -------------------------
 
@@ -469,25 +506,52 @@ class LocalRunner:
         instance (checks run at analysis — a cached plan skips them,
         so two runners with different policies must never share
         entries), and the full effective property set (analysis and
-        optimization both read properties)."""
+        optimization both read properties). None = this session has
+        no stable cache identity (unhashable, unstampable policy);
+        callers must skip the plan cache."""
         from presto_tpu.session_properties import effective
         s = self.session
         props = tuple(sorted(
             (k, v) for k, v in effective(s.properties).items()
             if isinstance(v, (int, float, str, bool, type(None)))))
         ac = self.catalogs.access_control
+        rules_fp = None
         if ac is not None:
+            # fold the policy CONTENT in, not just its identity: a
+            # cached plan skips the analysis-time checks, and rule
+            # lists are mutated in place (append a revoke) — the key
+            # must change when the rules do, or a revoked user keeps
+            # reading from cached plans. AccessRule is a dataclass,
+            # so repr renders values; policies without a `rules`
+            # list key on identity alone and must be replaced
+            # wholesale to change
+            rules = getattr(ac, "rules", None)
+            if isinstance(rules, (list, tuple)):
+                rules_fp = tuple(repr(r) for r in rules)
             try:
                 hash(ac)  # held in the key: no GC-reuse aliasing
             except TypeError:
-                # unhashable policy: key on its id, and PIN the object
-                # so the address can never be recycled by a different
-                # policy while cached plans reference it
-                if not any(x is ac for x in _AC_KEY_PINS):
-                    _AC_KEY_PINS.append(ac)
-                ac = ("ac-id", id(ac))
+                # unhashable policy: mint a token once and stamp it on
+                # the object — a per-policy identity that lives exactly
+                # as long as the policy does (id() would need the
+                # object pinned forever to stay unambiguous)
+                tok = getattr(ac, "_plan_cache_token", None)
+                if tok is None:
+                    with _AC_TOKEN_LOCK:
+                        tok = getattr(ac, "_plan_cache_token", None)
+                        if tok is None:
+                            tok = next(_AC_TOKEN_MINT)
+                            try:
+                                object.__setattr__(
+                                    ac, "_plan_cache_token", tok)
+                            except (AttributeError, TypeError):
+                                # unstampable (slots) AND unhashable:
+                                # no stable identity exists — caller
+                                # skips the plan cache entirely
+                                return None
+                ac = ("ac-token", tok)
         return (s.catalog, s.schema, getattr(s, "user", ""), ac,
-                props)
+                rules_fp, props)
 
     def _plan_query(self, stmt: Optional[T.Node], sql: str,
                     cache_text: Optional[str] = None) -> N.OutputNode:
@@ -498,9 +562,12 @@ class LocalRunner:
         pc = self._plan_cache()
         key = None
         if pc is not None:
+            skey = self._session_cache_key()
+            if skey is None:
+                pc = None  # no stable session identity -> uncached
+        if pc is not None:
             from presto_tpu.cache import normalize_sql
-            key = ("sql", cache_text or normalize_sql(sql),
-                   self._session_cache_key())
+            key = ("sql", cache_text or normalize_sql(sql), skey)
             plan = pc.get(key, self.catalogs)
             if plan is not None:
                 return plan
@@ -548,13 +615,19 @@ class LocalRunner:
     # registry lives on the runner's session surface)
 
     def _prepared_registry(self) -> Dict[str, T.Node]:
+        """The CURRENT identity's name -> AST namespace. Scoped per
+        user, not per runner: the single-node coordinator drives one
+        shared runner for every HTTP client, and a flat registry
+        would let user B's PREPARE s1 shadow user A's (A's EXECUTE s1
+        silently runs B's statement), or B's DEALLOCATE break A's."""
         reg = getattr(self, "_prepared", None)
         if reg is None:
             reg = self._prepared = {}
-        return reg
+        return reg.setdefault(getattr(self.session, "user", ""), {})
 
-    def _execute_stmt(self, stmt: T.Node,
-                      sql: str) -> MaterializedResult:
+    def _execute_stmt(self, stmt: T.Node, sql: str,
+                      cache_text: Optional[str] = None
+                      ) -> MaterializedResult:
         if isinstance(stmt, T.Prepare):
             self._prepared_registry()[stmt.name] = stmt.statement
             return self._text_result("result", ["PREPARE"])
@@ -626,6 +699,7 @@ class LocalRunner:
             # back to the registry default (reference: RESET SESSION);
             # unknown names reject like SET would — a typo must not
             # silently leave the real override in place
+            self._reject_request_scoped_mutation()
             from presto_tpu.session_properties import SESSION_PROPERTIES
             if "." not in stmt.name \
                     and stmt.name not in SESSION_PROPERTIES:
@@ -653,7 +727,7 @@ class LocalRunner:
         if not isinstance(stmt, T.Query):
             raise QueryError(
                 f"unsupported statement {type(stmt).__name__}")
-        return self._run_query_statement(stmt, sql)
+        return self._run_query_statement(stmt, sql, cache_text)
 
     def _run_query_statement(self, stmt: Optional[T.Node], sql: str,
                              cache_text: Optional[str] = None
@@ -1144,6 +1218,7 @@ class LocalRunner:
         raise QueryError("unsupported SHOW")
 
     def _set_session(self, stmt: T.SetSession) -> MaterializedResult:
+        self._reject_request_scoped_mutation()
         from presto_tpu.planner.analyzer import _Analyzer, Scope
         from presto_tpu.planner.analyzer import PlannerContext
         ctx = PlannerContext(self.catalogs, self.session)

@@ -352,3 +352,172 @@ def test_plan_cache_no_cross_runner_eviction_pingpong(fresh_caches):
     assert b.execute("select x from t").rows() == [(2,)]
     assert mgr.plan.stats.evictions == ev0
     assert mgr.plan.stats.hits >= h0 + 2
+
+
+def test_normalize_sql_is_comment_aware(fresh_caches):
+    """A `--` comment ends at ITS newline: collapsing that newline
+    into a space would let the comment swallow the following tokens
+    and alias two queries with different answers (a false hit).
+    Reviewed end-to-end: the 3-row query must not poison the key of
+    the 1-row query."""
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("memory", "default")
+    r.execute(
+        "create table t as select * from (values (1), (2), (3)) v(x)")
+    assert len(r.execute("SELECT 1 --x\nFROM t").rows()) == 3
+    # semantically `SELECT 1` — everything after -- is comment
+    assert len(r.execute("SELECT 1 --x FROM t").rows()) == 1
+
+    from presto_tpu.cache import normalize_sql
+    # comments are token separators, never token glue
+    assert normalize_sql("SELECT 1 --x\nFROM t") != normalize_sql(
+        "SELECT 1 --x FROM t")
+    assert normalize_sql("SELECT 1 --x FROM t") == normalize_sql(
+        "SELECT 1")
+    # comment variants of one statement share a key (more hits,
+    # same semantics)
+    assert normalize_sql("select/*c*/1") == normalize_sql("select 1")
+    assert normalize_sql("select 1 -- trailing") == normalize_sql(
+        "select 1")
+    assert normalize_sql("select /* a\nb */ 1") == normalize_sql(
+        "select 1")
+    # comment markers inside quotes are DATA, not comments
+    assert normalize_sql("select '--x' v") != normalize_sql(
+        "select '' v")
+    assert normalize_sql("select '/*x*/' v") != normalize_sql(
+        "select '' v")
+    # unterminated block comment (a LexError at parse time) must not
+    # alias a valid statement
+    assert normalize_sql("select 1 /*x") != normalize_sql("select 1")
+    # token-derived keys: keyword/identifier case normalizes away,
+    # but only ONE trailing semicolon (what the grammar accepts) drops
+    assert normalize_sql("SELECT x FROM T") == normalize_sql(
+        "select x from t")
+    assert normalize_sql('select "Q" from t') != normalize_sql(
+        'select "q" from t')
+    assert normalize_sql("select 1;;") != normalize_sql("select 1")
+
+
+def test_execute_as_isolates_session_properties(fresh_caches):
+    """The per-request identity path carries a COPY of the properties
+    dict, so one HTTP client can't mutate planner/cache behavior for
+    every other user of the shared single-node runner — and because
+    that copy dies with the request, SET/RESET SESSION reject loudly
+    instead of returning success with no effect."""
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.runner.local import QueryError
+    r = LocalRunner("memory", "default")
+    for user in ("alice", ""):  # the default user is isolated too
+        with pytest.raises(QueryError, match="per-request"):
+            r.execute_as("set session batch_rows = 128", user)
+        with pytest.raises(QueryError, match="per-request"):
+            r.execute_as("reset session batch_rows", user)
+        assert "batch_rows" not in r.session.properties
+    # queries still run under the per-request identity
+    r.execute("create table t as select 1 x")
+    assert r.execute_as("select x from t", "alice").rows() == [(1,)]
+    # the embedded (non-request) path keeps durable SET SESSION
+    r.execute("set session batch_rows = 128")
+    assert r.session.properties["batch_rows"] == 128
+
+
+def test_unhashable_access_control_keys_on_minted_token(fresh_caches):
+    """Unhashable policies get a minted token stamped on the object
+    (nothing pinned process-wide — the old id()+pin scheme leaked one
+    object per policy forever); distinct policies never share keys."""
+    from presto_tpu.execution.access_control import (
+        AccessControlManager,
+    )
+    from presto_tpu.runner import LocalRunner
+
+    class UnhashablePolicy(AccessControlManager):
+        def __eq__(self, other):  # kills hashability
+            return self is other
+        __hash__ = None
+
+    a = LocalRunner("memory", "default",
+                    access_control=UnhashablePolicy())
+    b = LocalRunner("memory", "default",
+                    access_control=UnhashablePolicy())
+    ka = a._session_cache_key()
+    kb = b._session_cache_key()
+    assert ka is not None and kb is not None and ka != kb
+    # stable across calls (token minted once, stamped on the policy)
+    assert a._session_cache_key() == ka
+    # plan caching still works end-to-end under such a policy
+    a.execute("create table t as select 1 x")
+    assert a.execute("select x from t").rows() == [(1,)]
+    assert a.execute("select x from t").rows() == [(1,)]
+    from presto_tpu.cache import get_cache_manager
+    assert get_cache_manager().plan.stats.hits >= 1
+
+
+def test_split_token_rejects_default_repr():
+    """An unhashable split payload whose repr falls back to
+    object.__repr__ identifies by ADDRESS — unstable across runs and
+    reusable after GC (a recycled address could serve another split's
+    pages). Such splits are uncacheable, not trusted."""
+    from presto_tpu.cache import split_token
+
+    class Split:
+        def __init__(self, info):
+            self.info = info
+            self.partition = 0
+
+    class Opaque:  # unhashable, default repr
+        __hash__ = None
+
+    assert split_token(Split(Opaque())) is None
+    assert split_token(Split([Opaque()])) is None  # nested too
+    # unhashable but value-rendering payloads stay cacheable
+    t = split_token(Split({"path": "f.orc", "row": 5}))
+    assert t is not None
+    assert t == split_token(Split({"path": "f.orc", "row": 5}))
+    # hashable payloads keep first-class identity
+    assert split_token(Split(("f.orc", 5))) == (("f.orc", 5), 0)
+
+
+def test_rule_mutation_invalidates_cached_plan(fresh_caches):
+    """Appending a revoke to the policy's in-place rules list must
+    change the plan-cache key: cached plans skip the analysis-time
+    access checks, so a key holding only the policy INSTANCE would
+    keep serving a revoked user until eviction."""
+    from presto_tpu.execution.access_control import (
+        AccessControlManager, AccessRule,
+    )
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.runner.local import QueryError
+    ac = AccessControlManager([])
+    r = LocalRunner("memory", "default", user="bob",
+                    access_control=ac)
+    r.execute("create table secret as select 1 x")
+    assert r.execute("select x from secret").rows() == [(1,)]
+    assert r.execute("select x from secret").rows() == [(1,)]  # warm
+    ac.rules.append(AccessRule(user="bob", table="secret",
+                               allow_select=False))
+    with pytest.raises(QueryError, match="cannot select"):
+        r.execute("select x from secret")
+    # and lifting the revoke works again (key moves back)
+    ac.rules.pop()
+    assert r.execute("select x from secret").rows() == [(1,)]
+
+
+def test_put_rejects_instead_of_raising_on_reserve_race(
+        fresh_caches, monkeypatch):
+    """A best-effort cache insert must never fail the caller's query:
+    if a concurrent budget shrink makes pool.reserve throw after the
+    fit check, put() counts a rejection and returns False."""
+    from presto_tpu.batch import Batch
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.execution.memory import MemoryLimitExceeded
+    from presto_tpu.types import BIGINT
+    mgr = get_cache_manager({"cache_memory_bytes": 1 << 20})
+
+    def boom(tag, nbytes):
+        raise MemoryLimitExceeded(tag, nbytes, 0, 0)
+
+    monkeypatch.setattr(mgr.pool, "reserve", boom)
+    b = Batch.from_pydict({"x": ([1], BIGINT)})
+    assert mgr.fragment.put(("k",), [b]) is False
+    assert mgr.fragment.stats.rejected == 1
+    assert len(mgr.fragment) == 0
