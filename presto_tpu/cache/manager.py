@@ -108,6 +108,17 @@ class ResultCache:
             return e.value
 
     def put(self, key, batches: List, deps=None) -> bool:
+        from presto_tpu.execution import faults
+        if faults.ARMED:
+            # fault site `cache.put`: an injected insert failure is
+            # ABSORBED as a rejection — the cache is best-effort by
+            # contract, so a flaky cache tier degrades hit rate, never
+            # correctness (chaos tests assert exactly this)
+            try:
+                faults.fire("cache.put", tag=self.tag, key=key)
+            except faults.InjectedFault:
+                self.stats.rejected += 1
+                return False
         nbytes = sum(batch_bytes(b) for b in batches)
         cap = self.entry_byte_cap()
         if cap is not None and nbytes > cap:

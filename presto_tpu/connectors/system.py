@@ -35,7 +35,8 @@ _TABLES: Dict[str, List] = {
                       ("state", VARCHAR)],
     "runtime.queries": [("query_id", BIGINT), ("state", VARCHAR),
                         ("query", VARCHAR), ("output_rows", BIGINT),
-                        ("elapsed_ms", DOUBLE)],
+                        ("elapsed_ms", DOUBLE),
+                        ("error_kind", VARCHAR)],
     "runtime.caches": [("level", VARCHAR), ("hits", BIGINT),
                        ("misses", BIGINT), ("evictions", BIGINT),
                        ("entries", BIGINT), ("bytes", BIGINT)],
@@ -166,7 +167,7 @@ def runner_system_connector(runner) -> SystemConnector:
                     if res is not None else -1
                 q.pop("_result", None)
             out.append((q["id"], q["state"], q["sql"], rows,
-                        q["elapsed_ms"]))
+                        q["elapsed_ms"], q.get("error_kind")))
         return out
 
     def catalogs():

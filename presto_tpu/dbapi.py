@@ -13,10 +13,11 @@ the same queued/executing client protocol as the CLI.
 from __future__ import annotations
 
 import datetime
+import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
 apilevel = "2.0"
-threadsafety = 1          # threads may share the module
+threadsafety = 2          # threads may share the module and connections
 paramstyle = "qmark"
 
 
@@ -28,6 +29,16 @@ class ProgrammingError(Error):
     pass
 
 
+class OperationalError(Error):
+    """Runtime failure outside the program's control (PEP 249
+    taxonomy). `kind` carries the engine's structured failure kind
+    ("cancelled", "deadline_exceeded", ...) when one exists."""
+
+    def __init__(self, message: str, kind: Optional[str] = None):
+        super().__init__(message)
+        self.kind = kind
+
+
 class Cursor:
     arraysize = 1
 
@@ -37,6 +48,16 @@ class Cursor:
         self._pos = 0
         self.description = None
         self.rowcount = -1
+        #: cooperative kill flag for the in-flight execute (PEP 249
+        #: optional extension, like psycopg's cursor-level cancel):
+        #: set from ANOTHER thread while execute() runs
+        self._cancel = threading.Event()
+        #: remote connections: a PER-CURSOR protocol client, so
+        #: cancel() kills only THIS cursor's in-flight statement —
+        #: threadsafety=2 sanctions cursors of one connection on
+        #: different threads, and a connection-shared client would
+        #: kill a sibling cursor's query
+        self._client = conn._make_client()
 
     # -- execution ---------------------------------------------------------
 
@@ -44,7 +65,9 @@ class Cursor:
                 parameters: Optional[Sequence[Any]] = None) -> "Cursor":
         if parameters is not None:
             sql = _bind(sql, parameters)
-        columns, rows = self._conn._run(sql)
+        self._cancel.clear()
+        columns, rows = self._conn._run(sql, cancel=self._cancel,
+                                        client=self._client)
         self._rows = rows
         self._pos = 0
         self.rowcount = len(rows)
@@ -52,6 +75,16 @@ class Cursor:
             (name, typ, None, None, None, None, None)
             for name, typ in columns]
         return self
+
+    def cancel(self) -> None:
+        """Kill the statement this cursor is currently executing (call
+        from another thread). In-process, the runner's drive loop
+        notices within one round; against a server, the coordinator
+        gets a DELETE and aborts its workers. The interrupted
+        execute() raises OperationalError(kind="cancelled")."""
+        self._cancel.set()
+        if self._client is not None:
+            self._client.cancel()
 
     def executemany(self, sql: str,
                     seq_of_parameters: Sequence[Sequence[Any]]) -> None:
@@ -106,36 +139,49 @@ class Cursor:
 class Connection:
     def __init__(self, server: Optional[str] = None,
                  catalog: Optional[str] = None,
-                 schema: Optional[str] = None):
+                 schema: Optional[str] = None,
+                 properties: Optional[dict] = None):
         self._server = server
-        self._client = None
         self._runner = None
         if server is not None:
-            if catalog is not None or schema is not None:
+            if catalog is not None or schema is not None \
+                    or properties is not None:
                 # the client protocol carries no session context yet;
                 # silently running against the coordinator's defaults
                 # would be a wrong-catalog footgun
                 raise Error(
-                    "catalog/schema cannot be set on a remote "
-                    "connection — the coordinator's session applies")
-            from presto_tpu.server.coordinator import StatementClient
-            self._client = StatementClient(server)
+                    "catalog/schema/properties cannot be set on a "
+                    "remote connection — the coordinator's session "
+                    "applies")
+            self._remote = True
         else:
             from presto_tpu.runner import LocalRunner
+            self._remote = False
             self._runner = LocalRunner(catalog or "tpch",
-                                       schema or "tiny")
+                                       schema or "tiny",
+                                       properties)
 
-    def _run(self, sql: str):
+    def _make_client(self):
+        """A fresh protocol client for one cursor (None in-process)."""
+        if not self._remote:
+            return None
+        from presto_tpu.server.coordinator import StatementClient
+        return StatementClient(self._server)
+
+    def _run(self, sql: str, cancel: Optional[threading.Event] = None,
+             client=None):
         """-> ([(name, type_name)], rows) with DATE decoded."""
         try:
-            if self._client is not None:
-                columns, data = self._client.execute(sql)
+            if self._remote:
+                columns, data = client.execute(sql)
                 names = [(c["name"], c.get("type", "")) for c in columns]
                 types = [c.get("type", "") for c in columns]
                 rows = [tuple(_decode(v, t) for v, t in zip(r, types))
                         for r in data]
                 return names, rows
-            res = self._runner.execute(sql)
+            res = self._runner.execute(
+                sql, cancel=cancel.is_set if cancel is not None
+                else None)
             names = [(n, f.type.name)
                      for n, f in zip(res.names, res.fields)]
             types = [f.type.name for f in res.fields]
@@ -145,6 +191,9 @@ class Connection:
         except Error:
             raise
         except Exception as e:  # noqa: BLE001 — PEP 249 error surface
+            kind = getattr(e, "kind", None)
+            if kind is not None:
+                raise OperationalError(str(e), kind=kind) from e
             raise Error(str(e)) from e
 
     def cursor(self) -> Cursor:
@@ -157,7 +206,7 @@ class Connection:
         raise Error("transactions are not supported")
 
     def close(self) -> None:
-        self._client = None
+        self._remote = False
         self._runner = None
 
 
@@ -251,5 +300,6 @@ def _literal(p) -> str:
 
 def connect(server: Optional[str] = None,
             catalog: Optional[str] = None,
-            schema: Optional[str] = None) -> Connection:
-    return Connection(server, catalog, schema)
+            schema: Optional[str] = None,
+            properties: Optional[dict] = None) -> Connection:
+    return Connection(server, catalog, schema, properties)

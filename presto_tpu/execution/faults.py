@@ -1,0 +1,257 @@
+"""Deterministic fault-injection registry (reference analog: the
+failure-injection hooks Trino's fault-tolerant-execution work used to
+prove exchange-tier absorption, plus presto-tests' TestingTaskFailure
+plumbing — collapsed to one process-wide registry of NAMED sites).
+
+Sites are fixed, cheap call points on the engine's failure-domain
+seams:
+
+    exchange.push       HttpExchange producer-side POST (phase
+                        "before" = page never left, "after" = page
+                        landed but the response was lost — the
+                        idempotent-re-push case)
+    exchange.pop        ExchangeRegistry.pop on the consumer side
+    task.dispatch       the coordinator's POST /v1/task
+    operator.add_input  the Driver loop, before moving a batch into
+                        an operator (ctx carries the operator object)
+    page_source.next    every batch a connector page source yields
+    cache.put           ResultCache.put (absorbed as a rejection —
+                        a best-effort cache must never fail a query)
+
+Zero overhead when disarmed: every site guards its fire() call with
+the module-level ``ARMED`` bool, so the cold path pays one attribute
+load and branch per batch move — nothing else. Arming is explicit
+(tests call :func:`arm`), via the ``fault_injection`` session
+property, or via the ``PRESTO_TPU_FAULTS`` env var (how subprocess
+workers get armed).
+
+Triggers are SEEDED and deterministic: ``once`` (the first matching
+call), ``nth`` (the n-th matching call, once), ``every`` (every n-th
+matching call, forever — the chaos-bench trigger), ``prob``
+(per-call coin flip from ``random.Random(seed)``), ``always``.
+Tests needing surgical precision pass a ``predicate`` over the site's
+context dict instead of a spec string.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+#: fast gate read by every site before calling fire(); kept exactly
+#: in sync with "any injection armed" under _LOCK
+ARMED = False
+
+_LOCK = threading.Lock()
+_INJECTIONS: Dict[str, List["_Injection"]] = {}
+#: last spec applied by ensure_spec — re-applying the SAME spec is a
+#: no-op so per-execution arming doesn't reset trigger counters
+_APPLIED_SPEC: Optional[str] = None
+
+SITES = (
+    "exchange.push", "exchange.pop", "task.dispatch",
+    "operator.add_input", "page_source.next", "cache.put",
+)
+
+
+class InjectedFault(ConnectionError):
+    """The default injected error. Subclasses ConnectionError so the
+    transport retry tier (http backoff) absorbs it exactly like a real
+    dropped connection when injected at an RPC site."""
+
+    def __init__(self, message: str, site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+class _Injection:
+    def __init__(self, site: str, trigger: str = "once", n: int = 1,
+                 p: float = 0.0, seed: int = 0,
+                 error: Optional[Callable[[], BaseException]] = None,
+                 predicate: Optional[Callable[[dict], bool]] = None,
+                 phase: Optional[str] = None,
+                 from_spec: bool = False):
+        #: True when armed by ensure_spec — a CHANGED spec replaces
+        #: exactly these, never API-armed injections
+        self.from_spec = from_spec
+        if trigger not in ("once", "nth", "every", "prob", "always"):
+            raise ValueError(f"unknown fault trigger {trigger!r}")
+        self.site = site
+        self.trigger = trigger
+        self.n = max(1, int(n))
+        self.p = float(p)
+        self.phase = phase
+        self.predicate = predicate
+        self.error = error or (lambda: InjectedFault(
+            f"injected fault at {site}", site))
+        import random
+        self._rng = random.Random(seed)
+        self.calls = 0     # matching calls seen
+        self.fired = 0     # faults actually raised
+
+    def should_fire(self, ctx: dict) -> bool:
+        """Called under _LOCK. Trigger counters advance only on calls
+        that match phase + predicate, so a spec like nth:3 means 'the
+        3rd matching call', not 'the 3rd call of any kind'."""
+        if self.phase is not None and ctx.get("phase") != self.phase:
+            return False
+        if self.predicate is not None and not self.predicate(ctx):
+            return False
+        self.calls += 1
+        if self.trigger == "once":
+            fire = self.fired == 0
+        elif self.trigger == "nth":
+            fire = self.calls == self.n
+        elif self.trigger == "every":
+            fire = self.calls % self.n == 0
+        elif self.trigger == "prob":
+            fire = self._rng.random() < self.p
+        else:  # always
+            fire = True
+        if fire:
+            self.fired += 1
+        return fire
+
+
+def arm(site: str, trigger: str = "once", n: int = 1, p: float = 0.0,
+        seed: int = 0, error: Optional[Callable] = None,
+        predicate: Optional[Callable[[dict], bool]] = None,
+        phase: Optional[str] = None,
+        from_spec: bool = False) -> _Injection:
+    """Arm one injection at `site`. Returns the injection so tests can
+    assert `.fired`/`.calls` afterwards."""
+    global ARMED
+    if site not in SITES:
+        raise ValueError(
+            f"unknown fault site {site!r} (known: {', '.join(SITES)})")
+    inj = _Injection(site, trigger, n, p, seed, error, predicate,
+                     phase, from_spec)
+    with _LOCK:
+        _INJECTIONS.setdefault(site, []).append(inj)
+        ARMED = True
+    return inj
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Remove every injection (or just `site`'s) and drop the applied
+    spec so a later ensure_spec() re-arms from scratch."""
+    global ARMED, _APPLIED_SPEC
+    with _LOCK:
+        if site is None:
+            _INJECTIONS.clear()
+        else:
+            _INJECTIONS.pop(site, None)
+        ARMED = any(_INJECTIONS.values())
+        if not ARMED:
+            _APPLIED_SPEC = None
+
+
+def fired(site: str) -> int:
+    """Total faults raised at `site` by currently armed injections."""
+    with _LOCK:
+        return sum(i.fired for i in _INJECTIONS.get(site, ()))
+
+
+def counters() -> Dict[str, Dict[str, int]]:
+    """{site: {"calls": n, "fired": n}} for every armed site — served
+    on /v1/info so tests can assert a SUBPROCESS worker's injected
+    fault actually fired (a chaos test that never fires is vacuous)."""
+    with _LOCK:
+        return {site: {"calls": sum(i.calls for i in inj),
+                       "fired": sum(i.fired for i in inj)}
+                for site, inj in _INJECTIONS.items() if inj}
+
+
+def fire(site: str, **ctx: Any) -> None:
+    """Site call point: raise the armed error when a trigger matches.
+    Sites guard this behind `if faults.ARMED` — never call it on a hot
+    path unguarded."""
+    with _LOCK:
+        injections = _INJECTIONS.get(site)
+        if not injections:
+            return
+        to_raise = None
+        for inj in injections:
+            if inj.should_fire(ctx):
+                to_raise = inj.error()
+                break
+    if to_raise is not None:
+        raise to_raise
+
+
+def parse_spec(spec: str) -> List[dict]:
+    """``site:trigger[:arg][:seed]`` semicolon-separated, e.g.
+    ``exchange.push:nth:3`` or ``operator.add_input:prob:0.05:42`` or
+    ``page_source.next:once``. The arg is `n` for nth/every and `p`
+    for prob."""
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2:
+            raise ValueError(
+                f"bad fault spec {part!r} (want site:trigger[:arg])")
+        site, trigger = bits[0], bits[1]
+        kw: dict = {"site": site, "trigger": trigger}
+        if len(bits) > 2:
+            if trigger == "prob":
+                kw["p"] = float(bits[2])
+            else:
+                kw["n"] = int(bits[2])
+        if len(bits) > 3:
+            kw["seed"] = int(bits[3])
+        out.append(kw)
+    return out
+
+
+def ensure_spec(spec: Optional[str]) -> None:
+    """Idempotently apply the SESSION-PROPERTY spec string: the SAME
+    spec arming on every execution must not reset trigger counters,
+    so re-applies are no-ops. A CHANGED spec REPLACES the previous
+    spec's injections, and an EMPTY/absent spec REMOVES them — so
+    `SET SESSION fault_injection = ''` really disarms, as the
+    property documents. API-armed injections (tests, the env-var
+    channel) are never touched by this path.
+
+    check + purge + arm + publish happen under ONE lock hold: two
+    concurrent executes applying the same new spec must not both
+    pass the check and arm duplicates ('once' firing twice would
+    break the documented determinism)."""
+    global ARMED, _APPLIED_SPEC
+    # parse/validate OUTSIDE the lock — a bad spec must not have
+    # dropped the old one, and unknown sites must reject like arm()
+    parsed = parse_spec(spec) if spec else []
+    for kw in parsed:
+        if kw["site"] not in SITES:
+            raise ValueError(
+                f"unknown fault site {kw['site']!r} "
+                f"(known: {', '.join(SITES)})")
+    with _LOCK:
+        if (spec or None) == _APPLIED_SPEC:
+            return
+        for site in list(_INJECTIONS):
+            kept = [i for i in _INJECTIONS[site] if not i.from_spec]
+            if kept:
+                _INJECTIONS[site] = kept
+            else:
+                del _INJECTIONS[site]
+        for kw in parsed:
+            _INJECTIONS.setdefault(kw["site"], []).append(
+                _Injection(**kw, from_spec=True))
+        ARMED = any(_INJECTIONS.values())
+        _APPLIED_SPEC = spec or None
+
+
+#: subprocess workers (and anything else that can't call arm()) get
+#: armed through the environment at import time. These arm as
+#: API-style injections (from_spec=False) so the session-property
+#: channel — which disarms on an empty property — can never clobber
+#: an operator's env-level arming
+_env_spec = os.environ.get("PRESTO_TPU_FAULTS")
+if _env_spec:
+    for _kw in parse_spec(_env_spec):
+        arm(**_kw)
+del _env_spec

@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
+from presto_tpu.execution import faults
 from presto_tpu.operators.base import Operator
 
 
@@ -57,6 +58,13 @@ class Driver:
                     jax.block_until_ready(batch)
                 current.ctx.stats.busy_seconds += time.perf_counter() - t0
                 if batch is not None:
+                    if faults.ARMED:
+                        # fault site `operator.add_input`: the ONE
+                        # choke point every batch hand-off crosses —
+                        # chaos tests fail (or stall) any operator of
+                        # any pipeline here without monkeypatching
+                        faults.fire("operator.add_input", op=nxt,
+                                    name=nxt.ctx.name)
                     t0 = time.perf_counter()
                     nxt.add_input(batch)
                     nxt.ctx.stats.busy_seconds += time.perf_counter() - t0

@@ -13,6 +13,7 @@ import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from presto_tpu.batch import Batch, DEFAULT_BATCH_ROWS
+from presto_tpu.execution import faults as _faults
 from presto_tpu.expr.compile import CompiledExpr, compile_expression
 from presto_tpu.expr.ir import InputRef, RowExpression, walk, InputRef
 from presto_tpu.operators import misc_ops
@@ -361,6 +362,12 @@ class LocalExecutionPlanner:
                     acc = [] if key is not None else None
                 acc_bytes = 0
                 for b in raw:
+                    if _faults.ARMED:
+                        # fault site `page_source.next`: every batch a
+                        # connector yields, cached or fresh
+                        _faults.fire("page_source.next",
+                                     table=handle.table,
+                                     catalog=handle.catalog)
                     if acc is not None:
                         acc_bytes += batch_bytes(b)
                         if entry_cap is not None \

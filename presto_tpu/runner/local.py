@@ -22,7 +22,30 @@ from presto_tpu.schema import RelationSchema
 
 
 class QueryError(Exception):
-    pass
+    """Engine-facing query failure. `kind` is the structured failure
+    taxonomy the lifecycle layer switches on (and `system.runtime.
+    queries` / the client protocol surface): "cancelled",
+    "deadline_exceeded", "abandoned", or None for ordinary errors."""
+
+    def __init__(self, message: str, kind: Optional[str] = None):
+        super().__init__(message)
+        self.kind = kind
+
+
+def check_lifecycle(cancel, deadline: Optional[float]) -> None:
+    """THE cooperative kill/deadline checkpoint, shared by every
+    drive loop (local runner, mesh phases, the coordinator's root
+    drive): polls the cancel callable, then the monotonic deadline,
+    and raises the structured QueryError kinds. One copy so the
+    message text and kind strings can never drift between loops."""
+    if cancel is not None and cancel():
+        raise QueryError("query cancelled", kind="cancelled")
+    if deadline is not None:
+        import time as _time
+        if _time.monotonic() > deadline:
+            raise QueryError(
+                "query exceeded query_max_run_time_ms",
+                kind="deadline_exceeded")
 
 
 #: plugin_dir -> PluginRegistry — module EXECUTION (the expensive,
@@ -437,7 +460,9 @@ class LocalRunner:
         finally:
             self._session_tl.override = prev
 
-    def execute_as(self, sql: str, user: str) -> MaterializedResult:
+    def execute_as(self, sql: str, user: str, cancel=None,
+                   deadline: Optional[float] = None
+                   ) -> MaterializedResult:
         """Execute with a per-request identity (the single-node
         coordinator's path: many users share one runner). The user
         rides the THREAD-LOCAL session override, so analysis-time
@@ -454,7 +479,7 @@ class LocalRunner:
             properties=dict(self._session.properties),
             request_scoped=True)
         try:
-            return self.execute(sql)
+            return self.execute(sql, cancel=cancel, deadline=deadline)
         finally:
             self._session_tl.override = None
 
@@ -469,7 +494,44 @@ class LocalRunner:
                 "single-node coordinator: sessions are per-request; "
                 "configure properties on the Coordinator instead")
 
-    def execute(self, sql: str) -> MaterializedResult:
+    def execute(self, sql: str, cancel=None,
+                deadline: Optional[float] = None) -> MaterializedResult:
+        """`cancel` is an optional () -> bool polled at every
+        drive-loop round (cooperative kill); `deadline` an optional
+        time.monotonic() instant enforced at the same checkpoints.
+        The session's own `query_max_run_time_ms` tightens the
+        deadline — whichever comes first wins. Both ride a THREAD-
+        LOCAL (like the session override) so the whole statement tree
+        — width retries, write wrappers, EXPLAIN ANALYZE — shares one
+        lifecycle without threading two parameters through every
+        call."""
+        import time as _time
+        from presto_tpu.session_properties import get_property
+        limit_ms = get_property(self.session.properties,
+                                "query_max_run_time_ms")
+        if limit_ms:
+            d = _time.monotonic() + float(limit_ms) / 1000.0
+            deadline = d if deadline is None else min(deadline, d)
+        # session-property fault channel: applied (or, when the
+        # property is empty/absent again, REMOVED) idempotently —
+        # ensure_spec never touches API/env-armed injections
+        from presto_tpu.execution import faults
+        faults.ensure_spec(
+            self.session.properties.get("fault_injection"))
+        prev = getattr(self._session_tl, "lifecycle", None)
+        self._session_tl.lifecycle = (cancel, deadline)
+        try:
+            return self._execute_lifecycled(sql)
+        finally:
+            self._session_tl.lifecycle = prev
+
+    def _lifecycle(self):
+        """(cancel callable | None, monotonic deadline | None) of the
+        statement this thread is executing."""
+        return getattr(self._session_tl, "lifecycle", None) \
+            or (None, None)
+
+    def _execute_lifecycled(self, sql: str) -> MaterializedResult:
         pc = self._plan_cache()
         skey = self._session_cache_key() if pc is not None else None
         ntext = None
@@ -742,7 +804,8 @@ class LocalRunner:
         # client threads, and a read-modify-write here would mint
         # duplicate query ids
         entry = {"id": next(self._query_id_mint), "sql": sql.strip(),
-                 "state": "RUNNING", "rows": 0, "elapsed_ms": 0.0}
+                 "state": "RUNNING", "rows": 0, "elapsed_ms": 0.0,
+                 "error_kind": None}
         self.query_history.append(entry)
         del self.query_history[:-1000]  # bounded history
         t0 = _time.perf_counter()
@@ -763,8 +826,13 @@ class LocalRunner:
             entry["rows"] = None
             entry["_result"] = weakref.ref(result)
             return result
-        except Exception:
+        except Exception as e:
             entry["state"] = "FAILED"
+            # structured failure taxonomy (cancelled / deadline_
+            # exceeded / ...) so system.runtime.queries shows WHY,
+            # not just that it failed
+            entry["error_kind"] = getattr(e, "kind", None) \
+                or type(e).__name__
             raise
         finally:
             entry["elapsed_ms"] = round(
@@ -804,11 +872,14 @@ class LocalRunner:
                 QueryKilledByMemoryManager,
             )
             from presto_tpu.execution.memory import MemoryLimitExceeded
+            cancel, deadline = self._lifecycle()
             try:
                 try:
                     drivers = self.drive_pipelines(lplan.pipelines,
                                                    profile=profile,
-                                                   pool=pool)
+                                                   pool=pool,
+                                                   cancel=cancel,
+                                                   deadline=deadline)
                 finally:
                     if cm is not None:
                         cm.finish_query(cm_qid)
@@ -858,7 +929,9 @@ class LocalRunner:
     def drive_pipelines(pipelines: List[List],
                         max_idle_s: float = 600.0,
                         profile: bool = False,
-                        pool=None, cancel=None) -> List[Driver]:
+                        pool=None, cancel=None,
+                        deadline: Optional[float] = None
+                        ) -> List[Driver]:
         """Round-robin all drivers to completion (the TaskExecutor
         stand-in; shared by the local runner and worker tasks).
 
@@ -866,16 +939,22 @@ class LocalRunner:
         input arrives over the network exchange (a producer on another
         node may still be compiling) legitimately spins for a while, so
         no-progress rounds sleep briefly and only a `max_idle_s` stretch
-        with zero progress is treated as a deadlock. `cancel` is an
-        optional () -> bool polled each round (task abort)."""
+        with zero progress is treated as a deadlock.
+
+        `cancel` is an optional () -> bool polled each round — the
+        cooperative kill point shared by task abort, client kill, and
+        query abandonment. `deadline` is an optional time.monotonic()
+        instant checked at the same cadence (per-query
+        query_max_run_time_ms): a runaway query terminates within one
+        drive-loop round of either tripping, releasing its drivers
+        (and their device buffers) through the error path."""
         import time as _time
         dctx = DriverContext(profile=profile, memory=pool)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
         idle_since: Optional[float] = None
         while True:
-            if cancel is not None and cancel():
-                raise QueryError("task cancelled")
+            check_lifecycle(cancel, deadline)
             all_done = True
             progress = False
             for d in drivers:

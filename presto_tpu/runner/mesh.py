@@ -287,6 +287,7 @@ class MeshRunner(LocalRunner):
 
         t0 = _time.perf_counter()
         stat_snaps: List[List] = []
+        cancel, deadline = self._lifecycle()
         try:
             self._drive_phased(fplan, all_drivers, instance_drivers,
                                remaining_lifespans, exchanges,
@@ -295,7 +296,8 @@ class MeshRunner(LocalRunner):
                                deferred=deferred,
                                phase_deps=phase_deps,
                                lifespans_of=lifespans_of,
-                               recover=recover)
+                               recover=recover,
+                               cancel=cancel, deadline=deadline)
             from presto_tpu.operators.base import run_deferred_checks
             run_deferred_checks(dctx)
         finally:
@@ -319,7 +321,9 @@ class MeshRunner(LocalRunner):
                       deferred: Optional[List[int]] = None,
                       phase_deps: Optional[Dict[int, List[int]]] = None,
                       lifespans_of: Optional[Dict[int, int]] = None,
-                      recover: bool = False) -> None:
+                      recover: bool = False,
+                      cancel=None,
+                      deadline: Optional[float] = None) -> None:
         """Round-robin drive with lifespan phases: when the loop stalls
         because a grouped fragment's current bucket is drained, advance
         its input exchanges to the next bucket and spawn fresh task
@@ -420,8 +424,14 @@ class MeshRunner(LocalRunner):
             swap_generation(fid, abort)
             return True
 
+        from presto_tpu.runner.local import check_lifecycle
         rounds = 0
         while True:
+            # the same lifecycle checkpoints as the local drive loop:
+            # kill and deadline both terminate within one round, even
+            # mid-lifespan (retained bucket pages are dropped by the
+            # caller's finally-close of every exchange)
+            check_lifecycle(cancel, deadline)
             all_done = not deferred
             progress = False
             for d in list(all_drivers):

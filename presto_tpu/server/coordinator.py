@@ -19,20 +19,84 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+from presto_tpu.execution import faults
 from presto_tpu.server.node import (
-    Node, build_http_exchanges, derive_fragments, http_get, http_post,
+    TRANSPORT_RETRIES, Node, build_http_exchanges, derive_fragments,
+    http_delete, http_get, http_post,
 )
 
 
 class TaskFailed(RuntimeError):
     """A remote task failed; carries the structured retry hint when
-    the failure is one of the engine's sync-free overflow errors."""
+    the failure is one of the engine's sync-free overflow errors, and
+    the worker url when the failure implicates the WORKER (unreachable
+    / connection-level) rather than the query — the elastic retry
+    loop blacklists implicated workers for the query's later attempts
+    even if their /v1/info recovers (a flapping worker must not be
+    re-picked)."""
 
     def __init__(self, message: str, kind: Optional[str] = None,
-                 suggested: Optional[int] = None):
+                 suggested: Optional[int] = None,
+                 worker: Optional[str] = None):
         super().__init__(message)
         self.kind = kind
         self.suggested = suggested
+        self.worker = worker
+
+
+class QueryFailed(RuntimeError):
+    """Client-side structured failure (reference: presto-client's
+    QueryError): `kind` carries the engine's failure taxonomy
+    ("cancelled", "deadline_exceeded", "abandoned", "client_timeout",
+    or None)."""
+
+    def __init__(self, message: str, kind: Optional[str] = None,
+                 query_id: Optional[str] = None):
+        super().__init__(message)
+        self.kind = kind
+        self.query_id = query_id
+
+
+class QueryCancelled(QueryFailed):
+    """The query was killed (client DELETE / abandonment)."""
+
+
+class QueryTimedOut(QueryFailed):
+    """Client-side poll timeout or server-side deadline. When the
+    CLIENT times out it first issues the kill, so the server stops
+    burning coordinator/worker/cache budget on an answer nobody will
+    read."""
+
+
+class QueryLifecycle:
+    """Per-query control surface threaded from the coordinator's
+    client protocol down to every drive loop: the cooperative cancel
+    event, the monotonic deadline, the live attempt's remote tasks
+    (so a kill can fan out task DELETEs immediately instead of
+    waiting a drive round), and the attempt counter chaos tests
+    assert on (a transient exchange fault absorbed below this tier
+    must leave attempts == 1)."""
+
+    def __init__(self, cancel: Optional[threading.Event] = None,
+                 deadline: Optional[float] = None):
+        self.cancel = cancel if cancel is not None \
+            else threading.Event()
+        self.deadline = deadline
+        #: (task_id, worker_url) of the CURRENT attempt
+        self.remote: List[tuple] = []
+        self.attempts = 0
+        #: WHY the cancel event was set ("cancelled" vs "abandoned")
+        #: — the drive loop only knows it was told to stop
+        self.kill_kind: Optional[str] = None
+
+    def abort_remote(self) -> None:
+        """Best-effort DELETE of the live attempt's worker tasks —
+        idempotent with the attempt's own release path."""
+        for task_id, wurl in list(self.remote):
+            try:
+                http_delete(f"{wurl}/v1/task/{task_id}", timeout=5)
+            except Exception:  # noqa: BLE001 — best-effort abort
+                pass
 
 
 def _retry_hint(e: Exception):
@@ -57,6 +121,7 @@ class _Query:
         self.sql = sql
         self.state = "QUEUED"
         self.error: Optional[str] = None
+        self.error_kind: Optional[str] = None
         self.columns: Optional[List[dict]] = None
         self.data: Optional[List[list]] = None
         self.done_at: Optional[float] = None  # set at terminal state
@@ -66,6 +131,7 @@ class _Query:
         self.dispatch = None  # resource-group dispatch callback
         self.last_poll = time.monotonic()
         self.created_at = time.monotonic()
+        self.lifecycle = QueryLifecycle()
 
 
 #: result rows per client page (reference: the target-result-size
@@ -126,6 +192,29 @@ class Coordinator(Node):
         #: receiving {"event": "query_created"|"query_completed", ...};
         #: listener errors never fail queries
         self.event_listeners: List = []
+        #: periodic pruner (reference: DispatchManager's scheduled
+        #: query-abandonment sweep): abandonment must fire on an
+        #: OTHERWISE-IDLE coordinator too — with pruning only on new
+        #: statement POSTs, a lone client that submitted and died
+        #: would leave its RUNNING query burning to completion
+        self._pruner_stop = threading.Event()
+        self._pruner = threading.Thread(target=self._prune_loop,
+                                        daemon=True)
+
+    def start(self) -> None:
+        super().start()
+        self._pruner.start()
+
+    def stop(self) -> None:
+        self._pruner_stop.set()
+        super().stop()
+
+    def _prune_loop(self, period_s: float = 15.0) -> None:
+        while not self._pruner_stop.wait(period_s):
+            try:
+                self._prune_queries()
+            except Exception:  # noqa: BLE001 — the sweep must outlive
+                pass           # any one bad query entry
 
     def _fire_event(self, payload: dict) -> None:
         for listener in self.event_listeners:
@@ -217,6 +306,7 @@ class Coordinator(Node):
                 "elapsed_ms": round(elapsed * 1000, 1),
                 "rows": len(q.data) if q.data is not None else 0,
                 "error": q.error,
+                "error_kind": q.error_kind,
                 "sql": q.sql[:500],
             })
         return sorted(out, key=lambda r: -r["elapsed_ms"])
@@ -260,7 +350,8 @@ class Coordinator(Node):
                         f"{self.url}/v1/statement/executing/" \
                         f"{qid}/{token + 1}"
             elif q.state == "FAILED":
-                out["error"] = {"message": q.error}
+                out["error"] = {"message": q.error,
+                                "errorKind": q.error_kind}
             else:
                 out["nextUri"] = f"{self.url}/v1/statement/executing/" \
                                  f"{qid}/{token}"
@@ -330,7 +421,8 @@ th{{background:#222}}
     # -- query execution ---------------------------------------------------
 
     def _prune_queries(self, ttl_s: float = 600.0,
-                       queued_abandon_s: float = 60.0) -> None:
+                       queued_abandon_s: float = 60.0,
+                       running_abandon_s: float = 300.0) -> None:
         """Evict terminal queries (and their buffered result rows)
         `ttl_s` after they FINISHED/FAILED — the clock starts at
         completion so a slow query's results stay fetchable. pop()
@@ -339,22 +431,78 @@ th{{background:#222}}
         QUEUED queries whose client stopped polling for
         `queued_abandon_s` are cancelled out of their resource group's
         queue — an abandoned submission must not hold a queue position
-        against live clients (reference: DispatchManager's
-        query-abandonment pruning)."""
+        against live clients — and RUNNING queries whose client
+        stopped polling for `running_abandon_s` are KILLED through the
+        same cooperative-cancel path as an explicit DELETE: an
+        abandoned query must not burn coordinator, worker, and cache
+        budget to completion for an answer nobody will fetch
+        (reference: DispatchManager's query-abandonment pruning + the
+        client protocol's abandonment semantics in the Presto
+        paper)."""
         now = time.monotonic()
         for q in list(self.queries.values()):
-            if q.state == "QUEUED" and q.dispatch is not None \
+            if q.done_at is not None:
+                continue
+            if q.state == "QUEUED" \
                     and now - q.last_poll > queued_abandon_s:
-                if self.resource_groups.cancel_queued(q.group,
-                                                      q.dispatch):
-                    q.state = "FAILED"
-                    q.error = "query abandoned while queued"
-                    q.done_at = now
-                    q.dispatch()  # unblock the waiting runner thread
+                self._kill_query(q, "query abandoned while queued",
+                                 kind="abandoned")
+            elif q.state == "RUNNING" \
+                    and now - q.last_poll > running_abandon_s:
+                self._kill_query(q, "query abandoned while running",
+                                 kind="abandoned")
         for qid in [qid for qid, q in list(self.queries.items())
                     if q.done_at is not None
                     and now - q.done_at > ttl_s]:
             self.queries.pop(qid, None)
+
+    def _kill_query(self, q: _Query, message: str,
+                    kind: str = "cancelled") -> bool:
+        """Cooperatively stop a query in ANY non-terminal state; a
+        no-op on terminal queries (kill is idempotent — cancelling a
+        FINISHED query must not disturb its fetchable results).
+
+        QUEUED: the dispatch callback is cancelled out of its resource
+        group's queue and the waiting runner thread unblocked — the
+        queue position frees without ever running.
+
+        RUNNING: the per-query cancel event is set (every drive loop
+        — coordinator root drive, shared single-node runner, worker
+        tasks — polls it each round) and the live attempt's remote
+        tasks get an immediate best-effort DELETE fan-out; state
+        transition + resource release stay with _run_query's finally,
+        which owns them."""
+        if q.done_at is not None:
+            return False
+        q.lifecycle.kill_kind = kind
+        q.lifecycle.cancel.set()
+        if q.state == "QUEUED" and q.dispatch is not None \
+                and self.resource_groups.cancel_queued(q.group,
+                                                       q.dispatch):
+            q.state = "FAILED"
+            q.error = message
+            q.error_kind = kind
+            q.done_at = time.monotonic()
+            q.dispatch()  # unblock the waiting runner thread
+            return True
+        q.lifecycle.abort_remote()
+        return True
+
+    def handle_delete(self, path: str) -> bytes:
+        if path.startswith("/v1/statement/"):
+            # client kill (reference: StatementClientV1.close DELETEs
+            # its nextUri; QueuedStatementResource.cancelQuery):
+            # accepts both the submit URI (/v1/statement/{id}) and
+            # the executing nextUri form
+            parts = [p for p in path.split("/") if p]
+            qid = parts[3] if len(parts) > 3 \
+                and parts[2] == "executing" else parts[2]
+            q = self.queries[qid]  # KeyError -> 404
+            self._kill_query(q, "query cancelled by client request",
+                             kind="cancelled")
+            return json.dumps({"id": q.id,
+                               "state": q.state}).encode()
+        return super().handle_delete(path)
 
     def _run_query(self, q: _Query, has_slot: bool = True,
                    dispatched: Optional[threading.Event] = None) -> None:
@@ -368,9 +516,22 @@ th{{background:#222}}
                 return
         q.state = "RUNNING"
         try:
+            # per-query deadline: anchored at SUBMIT (queue time
+            # counts — reference: query_max_run_time, which includes
+            # queued time, vs query_max_execution_time)
+            from presto_tpu.session_properties import get_property
+            limit_ms = get_property(self.properties,
+                                    "query_max_run_time_ms")
+            if limit_ms:
+                q.lifecycle.deadline = \
+                    q.created_at + float(limit_ms) / 1000.0
+            if q.lifecycle.cancel.is_set():
+                raise QueryFailed("query cancelled before execution",
+                                  kind="cancelled")
             result = self.execute(
                 q.sql, on_columns=lambda cols: setattr(
-                    q, "columns", cols), user=q.user)
+                    q, "columns", cols), user=q.user,
+                lifecycle=q.lifecycle)
             q.columns = [
                 {"name": n, "type": f.type.display()}
                 for n, f in zip(result.names, result.fields)]
@@ -379,6 +540,11 @@ th{{background:#222}}
             q.state = "FINISHED"
         except Exception as e:  # noqa: BLE001
             q.error = f"{type(e).__name__}: {e}"
+            # the kill reason (abandoned vs cancelled) outranks the
+            # drive loop's generic "cancelled": the drive only knows
+            # it was told to stop, the killer knows why
+            q.error_kind = q.lifecycle.kill_kind \
+                or getattr(e, "kind", None)
             q.state = "FAILED"
         finally:
             q.done_at = time.monotonic()
@@ -391,7 +557,8 @@ th{{background:#222}}
                 "rows": len(q.data) if q.data is not None else 0,
                 "error": q.error})
 
-    def execute(self, sql: str, on_columns=None, user: str = ""):
+    def execute(self, sql: str, on_columns=None, user: str = "",
+                lifecycle: Optional[QueryLifecycle] = None):
         """Distributed execution with elastic retry: a failed or dead
         worker fails the attempt, the membership is re-probed, and the
         query re-runs on the survivors — splits regenerate identically
@@ -399,11 +566,19 @@ th{{background:#222}}
         SqlQueryScheduler section retry :667-690 + P7/P8 relocatable
         splits; a whole-query retry is the single-section case).
         `on_columns` fires once the output schema is known (before any
-        result rows exist — the client protocol's early-columns)."""
+        result rows exist — the client protocol's early-columns).
+        `lifecycle` carries the cooperative cancel event + deadline
+        (see QueryLifecycle); its attempt counter is how tests prove a
+        transient exchange fault was absorbed BELOW this retry tier."""
         from presto_tpu.session_properties import get_property
+        if lifecycle is None:
+            lifecycle = QueryLifecycle()
         if self.single_node:
+            lifecycle.attempts += 1
             runner = self._runner()
-            result = runner.execute_as(sql, user)
+            result = runner.execute_as(
+                sql, user, cancel=lifecycle.cancel.is_set,
+                deadline=lifecycle.deadline)
             if on_columns is not None:
                 on_columns([
                     {"name": n, "type": f.type.display()}
@@ -413,14 +588,25 @@ th{{background:#222}}
                                    "query_retries"))
         workers = list(self.worker_urls)
         props = dict(self.properties)
+        #: workers implicated in a connection-level failure this
+        #: query: never re-picked by a later attempt, even if their
+        #: /v1/info answers again (a flapping worker would otherwise
+        #: eat the whole retry budget)
+        blacklist: set = set()
         attempt = 0
         bumps = 0
         while True:
             try:
                 return self._execute_attempt(sql, workers, props,
                                              on_columns=on_columns,
-                                             user=user)
+                                             user=user,
+                                             lifecycle=lifecycle)
             except Exception as e:  # noqa: BLE001 — inspect + retry
+                # a killed/expired query must NOT burn the elastic
+                # retry budget re-running work nobody wants
+                if getattr(e, "kind", None) in ("cancelled",
+                                                "deadline_exceeded"):
+                    raise
                 # sync-free overflow protocol: re-run the WHOLE query
                 # with the suggested setting (any fragment may have
                 # raised it, local or remote) — not a failure retry
@@ -433,8 +619,13 @@ th{{background:#222}}
                 attempt += 1
                 if attempt > retries:
                     raise
+                bad = getattr(e, "worker", None)
+                if bad:
+                    blacklist.add(bad)
                 alive = []
                 for url in workers:
+                    if url in blacklist:
+                        continue
                     try:
                         st = json.loads(http_get(f"{url}/v1/info",
                                                  timeout=5))
@@ -445,9 +636,9 @@ th{{background:#222}}
                 if not alive:
                     raise
                 if len(alive) == len(workers):
-                    # nothing died — the failure is the query's own
-                    # (analysis error, execution bug): don't mask it
-                    # behind a retry
+                    # nothing died and no worker was implicated — the
+                    # failure is the query's own (analysis error,
+                    # execution bug): don't mask it behind a retry
                     raise
                 workers = alive
                 continue
@@ -479,8 +670,12 @@ th{{background:#222}}
 
     def _execute_attempt(self, sql: str, worker_urls: List[str],
                          properties: Optional[dict] = None,
-                         on_columns=None, user: str = ""):
+                         on_columns=None, user: str = "",
+                         lifecycle: Optional[QueryLifecycle] = None):
         """One scheduling attempt over a fixed worker set."""
+        if lifecycle is None:
+            lifecycle = QueryLifecycle()
+        lifecycle.attempts += 1
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
         )
@@ -535,6 +730,10 @@ th{{background:#222}}
         # attempt's remote tasks and drop its exchange state before the
         # retry loop launches the next attempt
         remote: List[tuple] = []
+        # the lifecycle sees the live attempt's tasks (same list
+        # object) so a kill fans out task DELETEs without waiting for
+        # the drive loop's next cancel poll
+        lifecycle.remote = remote
         stop = threading.Event()
         try:
             # dispatch distributed fragments: one task per worker
@@ -562,8 +761,22 @@ th{{background:#222}}
                         "n_producers_by_edge": n_producers_by_edge,
                         "coordinator_url": self.url,
                     }
-                    http_post(f"{wurl}/v1/task",
-                              json.dumps(spec).encode())
+                    body = json.dumps(spec).encode()
+
+                    def dispatch(wurl=wurl, body=body):
+                        # fault site + transport retry INSIDE one
+                        # dispatch: a lost response re-POSTs, and the
+                        # worker's idempotent create_task dedups
+                        if faults.ARMED:
+                            faults.fire("task.dispatch", url=wurl)
+                        http_post(f"{wurl}/v1/task", body)
+                    from presto_tpu.server.node import _retry_transient
+                    try:
+                        _retry_transient(dispatch, TRANSPORT_RETRIES)
+                    except Exception as e:  # noqa: BLE001
+                        raise TaskFailed(
+                            f"task dispatch to {wurl} failed: {e}",
+                            worker=wurl) from e
                     remote.append((task_id, wurl))
 
             # run single-partition fragments here (root last -> result)
@@ -597,16 +810,21 @@ th{{background:#222}}
             def watch():
                 # failure detection: poll remote task state; a failed
                 # task fails the query (reference:
-                # ContinuousTaskStatusFetcher + RequestErrorTracker)
+                # ContinuousTaskStatusFetcher + RequestErrorTracker).
+                # Status polls retry with backoff so one dropped poll
+                # response doesn't escalate to a whole-query retry —
+                # only a worker that stays unreachable does (and it
+                # gets blacklisted for this query's later attempts)
                 while not stop.is_set():
                     for task_id, wurl in remote:
                         try:
                             st = json.loads(http_get(
                                 f"{wurl}/v1/task/{task_id}",
-                                timeout=10))
+                                timeout=10, retries=2))
                         except Exception as e:  # noqa: BLE001
                             failure.append(TaskFailed(
-                                f"worker {wurl} unreachable: {e}"))
+                                f"worker {wurl} unreachable: {e}",
+                                worker=wurl))
                             return
                         if st["state"] == "failed":
                             failure.append(TaskFailed(
@@ -619,9 +837,13 @@ th{{background:#222}}
 
             watcher = threading.Thread(target=watch, daemon=True)
             watcher.start()
-            drivers = self._drive_with_failures(pipelines, failure)
+            drivers = self._drive_with_failures(
+                pipelines, failure,
+                cancel=lifecycle.cancel.is_set,
+                deadline=lifecycle.deadline)
         finally:
             stop.set()
+            lifecycle.remote = []
             self._release_everywhere(query_id, worker_urls)
         if failure:
             raise failure[0]
@@ -641,9 +863,16 @@ th{{background:#222}}
 
     @staticmethod
     def _drive_with_failures(pipelines, failure: List[str],
-                             max_idle_s: float = 600.0):
+                             max_idle_s: float = 600.0,
+                             cancel=None,
+                             deadline: Optional[float] = None):
+        """The coordinator's OWN drive loop (root + single-partition
+        fragments) — it polls the same cancel hook and deadline as
+        worker tasks do, so a kill stops the whole topology, not just
+        the remote fringe."""
         from presto_tpu.operators.base import DriverContext
         from presto_tpu.operators.driver import Driver
+        from presto_tpu.runner.local import check_lifecycle
         dctx = DriverContext()
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
@@ -651,6 +880,7 @@ th{{background:#222}}
         while True:
             if failure:
                 raise failure[0]
+            check_lifecycle(cancel, deadline)
             all_done = True
             progress = False
             for d in drivers:
@@ -683,13 +913,56 @@ class StatementClient:
     """Minimal client protocol driver (reference: presto-client
     StatementClientV1.advance:323 following nextUri). `user`/`source`
     travel as X-Presto-User / X-Presto-Source and drive resource-group
-    selection."""
+    selection.
+
+    Usable as a context manager: leaving the block cancels any query
+    still in flight (the reference client's close() semantics), so
+
+        with StatementClient(url) as c:
+            c.execute(sql)
+
+    never leaks a server-side RUNNING query on an exception."""
 
     def __init__(self, server: str, user: str = "",
                  source: str = ""):
         self.server = server.rstrip("/")
         self.user = user
         self.source = source
+        #: ids of the in-flight queries (multiple when threads share
+        #: the client) — what cancel() kills by default. A set under
+        #: a lock, not a single slot: with concurrent executes a lone
+        #: slot could resolve to None (no-op) or to ANOTHER thread's
+        #: query (wrong kill)
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
+
+    def __enter__(self) -> "StatementClient":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.cancel()
+
+    def cancel(self, query_id: Optional[str] = None) -> bool:
+        """Kill `query_id` — or, with no argument, EVERY query this
+        client currently has in flight (the connection-level cancel
+        semantics of the reference client's close()) — server-side
+        via DELETE /v1/statement/{id}. Safe to call from another
+        thread while execute() polls; idempotent; False when there is
+        nothing to cancel or no kill reached the server."""
+        if query_id is not None:
+            qids = [query_id]
+        else:
+            with self._inflight_lock:
+                qids = list(self._inflight)
+        ok = False
+        for qid in qids:
+            try:
+                http_delete(f"{self.server}/v1/statement/{qid}",
+                            timeout=10)
+                ok = True
+            except Exception:  # noqa: BLE001 — best-effort kill
+                pass
+        return ok
 
     def execute(self, sql: str, timeout: float = 600.0):
         headers = {}
@@ -701,24 +974,48 @@ class StatementClient:
             f"{self.server}/v1/statement", sql.encode(),
             timeout=timeout, headers=headers))
         deadline = time.time() + timeout
-        next_uri = resp["nextUri"]
-        columns = None
-        data: list = []
-        while True:
-            state = json.loads(http_get(next_uri))
-            s = state["stats"]["state"]
-            if "columns" in state and columns is None:
-                columns = state["columns"]
-            if s == "FAILED":
-                raise RuntimeError(state["error"]["message"])
-            if s == "FINISHED":
-                data.extend(state.get("data", []))
-                nxt = state.get("nextUri")
-                if nxt is None:
-                    return columns, data
-                next_uri = nxt
-                continue
-            next_uri = state["nextUri"]
-            if time.time() > deadline:
-                raise TimeoutError(f"query {resp['id']} timed out")
-            time.sleep(0.1)
+        qid = resp["id"]
+        with self._inflight_lock:
+            self._inflight.add(qid)
+        try:
+            next_uri = resp["nextUri"]
+            columns = None
+            data: list = []
+            while True:
+                # deadline gates EVERY round trip — including result
+                # paging of a FINISHED query (a slow multi-page fetch
+                # must time out too, not just a slow execution)
+                if time.time() > deadline:
+                    # kill server-side FIRST: a client that walks away
+                    # must not leave the query burning coordinator,
+                    # worker, and cache budget to completion
+                    self.cancel(qid)
+                    raise QueryTimedOut(
+                        f"query {qid} exceeded the client timeout "
+                        f"({timeout:g}s); kill issued",
+                        kind="client_timeout", query_id=qid)
+                state = json.loads(http_get(next_uri))
+                s = state["stats"]["state"]
+                if "columns" in state and columns is None:
+                    columns = state["columns"]
+                if s == "FAILED":
+                    err = state.get("error") or {}
+                    kind = err.get("errorKind")
+                    cls = QueryCancelled \
+                        if kind in ("cancelled", "abandoned") \
+                        else QueryTimedOut \
+                        if kind == "deadline_exceeded" else QueryFailed
+                    raise cls(err.get("message", "query failed"),
+                              kind=kind, query_id=qid)
+                if s == "FINISHED":
+                    data.extend(state.get("data", []))
+                    nxt = state.get("nextUri")
+                    if nxt is None:
+                        return columns, data
+                    next_uri = nxt
+                    continue
+                next_uri = state["nextUri"]
+                time.sleep(0.1)
+        finally:
+            with self._inflight_lock:
+                self._inflight.discard(qid)

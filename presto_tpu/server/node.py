@@ -19,30 +19,80 @@ from __future__ import annotations
 
 import collections
 import json
+import random
 import threading
 import time
 import traceback
+import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from presto_tpu.batch import Batch
+from presto_tpu.execution import faults
 from presto_tpu.operators.exchange_ops import edge_key_dicts
 from presto_tpu.server.serde import batch_from_bytes, batch_to_bytes
 
+#: transport retry budget for the exchange data plane and task RPCs —
+#: the tier BELOW elastic whole-query retry (reference: Trino's
+#: fault-tolerant exchange, "Project Tardigrade"): a transient network
+#: blip is absorbed here with backoff, so the expensive re-run tier
+#: only sees real node loss
+TRANSPORT_RETRIES = 4
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 1.0
+
+
+def _retry_transient(fn, retries: int, base_s: float = _BACKOFF_BASE_S,
+                     cap_s: float = _BACKOFF_CAP_S):
+    """Run `fn` with bounded exponential backoff + jitter on
+    TRANSPORT-level failures (refused/reset/timeout). HTTP error
+    RESPONSES (4xx/5xx) are application errors — the server spoke, it
+    said no — and are never retried here."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except urllib.error.HTTPError:
+            raise
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError):
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = min(base_s * (2 ** (attempt - 1)), cap_s)
+            # jitter keeps a fleet of retriers from re-colliding
+            time.sleep(delay * (0.5 + random.random() * 0.5))
+
 
 def http_post(url: str, body: bytes, timeout: float = 60.0,
-              headers: Optional[dict] = None) -> bytes:
-    req = urllib.request.Request(url, data=body, method="POST")
-    for k, v in (headers or {}).items():
-        req.add_header(k, v)
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return r.read()
+              headers: Optional[dict] = None,
+              retries: int = 0) -> bytes:
+    def send():
+        req = urllib.request.Request(url, data=body, method="POST")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read()
+    return _retry_transient(send, retries) if retries else send()
 
 
-def http_get(url: str, timeout: float = 60.0) -> bytes:
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.read()
+def http_get(url: str, timeout: float = 60.0,
+             retries: int = 0) -> bytes:
+    def send():
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read()
+    return _retry_transient(send, retries) if retries else send()
+
+
+def http_delete(url: str, timeout: float = 60.0,
+                retries: int = 0) -> bytes:
+    def send():
+        req = urllib.request.Request(url, method="DELETE")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read()
+    return _retry_transient(send, retries) if retries else send()
 
 
 class ExchangeRegistry:
@@ -65,6 +115,13 @@ class ExchangeRegistry:
         # entries no one will ever pop (bounded FIFO)
         self._released: "collections.OrderedDict[str, None]" = \
             collections.OrderedDict()
+        #: highest sequence number accepted per (exchange key,
+        #: consumer, producer) — a producer retries a timed-out push
+        #: with the SAME seq, so a push that actually landed before
+        #: its response was lost is dropped here instead of
+        #: double-delivering (at-least-once transport + dedup =
+        #: exactly-once delivery)
+        self._last_seq: Dict[Tuple[str, int, int], int] = {}
 
     def _is_released(self, key: str) -> bool:
         return key.split(":", 1)[0] in self._released
@@ -73,11 +130,20 @@ class ExchangeRegistry:
         with self._lock:
             self._expected[key] = count
 
-    def receive(self, key: str, consumer: int,
-                payload: bytes) -> None:
+    def receive(self, key: str, consumer: int, payload: bytes,
+                producer: Optional[int] = None,
+                seq: Optional[int] = None) -> None:
         with self._lock:
             if self._is_released(key):
                 return
+            if producer is not None and seq is not None:
+                sk = (key, consumer, producer)
+                if self._last_seq.get(sk, -1) >= seq:
+                    return  # duplicate delivery of a retried push
+                # pushes per (producer, consumer) are sequential (one
+                # drive thread per producer task), so marking before
+                # decode cannot skip a gap
+                self._last_seq[sk] = seq
         batch = batch_from_bytes(payload)
         with self._lock:
             if not self._is_released(key):
@@ -98,6 +164,8 @@ class ExchangeRegistry:
                 self._queues[(key, consumer)].append(batch)
 
     def pop(self, key: str, consumer: int) -> Optional[Batch]:
+        if faults.ARMED:
+            faults.fire("exchange.pop", key=key, consumer=consumer)
         with self._lock:
             q = self._queues[(key, consumer)]
             return q.popleft() if q else None
@@ -127,6 +195,9 @@ class ExchangeRegistry:
             for k in [k for k in self._expected
                       if k.startswith(prefix)]:
                 del self._expected[k]
+            for k in [k for k in self._last_seq
+                      if k[0].startswith(prefix)]:
+                del self._last_seq[k]
 
 
 def _host_segment(host: Batch, lo: int, hi: int) -> Batch:
@@ -179,6 +250,11 @@ class HttpExchange:
         registry.expect_producers(exchange_key, n_producers)
         self._rr = 0
         self._remaps = build_remap_tables(hash_dicts, key_dictionaries)
+        #: outgoing page sequence per (producer, consumer): rides the
+        #: push URL so a retried POST is deduplicated by the receiver
+        #: (pushes per pair are sequential — one drive thread per
+        #: producer task)
+        self._seq: Dict[Tuple[int, int], int] = {}
 
     # -- producer side (outgoing HTTP) -------------------------------------
 
@@ -186,12 +262,33 @@ class HttpExchange:
         return self.self_url is not None \
             and self.consumer_urls[consumer] == self.self_url
 
-    def _post(self, consumer: int, payload: bytes) -> None:
-        url = f"{self.consumer_urls[consumer]}/v1/exchange/" \
-              f"{self.exchange_id}/{consumer}"
-        http_post(url, payload)
+    def _post(self, consumer: int, payload: bytes,
+              producer: int) -> None:
+        """One page push: sequence-numbered, retried with backoff.
+        The fault sites sit INSIDE the retry loop so an injected
+        "before" fault models a page that never left (the retry
+        delivers it) and an "after" fault models a page that landed
+        with its response lost (the retry re-sends; the receiver's
+        seq dedup drops the duplicate)."""
+        sk = (producer, consumer)
+        seq = self._seq.get(sk, -1) + 1
+        self._seq[sk] = seq
+        url = (f"{self.consumer_urls[consumer]}/v1/exchange/"
+               f"{self.exchange_id}/{consumer}"
+               f"?producer={producer}&seq={seq}")
 
-    def _deliver_whole(self, consumers: List[int], batch: Batch) -> None:
+        def send():
+            if faults.ARMED:
+                faults.fire("exchange.push", phase="before", url=url,
+                            seq=seq)
+            http_post(url, payload)
+            if faults.ARMED:
+                faults.fire("exchange.push", phase="after", url=url,
+                            seq=seq)
+        _retry_transient(send, TRANSPORT_RETRIES)
+
+    def _deliver_whole(self, consumers: List[int], batch: Batch,
+                       producer: int) -> None:
         """Route one un-split batch to each listed consumer: local ones
         share the compacted host batch, remote ones share ONE
         serialization."""
@@ -211,19 +308,20 @@ class HttpExchange:
         elif remote:
             payload = batch_to_bytes(batch)
         for c in remote:
-            self._post(c, payload)
+            self._post(c, payload, producer)
 
     def push(self, producer: int, batch: Batch) -> None:
         if self.scheme == "gather":
-            self._deliver_whole([0], batch)
+            self._deliver_whole([0], batch, producer)
         elif self.scheme == "broadcast":
-            self._deliver_whole(list(range(self.n_consumers)), batch)
+            self._deliver_whole(list(range(self.n_consumers)), batch,
+                                producer)
         elif self.scheme == "passthrough":
-            self._deliver_whole([producer], batch)
+            self._deliver_whole([producer], batch, producer)
         elif self.scheme == "repartition" and not self.partition_keys:
             c = self._rr % self.n_consumers
             self._rr += 1
-            self._deliver_whole([c], batch)
+            self._deliver_whole([c], batch, producer)
         else:
             import jax
 
@@ -243,9 +341,12 @@ class HttpExchange:
                     self.registry.receive_local(self.exchange_id, c, seg)
                 else:
                     self._post(c, batch_to_bytes(seg,
-                                                 assume_compact=True))
+                                                 assume_compact=True),
+                               producer)
 
     def producer_done(self, producer: int) -> None:
+        # eos is naturally idempotent (producer-set union), so the
+        # retried POST needs no sequence number
         for c in range(self.n_consumers):
             if self._is_local(c):
                 self.registry.receive_eos(self.exchange_id, c, producer)
@@ -253,7 +354,7 @@ class HttpExchange:
             http_post(
                 f"{self.consumer_urls[c]}/v1/exchange/"
                 f"{self.exchange_id}/{c}/eos?producer={producer}",
-                b"")
+                b"", retries=TRANSPORT_RETRIES)
 
     # -- consumer side (local registry) ------------------------------------
 
@@ -325,6 +426,19 @@ class NodeHandler(BaseHTTPRequestHandler):
                 {"error": f"{type(e).__name__}: {e}",
                  "trace": traceback.format_exc(limit=5)}).encode())
 
+    def do_DELETE(self):
+        try:
+            body = self.node.handle_delete(self.path)
+        except KeyError:
+            self._reply(404, b'{"error": "not found"}')
+            return
+        except Exception as e:  # noqa: BLE001 — surface to caller
+            self._reply(500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}",
+                 "trace": traceback.format_exc(limit=5)}).encode())
+            return
+        self._reply(200, body)
+
 
 class Node:
     """Shared HTTP node: exchange receipt + task RPC. The coordinator
@@ -362,8 +476,13 @@ class Node:
 
     def handle_get(self, path: str) -> bytes:
         if path == "/v1/info":
-            return json.dumps({"state": "active",
-                               "devices": self.n_devices}).encode()
+            info = {"state": "active", "devices": self.n_devices}
+            if faults.ARMED:
+                # observability for env-armed subprocess workers:
+                # chaos tests assert the fault FIRED, not just that
+                # the query survived (a never-firing test is vacuous)
+                info["faults"] = faults.counters()
+            return json.dumps(info).encode()
         if path == "/v1/tasks":
             # observability + test support (reference: /v1/task listing)
             return json.dumps({
@@ -381,15 +500,22 @@ class Node:
                     headers: Optional[dict] = None) -> bytes:
         if path.startswith("/v1/exchange/"):
             rest = path[len("/v1/exchange/"):]
-            if "/eos" in rest:
-                head, query = rest.split("/eos", 1)
-                xid_s, consumer_s = head.rsplit("/", 1)
-                producer = int(query.split("producer=")[1])
+            params: Dict[str, str] = {}
+            if "?" in rest:
+                rest, qs = rest.split("?", 1)
+                params = dict(urllib.parse.parse_qsl(qs))
+            if rest.endswith("/eos"):
+                xid_s, consumer_s = rest[:-len("/eos")].rsplit("/", 1)
                 self.registry.receive_eos(xid_s, int(consumer_s),
-                                          producer)
+                                          int(params["producer"]))
                 return b"{}"
             xid_s, consumer_s = rest.rsplit("/", 1)
-            self.registry.receive(xid_s, int(consumer_s), body)
+            producer = params.get("producer")
+            seq = params.get("seq")
+            self.registry.receive(
+                xid_s, int(consumer_s), body,
+                producer=int(producer) if producer is not None else None,
+                seq=int(seq) if seq is not None else None)
             return b"{}"
         if path == "/v1/task":
             spec = json.loads(body.decode())
@@ -404,12 +530,32 @@ class Node:
             return b"{}"
         raise KeyError(path)
 
+    def handle_delete(self, path: str) -> bytes:
+        if path.startswith("/v1/task/"):
+            # task abort (reference: TaskResource DELETE
+            # /v1/task/{taskId}): set the cancel flag the drive loop
+            # polls each round. Idempotent — a second DELETE, or one
+            # racing natural completion, just reports the state
+            tid = path.rsplit("/", 1)[1]
+            t = self.tasks[tid]
+            t.cancel.set()
+            return json.dumps({"taskId": tid,
+                               "state": t.state}).encode()
+        raise KeyError(path)
+
     # -- task execution ----------------------------------------------------
 
     def create_task(self, spec: dict) -> None:
         self._prune_tasks()
+        tid = spec["task_id"]
         state = TaskState()
-        self.tasks[spec["task_id"]] = state
+        # idempotent create: a dispatch POST whose response was lost
+        # gets retried by the coordinator — the task must not run
+        # twice (reference: TaskResource's create-or-update).
+        # setdefault is atomic under the GIL, so concurrent retries
+        # can't both win
+        if self.tasks.setdefault(tid, state) is not state:
+            return
         threading.Thread(target=self._run_task, args=(spec, state),
                          daemon=True).start()
 

@@ -150,6 +150,21 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "columns, constraint) so repeat scans skip the read/generate "
         "+ decode path (reference: the hive connector's data cache)"),
     PropertyDef(
+        "query_max_run_time_ms", "bigint", 0,
+        "Per-query wall-clock budget enforced at every drive-loop "
+        "checkpoint (coordinator root drive, local runner, mesh "
+        "phases); 0 = unlimited. Tripping fails the query with the "
+        "structured deadline_exceeded kind, releasing its resource-"
+        "group slot and aborting remote tasks (reference: "
+        "query_max_run_time)", _non_negative),
+    PropertyDef(
+        "fault_injection", "varchar", "",
+        "Deterministic fault-injection spec armed at execute time: "
+        "'site:trigger[:arg][:seed]' entries separated by ';' (e.g. "
+        "'exchange.push:nth:3'; sites/triggers in execution/"
+        "faults.py). Empty = disarmed, zero overhead. Applying the "
+        "SAME spec repeatedly does not reset trigger counters"),
+    PropertyDef(
         "cache_memory_bytes", "bigint", 4 << 30,
         "Shared byte budget of the fragment-result + page-source "
         "caches, charged to the cache manager's tagged MemoryPool; "

@@ -16,14 +16,21 @@ Protocol:
   warm  — every client runs the full mix `warm_rounds` times
   off   — (optional) the mix once more against a coordinator with
           every cache level disabled, for the equivalence oracle
+  chaos — (--chaos) the mix again with the deterministic fault
+          registry armed at a FIXED seed (periodic injected faults at
+          operator and cache seams): reports availability + an error
+          taxonomy alongside QPS, and every query that SUCCEEDS under
+          chaos must still be byte-identical to the warm phase —
+          faults may cost availability, never correctness.
 
 Every phase checksums each query's result rows; the run fails loudly
-if warm results are not byte-identical to cold and to caches-off.
+if warm results are not byte-identical to cold and to caches-off (or
+if any chaos-phase success diverges).
 
 Usage:
     python -m presto_tpu.tools.serving_bench --clients 4 \
         --schema sf0_1 --mix q1,q3,q6,q13 --warm-rounds 3 \
-        --out BENCH_SERVING_r07.json
+        --chaos --out BENCH_SERVING_r08.json
 """
 
 from __future__ import annotations
@@ -39,6 +46,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 #: agg q1/q6, a 3-way join q3, a join+group q13) — the shape a BI
 #: dashboard refresh sends at a serving cluster
 DEFAULT_MIX = ("q1", "q3", "q6", "q13")
+
+#: the fixed-seed chaos recipe: a transient operator fault roughly
+#: every ~150 batch hand-offs (fails the unlucky query with a clean
+#: structured error) and a cache-insert fault every 3rd put (absorbed
+#: as a rejection by contract) — deterministic via the spec's seeds
+DEFAULT_CHAOS_SPEC = ("operator.add_input:every:150:7;"
+                      "cache.put:every:3:11")
 
 
 def _percentile(xs: Sequence[float], p: float) -> float:
@@ -60,15 +74,24 @@ def _checksum(rows: List[list]) -> str:
 
 
 def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
+               tolerant: bool = False, timeout_s: float = 600.0,
                ) -> Tuple[dict, Dict[str, set]]:
     """Run each client's (name, sql) list on its own thread through
     the HTTP client protocol. Returns (phase stats, {query name ->
-    set of checksums over EVERY execution} — a single transient bad
-    read anywhere in the phase widens the set and fails the oracle)."""
+    set of checksums over EVERY SUCCESSFUL execution} — a single
+    transient bad read anywhere in the phase widens the set and fails
+    the oracle).
+
+    Default mode treats any query failure as fatal (the bench is
+    broken). `tolerant` is the CHAOS mode: per-query failures are
+    expected, recorded into an error taxonomy, and reported as
+    availability — the per-query client timeout bounds every fault
+    mode, so a chaos phase can lose availability but never hang."""
     from presto_tpu.server.coordinator import StatementClient
     latencies: List[float] = []
     checks: Dict[str, set] = {}
     errors: List[str] = []
+    taxonomy: Dict[str, int] = {}
     lock = threading.Lock()
     # count only clients with work: an empty assignment spawns no
     # thread, and a barrier party that never arrives would hang the
@@ -83,10 +106,16 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
         for name, sql in work:
             t0 = time.perf_counter()
             try:
-                _, data = c.execute(sql)
-            except Exception as e:  # noqa: BLE001 — recorded, fatal
+                _, data = c.execute(sql, timeout=timeout_s)
+            except Exception as e:  # noqa: BLE001 — recorded
+                kind = getattr(e, "kind", None) \
+                    or str(e).split(":", 1)[0].strip() \
+                    or type(e).__name__
                 with lock:
                     errors.append(f"{name}: {type(e).__name__}: {e}")
+                    taxonomy[kind] = taxonomy.get(kind, 0) + 1
+                if tolerant:
+                    continue
                 return
             dt = time.perf_counter() - t0
             with lock:
@@ -102,7 +131,7 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    if errors:
+    if errors and not tolerant:
         raise RuntimeError("serving bench query failed: "
                            + "; ".join(errors))
     n = len(latencies)
@@ -113,6 +142,15 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
         "p50_ms": round(_percentile(latencies, 0.50) * 1e3, 1),
         "p95_ms": round(_percentile(latencies, 0.95) * 1e3, 1),
     }
+    if tolerant:
+        total = n + len(errors)
+        stats.update({
+            "queries": total,
+            "succeeded": n,
+            "failed": len(errors),
+            "availability": round(n / total, 4) if total else None,
+            "errors": dict(sorted(taxonomy.items())),
+        })
     return stats, checks
 
 
@@ -129,6 +167,9 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       mix: Sequence[str] = DEFAULT_MIX,
                       warm_rounds: int = 3,
                       verify_off: bool = True,
+                      chaos: bool = False,
+                      chaos_rounds: int = 2,
+                      chaos_spec: str = DEFAULT_CHAOS_SPEC,
                       host: str = "127.0.0.1") -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.server.coordinator import Coordinator
@@ -141,6 +182,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                         max_concurrent_queries=clients,
                         single_node=True)
     coord.start()
+    chaos_doc = None
     try:
         # cold: each query exactly once, spread over the clients
         cold_assign = [work[i::clients] for i in range(clients)]
@@ -149,6 +191,36 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
         warm_assign = [list(work) * warm_rounds
                        for _ in range(clients)]
         warm, warm_checks = _run_phase(coord.url, warm_assign)
+        if chaos:
+            # chaos: the SAME coordinator (warm caches, live resource
+            # groups) under seeded periodic faults
+            from presto_tpu.execution import faults
+            faults.disarm()
+            for kw in faults.parse_spec(chaos_spec):
+                faults.arm(**kw)
+            try:
+                chaos_assign = [list(work) * chaos_rounds
+                                for _ in range(clients)]
+                chaos_stats, chaos_checks = _run_phase(
+                    coord.url, chaos_assign, tolerant=True,
+                    timeout_s=120.0)
+            finally:
+                faults.disarm()
+            # correctness oracle: every SUCCESS under chaos must be
+            # byte-identical to the warm phase's answer
+            consistent = all(
+                len(sums) == 1 and sums == warm_checks.get(name)
+                for name, sums in chaos_checks.items())
+            chaos_doc = {
+                "spec": chaos_spec,
+                "rounds": chaos_rounds,
+                **chaos_stats,
+                "successes_match_warm": consistent,
+            }
+            if not consistent:
+                raise RuntimeError(
+                    "chaos-phase successes diverged from warm "
+                    "results: " + json.dumps(chaos_doc, indent=1))
     finally:
         coord.stop()
 
@@ -205,6 +277,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
         "caches_off": off,
         "results_identical": identical,
         "cache": cache_stats,
+        "chaos": chaos_doc,
     }
     if not identical:
         raise RuntimeError(
@@ -227,12 +300,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--warm-rounds", type=int, default=3)
     p.add_argument("--skip-off", action="store_true",
                    help="skip the caches-disabled equivalence phase")
+    p.add_argument("--chaos", action="store_true",
+                   help="run a seeded fault-injection phase and "
+                        "report availability + error taxonomy")
+    p.add_argument("--chaos-rounds", type=int, default=2)
+    p.add_argument("--chaos-spec", default=DEFAULT_CHAOS_SPEC,
+                   help="fault spec (site:trigger[:arg][:seed];...)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
     doc = run_serving_bench(
         clients=args.clients, schema=args.schema,
         mix=[m.strip() for m in args.mix.split(",") if m.strip()],
-        warm_rounds=args.warm_rounds, verify_off=not args.skip_off)
+        warm_rounds=args.warm_rounds, verify_off=not args.skip_off,
+        chaos=args.chaos, chaos_rounds=args.chaos_rounds,
+        chaos_spec=args.chaos_spec)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

@@ -1,0 +1,737 @@
+"""Chaos + lifecycle battery: cooperative cancellation (kill /
+deadline / abandonment), exchange-tier fault absorption, and the
+deterministic fault-injection registry itself.
+
+Invariant under every injected fault: byte-identical results or a
+clean STRUCTURED failure — never a hang, never a wrong answer
+(reference: the Presto paper's client-abandonment semantics +
+Trino's fault-tolerant exchange tier).
+
+The stall helper turns any query into a slow one WITHOUT failing it:
+a predicate on the `operator.add_input` site that sleeps and declines
+to fire — so cancellation races are deterministic instead of
+depending on query size.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.execution import faults
+
+pytestmark = pytest.mark.chaos
+
+SQL_AGG = ("select returnflag, count(*) c, sum(quantity) q "
+           "from lineitem group by returnflag order by returnflag")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+def _stall(delay_s: float = 0.02):
+    """Arm a never-firing sleeper on every batch hand-off."""
+    def sleeper(ctx):
+        time.sleep(delay_s)
+        return False
+    return faults.arm("operator.add_input", trigger="always",
+                      predicate=sleeper)
+
+
+def _wait_for(pred, timeout_s: float = 20.0, what: str = "condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout_s:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# the registry itself
+
+
+def test_registry_triggers_deterministic():
+    calls = []
+
+    def hit(n):
+        try:
+            faults.fire("cache.put", n=n)
+        except faults.InjectedFault:
+            calls.append(n)
+
+    inj = faults.arm("cache.put", trigger="nth", n=3)
+    for i in range(6):
+        hit(i)
+    assert calls == [2] and inj.fired == 1 and inj.calls == 6
+    faults.disarm()
+    assert not faults.ARMED  # the zero-overhead gate drops with arms
+
+    faults.arm("cache.put", trigger="every", n=2)
+    calls.clear()
+    for i in range(6):
+        hit(i)
+    assert calls == [1, 3, 5]
+    faults.disarm()
+
+    # seeded probability: same seed -> same firing pattern, twice
+    def pattern():
+        faults.arm("cache.put", trigger="prob", p=0.5, seed=42)
+        out = []
+        for i in range(20):
+            try:
+                faults.fire("cache.put")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        faults.disarm()
+        return out
+
+    a, b = pattern(), pattern()
+    assert a == b and 0 < sum(a) < 20
+
+
+def test_registry_spec_parsing_idempotent():
+    faults.ensure_spec("cache.put:once; exchange.pop:nth:5:7")
+    assert faults.ARMED
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("cache.put")
+    # re-applying the SAME spec must not reset counters ("once" stays
+    # spent) — this is what lets execute() arm per-statement safely
+    faults.ensure_spec("cache.put:once; exchange.pop:nth:5:7")
+    faults.fire("cache.put")  # does not raise again
+    # a CHANGED spec REPLACES the old spec's injections (sessions
+    # alternating specs must not stack duplicates), while API-armed
+    # injections survive the swap
+    api_inj = faults.arm("task.dispatch", trigger="once")
+    faults.ensure_spec("page_source.next:once")
+    faults.fire("cache.put")          # old spec gone
+    faults.fire("exchange.pop")       # old spec gone
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("page_source.next")
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("task.dispatch")  # API injection still armed
+    assert api_inj.fired == 1
+    # an EMPTY spec removes the property-armed injections (the
+    # documented 'Empty = disarmed') but never API-armed ones
+    faults.ensure_spec("")
+    faults.fire("page_source.next")  # spec injection gone
+    assert faults.ARMED  # the spent API injection is still armed
+    with pytest.raises(ValueError):
+        faults.arm("no.such.site")
+    with pytest.raises(ValueError):
+        faults.parse_spec("cache.put")  # missing trigger
+
+
+# ---------------------------------------------------------------------------
+# exchange tier: exactly-once under retried pushes
+
+
+def _push_batch(seed=0, n=64):
+    from presto_tpu.batch import Batch
+    from presto_tpu.types import BIGINT
+    rng = np.random.default_rng(seed)
+    return Batch.from_numpy(
+        {"k": rng.integers(0, 1000, size=n)}, {"k": BIGINT})
+
+
+def _drain_rows(registry, key, consumer=0):
+    rows = []
+    while True:
+        b = registry.pop(key, consumer)
+        if b is None:
+            return rows
+        rows.extend(b.to_pydict()["k"])
+
+
+@pytest.mark.parametrize("phase", ["before", "after"])
+def test_exchange_push_retry_delivers_exactly_once(phase):
+    """phase="before": the page never left — the retry delivers it.
+    phase="after": the page LANDED but the response was lost — the
+    retry re-sends and the receiver's seq dedup drops the duplicate.
+    Either way: every row exactly once, one fault absorbed, zero
+    escalation."""
+    from presto_tpu.server.node import ExchangeRegistry, HttpExchange
+    from presto_tpu.server.node import Node
+    node = Node()
+    node.start()
+    try:
+        key = f"chaos-{phase}:0"
+        node.registry.expect_producers(key, 1)
+        ex = HttpExchange(key, "gather", [], None, [], [node.url], 1,
+                          ExchangeRegistry(), self_url=None)
+        inj = faults.arm("exchange.push", trigger="nth", n=1,
+                         phase=phase)
+        b1, b2 = _push_batch(1), _push_batch(2)
+        ex.push(0, b1)   # fault fires inside this push's retry loop
+        ex.push(0, b2)
+        ex.producer_done(0)
+        assert inj.fired == 1, "fault never fired — test is vacuous"
+        _wait_for(lambda: node.registry.finished(key, 0)
+                  or node.registry.has_output(key, 0), 10, "delivery")
+        got = sorted(_drain_rows(node.registry, key))
+        want = sorted(list(b1.to_pydict()["k"])
+                      + list(b2.to_pydict()["k"]))
+        assert got == want  # nothing lost, nothing doubled
+    finally:
+        node.stop()
+
+
+def test_exchange_fault_beyond_retry_budget_escalates():
+    """More consecutive transport faults than the retry budget must
+    surface the error (bounded backoff, not an infinite loop)."""
+    from presto_tpu.server.node import ExchangeRegistry, HttpExchange
+    from presto_tpu.server.node import Node
+    node = Node()
+    node.start()
+    try:
+        key = "chaos-budget:0"
+        ex = HttpExchange(key, "gather", [], None, [], [node.url], 1,
+                          ExchangeRegistry(), self_url=None)
+        faults.arm("exchange.push", trigger="always", phase="before")
+        with pytest.raises(faults.InjectedFault):
+            ex.push(0, _push_batch())
+    finally:
+        node.stop()
+
+
+# ---------------------------------------------------------------------------
+# cancellation: single-node topology
+
+
+#: session shape for the stall-based lifecycle tests: caches OFF (a
+#: fragment-cache replay of a repeated query crosses only a couple of
+#: batch hand-offs) and SMALL batches (tiny-scale lineitem fits one
+#: default 64K-row batch) — together they guarantee every stalled
+#: query crosses dozens of `operator.add_input` hand-offs, making
+#: cancellation races deterministic instead of timing-dependent
+NO_CACHE = {"plan_cache_enabled": False,
+            "fragment_result_cache_enabled": False,
+            "page_source_cache_enabled": False,
+            "batch_rows": 256}
+
+
+@pytest.fixture()
+def single_node_coord():
+    from presto_tpu.server.coordinator import Coordinator
+    coord = Coordinator([], "tpch", "tiny", single_node=True,
+                        max_concurrent_queries=2,
+                        max_queued_queries=10,
+                        properties=dict(NO_CACHE))
+    coord.start()
+    yield coord
+    coord.stop()
+
+
+def _client_run(coord, sql, errors, results, user="chaos"):
+    from presto_tpu.server.coordinator import StatementClient
+    c = StatementClient(coord.url, user=user, source="chaos")
+    try:
+        results.append(c.execute(sql))
+    except Exception as e:  # noqa: BLE001 — recorded for assertions
+        errors.append(e)
+
+
+def test_cancel_running_query_single_node(single_node_coord):
+    from presto_tpu.server.coordinator import QueryCancelled
+    coord = single_node_coord
+    _stall(0.02)
+    errors, results = [], []
+    t = threading.Thread(target=_client_run,
+                         args=(coord, SQL_AGG, errors, results))
+    t.start()
+    _wait_for(lambda: any(q.state == "RUNNING"
+                          for q in coord.queries.values()),
+              what="query RUNNING")
+    q = next(q for q in coord.queries.values())
+    from presto_tpu.server.node import http_delete
+    resp = json.loads(http_delete(
+        f"{coord.url}/v1/statement/{q.id}"))
+    assert resp["id"] == q.id
+    t.join(timeout=15)
+    assert not t.is_alive(), "cancel did not stop the query"
+    assert len(errors) == 1 and isinstance(errors[0], QueryCancelled)
+    assert errors[0].kind == "cancelled"
+    assert q.state == "FAILED" and q.error_kind == "cancelled"
+    # resource-group slot released
+    assert all(g["running"] == 0 and g["queued"] == 0
+               for g in coord.resource_groups.snapshot())
+    # the shared runner is healthy: a clean query still answers
+    from presto_tpu.server.coordinator import StatementClient
+    faults.disarm()
+    _, rows = StatementClient(coord.url).execute(
+        "select count(*) from nation")
+    assert rows == [[25]]
+
+
+def test_cancel_is_idempotent_across_states(single_node_coord):
+    from presto_tpu.server.coordinator import (
+        QueryCancelled, StatementClient,
+    )
+    from presto_tpu.server.node import http_delete, http_get
+    coord = single_node_coord
+    # FINISHED: kill must be a no-op and results stay fetchable
+    c = StatementClient(coord.url, user="idem")
+    _, rows = c.execute("select count(*) from region")
+    qid = next(q.id for q in coord.queries.values()
+               if q.state == "FINISHED")
+    for _ in range(2):  # twice: idempotent
+        resp = json.loads(http_delete(
+            f"{coord.url}/v1/statement/{qid}"))
+        assert resp["state"] == "FINISHED"
+    page = json.loads(http_get(
+        f"{coord.url}/v1/statement/executing/{qid}/0"))
+    assert page["data"] == [[5]]
+    # unknown id -> 404, not a crash
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        http_delete(f"{coord.url}/v1/statement/nope")
+
+    # QUEUED: fill both slots with stalled queries, queue a third,
+    # kill it before it ever runs
+    _stall(0.02)
+    errors, results = [], []
+    threads = [threading.Thread(target=_client_run,
+                                args=(coord, SQL_AGG, errors, results))
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    _wait_for(lambda: any(q.state == "QUEUED"
+                          for q in coord.queries.values()),
+              what="a QUEUED query")
+    queued = next(q for q in coord.queries.values()
+                  if q.state == "QUEUED")
+    for _ in range(2):  # twice: idempotent
+        json.loads(http_delete(
+            f"{coord.url}/v1/statement/{queued.id}"))
+    # the kill is synchronous for a query still QUEUED, asynchronous
+    # (next drive round) if a freed slot dispatched it in the
+    # meantime — either way it must settle FAILED/cancelled
+    _wait_for(lambda: queued.state == "FAILED",
+              what="killed query settling")
+    assert queued.error_kind == "cancelled"
+    # now kill the running pair too and let everything settle
+    for q in list(coord.queries.values()):
+        if q.state == "RUNNING":
+            http_delete(f"{coord.url}/v1/statement/{q.id}")
+    for t in threads:
+        t.join(timeout=15)
+        assert not t.is_alive()
+    assert len(errors) == 3
+    assert all(isinstance(e, QueryCancelled) for e in errors)
+    assert all(g["running"] == 0 and g["queued"] == 0
+               for g in coord.resource_groups.snapshot())
+
+
+def test_cancel_storm_leaves_server_clean(single_node_coord):
+    """A concurrent cancel storm against the shared runner: every
+    query dies structured, the resource group zeroes out, the cache
+    manager's pool ledger stays consistent with its entries, and the
+    server still serves."""
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.server.coordinator import StatementClient
+    from presto_tpu.server.node import http_delete
+    coord = single_node_coord
+    _stall(0.01)
+    errors, results = [], []
+    n = 6
+    threads = [threading.Thread(
+        target=_client_run,
+        args=(coord, SQL_AGG, errors, results, f"storm-{i}"))
+        for i in range(n)]
+    for t in threads:
+        t.start()
+    _wait_for(lambda: sum(q.state in ("RUNNING", "QUEUED")
+                          for q in coord.queries.values()) == n,
+              what="all storm queries admitted")
+    # kill in submission order, concurrently with execution
+    for q in list(coord.queries.values()):
+        http_delete(f"{coord.url}/v1/statement/{q.id}")
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive()
+    assert len(errors) == n and not results
+    assert all(g["running"] == 0 and g["queued"] == 0
+               for g in coord.resource_groups.snapshot())
+    # cache budget ledger consistent: reserved == sum of live entries
+    mgr = get_cache_manager()
+    assert mgr.pool.reserved == mgr.fragment.bytes + mgr.page.bytes
+    # and the serving surface still works end to end
+    faults.disarm()
+    _, rows = StatementClient(coord.url).execute(
+        "select level from system.runtime.caches order by level")
+    assert rows == [["fragment"], ["page"], ["plan"]]
+
+
+def test_running_abandonment_pruned(single_node_coord):
+    """A RUNNING query whose client vanished is killed by the pruner
+    (previously only QUEUED queries were reaped — an abandoned
+    RUNNING query burned to completion)."""
+    from presto_tpu.server.node import http_post
+    coord = single_node_coord
+    _stall(0.02)
+    # submit WITHOUT ever polling (the vanished client)
+    resp = json.loads(http_post(
+        f"{coord.url}/v1/statement", SQL_AGG.encode(),
+        headers={"X-Presto-User": "ghost"}))
+    qid = resp["id"]
+    _wait_for(lambda: coord.queries[qid].state == "RUNNING",
+              what="ghost query RUNNING")
+    time.sleep(0.3)
+    coord._prune_queries(running_abandon_s=0.2)
+    _wait_for(lambda: coord.queries[qid].state == "FAILED",
+              what="abandoned query killed")
+    assert coord.queries[qid].error_kind == "abandoned"
+    assert all(g["running"] == 0
+               for g in coord.resource_groups.snapshot())
+
+
+def test_client_timeout_issues_server_side_kill(single_node_coord):
+    from presto_tpu.server.coordinator import (
+        QueryTimedOut, StatementClient,
+    )
+    coord = single_node_coord
+    _stall(0.05)
+    c = StatementClient(coord.url, user="impatient")
+    with pytest.raises(QueryTimedOut) as ei:
+        c.execute(SQL_AGG, timeout=0.5)
+    assert ei.value.kind == "client_timeout"
+    qid = ei.value.query_id
+    # the timeout handed the server a kill: the query dies instead of
+    # burning the shared runner to completion
+    _wait_for(lambda: coord.queries[qid].state == "FAILED",
+              what="server-side kill after client timeout")
+    assert coord.queries[qid].error_kind == "cancelled"
+    assert all(g["running"] == 0
+               for g in coord.resource_groups.snapshot())
+
+
+def test_statement_client_context_manager_cancels(single_node_coord):
+    from presto_tpu.server.coordinator import StatementClient
+    coord = single_node_coord
+    _stall(0.02)
+    done = threading.Event()
+
+    def run():
+        with StatementClient(coord.url, user="ctx") as c:
+            threading.Thread(
+                target=lambda: (done.wait(10), c.cancel()),
+                daemon=True).start()
+            try:
+                c.execute(SQL_AGG)
+            except Exception:  # noqa: BLE001 — cancellation expected
+                pass
+
+    t = threading.Thread(target=run)
+    t.start()
+    _wait_for(lambda: any(q.state == "RUNNING"
+                          for q in coord.queries.values()),
+              what="ctx query RUNNING")
+    done.set()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    _wait_for(lambda: all(q.done_at is not None
+                          for q in coord.queries.values()),
+              what="all queries terminal")
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+
+
+def test_deadline_local_runner_structured():
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.runner.local import QueryError
+    r = LocalRunner("tpch", "tiny",
+                    {"query_max_run_time_ms": 250, **NO_CACHE})
+    _stall(0.05)
+    with pytest.raises(QueryError) as ei:
+        r.execute(SQL_AGG)
+    assert ei.value.kind == "deadline_exceeded"
+    faults.disarm()
+    # the structured kind lands in system.runtime.queries (the
+    # observation query runs WITHOUT the 250ms budget — cold jit
+    # compile alone would trip it)
+    r.session.properties.pop("query_max_run_time_ms")
+    rows = r.execute(
+        "select state, error_kind from system.runtime.queries "
+        "order by query_id").rows()
+    assert ("FAILED", "deadline_exceeded") in [
+        (s, k) for s, k, in rows]
+    # and an un-stalled query under the same session finishes fine
+    assert r.execute("select count(*) from nation").rows() == [(25,)]
+
+
+def test_deadline_mesh_runner():
+    from presto_tpu.runner import MeshRunner
+    from presto_tpu.runner.local import QueryError
+    mesh = MeshRunner("tpch", "tiny",
+                      {"query_max_run_time_ms": 250,
+                       "target_splits": 8, **NO_CACHE})
+    _stall(0.05)
+    with pytest.raises(QueryError) as ei:
+        mesh.execute(SQL_AGG)
+    assert ei.value.kind == "deadline_exceeded"
+
+
+def test_deadline_under_load_coordinator():
+    from presto_tpu.server.coordinator import (
+        Coordinator, QueryTimedOut,
+    )
+    coord = Coordinator([], "tpch", "tiny", single_node=True,
+                        max_concurrent_queries=3,
+                        properties={"query_max_run_time_ms": 500,
+                                    **NO_CACHE})
+    coord.start()
+    try:
+        _stall(0.05)
+        errors, results = [], []
+        threads = [threading.Thread(
+            target=_client_run,
+            args=(coord, SQL_AGG, errors, results, f"dl-{i}"))
+            for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert len(errors) == 3 and not results
+        assert all(isinstance(e, QueryTimedOut)
+                   and e.kind == "deadline_exceeded" for e in errors)
+        assert all(g["running"] == 0 and g["queued"] == 0
+                   for g in coord.resource_groups.snapshot())
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# dbapi cursor cancel (in-process)
+
+
+def test_dbapi_cursor_cancel():
+    import presto_tpu.dbapi as dbapi
+    conn = dbapi.connect(catalog="tpch", schema="tiny",
+                         properties=dict(NO_CACHE))
+    cur = conn.cursor()
+    _stall(0.03)
+    caught = []
+
+    def run():
+        try:
+            cur.execute(SQL_AGG)
+        except dbapi.OperationalError as e:
+            caught.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.4)
+    cur.cancel()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert len(caught) == 1 and caught[0].kind == "cancelled"
+    faults.disarm()
+    assert cur.execute("select 1").fetchall() == [(1,)]
+
+
+# ---------------------------------------------------------------------------
+# best-effort tiers degrade, never corrupt
+
+
+def test_cache_put_faults_absorbed_as_rejections():
+    from presto_tpu.cache import get_cache_manager
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    want = r.execute(SQL_AGG).rows()
+    mgr = get_cache_manager()
+    mgr.clear()  # cold caches: the armed runs must attempt inserts
+    before = mgr.fragment.stats.rejected + mgr.page.stats.rejected
+    inj = faults.arm("cache.put", trigger="always")
+    got1 = r.execute(SQL_AGG).rows()
+    got2 = r.execute(SQL_AGG).rows()
+    assert inj.fired > 0, "no cache insert attempted — vacuous"
+    after = mgr.fragment.stats.rejected + mgr.page.stats.rejected
+    assert after - before >= inj.fired  # absorbed, counted
+    assert got1 == got2 == want  # a flaky cache never corrupts
+    faults.disarm()
+    assert r.execute(SQL_AGG).rows() == want
+
+
+def test_page_source_fault_fails_clean_never_wrong():
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny", dict(NO_CACHE))
+    want = r.execute(SQL_AGG).rows()
+    faults.arm("page_source.next", trigger="nth", n=2)
+    with pytest.raises(faults.InjectedFault):
+        r.execute(SQL_AGG)
+    faults.disarm()
+    assert r.execute(SQL_AGG).rows() == want
+
+
+def test_exchange_pop_fault_fails_clean():
+    from presto_tpu.runner import MeshRunner
+    # mesh pops don't hit the HTTP registry; run a worker-topology
+    # query through the registry path instead via the local site:
+    # exchange.pop is the ExchangeRegistry seam, so drive it directly
+    from presto_tpu.server.node import ExchangeRegistry
+    reg = ExchangeRegistry()
+    faults.arm("exchange.pop", trigger="once")
+    with pytest.raises(faults.InjectedFault):
+        reg.pop("q:0", 0)
+    faults.disarm()
+    assert reg.pop("q:0", 0) is None
+    _ = MeshRunner  # referenced: the mesh tier is covered elsewhere
+
+
+# ---------------------------------------------------------------------------
+# worker topology (subprocess workers over the real HTTP plane)
+
+
+def _spawn_worker(extra_env=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           **(extra_env or {})}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.server.node",
+         "--port", "0"],
+        cwd="/root/repo", env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    url = json.loads(proc.stdout.readline())["url"]
+    return proc, url
+
+
+def _kill_worker(proc):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture(scope="module")
+def worker():
+    proc, url = _spawn_worker()
+    yield url
+    _kill_worker(proc)
+
+
+def test_transient_exchange_fault_absorbed_below_query_retry(worker):
+    """THE tentpole oracle: a worker whose FIRST exchange push drops
+    transiently (env-armed registry in the subprocess) must deliver a
+    byte-identical result on attempt ONE — the backoff + idempotent
+    re-push tier absorbs it; the elastic whole-query retry never
+    engages."""
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.server.coordinator import (
+        Coordinator, QueryLifecycle,
+    )
+    from presto_tpu.server.node import http_get
+    proc, url = _spawn_worker(
+        {"PRESTO_TPU_FAULTS": "exchange.push:nth:1"})
+    coord = Coordinator([url], "tpch", "tiny")
+    try:
+        coord.start()
+        coord.check_workers()
+        lifecycle = QueryLifecycle()
+        got = sorted(coord.execute(SQL_AGG,
+                                   lifecycle=lifecycle).rows())
+        want = sorted(LocalRunner("tpch", "tiny")
+                      .execute(SQL_AGG).rows())
+        assert got == want  # byte-identical to the fault-free run
+        assert lifecycle.attempts == 1, \
+            "transient exchange fault escalated to whole-query retry"
+        info = json.loads(http_get(f"{url}/v1/info"))
+        assert info.get("faults", {}).get(
+            "exchange.push", {}).get("fired", 0) >= 1, \
+            "worker-side fault never fired — test is vacuous"
+    finally:
+        coord.stop()
+        _kill_worker(proc)
+
+
+def test_flapping_worker_blacklisted_across_attempts(worker):
+    """A worker that answers /v1/info but fails task dispatch must be
+    blacklisted for the query's later attempts — not re-picked just
+    because its health probe recovers."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.server.coordinator import (
+        Coordinator, QueryLifecycle,
+    )
+
+    class Flaky(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b'{"state": "active", "devices": 1}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            self.send_response(500)  # every dispatch fails
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+    flaky = ThreadingHTTPServer(("127.0.0.1", 0), Flaky)
+    flaky_url = f"http://127.0.0.1:{flaky.server_address[1]}"
+    threading.Thread(target=flaky.serve_forever, daemon=True).start()
+    coord = Coordinator([flaky_url, worker], "tpch", "tiny")
+    try:
+        coord.start()
+        lifecycle = QueryLifecycle()
+        got = sorted(coord.execute(SQL_AGG,
+                                   lifecycle=lifecycle).rows())
+        want = sorted(LocalRunner("tpch", "tiny")
+                      .execute(SQL_AGG).rows())
+        assert got == want
+        assert lifecycle.attempts == 2  # attempt 1 hit the flapper
+    finally:
+        coord.stop()
+        flaky.shutdown()
+
+
+def test_cancel_distributed_query_aborts_worker_tasks(worker):
+    from presto_tpu.server.coordinator import (
+        Coordinator, QueryCancelled,
+    )
+    from presto_tpu.server.node import http_delete, http_get
+    coord = Coordinator([worker], "tpch", "tiny")
+    coord.start()
+    try:
+        _stall(0.05)  # stalls the COORDINATOR's root drive
+        errors, results = [], []
+        t = threading.Thread(
+            target=_client_run,
+            args=(coord, SQL_AGG, errors, results))
+        t.start()
+        _wait_for(lambda: any(q.state == "RUNNING"
+                              for q in coord.queries.values()),
+                  what="distributed query RUNNING")
+        q = next(iter(coord.queries.values()))
+        _wait_for(lambda: q.lifecycle.remote
+                  or json.loads(http_get(f"{worker}/v1/tasks")),
+                  what="tasks dispatched")
+        http_delete(f"{coord.url}/v1/statement/{q.id}")
+        t.join(timeout=20)
+        assert not t.is_alive(), "distributed cancel hung"
+        assert errors and isinstance(errors[0], QueryCancelled)
+
+        def all_tasks_terminal():
+            tasks = json.loads(http_get(f"{worker}/v1/tasks"))
+            return all(t["state"] in ("aborted", "finished", "failed")
+                       for t in tasks.values())
+        _wait_for(all_tasks_terminal, what="worker tasks aborted")
+        assert all(g["running"] == 0
+                   for g in coord.resource_groups.snapshot())
+    finally:
+        coord.stop()

@@ -6,6 +6,7 @@ nothing."""
 
 import pytest
 
+from presto_tpu.execution import faults
 from presto_tpu.operators.base import RetryableTaskError
 
 
@@ -16,38 +17,47 @@ PROPS = {"target_splits": 8, "lifespans": 4,
          "recoverable_grouped_execution": True}
 
 
-def _inject_once(monkeypatch, state):
-    """Make the NINTH final-aggregation instance (the final fragment
-    runs 8 tasks per generation, so instance 9 is generation 2 =
-    bucket 1, whose input pages are retained) fail transiently on its
-    first input."""
-    from presto_tpu.operators import aggregation as agg_mod
-    orig_init = agg_mod.AggregationOperator.__init__
-    orig_add = agg_mod.AggregationOperator.add_input
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
 
-    def init(self, *a, **k):
-        orig_init(self, *a, **k)
-        if self.mode == "final":
-            state["finals"] = state.get("finals", 0) + 1
-            self._fault_gen = state["finals"]
 
-    def add_input(self, batch):
-        if getattr(self, "_fault_gen", 0) == 9 \
-                and not state.get("raised"):
+def _inject_once(state):
+    """Arm the faults registry (execution/faults.py) to fail the NINTH
+    final-aggregation instance (the final fragment runs 8 tasks per
+    generation, so instance 9 is generation 2 = bucket 1, whose input
+    pages are retained) transiently on its first input — the same
+    injection the old monkeypatch version hand-rolled, now through the
+    driver's `operator.add_input` site."""
+    seen: dict = {}
+    refs: list = []  # pin operators so id() can't be recycled
+
+    def ninth_final_agg(ctx) -> bool:
+        op = ctx.get("op")
+        if type(op).__name__ != "AggregationOperator" \
+                or getattr(op, "mode", None) != "final":
+            return False
+        if id(op) not in seen:
+            refs.append(op)
+            seen[id(op)] = len(seen) + 1
+        if seen[id(op)] == 9 and not state.get("raised"):
             state["raised"] = True
-            raise RetryableTaskError("injected transient fault")
-        return orig_add(self, batch)
-    monkeypatch.setattr(agg_mod.AggregationOperator, "__init__", init)
-    monkeypatch.setattr(agg_mod.AggregationOperator, "add_input",
-                        add_input)
+            return True
+        return False
+
+    faults.arm("operator.add_input", trigger="always",
+               predicate=ninth_final_agg,
+               error=lambda: RetryableTaskError(
+                   "injected transient fault"))
 
 
 @pytest.mark.slow
-def test_bucket_retry_recovers(monkeypatch):
+def test_bucket_retry_recovers():
     from presto_tpu.runner import LocalRunner, MeshRunner
     want = sorted(LocalRunner("tpch", "tiny").execute(SQL).rows())
     state = {}
-    _inject_once(monkeypatch, state)
+    _inject_once(state)
     mesh = MeshRunner("tpch", "tiny", PROPS)
     got = sorted(mesh.execute(SQL).rows())
     assert state.get("raised"), "fault never fired — test is vacuous"
@@ -57,10 +67,10 @@ def test_bucket_retry_recovers(monkeypatch):
         assert abs(g[2] - w[2]) < 1e-6
 
 
-def test_without_recoverability_the_query_fails(monkeypatch):
+def test_without_recoverability_the_query_fails():
     from presto_tpu.runner import MeshRunner
     state = {}
-    _inject_once(monkeypatch, state)
+    _inject_once(state)
     mesh = MeshRunner("tpch", "tiny",
                       {**PROPS, "recoverable_grouped_execution": False})
     with pytest.raises(Exception, match="injected transient fault"):

@@ -32,6 +32,30 @@ def test_serving_bench_smoke():
     assert doc["warm"]["qps"] > 0 and doc["cold"]["qps"] > 0
 
 
+def test_serving_bench_chaos_phase():
+    """--chaos: seeded periodic faults over the warm coordinator —
+    availability + error taxonomy reported, and every query that
+    SUCCEEDS under chaos stays byte-identical to the warm phase."""
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.execution import faults
+    from presto_tpu.tools.serving_bench import run_serving_bench
+    reset_cache_manager()
+    doc = run_serving_bench(
+        clients=2, schema="tiny", mix=("q6", "q1"), warm_rounds=1,
+        verify_off=False, chaos=True, chaos_rounds=2,
+        chaos_spec="operator.add_input:every:10:7;cache.put:every:2")
+    assert not faults.ARMED  # the bench must disarm behind itself
+    chaos = doc["chaos"]
+    for key in ("spec", "rounds", "queries", "succeeded", "failed",
+                "availability", "errors", "qps",
+                "successes_match_warm"):
+        assert key in chaos, key
+    assert chaos["queries"] == 8  # 2 clients x 2 queries x 2 rounds
+    assert chaos["succeeded"] + chaos["failed"] == 8
+    assert chaos["successes_match_warm"] is True
+    assert sum(chaos["errors"].values()) == chaos["failed"]
+
+
 @pytest.mark.slow
 def test_serving_bench_full_capture_shape():
     """The committed-capture configuration end to end (small scale)."""
