@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from presto_tpu.execution.memory import (
     MemoryLimitExceeded, MemoryPool, batch_bytes,
 )
+from presto_tpu.telemetry import trace as _trace
 
 
 @dataclasses.dataclass
@@ -98,6 +99,19 @@ class ResultCache:
         return self.pool.budget // self.MAX_ENTRY_FRACTION
 
     def get(self, key):
+        if _trace.ACTIVE and _trace.current() is not None:
+            # traced queries see cache lookups as spans, hit/miss in
+            # the args (the cache tier of the query timeline)
+            with _trace.span(f"cache.get:{self.tag}", "cache") as rec:
+                out = self._get(key)
+                if rec is not None:
+                    rec.instant(
+                        f"cache.{'hit' if out is not None else 'miss'}"
+                        f":{self.tag}", "cache")
+                return out
+        return self._get(key)
+
+    def _get(self, key):
         with self._lock:
             e = self._entries.get(key)
             if e is None:
@@ -108,6 +122,12 @@ class ResultCache:
             return e.value
 
     def put(self, key, batches: List, deps=None) -> bool:
+        if _trace.ACTIVE and _trace.current() is not None:
+            with _trace.span(f"cache.put:{self.tag}", "cache"):
+                return self._put(key, batches, deps)
+        return self._put(key, batches, deps)
+
+    def _put(self, key, batches: List, deps=None) -> bool:
         from presto_tpu.execution import faults
         if faults.ARMED:
             # fault site `cache.put`: an injected insert failure is

@@ -36,7 +36,24 @@ _TABLES: Dict[str, List] = {
     "runtime.queries": [("query_id", BIGINT), ("state", VARCHAR),
                         ("query", VARCHAR), ("output_rows", BIGINT),
                         ("elapsed_ms", DOUBLE),
-                        ("error_kind", VARCHAR)],
+                        ("error_kind", VARCHAR),
+                        # QueryStats projection (telemetry): wall_ms
+                        # mirrors elapsed_ms, queued_ms is admission
+                        # wait (0 on a runner — no queue), compile_ms
+                        # is the query's XLA-compile share, rows_out
+                        # the lazily-resolved output row count
+                        ("wall_ms", DOUBLE), ("queued_ms", DOUBLE),
+                        ("compile_ms", DOUBLE),
+                        ("rows_out", BIGINT)],
+    "runtime.operator_stats": [
+        ("query_id", BIGINT), ("pipeline", BIGINT),
+        ("operator_id", BIGINT), ("name", VARCHAR),
+        ("input_batches", BIGINT), ("input_rows", BIGINT),
+        ("output_batches", BIGINT), ("output_rows", BIGINT),
+        ("busy_ms", DOUBLE), ("compile_ms", DOUBLE),
+        ("execute_ms", DOUBLE), ("blocked_ms", DOUBLE),
+        ("cache_hits", BIGINT), ("cache_misses", BIGINT),
+        ("peak_bytes", BIGINT)],
     "runtime.caches": [("level", VARCHAR), ("hits", BIGINT),
                        ("misses", BIGINT), ("evictions", BIGINT),
                        ("entries", BIGINT), ("bytes", BIGINT)],
@@ -167,7 +184,31 @@ def runner_system_connector(runner) -> SystemConnector:
                     if res is not None else -1
                 q.pop("_result", None)
             out.append((q["id"], q["state"], q["sql"], rows,
-                        q["elapsed_ms"], q.get("error_kind")))
+                        q["elapsed_ms"], q.get("error_kind"),
+                        q["elapsed_ms"], q.get("queued_ms", 0.0),
+                        q.get("compile_ms", 0.0), rows))
+        return out
+
+    def operator_stats():
+        # per-operator drain snapshots of recent queries (rows/bytes
+        # populate under EXPLAIN ANALYZE; batch/kernel/cache counters
+        # always) — the system-table face of the QueryStats tree
+        out = []
+        for rec in runner.operator_stats_history:
+            for pi, ops in enumerate(rec["pipelines"]):
+                for s in ops:
+                    out.append((
+                        rec["query_id"], pi, s["operator_id"],
+                        s["name"], s["input_batches"],
+                        s["input_rows"], s["output_batches"],
+                        s["output_rows"],
+                        round(s["busy_seconds"] * 1e3, 3),
+                        round(s.get("compile_ns", 0) / 1e6, 3),
+                        round(s.get("execute_ns", 0) / 1e6, 3),
+                        round(s.get("blocked_ns", 0) / 1e6, 3),
+                        s.get("cache_hits", 0),
+                        s.get("cache_misses", 0),
+                        s.get("peak_bytes", 0)))
         return out
 
     def catalogs():
@@ -204,6 +245,7 @@ def runner_system_connector(runner) -> SystemConnector:
         "runtime.nodes": nodes,
         "runtime.queries": queries,
         "runtime.caches": caches,
+        "runtime.operator_stats": operator_stats,
         "metadata.catalogs": catalogs,
         "metadata.tables": tables,
     })

@@ -65,8 +65,20 @@ class ExpressionCompileError(Exception):
 
 def compile_expression(expr: RowExpression,
                        schema: Dict[str, ColumnSchema]) -> CompiledExpr:
+    # host-side closure building is the non-XLA share of plan->kernel
+    # cost; telemetry splits it out from jit compile/execute so EXPLAIN
+    # ANALYZE and /v1/metrics can attribute all three
+    import time as _time
+
+    from presto_tpu.telemetry import kernels as _tk
+    if not _tk.ENABLED:
+        ce = _Compiler(schema).compile(expr)
+        ce.ir = expr
+        return ce
+    t0 = _time.perf_counter_ns()
     ce = _Compiler(schema).compile(expr)
     ce.ir = expr
+    _tk.record_expr_compile(_time.perf_counter_ns() - t0)
     return ce
 
 

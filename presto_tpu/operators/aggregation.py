@@ -192,6 +192,11 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                 batch.row_valid, key_cols, agg_inputs, agg_weights,
                 aggs, out_cap, merge)
 
+    # compile-vs-execute attribution rides the cached kernel (same
+    # contract as core's filter_project instrumentation)
+    from presto_tpu.telemetry.kernels import instrument_kernel
+    kernel = instrument_kernel(kernel, "agg_step")
+
     if key is not None:
         _AGG_STEP_CACHE[key] = kernel
         while len(_AGG_STEP_CACHE) > _AGG_STEP_CACHE_MAX:
@@ -225,6 +230,9 @@ def make_agg_finalize_kernel(mode: str, key_names, key_types, key_dicts,
                 state, key_names, key_types, key_dicts, out_names, aggs)
         return hashagg.finalize(state, key_names, key_types, key_dicts,
                                 out_names, aggs)
+
+    from presto_tpu.telemetry.kernels import instrument_kernel
+    fin = instrument_kernel(fin, "agg_finalize")
 
     _AGG_FIN_CACHE[key] = fin
     while len(_AGG_FIN_CACHE) > _AGG_STEP_CACHE_MAX:

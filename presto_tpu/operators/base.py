@@ -36,6 +36,19 @@ class OperatorStats:
     output_batches: int = 0
     output_rows: int = 0
     busy_seconds: float = 0.0
+    #: XLA attribution, credited by telemetry.kernels at the jit-kernel
+    #: cache boundary while this operator's add_input/get_output runs:
+    #: a kernel call that grew the jit executable cache was a COMPILE
+    #: (cache-miss trace), anything else is dispatch/execute
+    compile_ns: int = 0
+    execute_ns: int = 0
+    #: wall ns this operator reported is_blocked() while the driver
+    #: wanted to move a batch through it (profiled runs only)
+    blocked_ns: int = 0
+    #: batch payload bytes moved through this operator (profiled runs
+    #: only — batch_bytes reads array metadata, no device sync)
+    input_bytes: int = 0
+    output_bytes: int = 0
     #: operator-state spill (memory revocation) counters
     spilled_batches: int = 0
     spilled_bytes: int = 0
@@ -54,11 +67,28 @@ class OperatorStats:
             self.output_rows = int(self.output_rows_dev)
 
     def snapshot(self) -> Dict[str, Any]:
+        """Plain-dict copy of the scalar counters. Built explicitly —
+        dataclasses.asdict would deep-copy the live *_dev device
+        arrays (a device allocation each), and nulling them around the
+        walk would be a mutate-under-read hazard for any live-status
+        sampler."""
         self.materialize()
-        d = dataclasses.asdict(self)
-        d.pop("input_rows_dev")
-        d.pop("output_rows_dev")
-        return d
+        return {
+            "input_batches": self.input_batches,
+            "input_rows": self.input_rows,
+            "output_batches": self.output_batches,
+            "output_rows": self.output_rows,
+            "busy_seconds": self.busy_seconds,
+            "compile_ns": self.compile_ns,
+            "execute_ns": self.execute_ns,
+            "blocked_ns": self.blocked_ns,
+            "input_bytes": self.input_bytes,
+            "output_bytes": self.output_bytes,
+            "spilled_batches": self.spilled_batches,
+            "spilled_bytes": self.spilled_bytes,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+        }
 
 
 @dataclasses.dataclass
@@ -185,9 +215,11 @@ class Operator(abc.ABC):
         s.input_batches += 1
         if self.ctx.driver_context.profile:
             import jax.numpy as jnp
+            from presto_tpu.execution.memory import batch_bytes
             n = jnp.sum(batch.row_valid)
             s.input_rows_dev = n if s.input_rows_dev is None \
                 else s.input_rows_dev + n
+            s.input_bytes += batch_bytes(batch)
 
     def _count_out(self, batch: Optional[Batch]) -> Optional[Batch]:
         if batch is not None:
@@ -195,9 +227,11 @@ class Operator(abc.ABC):
             s.output_batches += 1
             if self.ctx.driver_context.profile:
                 import jax.numpy as jnp
+                from presto_tpu.execution.memory import batch_bytes
                 n = jnp.sum(batch.row_valid)
                 s.output_rows_dev = n if s.output_rows_dev is None \
                     else s.output_rows_dev + n
+                s.output_bytes += batch_bytes(batch)
         return batch
 
 

@@ -192,6 +192,12 @@ def make_filter_project_kernel(
             cols[name] = Column(d, m, ce.type, ce.dictionary)
         return Batch(cols, rv)
 
+    # compile-vs-execute attribution travels WITH the cached kernel:
+    # an LRU hit keeps its warm jit cache, so its calls report execute
+    # only (telemetry/kernels.py)
+    from presto_tpu.telemetry.kernels import instrument_kernel
+    kernel = instrument_kernel(kernel, "filter_project")
+
     if key is not None:
         _FP_KERNEL_CACHE[key] = kernel
         while len(_FP_KERNEL_CACHE) > _FP_KERNEL_CACHE_MAX:

@@ -13,6 +13,8 @@ from typing import List, Optional
 
 from presto_tpu.execution import faults
 from presto_tpu.operators.base import Operator
+from presto_tpu.telemetry import kernels as _tk
+from presto_tpu.telemetry import trace as _trace
 
 
 class Driver:
@@ -37,16 +39,41 @@ class Driver:
         return progress
 
     def _process_once(self) -> bool:
+        # the finally guards the thread-local operator binding: width-
+        # retry control flow (GroupLimitExceeded etc.) raises straight
+        # out of add_input, and the binding must not outlive the
+        # hand-off it belongs to (a stale binding would credit kernel
+        # time to a dead operator and pin its stats)
+        if not _tk.ENABLED:
+            return self._process_once_inner()
+        try:
+            return self._process_once_inner()
+        finally:
+            _tk.set_current_op(None)
+
+    def _process_once_inner(self) -> bool:
         ops = self.operators
         moved = False
         profile = ops[0].ctx.driver_context.profile
+        # telemetry attribution: bind the operator whose method runs to
+        # the thread so kernel calls inside it credit compile/execute
+        # ns to the right OperatorStats (telemetry/kernels.py); spans
+        # only exist when a trace recorder is current on this thread
+        timing = _tk.ENABLED
+        tracing = _trace.ACTIVE and _trace.current() is not None
         # walk adjacent pairs, moving at most one batch per pair
         # (Driver.processInternal:371)
         for i in range(len(ops) - 1):
             current, nxt = ops[i], ops[i + 1]
             if current.is_blocked() or nxt.is_blocked():
+                if profile:
+                    self._note_blocked(current, nxt)
                 continue
+            if profile:
+                self._note_blocked(current, nxt)  # closes open windows
             if nxt.needs_input() and not current.is_finished():
+                if timing:
+                    _tk.set_current_op(current.ctx.stats)
                 t0 = time.perf_counter()
                 batch = current.get_output()
                 if profile and batch is not None:
@@ -56,7 +83,12 @@ class Driver:
                     # the reference's EXPLAIN ANALYZE overhead)
                     import jax
                     jax.block_until_ready(batch)
-                current.ctx.stats.busy_seconds += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                current.ctx.stats.busy_seconds += dt
+                if tracing and batch is not None:
+                    _trace.current().add(
+                        f"op:{current.ctx.name}.get_output",
+                        "operator", int(t0 * 1e9), int(dt * 1e9))
                 if batch is not None:
                     if faults.ARMED:
                         # fault site `operator.add_input`: the ONE
@@ -65,20 +97,51 @@ class Driver:
                         # any pipeline here without monkeypatching
                         faults.fire("operator.add_input", op=nxt,
                                     name=nxt.ctx.name)
+                    if timing:
+                        _tk.set_current_op(nxt.ctx.stats)
                     t0 = time.perf_counter()
                     nxt.add_input(batch)
-                    nxt.ctx.stats.busy_seconds += time.perf_counter() - t0
+                    dt = time.perf_counter() - t0
+                    nxt.ctx.stats.busy_seconds += dt
+                    if tracing:
+                        _trace.current().add(
+                            f"op:{nxt.ctx.name}.add_input",
+                            "operator", int(t0 * 1e9), int(dt * 1e9))
                     moved = True
+                if timing:
+                    _tk.set_current_op(None)
             # unwind finished prefix (Driver.java:438-447)
             if current.is_finished():
                 nxt.finish()
         # drain the tail operator if it is a sink that self-drives
         tail = self.operators[-1]
         if not tail.is_finished() and not tail.is_blocked():
+            if timing:
+                _tk.set_current_op(tail.ctx.stats)
             out = tail.get_output()
+            if timing:
+                _tk.set_current_op(None)
             if out is not None:
                 moved = True
         return moved
+
+    @staticmethod
+    def _note_blocked(current, nxt) -> None:
+        """Profiled runs: accumulate wall time an operator spent
+        blocking a hand-off (first blocked observation -> first
+        subsequent unblocked one, tracked per OperatorContext)."""
+        now = time.perf_counter()
+        for op in (current, nxt):
+            ctx = op.ctx
+            if op.is_blocked():
+                since = getattr(ctx, "_blocked_since", None)
+                if since is None:
+                    ctx._blocked_since = now
+            else:
+                since = getattr(ctx, "_blocked_since", None)
+                if since is not None:
+                    ctx.stats.blocked_ns += int((now - since) * 1e9)
+                    ctx._blocked_since = None
 
     def run_to_completion(self, max_steps: int = 1_000_000) -> None:
         steps = 0
@@ -86,7 +149,11 @@ class Driver:
             progress = self.process()
             steps += 1
             if steps > max_steps:
-                raise RuntimeError("driver did not converge (livelock?)")
+                # a wedged pipeline must be DIAGNOSABLE, not a bare
+                # RuntimeError: the structured kind travels the query
+                # failure taxonomy and the per-operator snapshot shows
+                # WHERE the batches stopped (rows in vs out per stage)
+                raise self._stall_error(max_steps)
             if not progress and not self.is_finished():
                 blocked = [op.ctx.name for op in self.operators
                            if op.is_blocked()]
@@ -100,9 +167,32 @@ class Driver:
                 # advance (e.g. finish propagation), bounded by max_steps
         self.close()
 
+    def _stall_error(self, max_steps: int):
+        """QueryError(kind="driver_stall") carrying the per-operator
+        stats snapshot of the wedged pipeline."""
+        from presto_tpu.runner.local import QueryError
+        from presto_tpu.telemetry import snapshot_drivers
+        snap = snapshot_drivers([self])[0]
+        chain = " -> ".join(
+            f"{s['name']}[{s['input_batches']} in/"
+            f"{s['output_batches']} out]" for s in snap)
+        err = QueryError(
+            f"driver did not converge after {max_steps} steps "
+            f"(livelock?): {chain}", kind="driver_stall")
+        err.operator_stats = snap
+        return err
+
     def close(self) -> None:
         if not self._closed:
+            now = time.perf_counter()
             for op in self.operators:
+                # close any open blocked window: an operator still
+                # blocked when the pipeline ends (LIMIT finished
+                # upstream of a blocked exchange) must not report 0
+                since = getattr(op.ctx, "_blocked_since", None)
+                if since is not None:
+                    op.ctx.stats.blocked_ns += int((now - since) * 1e9)
+                    op.ctx._blocked_since = None
                 op.close()
                 op.ctx.release_all()
             self._closed = True

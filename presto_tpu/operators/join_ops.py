@@ -396,6 +396,18 @@ def make_probe_kernel(key_names: Tuple[str, ...], join_type: str,
             return _expand_project(table, batch, lo_enc, None, matched,
                                    out_capacity)
 
+    # compile-vs-execute attribution rides the cached kernel. The CPU
+    # form is a host wrapper over THREE jits — the per-probe stage2
+    # plus the shared module-level hash/search jits — so all three
+    # executable caches are polled for compile detection
+    from presto_tpu.telemetry.kernels import instrument_kernel
+    if ops_common.cpu_backend():
+        kernel = instrument_kernel(
+            kernel, "join_probe",
+            jits=[stage2, join_ops._hash_jit, join_ops._search_jit])
+    else:
+        kernel = instrument_kernel(kernel, "join_probe")
+
     if key is not None:
         _PROBE_KERNEL_CACHE[key] = kernel
         while len(_PROBE_KERNEL_CACHE) > _PROBE_KERNEL_CACHE_MAX:

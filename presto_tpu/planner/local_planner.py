@@ -153,9 +153,17 @@ class LocalExecutionPlanner:
         #: planning inside a recorded fragment: nested eligible
         #: subtrees must not wrap again (the outermost wins)
         self._in_fragment = False
+        #: telemetry: plan-node id() -> operator ids minted while that
+        #: node was being dispatched (EXPLAIN ANALYZE joins operator
+        #: stats back onto the plan tree through this)
+        self.node_ops: Dict[int, List[int]] = {}
+        self._node_stack: List[int] = []
 
     def _next_id(self) -> int:
         self._op_id += 1
+        if self._node_stack:
+            self.node_ops.setdefault(
+                self._node_stack[-1], []).append(self._op_id)
         return self._op_id
 
     def plan(self, root: N.OutputNode) -> LocalExecutionPlan:
@@ -233,6 +241,16 @@ class LocalExecutionPlanner:
         if m is None:
             raise LocalPlanningError(
                 f"no local planning for {type(node).__name__}")
+        # operator ids minted while this node is on top of the stack
+        # belong to IT (children push their own frame) — the node ->
+        # operator join EXPLAIN ANALYZE annotates the plan tree with
+        self._node_stack.append(id(node))
+        try:
+            self._dispatch_inner(node, pipe, m)
+        finally:
+            self._node_stack.pop()
+
+    def _dispatch_inner(self, node: N.PlanNode, pipe: List, m) -> None:
         probe = self._fragment_cache_probe(node)
         if probe is None:
             m(node, pipe)
@@ -378,6 +396,12 @@ class LocalExecutionPlanner:
                     out = b.rename(rename)
                     if task.device is not None:
                         out = _jax.device_put(out, task.device)
+                        from presto_tpu.telemetry.metrics import (
+                            METRICS,
+                        )
+                        METRICS.inc(
+                            "presto_tpu_transfer_bytes_total",
+                            batch_bytes(out), direction="h2d")
                     yield out
                 else:
                     # natural exhaustion only: an abandoned iterator

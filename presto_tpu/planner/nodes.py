@@ -452,8 +452,12 @@ class RemoteSourceNode(PlanNode):
     output: Tuple[Field, ...]
 
 
-def plan_text(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style tree rendering (reference: planPrinter/)."""
+def plan_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
+    """EXPLAIN-style tree rendering (reference: planPrinter/).
+
+    `annotate`, when given, maps a PlanNode to extra per-node lines
+    (EXPLAIN ANALYZE joins operator stats back onto the tree through
+    it — rows/wall/compile/cache under each node)."""
     pad = "  " * indent
     name = type(node).__name__.replace("Node", "")
     details = ""
@@ -482,6 +486,9 @@ def plan_text(node: PlanNode, indent: int = 0) -> str:
     elif isinstance(node, OutputNode):
         details = f"[{node.names}]"
     lines = [f"{pad}{name}{details} => {[f.symbol for f in node.output]}"]
+    if annotate is not None:
+        for extra in annotate(node):
+            lines.append(f"{pad}  | {extra}")
     for s in node.sources():
-        lines.append(plan_text(s, indent + 1))
+        lines.append(plan_text(s, indent + 1, annotate))
     return "\n".join(lines)

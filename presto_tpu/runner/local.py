@@ -343,6 +343,9 @@ class LocalRunner:
         # engine state as tables (system.runtime / system.metadata)
         from presto_tpu.connectors.system import runner_system_connector
         self.query_history: List[Dict[str, Any]] = []
+        #: recent queries' per-operator stats snapshots (bounded ring)
+        #: — the system.runtime.operator_stats source
+        self.operator_stats_history: List[Dict[str, Any]] = []
         self.catalogs.register("system", runner_system_connector(self))
         self._session_tl = _threading.local()
         self._query_id_mint = _itertools.count()
@@ -419,6 +422,26 @@ class LocalRunner:
                 cm = ClusterMemoryManager(int(budget))
                 self._cluster_mgr = cm
             return cm
+
+    # -- per-thread profile scratch (the shared single-node runner is
+    # driven by many client threads concurrently: one query's EXPLAIN
+    # ANALYZE must never render another query's stats) ----------------
+
+    @property
+    def _last_profile(self) -> Optional[str]:
+        return getattr(self._session_tl, "last_profile", None)
+
+    @_last_profile.setter
+    def _last_profile(self, value) -> None:
+        self._session_tl.last_profile = value
+
+    @property
+    def _last_annotate(self):
+        return getattr(self._session_tl, "last_annotate", None)
+
+    @_last_annotate.setter
+    def _last_annotate(self, value) -> None:
+        self._session_tl.last_annotate = value
 
     @property
     def session(self) -> Session:
@@ -518,12 +541,75 @@ class LocalRunner:
         from presto_tpu.execution import faults
         faults.ensure_spec(
             self.session.properties.get("fault_injection"))
+        # telemetry: per-statement kernel counters always (cheap ints
+        # on a thread-local), a trace recorder only when the session
+        # asks for one (query_trace_enabled)
+        from presto_tpu.telemetry import build_query_stats
+        from presto_tpu.telemetry import kernels as _tk
+        from presto_tpu.telemetry import trace as _trace
+        recorder = None
+        prev_rec = None
+        activated = False
+        if bool(get_property(self.session.properties,
+                             "query_trace_enabled")):
+            recorder = _trace.TraceRecorder()
+            prev_rec = _trace.activate(recorder)
+            activated = True
+        prev_q = _tk.begin_query()
         prev = getattr(self._session_tl, "lifecycle", None)
         self._session_tl.lifecycle = (cancel, deadline)
+        self._session_tl.op_stats = None  # this statement's snapshots
+        t0 = _time.perf_counter()
+        t0_ns = _time.perf_counter_ns()
         try:
-            return self._execute_lifecycled(sql)
+            result = self._execute_lifecycled(sql)
+        except BaseException as e:
+            # a FAILED traced query keeps its timeline: events (root
+            # span included) ride the exception; servers forward them
+            # to the trace endpoint
+            _trace.attach_failure(recorder, e, t0_ns, sql)
+            recorder = None  # root span already closed
+            # ... and its QueryStats: a query killed after 15s of XLA
+            # compiles must still report that compile time (failure is
+            # exactly when you want the attribution)
+            try:
+                e.query_stats = build_query_stats(
+                    (_time.perf_counter() - t0) * 1000, 0.0,
+                    _tk.query_counters())
+            except Exception:  # noqa: BLE001 — slotted exceptions
+                pass
+            # EVERY statement counts exactly once, whatever its shape
+            # (SELECT, SHOW/SET, DDL, even unparseable text) — the
+            # per-topology counter on /v1/metrics must match the
+            # query registry, not just the SELECT-shaped subset
+            from presto_tpu.telemetry.metrics import METRICS
+            METRICS.inc("presto_tpu_queries_total", state="FAILED",
+                        error_kind=getattr(e, "kind", None)
+                        or type(e).__name__)
+            raise
         finally:
             self._session_tl.lifecycle = prev
+            counters = _tk.end_query(prev_q)
+            if recorder is not None:
+                recorder.add("query", "query", t0_ns,
+                             _time.perf_counter_ns() - t0_ns,
+                             {"sql": sql[:200]})
+            if activated:
+                _trace.deactivate(prev_rec)
+        from presto_tpu.telemetry.metrics import METRICS
+        METRICS.inc("presto_tpu_queries_total", state="FINISHED",
+                    error_kind="")
+        # the full stats tree rides the result so servers (the single-
+        # node coordinator) can expose it without reaching back into
+        # runner internals
+        ops = getattr(self._session_tl, "op_stats", None)
+        result.query_stats = build_query_stats(
+            (_time.perf_counter() - t0) * 1000, 0.0, counters,
+            tasks=[{"task_id": "local", "pipelines": ops}]
+            if ops is not None else None)
+        result.trace_events = recorder.events() \
+            if recorder is not None else None
+        return result
 
     def _lifecycle(self):
         """(cancel callable | None, monotonic deadline | None) of the
@@ -750,7 +836,7 @@ class LocalRunner:
             return self._rows_result(
                 ["Column Name", "Type"], rows, (VARCHAR, VARCHAR))
         if isinstance(stmt, T.Explain):
-            return self._explain(stmt)
+            return self._explain(stmt, sql)
         if isinstance(stmt, (T.ShowTables, T.ShowSchemas, T.ShowCatalogs,
                              T.ShowColumns, T.ShowSession,
                              T.ShowFunctions)):
@@ -803,11 +889,7 @@ class LocalRunner:
         # single-node coordinator drives one shared runner from many
         # client threads, and a read-modify-write here would mint
         # duplicate query ids
-        entry = {"id": next(self._query_id_mint), "sql": sql.strip(),
-                 "state": "RUNNING", "rows": 0, "elapsed_ms": 0.0,
-                 "error_kind": None}
-        self.query_history.append(entry)
-        del self.query_history[:-1000]  # bounded history
+        entry = self._new_history_entry(sql)
         t0 = _time.perf_counter()
         try:
             def plan_and_run():
@@ -835,11 +917,50 @@ class LocalRunner:
                 or type(e).__name__
             raise
         finally:
-            entry["elapsed_ms"] = round(
-                (_time.perf_counter() - t0) * 1000, 3)
+            self._finish_history_entry(entry, t0)
 
-    def create_plan(self, sql: str) -> N.OutputNode:
-        stmt = parse_statement(sql)
+    def _new_history_entry(self, sql: str) -> Dict[str, Any]:
+        entry = {"id": next(self._query_id_mint), "sql": sql.strip(),
+                 "state": "RUNNING", "rows": 0, "elapsed_ms": 0.0,
+                 "error_kind": None, "queued_ms": 0.0,
+                 "compile_ms": 0.0, "execute_ms": 0.0}
+        self.query_history.append(entry)
+        del self.query_history[:-1000]  # bounded history
+        return entry
+
+    def _finish_history_entry(self, entry: Dict[str, Any],
+                              t0: float) -> None:
+        """The ONE finally-side bookkeeping of a statement's history
+        entry (shared by SELECT and EXPLAIN ANALYZE paths): elapsed,
+        the per-statement kernel counters installed by execute(), the
+        drained operator snapshot, and the process query counter —
+        feeding system.runtime.queries / .operator_stats and
+        /v1/metrics."""
+        import time as _time
+
+        from presto_tpu.telemetry import kernels as _tk
+        from presto_tpu.telemetry.metrics import METRICS
+        entry["elapsed_ms"] = round(
+            (_time.perf_counter() - t0) * 1000, 3)
+        counters = _tk.query_counters()
+        if counters is not None:
+            entry["compile_ms"] = round(
+                counters["compile_ns"] / 1e6, 3)
+            entry["execute_ms"] = round(
+                counters["execute_ns"] / 1e6, 3)
+        ops = getattr(self._session_tl, "op_stats", None)
+        if ops is not None:
+            self._record_operator_stats(entry["id"], ops)
+        # (presto_tpu_queries_total is counted once per STATEMENT in
+        # execute() — counting here too would double-count SELECTs and
+        # miss SHOW/SET/DDL/parse failures entirely)
+
+    def create_plan(self, sql: str,
+                    stmt: Optional[T.Node] = None) -> N.OutputNode:
+        """`stmt` lets a caller that already parsed (and possibly
+        unwrapped — derive_fragments strips EXPLAIN) skip re-parsing."""
+        if stmt is None:
+            stmt = parse_statement(sql)
         if not isinstance(stmt, T.Query):
             raise QueryError("create_plan expects a query")
         return plan_statement(stmt, self.catalogs, self.session)
@@ -915,13 +1036,24 @@ class LocalRunner:
                 if on_retry is not None:
                     on_retry()
                 continue
+            # snapshot per-operator stats ALWAYS (plain dicts — the
+            # driver refs drop here, so no device batches get pinned):
+            # lightweight counters (batches, busy, compile/execute,
+            # cache) on plain runs, plus rows/bytes under profile
+            from presto_tpu.telemetry import (
+                render_operator_stats, snapshot_drivers,
+            )
+            snap = snapshot_drivers(drivers, pool)
+            self._session_tl.op_stats = snap
             if profile:
-                # snapshot the stats TEXT now and drop the driver refs:
-                # holding operators would pin their buffered device
-                # batches for the runner's lifetime
-                self._last_profile = self._render_operator_stats(
-                    self.snapshot_driver_stats(drivers),
-                    _time.perf_counter() - t0, pool)
+                self._last_profile = render_operator_stats(
+                    snap, _time.perf_counter() - t0, pool)
+                # node -> operator-id join for the annotated EXPLAIN
+                # ANALYZE tree (plan node identity survives into
+                # _explain — the planner mutates the same objects)
+                self._last_annotate = (
+                    planner.node_ops,
+                    {s["operator_id"]: s for ops in snap for s in ops})
             return MaterializedResult(lplan.result_names, lplan.result_sink,
                                       lplan.result_fields)
 
@@ -1174,7 +1306,8 @@ class LocalRunner:
 
     # -- metadata statements -------------------------------------------
 
-    def _explain(self, stmt: T.Explain) -> MaterializedResult:
+    def _explain(self, stmt: T.Explain,
+                 sql: str = "explain") -> MaterializedResult:
         inner = stmt.statement
         if not isinstance(inner, T.Query):
             raise QueryError("EXPLAIN supports queries only")
@@ -1184,60 +1317,78 @@ class LocalRunner:
         plan = optimize(plan, self.catalogs)
         prune_unused_columns(plan)
         if stmt.analyze:
-            result = self._run_plan(plan, profile=True)
-            text = N.plan_text(plan) + "\n\n" + self._last_profile + \
-                f"\n-- rows: {result.row_count}"
+            import time as _time
+            self._last_annotate = None
+            # a real history entry, appended UP FRONT like
+            # _run_query_statement's — a failing EXPLAIN ANALYZE must
+            # leave a FAILED row (deadline/OOM/stall are exactly what
+            # you profile for), and operator_stats rows must JOIN
+            # system.runtime.queries
+            entry = self._new_history_entry(sql)
+            t0 = _time.perf_counter()
+            try:
+                result = self._run_plan(plan, profile=True)
+                # annotated tree: each plan node carries the rows/
+                # wall/compile/cache of the operators it planned
+                # into, THEN the per-pipeline operator table (the two
+                # views join on id=N)
+                text = N.plan_text(plan, annotate=self._annotator()) \
+                    + "\n\n" + self._last_profile + \
+                    f"\n-- rows: {result.row_count}"
+                entry["state"] = "FINISHED"
+                entry["rows"] = result.row_count
+            except Exception as e:
+                entry["state"] = "FAILED"
+                entry["error_kind"] = getattr(e, "kind", None) \
+                    or type(e).__name__
+                raise
+            finally:
+                self._finish_history_entry(entry, t0)
         else:
             text = N.plan_text(plan)
         return self._text_result("Query Plan", text.split("\n"))
 
+    def _annotator(self):
+        """plan node -> stat lines, from the last profiled run's
+        (node -> operator ids) join (None when unavailable — mesh
+        plans are re-exchanged copies, their node identity is gone)."""
+        bundle = getattr(self, "_last_annotate", None)
+        if bundle is None:
+            return None
+        node_ops, by_id = bundle
+        from presto_tpu.telemetry.stats import operator_line
+
+        def annotate(node) -> List[str]:
+            out = []
+            for op_id in node_ops.get(id(node), ()):
+                s = by_id.get(op_id)
+                if s is not None:
+                    out.append(operator_line(s).strip())
+            return out
+        return annotate
+
+    def _record_operator_stats(self, query_id: int,
+                               pipelines: List[List]) -> None:
+        self.operator_stats_history.append(
+            {"query_id": query_id, "pipelines": pipelines})
+        del self.operator_stats_history[:-32]  # bounded ring
+
     @staticmethod
     def snapshot_driver_stats(drivers: List[Driver]) -> List[List]:
-        """Materialize per-operator stats WITHOUT retaining operators
-        (which would pin their device buffers)."""
-        out = []
-        for d in drivers:
-            ops = []
-            for op in d.operators:
-                op.ctx.stats.materialize()
-                ops.append((op.ctx.name, op.ctx.operator_id,
-                            op.ctx.tag, op.ctx.stats))
-            out.append(ops)
-        return out
+        """Materialize per-operator stats into plain dicts WITHOUT
+        retaining operators (which would pin their device buffers).
+        Kept as the runner-facing alias of telemetry.snapshot_drivers
+        (mesh retire + worker tasks call through here)."""
+        from presto_tpu.telemetry import snapshot_drivers
+        return snapshot_drivers(drivers)
 
     @staticmethod
     def _render_operator_stats(driver_stats: List[List], wall: float,
                                pool=None) -> str:
         """Per-operator execution stats (reference: planPrinter's
         EXPLAIN ANALYZE fragment rendering over OperatorStats)."""
-        lines = []
-        busy_total = 0.0
-        peaks = pool.peak_by_tag if pool is not None else {}
-        for pi, ops in enumerate(driver_stats):
-            lines.append(f"Pipeline {pi}:")
-            for name, op_id, tag, s in reversed(ops):
-                busy_total += s.busy_seconds
-                mem = peaks.get(tag, 0)
-                mem_s = f"  peak mem: {mem / 1e6:.1f}MB" if mem else ""
-                spill_s = (f"  spilled: {s.spilled_batches} batches/"
-                           f"{s.spilled_bytes / 1e6:.1f}MB"
-                           if s.spilled_batches else "")
-                cache_s = (f"  cache: {s.cache_hits} hits/"
-                           f"{s.cache_misses} misses"
-                           if s.cache_hits or s.cache_misses else "")
-                lines.append(
-                    f"  {name} [id={op_id}]  "
-                    f"rows: {s.input_rows:,} -> {s.output_rows:,}  "
-                    f"batches: {s.input_batches} -> "
-                    f"{s.output_batches}  "
-                    f"busy: {s.busy_seconds * 1e3:.1f}ms{mem_s}"
-                    f"{spill_s}{cache_s}")
-        lines.append(f"wall: {wall * 1e3:.1f}ms, "
-                     f"operator busy sum: {busy_total * 1e3:.1f}ms")
-        if pool is not None and pool.peak:
-            lines.append(f"peak reserved device memory: "
-                         f"{pool.peak / 1e6:.1f}MB")
-        return "\n".join(lines)
+        from presto_tpu.telemetry import render_operator_stats
+        return render_operator_stats(driver_stats, wall, pool)
 
     def _show(self, stmt) -> MaterializedResult:
         if isinstance(stmt, T.ShowCatalogs):

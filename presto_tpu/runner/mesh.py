@@ -292,7 +292,7 @@ class MeshRunner(LocalRunner):
             self._drive_phased(fplan, all_drivers, instance_drivers,
                                remaining_lifespans, exchanges,
                                spawn_fragment,
-                               stat_snaps if profile else None,
+                               stat_snaps,
                                deferred=deferred,
                                phase_deps=phase_deps,
                                lifespans_of=lifespans_of,
@@ -306,9 +306,16 @@ class MeshRunner(LocalRunner):
                 x.spilled_pages for x in exchanges.values())
             for x in exchanges.values():
                 x.close()
+        # snapshots are collected for every run (lightweight counters;
+        # rows only under profile) — they feed the query-history stats
+        # and system.runtime.operator_stats like the local runner's
+        self._session_tl.op_stats = stat_snaps
         if profile:
             self._last_profile = self._render_operator_stats(
                 stat_snaps, _time.perf_counter() - t0, pool)
+            # mesh plans are re-exchanged copies — plan-node identity
+            # is gone, so EXPLAIN ANALYZE keeps the pipeline table only
+            self._last_annotate = None
         return MaterializedResult(result.result_names,
                                   result.result_sink,
                                   result.result_fields)

@@ -131,7 +131,13 @@ class _Query:
         self.dispatch = None  # resource-group dispatch callback
         self.last_poll = time.monotonic()
         self.created_at = time.monotonic()
+        self.run_started_at: Optional[float] = None  # leaves QUEUED
         self.lifecycle = QueryLifecycle()
+        #: QueryStats tree (telemetry.build_query_stats) — served by
+        #: GET /v1/query/{id} and shipped to event listeners
+        self.stats: Optional[dict] = None
+        #: Chrome trace_event list when the query was traced
+        self.trace: Optional[list] = None
 
 
 #: result rows per client page (reference: the target-result-size
@@ -314,6 +320,17 @@ class Coordinator(Node):
     def handle_get(self, path: str) -> bytes:
         if path == "/v1/query":
             return json.dumps(self._query_rows()).encode()
+        if path.startswith("/v1/query/") and path.endswith("/trace"):
+            # Chrome trace_event export of a traced query (session
+            # property query_trace_enabled) — loads directly in
+            # chrome://tracing / Perfetto, or tools/trace_viewer.py
+            qid = path.split("/")[3]
+            q = self.queries[qid]  # KeyError -> 404
+            return json.dumps({
+                "displayTimeUnit": "ms",
+                "otherData": {"query_id": qid, "state": q.state},
+                "traceEvents": q.trace or [],
+            }).encode()
         if path.startswith("/v1/query/"):
             qid = path.rsplit("/", 1)[1]
             for row in self._query_rows():
@@ -321,6 +338,9 @@ class Coordinator(Node):
                     q = self.queries[qid]
                     row["sql"] = q.sql
                     row["columns"] = q.columns
+                    # the full stats tree: wall/queued/compile/execute
+                    # rollup + per-task, per-operator detail
+                    row["stats"] = q.stats
                     return json.dumps(row).encode()
             raise KeyError(qid)
         if path == "/v1/resourceGroups":
@@ -515,6 +535,7 @@ th{{background:#222}}
             if q.state == "FAILED":  # cancelled while queued
                 return
         q.state = "RUNNING"
+        q.run_started_at = time.monotonic()
         try:
             # per-query deadline: anchored at SUBMIT (queue time
             # counts — reference: query_max_run_time, which includes
@@ -538,6 +559,8 @@ th{{background:#222}}
             rows = result.rows()
             q.data = [list(r) for r in rows]
             q.state = "FINISHED"
+            q.stats = getattr(result, "query_stats", None)
+            q.trace = getattr(result, "trace_events", None)
         except Exception as e:  # noqa: BLE001
             q.error = f"{type(e).__name__}: {e}"
             # the kill reason (abandoned vs cancelled) outranks the
@@ -546,16 +569,60 @@ th{{background:#222}}
             q.error_kind = q.lifecycle.kill_kind \
                 or getattr(e, "kind", None)
             q.state = "FAILED"
+            # the failure trace + partial stats (when present) ride
+            # the exception — compile time spent before the failure
+            # must survive into the stats tree
+            q.trace = getattr(e, "trace_events", None)
+            q.stats = getattr(e, "query_stats", None)
         finally:
             q.done_at = time.monotonic()
+            # QueryStats rollup: the coordinator owns wall/queued (it
+            # saw submit and dispatch); the execution tier contributed
+            # compile/execute/tasks through the result
+            from presto_tpu.telemetry import build_query_stats
+            queued_ms = ((q.run_started_at or q.done_at)
+                         - q.created_at) * 1000
+            wall_ms = (q.done_at - q.created_at) * 1000
+            inner = dict(q.stats or {})
+            inner.pop("wall_ms", None)
+            inner.pop("queued_ms", None)
+            base = build_query_stats(
+                wall_ms, queued_ms, state=q.state,
+                error_kind=q.error_kind,
+                rows_out=len(q.data) if q.data is not None else 0)
+            if inner:
+                # don't resurrect fields the execution tier
+                # deliberately dropped (distributed trees omit
+                # kernel_calls/compiles — counts aren't shipped in
+                # task snapshots, zeros here would contradict the ns
+                # sums)
+                for k in ("kernel_calls", "kernel_compiles"):
+                    if k not in inner:
+                        base.pop(k, None)
+            q.stats = {**base, **inner,
+                       "wall_ms": round(wall_ms, 3),
+                       "queued_ms": round(queued_ms, 3)}
             self.resource_groups.finish(q.group, self._query_memory())
+            if not self.single_node:
+                # the worker topology never passes through a
+                # LocalRunner statement path (which owns this counter
+                # on single-node/embedded runners) — count here so
+                # /v1/metrics reports query totals on every topology
+                from presto_tpu.telemetry.metrics import METRICS
+                METRICS.inc("presto_tpu_queries_total",
+                            state=q.state,
+                            error_kind=q.error_kind or "")
+            # event listeners see the COMPLETED QueryStats payload —
+            # the same numbers GET /v1/query/{id} serves (satellite:
+            # external sinks must not need a second code path)
             self._fire_event({
                 "event": "query_completed", "id": q.id,
                 "state": q.state, "user": q.user, "group": q.group,
                 "elapsed_ms": round(
                     (q.done_at - q.created_at) * 1000, 1),
                 "rows": len(q.data) if q.data is not None else 0,
-                "error": q.error})
+                "error": q.error,
+                "stats": q.stats})
 
     def execute(self, sql: str, on_columns=None, user: str = "",
                 lifecycle: Optional[QueryLifecycle] = None):
@@ -588,6 +655,17 @@ th{{background:#222}}
                                    "query_retries"))
         workers = list(self.worker_urls)
         props = dict(self.properties)
+        # distributed tracing: the coordinator's drive/exchange/backoff
+        # spans record onto this thread's recorder; the finished trace
+        # rides the result to GET /v1/query/{id}/trace
+        import time as _time
+        from presto_tpu.telemetry import trace as _trace
+        recorder = None
+        prev_rec = None
+        t0_ns = _time.perf_counter_ns()
+        if bool(get_property(self.properties, "query_trace_enabled")):
+            recorder = _trace.TraceRecorder()
+            prev_rec = _trace.activate(recorder)
         #: workers implicated in a connection-level failure this
         #: query: never re-picked by a later attempt, even if their
         #: /v1/info answers again (a flapping worker would otherwise
@@ -595,53 +673,74 @@ th{{background:#222}}
         blacklist: set = set()
         attempt = 0
         bumps = 0
-        while True:
-            try:
-                return self._execute_attempt(sql, workers, props,
-                                             on_columns=on_columns,
-                                             user=user,
-                                             lifecycle=lifecycle)
-            except Exception as e:  # noqa: BLE001 — inspect + retry
-                # a killed/expired query must NOT burn the elastic
-                # retry budget re-running work nobody wants
-                if getattr(e, "kind", None) in ("cancelled",
-                                                "deadline_exceeded"):
-                    raise
-                # sync-free overflow protocol: re-run the WHOLE query
-                # with the suggested setting (any fragment may have
-                # raised it, local or remote) — not a failure retry
-                prop, suggested = _retry_hint(e)
-                if prop is not None and bumps < 8:
-                    bumps += 1
-                    props[prop] = max(suggested,
-                                      props.get(prop, 0) or 0)
-                    continue
-                attempt += 1
-                if attempt > retries:
-                    raise
-                bad = getattr(e, "worker", None)
-                if bad:
-                    blacklist.add(bad)
-                alive = []
-                for url in workers:
-                    if url in blacklist:
+        try:
+            while True:
+                try:
+                    result = self._execute_attempt(
+                        sql, workers, props, on_columns=on_columns,
+                        user=user, lifecycle=lifecycle)
+                    if recorder is not None:
+                        # root span closes the containment hierarchy
+                        # (same contract as LocalRunner.execute)
+                        recorder.add(
+                            "query", "query", t0_ns,
+                            _time.perf_counter_ns() - t0_ns,
+                            {"sql": sql[:200]})
+                        result.trace_events = recorder.events()
+                    return result
+                except Exception as e:  # noqa: BLE001 — inspect+retry
+                    # a killed/expired query must NOT burn the elastic
+                    # retry budget re-running work nobody wants
+                    if getattr(e, "kind", None) in ("cancelled",
+                                                    "deadline_exceeded"):
+                        raise
+                    # sync-free overflow protocol: re-run the WHOLE
+                    # query with the suggested setting (any fragment
+                    # may have raised it, local or remote) — not a
+                    # failure retry
+                    prop, suggested = _retry_hint(e)
+                    if prop is not None and bumps < 8:
+                        bumps += 1
+                        props[prop] = max(suggested,
+                                          props.get(prop, 0) or 0)
                         continue
-                    try:
-                        st = json.loads(http_get(f"{url}/v1/info",
-                                                 timeout=5))
-                        if st.get("state") == "active":
-                            alive.append(url)
-                    except Exception:  # noqa: BLE001 — dead worker
-                        pass
-                if not alive:
-                    raise
-                if len(alive) == len(workers):
-                    # nothing died and no worker was implicated — the
-                    # failure is the query's own (analysis error,
-                    # execution bug): don't mask it behind a retry
-                    raise
-                workers = alive
-                continue
+                    attempt += 1
+                    if attempt > retries:
+                        raise
+                    bad = getattr(e, "worker", None)
+                    if bad:
+                        blacklist.add(bad)
+                    alive = []
+                    for url in workers:
+                        if url in blacklist:
+                            continue
+                        try:
+                            st = json.loads(http_get(
+                                f"{url}/v1/info", timeout=5))
+                            if st.get("state") == "active":
+                                alive.append(url)
+                        except Exception:  # noqa: BLE001 — dead worker
+                            pass
+                    if not alive:
+                        raise
+                    if len(alive) == len(workers):
+                        # nothing died and no worker was implicated —
+                        # the failure is the query's own (analysis
+                        # error, execution bug): don't mask it behind
+                        # a retry
+                        raise
+                    workers = alive
+                    continue
+        except BaseException as e:
+            # a failed traced query keeps its timeline (same contract
+            # as LocalRunner.execute): events — root span included —
+            # ride the exception to _run_query, which serves them on
+            # the trace endpoint
+            _trace.attach_failure(recorder, e, t0_ns, sql)
+            raise
+        finally:
+            if recorder is not None:
+                _trace.deactivate(prev_rec)
 
     def _runner(self):
         """The shared single-node runner (lazy; LocalRunner.execute is
@@ -672,16 +771,56 @@ th{{background:#222}}
                          properties: Optional[dict] = None,
                          on_columns=None, user: str = "",
                          lifecycle: Optional[QueryLifecycle] = None):
-        """One scheduling attempt over a fixed worker set."""
+        """Counter shell around _execute_attempt_inner: the attempt's
+        per-query kernel counters must span PLANNING too —
+        compile_expression credits expr_compile_ns while fragments are
+        planned, and counters installed only around the drive loop
+        would report expr_compile_ms = 0 on this topology forever."""
+        from presto_tpu.telemetry import build_query_stats
+        from presto_tpu.telemetry import kernels as _tk
+        prev_q = _tk.begin_query()
+        try:
+            return self._execute_attempt_inner(
+                sql, worker_urls, properties, on_columns, user,
+                lifecycle)
+        except BaseException as e:
+            # failed attempts keep their kernel attribution (compile
+            # time burned before the failure); _run_query's merge
+            # supplies the real wall/queued
+            try:
+                e.query_stats = build_query_stats(
+                    0.0, 0.0, _tk.query_counters())
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        finally:
+            _tk.end_query(prev_q)
+
+    def _execute_attempt_inner(self, sql: str, worker_urls: List[str],
+                               properties: Optional[dict] = None,
+                               on_columns=None, user: str = "",
+                               lifecycle: Optional[QueryLifecycle]
+                               = None):
+        """One scheduling attempt over a fixed worker set. An EXPLAIN
+        [ANALYZE] statement is handled HERE on the worker topology:
+        plain EXPLAIN renders the fragmented plan without executing;
+        EXPLAIN ANALYZE runs the inner query with profiling on the
+        coordinator AND every worker task (spec carries profile=true),
+        then renders per-task operator stats — rows/wall plus the
+        compile-vs-execute split — next to the fragment tree."""
         if lifecycle is None:
             lifecycle = QueryLifecycle()
         lifecycle.attempts += 1
+        import time as _time
+        from presto_tpu.parser import parse_statement
+        from presto_tpu.parser import tree as T
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
         )
         from presto_tpu.runner.local import (
             LocalRunner, MaterializedResult,
         )
+        from presto_tpu.telemetry import kernels as _tk
         properties = dict(self.properties if properties is None
                           else properties)
         # the client's identity gates access control at the
@@ -690,7 +829,18 @@ th{{background:#222}}
         runner = LocalRunner(self.catalog, self.schema, properties,
                              user=user,
                              access_control=self.access_control)
-        fplan = derive_fragments(runner, sql)
+        stmt = parse_statement(sql)
+        explain = isinstance(stmt, T.Explain)
+        profile = explain and stmt.analyze
+        fplan = derive_fragments(runner, sql, stmt=stmt)
+        if explain and not profile:
+            # plain EXPLAIN: the fragmented plan, no execution
+            result = runner._text_result(
+                "Query Plan", fplan.text().split("\n"))
+            if on_columns is not None:
+                on_columns([{"name": "Query Plan",
+                             "type": "varchar"}])
+            return result
         if not worker_urls and any(
                 f.partitioning == "distributed"
                 for f in fplan.fragments.values()):
@@ -760,6 +910,7 @@ th{{background:#222}}
                         "consumer_urls_by_edge": consumer_urls_by_edge,
                         "n_producers_by_edge": n_producers_by_edge,
                         "coordinator_url": self.url,
+                        "profile": profile,
                     }
                     body = json.dumps(spec).encode()
 
@@ -799,7 +950,7 @@ th{{background:#222}}
                     pipelines.extend(
                         planner.plan_fragment(fragment.root, sinks))
             assert result is not None
-            if on_columns is not None:
+            if on_columns is not None and not explain:
                 on_columns([
                     {"name": n, "type": f.type.display()}
                     for n, f in zip(result.result_names,
@@ -837,19 +988,157 @@ th{{background:#222}}
 
             watcher = threading.Thread(target=watch, daemon=True)
             watcher.start()
+            t0 = _time.perf_counter()
             drivers = self._drive_with_failures(
-                pipelines, failure,
+                pipelines, failure, profile=profile,
                 cancel=lifecycle.cancel.is_set,
                 deadline=lifecycle.deadline)
+            wall_s = _time.perf_counter() - t0
+            # the attempt's counter dict is live on this thread (the
+            # shell owns begin/end); snapshot it now so the stats
+            # tree can't see a later attempt's accumulation
+            kernel_counters = dict(_tk.query_counters() or {})
+            # roll the topology's TaskStats up BEFORE releasing: the
+            # coordinator's own drivers snapshot here, each worker
+            # task's snapshot comes back in its status response.
+            # Remote stats collection stays OFF the failure path —
+            # it must never delay elastic-retry failover
+            tasks = [{"task_id": f"{query_id}.coordinator",
+                      "worker": self.url,
+                      "wall_s": round(wall_s, 6),
+                      "pipelines":
+                      LocalRunner.snapshot_driver_stats(drivers)}]
+            if not failure:
+                # always poll briefly for the snapshot: the root can
+                # drain before a worker's task thread PUBLISHES its
+                # stats (drive return + materialize), and an empty
+                # pipelines entry would zero the query's worker
+                # kernel time. Plain queries bound the wait at 2s
+                # (concurrent across tasks); EXPLAIN ANALYZE waits
+                # longer — its whole point is the numbers
+                tasks += self._collect_task_stats(
+                    remote, wait=True,
+                    timeout_s=10.0 if profile else 2.0)
         finally:
             stop.set()
             lifecycle.remote = []
             self._release_everywhere(query_id, worker_urls)
         if failure:
             raise failure[0]
-        return MaterializedResult(result.result_names,
-                                  result.result_sink,
-                                  result.result_fields)
+        from presto_tpu.telemetry import build_query_stats
+        qstats = build_query_stats(wall_s * 1000, 0.0,
+                                   kernel_counters, tasks=tasks)
+        # top-level compile/execute must mean the same thing on every
+        # topology: the sum over ALL tasks' operator credit (worker
+        # kernel time included — the coordinator-thread counters alone
+        # would report ~0 for a query whose compiles happened on
+        # workers). The coordinator's drivers ARE a task, so this
+        # replaces (not adds to) its thread-local share.
+        qstats["compile_ms"] = round(sum(
+            t["totals"]["compile_ms"] for t in qstats["tasks"]), 3)
+        qstats["execute_ms"] = round(sum(
+            t["totals"]["execute_ms"] for t in qstats["tasks"]), 3)
+        # call/compile COUNTS are coordinator-thread-only (snapshots
+        # don't ship per-op call counts) — serving them next to
+        # all-task ns sums would be self-contradictory, so drop them
+        # from the distributed tree
+        qstats.pop("kernel_calls", None)
+        qstats.pop("kernel_compiles", None)
+        if profile:
+            out = self._render_distributed_profile(
+                fplan, tasks, wall_s, qstats)
+            result = runner._text_result("Query Plan",
+                                         out.split("\n"))
+            if on_columns is not None:
+                on_columns([{"name": "Query Plan",
+                             "type": "varchar"}])
+            result.query_stats = qstats
+            return result
+        out = MaterializedResult(result.result_names,
+                                 result.result_sink,
+                                 result.result_fields)
+        out.query_stats = qstats
+        return out
+
+    def _collect_task_stats(self, remote: List[tuple],
+                            wait: bool = False,
+                            timeout_s: float = 10.0) -> List[dict]:
+        """Best-effort fetch of each remote task's operator-stats
+        snapshot from its status response. `wait` (EXPLAIN ANALYZE)
+        polls briefly for terminal state — the root drained implies
+        producers finished, but the task thread may not have published
+        its snapshot yet. Plain queries use ONE short-timeout GET per
+        task, issued CONCURRENTLY (a slow-but-alive worker must cost
+        the query's critical path at most one timeout, not one per
+        task), and take whatever is there: stats are best-effort."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch(task):
+            task_id, wurl = task
+            st = None
+            deadline = time.monotonic() + timeout_s
+            while True:
+                try:
+                    st = json.loads(http_get(
+                        f"{wurl}/v1/task/{task_id}",
+                        timeout=max(2.0, min(timeout_s, 10.0)),
+                        retries=1))
+                except Exception:  # noqa: BLE001 — best-effort
+                    break
+                if not wait or st.get("stats") is not None \
+                        or st.get("state") not in ("running",) \
+                        or time.monotonic() > deadline:
+                    break
+                time.sleep(0.05)
+            if st is None:
+                return None
+            stats = st.get("stats") or {}
+            out = {"task_id": task_id, "worker": wurl,
+                   "wall_s": stats.get("wall_s"),
+                   "pipelines": stats.get("pipelines") or []}
+            if st.get("stats") is None:
+                # snapshot not published in time: mark the entry so
+                # consumers know the task's kernel share is missing,
+                # not zero
+                out["partial"] = True
+            return out
+
+        if not remote:
+            return []
+        with ThreadPoolExecutor(
+                max_workers=min(len(remote), 16)) as pool:
+            return [t for t in pool.map(fetch, remote)
+                    if t is not None]
+
+    @staticmethod
+    def _render_distributed_profile(fplan, tasks: List[dict],
+                                    wall_s: float,
+                                    qstats: dict) -> str:
+        """Distributed EXPLAIN ANALYZE text: fragment tree + one
+        operator-stats section per task (rows/wall + compile-vs-
+        execute), + the query-level rollup."""
+        from presto_tpu.telemetry import render_operator_stats
+        parts = [fplan.text()]
+        for t in tasks:
+            parts.append(f"Task {t['task_id']} @ {t['worker']}:")
+            parts.append(render_operator_stats(
+                t.get("pipelines") or [],
+                t.get("wall_s") or wall_s))
+        # the query footer sums the per-OPERATOR kernel credit across
+        # every task (coordinator included). The coordinator's thread-
+        # local query counters in `qstats` cover the same calls — its
+        # drivers ARE tasks[0] — so they must NOT be added on top
+        # (that double-counted coordinator compile time)
+        total_c = 0.0
+        total_e = 0.0
+        for t in qstats.get("tasks", ()):
+            tt = t.get("totals", {})
+            total_c += tt.get("compile_ms", 0.0)
+            total_e += tt.get("execute_ms", 0.0)
+        parts.append(
+            f"query wall: {wall_s * 1e3:.1f}ms, compile sum: "
+            f"{total_c:.1f}ms, execute sum: {total_e:.1f}ms")
+        return "\n\n".join(parts)
 
     def _release_everywhere(self, query_id: str,
                             worker_urls: List[str]) -> None:
@@ -864,6 +1153,7 @@ th{{background:#222}}
     @staticmethod
     def _drive_with_failures(pipelines, failure: List[str],
                              max_idle_s: float = 600.0,
+                             profile: bool = False,
                              cancel=None,
                              deadline: Optional[float] = None):
         """The coordinator's OWN drive loop (root + single-partition
@@ -873,7 +1163,7 @@ th{{background:#222}}
         from presto_tpu.operators.base import DriverContext
         from presto_tpu.operators.driver import Driver
         from presto_tpu.runner.local import check_lifecycle
-        dctx = DriverContext()
+        dctx = DriverContext(profile=profile)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
         idle_since = None

@@ -33,6 +33,8 @@ from presto_tpu.batch import Batch
 from presto_tpu.execution import faults
 from presto_tpu.operators.exchange_ops import edge_key_dicts
 from presto_tpu.server.serde import batch_from_bytes, batch_to_bytes
+from presto_tpu.telemetry import trace as _trace
+from presto_tpu.telemetry.metrics import METRICS
 
 #: transport retry budget for the exchange data plane and task RPCs —
 #: the tier BELOW elastic whole-query retry (reference: Trino's
@@ -63,7 +65,18 @@ def _retry_transient(fn, retries: int, base_s: float = _BACKOFF_BASE_S,
                 raise
             delay = min(base_s * (2 ** (attempt - 1)), cap_s)
             # jitter keeps a fleet of retriers from re-colliding
-            time.sleep(delay * (0.5 + random.random() * 0.5))
+            sleep_s = delay * (0.5 + random.random() * 0.5)
+            METRICS.inc("presto_tpu_transport_retries_total")
+            METRICS.inc("presto_tpu_backoff_sleep_ns_total",
+                        sleep_s * 1e9)
+            if _trace.ACTIVE:
+                # retry/backoff sleeps show up as spans in a traced
+                # query's timeline (the faults tier's visible cost)
+                with _trace.span("transport.backoff", "retry",
+                                 attempt=attempt):
+                    time.sleep(sleep_s)
+            else:
+                time.sleep(sleep_s)
 
 
 def http_post(url: str, body: bytes, timeout: float = 60.0,
@@ -144,6 +157,10 @@ class ExchangeRegistry:
                 # drive thread per producer task), so marking before
                 # decode cannot skip a gap
                 self._last_seq[sk] = seq
+        METRICS.inc("presto_tpu_exchange_pages_total",
+                    direction="recv")
+        METRICS.inc("presto_tpu_exchange_bytes_total", len(payload),
+                    direction="recv")
         batch = batch_from_bytes(payload)
         with self._lock:
             if not self._is_released(key):
@@ -168,7 +185,15 @@ class ExchangeRegistry:
             faults.fire("exchange.pop", key=key, consumer=consumer)
         with self._lock:
             q = self._queues[(key, consumer)]
-            return q.popleft() if q else None
+            batch = q.popleft() if q else None
+        if batch is not None:
+            METRICS.inc("presto_tpu_exchange_pages_total",
+                        direction="pop")
+            if _trace.ACTIVE and _trace.current() is not None:
+                _trace.current().instant("exchange.pop", "exchange",
+                                         {"key": key,
+                                          "consumer": consumer})
+        return batch
 
     def has_output(self, key: str, consumer: int) -> bool:
         with self._lock:
@@ -285,7 +310,16 @@ class HttpExchange:
             if faults.ARMED:
                 faults.fire("exchange.push", phase="after", url=url,
                             seq=seq)
-        _retry_transient(send, TRANSPORT_RETRIES)
+        METRICS.inc("presto_tpu_exchange_pages_total",
+                    direction="push")
+        METRICS.inc("presto_tpu_exchange_bytes_total", len(payload),
+                    direction="push")
+        if _trace.ACTIVE and _trace.current() is not None:
+            with _trace.span("exchange.push", "exchange",
+                             consumer=consumer, bytes=len(payload)):
+                _retry_transient(send, TRANSPORT_RETRIES)
+        else:
+            _retry_transient(send, TRANSPORT_RETRIES)
 
     def _deliver_whole(self, consumers: List[int], batch: Batch,
                        producer: int) -> None:
@@ -301,7 +335,15 @@ class HttpExchange:
             n = batch.num_valid()
             host = jax.device_get(
                 batch.compact(bucket_capacity(max(n, 1)), known_valid=n))
+            from presto_tpu.execution.memory import batch_bytes
+            METRICS.inc("presto_tpu_transfer_bytes_total",
+                        batch_bytes(host), direction="d2h")
             for c in local:
+                # local short-circuit deliveries still count as pages
+                # (else pop > push + recv and the direction label is
+                # unusable for in-flight math)
+                METRICS.inc("presto_tpu_exchange_pages_total",
+                            direction="local")
                 self.registry.receive_local(self.exchange_id, c, host)
             if remote:
                 payload = batch_to_bytes(host, assume_compact=True)
@@ -332,12 +374,17 @@ class HttpExchange:
                 batch, tuple(self.partition_keys), self._remaps,
                 self.n_consumers)
             host, hbounds = jax.device_get((dev_sorted, bounds))
+            from presto_tpu.execution.memory import batch_bytes
+            METRICS.inc("presto_tpu_transfer_bytes_total",
+                        batch_bytes(host), direction="d2h")
             for c in range(self.n_consumers):
                 lo, hi = int(hbounds[c]), int(hbounds[c + 1])
                 if lo == hi:
                     continue  # nothing for this consumer
                 seg = _host_segment(host, lo, hi)
                 if self._is_local(c):
+                    METRICS.inc("presto_tpu_exchange_pages_total",
+                                direction="local")
                     self.registry.receive_local(self.exchange_id, c, seg)
                 else:
                     self._post(c, batch_to_bytes(seg,
@@ -372,6 +419,11 @@ class TaskState:
     def __init__(self):
         self.state = "running"
         self.error: Optional[str] = None
+        #: {"wall_s", "pipelines": per-operator snapshot dicts} of the
+        #: finished task — shipped in the /v1/task/{tid} status
+        #: response so the coordinator can roll TaskStats into
+        #: QueryStats
+        self.stats: Optional[dict] = None
         #: structured retry protocol: the engine's sync-free overflow
         #: errors (join capacity / group limit) are not failures — the
         #: COORDINATOR must re-run the whole query with the suggested
@@ -413,7 +465,8 @@ class NodeHandler(BaseHTTPRequestHandler):
                  "trace": traceback.format_exc(limit=5)}).encode())
             return
         ctype = "text/html" if self.path.startswith("/ui") \
-            else "application/json"
+            else "text/plain; version=0.0.4" \
+            if self.path == "/v1/metrics" else "application/json"
         self._reply(200, body, ctype)
 
     def do_POST(self):
@@ -483,6 +536,12 @@ class Node:
                 # the query survived (a never-firing test is vacuous)
                 info["faults"] = faults.counters()
             return json.dumps(info).encode()
+        if path == "/v1/metrics":
+            # Prometheus text scrape surface: every node — worker or
+            # coordinator — serves its own process counters + live
+            # cache/memory gauges (telemetry/metrics.py)
+            from presto_tpu.telemetry.metrics import render_prometheus
+            return render_prometheus().encode()
         if path == "/v1/tasks":
             # observability + test support (reference: /v1/task listing)
             return json.dumps({
@@ -493,7 +552,8 @@ class Node:
             t = self.tasks[tid]
             return json.dumps({"state": t.state, "error": t.error,
                                "error_kind": t.error_kind,
-                               "suggested": t.suggested}).encode()
+                               "suggested": t.suggested,
+                               "stats": t.stats}).encode()
         raise KeyError(path)
 
     def handle_post(self, path: str, body: bytes,
@@ -579,7 +639,7 @@ class Node:
 
     def _run_task(self, spec: dict, state: TaskState) -> None:
         try:
-            self.execute_fragment(spec, state.cancel)
+            state.stats = self.execute_fragment(spec, state.cancel)
             state.state = "finished"
         except Exception as e:  # noqa: BLE001
             if state.cancel.is_set():
@@ -605,11 +665,15 @@ class Node:
 
     def execute_fragment(self, spec: dict,
                          cancel: Optional[threading.Event] = None
-                         ) -> None:
+                         ) -> dict:
         """Re-derive the fragment plan from SQL (deterministic) and run
         this node's task(s) of fragment `fragment_id` — one subtask per
         local device when the spec carries `local_count` > 1 (mesh-per-
-        worker), all driven in one round-robin loop."""
+        worker), all driven in one round-robin loop. Returns
+        {"wall_s", "pipelines": per-operator snapshot dicts} — the
+        TaskStats the coordinator rolls into QueryStats;
+        `spec["profile"]` adds device row counters + device-inclusive
+        timing, the distributed EXPLAIN ANALYZE mode."""
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
         )
@@ -649,20 +713,35 @@ class Node:
             sinks = [exchanges[e.exchange_id] for e in sinks_edges]
             pipelines.extend(
                 planner.plan_fragment(fragment.root, sinks))
-        LocalRunner.drive_pipelines(
+        t0 = time.perf_counter()
+        drivers = LocalRunner.drive_pipelines(
             pipelines,
+            profile=bool(spec.get("profile")),
             cancel=cancel.is_set if cancel is not None else None)
+        return {"wall_s": round(time.perf_counter() - t0, 6),
+                "pipelines": LocalRunner.snapshot_driver_stats(drivers)}
 
 
-def derive_fragments(runner, sql: str):
+def derive_fragments(runner, sql: str, stmt=None):
     """SQL -> the same FragmentedPlan on every node (symbol allocation
-    and fragment numbering are deterministic)."""
+    and fragment numbering are deterministic). An EXPLAIN [ANALYZE]
+    wrapper is unwrapped here — a distributed EXPLAIN ANALYZE ships
+    the ORIGINAL text, and every node plans the inner query. `stmt`
+    lets a caller that already parsed the text skip the second
+    lex+parse walk."""
+    from presto_tpu.parser import parse_statement
+    from presto_tpu.parser import tree as T
     from presto_tpu.planner.exchanges import (
         add_exchanges, fragment_plan,
     )
     from presto_tpu.planner.local_planner import prune_unused_columns
     from presto_tpu.planner.optimizer import optimize
-    plan = optimize(runner.create_plan(sql), runner.catalogs)
+    if stmt is None:
+        stmt = parse_statement(sql)
+    if isinstance(stmt, T.Explain):
+        stmt = stmt.statement
+    plan = optimize(runner.create_plan(sql, stmt=stmt),
+                    runner.catalogs)
     prune_unused_columns(plan)
     plan = add_exchanges(plan, runner.catalogs, runner.session)
     return fragment_plan(plan)

@@ -165,6 +165,13 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "faults.py). Empty = disarmed, zero overhead. Applying the "
         "SAME spec repeatedly does not reset trigger counters"),
     PropertyDef(
+        "query_trace_enabled", "boolean", False,
+        "Record hierarchical trace spans (query -> driver -> operator "
+        "plus exchange/cache/backoff events) for this query; exported "
+        "as Chrome trace_event JSON via GET /v1/query/{id}/trace and "
+        "tools/trace_viewer.py. Off = zero recording overhead "
+        "(telemetry/trace.py)"),
+    PropertyDef(
         "cache_memory_bytes", "bigint", 4 << 30,
         "Shared byte budget of the fragment-result + page-source "
         "caches, charged to the cache manager's tagged MemoryPool; "

@@ -1,0 +1,164 @@
+"""Process-wide metrics registry, rendered in the Prometheus text
+exposition format (reference analog: presto-main's JMX metrics /
+/v1/jmx, re-expressed as the de-facto scrape format so any collector
+can consume GET /v1/metrics on the coordinator and every worker).
+
+Counters are monotonic and cheap (one small lock per inc — the sites
+are batch/page/query granular, never per row); gauges are sampled live
+at render time from their owning subsystems (cache manager, memory
+pools), so the scrape always reflects current state without the
+subsystems having to push."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[_Key, float] = {}
+        self._help: Dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help.setdefault(name, help_text)
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def get(self, name: str, **labels) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key, 0.0)
+
+    def total(self, name: str) -> float:
+        """Sum over every label combination of `name`."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
+    def snapshot(self) -> Dict[str, float]:
+        """{name{label="v",...}: value} — tests and bench deltas."""
+        with self._lock:
+            out = {}
+            for (name, labels), v in sorted(self._counters.items()):
+                out[_series(name, labels)] = v
+            return out
+
+    def render(self, extra=None) -> str:
+        """Prometheus text format. `extra` is an optional list of
+        (name, type, help, [(labels_dict, value)]) gauge families
+        sampled by the caller at scrape time."""
+        lines = []
+        with self._lock:
+            families: Dict[str, list] = {}
+            for (name, labels), v in sorted(self._counters.items()):
+                families.setdefault(name, []).append((labels, v))
+        for name, series in families.items():
+            lines.append(f"# HELP {name} "
+                         f"{self._help.get(name, name)}")
+            lines.append(f"# TYPE {name} counter")
+            for labels, v in series:
+                lines.append(f"{_series(name, labels)} {_num(v)}")
+        for name, typ, help_text, series in (extra or ()):
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {typ}")
+            for labels, v in series:
+                lines.append(
+                    f"{_series(name, tuple(sorted(labels.items())))}"
+                    f" {_num(v)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+def _series(name: str, labels) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{_escape(str(v))}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+#: THE process-wide registry (one per node process, like the cache
+#: manager singleton)
+METRICS = MetricsRegistry()
+
+# -- well-known series (described up front so a scrape before first
+# increment still explains them) --------------------------------------
+METRICS.describe("presto_tpu_queries_total",
+                 "Queries by terminal state (and error kind)")
+METRICS.describe("presto_tpu_kernel_calls_total",
+                 "Instrumented jit-kernel invocations")
+METRICS.describe("presto_tpu_kernel_compiles_total",
+                 "Kernel calls that triggered an XLA compile")
+METRICS.describe("presto_tpu_kernel_compile_ns_total",
+                 "Wall ns spent in calls that compiled (trace+XLA)")
+METRICS.describe("presto_tpu_kernel_execute_ns_total",
+                 "Wall ns spent dispatching already-compiled kernels")
+METRICS.describe("presto_tpu_expr_compile_ns_total",
+                 "Host ns building expression closures (expr/compile)")
+METRICS.describe("presto_tpu_exchange_pages_total",
+                 "Exchange pages by direction (push/recv/pop)")
+METRICS.describe("presto_tpu_exchange_bytes_total",
+                 "Exchange payload bytes by direction")
+METRICS.describe("presto_tpu_transport_retries_total",
+                 "Transport-level retry attempts (backoff tier)")
+METRICS.describe("presto_tpu_backoff_sleep_ns_total",
+                 "ns slept in transport retry backoff")
+METRICS.describe("presto_tpu_transfer_bytes_total",
+                 "host<->device transfer bytes by direction (d2h at "
+                 "exchange device_get, h2d at per-device scan "
+                 "placement)")
+
+
+def render_prometheus() -> str:
+    """METRICS counters + live gauges from the cache hierarchy and its
+    memory pool — the GET /v1/metrics body."""
+    extra = []
+    try:
+        from presto_tpu.cache import get_cache_manager
+        mgr = get_cache_manager(create=False)
+    except Exception:  # noqa: BLE001 — metrics must always render
+        mgr = None
+    if mgr is not None:
+        rows = mgr.snapshot_rows()
+        for metric, idx in (("hits", 1), ("misses", 2),
+                            ("evictions", 3)):
+            extra.append((
+                f"presto_tpu_cache_{metric}_total", "counter",
+                f"Cache {metric} by level",
+                [({"level": r[0]}, r[idx]) for r in rows]))
+        extra.append((
+            "presto_tpu_cache_entries", "gauge",
+            "Live cache entries by level",
+            [({"level": r[0]}, r[4]) for r in rows]))
+        extra.append((
+            "presto_tpu_cache_bytes", "gauge",
+            "Cached batch bytes by level",
+            [({"level": r[0]}, r[5]) for r in rows]))
+        extra.append((
+            "presto_tpu_memory_pool_reserved_bytes", "gauge",
+            "Reserved bytes of the shared cache memory pool",
+            [({"pool": "cache"}, mgr.pool.reserved)]))
+        if mgr.pool.budget is not None:
+            extra.append((
+                "presto_tpu_memory_pool_budget_bytes", "gauge",
+                "Byte budget of the shared cache memory pool",
+                [({"pool": "cache"}, mgr.pool.budget)]))
+    return METRICS.render(extra)

@@ -1,0 +1,167 @@
+"""Hierarchical trace spans (reference analog: the per-query
+splits/operator timeline of Presto's webapp + Chromium's
+``trace_event`` format, which is what chrome://tracing and Perfetto
+load directly).
+
+One ``TraceRecorder`` exists per TRACED query (session property
+``query_trace_enabled``); it rides a thread-local so any layer the
+drive thread passes through — driver loop, exchange push/pop, cache
+get/put, transport backoff — can record spans without parameter
+threading. Nesting is implicit: spans are Chrome "X" (complete) events
+on the recording thread's ``tid``, and containment by (ts, dur) IS the
+hierarchy (query ⊃ driver ⊃ operator), which is how the trace_event
+schema itself models call stacks.
+
+Zero overhead when disabled: every call site guards on the module bool
+``ACTIVE`` (kept equal to "any recorder is registered anywhere" under a
+lock, the faults.ARMED pattern), so an untraced query pays one
+attribute load + branch per site. Threads without a current recorder
+(HTTP handler threads, other queries' drive threads) no-op even while
+ACTIVE is True."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: fast gate: True iff at least one recorder is active somewhere in
+#: the process. Sites check this before touching the thread-local.
+ACTIVE = False
+
+_LOCK = threading.Lock()
+_ACTIVE_COUNT = 0
+_TL = threading.local()
+
+
+class TraceRecorder:
+    """Collects completed spans for one query; thread-safe (a traced
+    distributed query records from the coordinator drive thread AND
+    the exchange/transport threads that hold it current)."""
+
+    #: runaway guard: a pathological query must not buffer unbounded
+    #: span dicts (the cap is far above any sane trace)
+    MAX_EVENTS = 200_000
+
+    def __init__(self, query_id: str = ""):
+        self.query_id = query_id
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        #: thread ident -> small sequential lane id. Raw idents are
+        #: thread-descriptor ADDRESSES on glibc — their low bits are
+        #: identical across threads, so any masking scheme collides
+        #: and merges unrelated threads into one lane, corrupting the
+        #: containment-based hierarchy. Sequential ids cannot collide.
+        self._tids: Dict[int, int] = {}
+        self.dropped = 0
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = self._tids[ident] = len(self._tids)
+        return tid
+
+    def add(self, name: str, cat: str, t0_ns: int, dur_ns: int,
+            args: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            ev = {
+                "name": name, "cat": cat, "ph": "X",
+                # trace_event timestamps are MICROseconds
+                "ts": t0_ns / 1e3, "dur": dur_ns / 1e3,
+                "pid": 1, "tid": self._tid(),
+            }
+            if args:
+                ev["args"] = args
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def instant(self, name: str, cat: str,
+                args: Optional[Dict[str, Any]] = None) -> None:
+        """Point-in-time marker (Chrome "i" instant event)."""
+        with self._lock:
+            ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+                  "ts": time.perf_counter_ns() / 1e3,
+                  "pid": 1, "tid": self._tid()}
+            if args:
+                ev["args"] = args
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The document chrome://tracing / Perfetto loads verbatim."""
+        return {
+            "displayTimeUnit": "ms",
+            "otherData": {"query_id": self.query_id,
+                          "dropped_events": self.dropped},
+            "traceEvents": self.events(),
+        }
+
+
+def activate(recorder: TraceRecorder):
+    """Make `recorder` THIS thread's current recorder; returns the
+    previous one (restore it via deactivate). Bumps the global ACTIVE
+    gate."""
+    global ACTIVE, _ACTIVE_COUNT
+    prev = getattr(_TL, "recorder", None)
+    _TL.recorder = recorder
+    with _LOCK:
+        _ACTIVE_COUNT += 1
+        ACTIVE = True
+    return prev
+
+
+def deactivate(prev=None) -> None:
+    global ACTIVE, _ACTIVE_COUNT
+    _TL.recorder = prev
+    with _LOCK:
+        _ACTIVE_COUNT = max(0, _ACTIVE_COUNT - 1)
+        ACTIVE = _ACTIVE_COUNT > 0
+
+
+def current() -> Optional[TraceRecorder]:
+    return getattr(_TL, "recorder", None)
+
+
+def attach_failure(recorder: Optional[TraceRecorder], exc,
+                   t0_ns: int, sql: str) -> None:
+    """Close the root "query" span and ride the events on the
+    exception — THE failed-traced-query contract, shared by
+    LocalRunner.execute and the coordinator's distributed path (the
+    failure case is exactly when the timeline matters)."""
+    if recorder is None:
+        return
+    recorder.add("query", "query", t0_ns,
+                 time.perf_counter_ns() - t0_ns,
+                 {"sql": sql[:200], "failed": True})
+    try:
+        exc.trace_events = recorder.events()
+    except Exception:  # noqa: BLE001 — slotted exception types etc.
+        pass
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "engine", **args):
+    """Record a complete span around the body — a no-op (zero clock
+    reads) when this thread has no current recorder. Call sites should
+    additionally guard on `trace.ACTIVE` so the contextmanager object
+    itself is never built on untraced hot paths."""
+    rec = getattr(_TL, "recorder", None)
+    if rec is None:
+        yield None
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield rec
+    finally:
+        rec.add(name, cat, t0, time.perf_counter_ns() - t0,
+                args or None)

@@ -122,6 +122,12 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
                 latencies.append(dt)
                 checks.setdefault(name, set()).add(_checksum(data))
 
+    # per-phase XLA attribution: the process-wide kernel counters are
+    # monotonic and phases run sequentially, so before/after deltas
+    # are exactly this phase's compile-vs-execute split
+    from presto_tpu.telemetry.metrics import METRICS
+    compile0 = METRICS.total("presto_tpu_kernel_compile_ns_total")
+    execute0 = METRICS.total("presto_tpu_kernel_execute_ns_total")
     threads = [threading.Thread(target=client, args=(i, work))
                for i, work in enumerate(assignments)]
     for t in threads:
@@ -141,6 +147,15 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
         "qps": round(n / wall, 3) if wall > 0 else None,
         "p50_ms": round(_percentile(latencies, 0.50) * 1e3, 1),
         "p95_ms": round(_percentile(latencies, 0.95) * 1e3, 1),
+        "p99_ms": round(_percentile(latencies, 0.99) * 1e3, 1),
+        "max_ms": round(max(latencies) * 1e3, 1) if latencies
+        else 0.0,
+        "kernel_compile_ms": round(
+            (METRICS.total("presto_tpu_kernel_compile_ns_total")
+             - compile0) / 1e6, 1),
+        "kernel_execute_ms": round(
+            (METRICS.total("presto_tpu_kernel_execute_ns_total")
+             - execute0) / 1e6, 1),
     }
     if tolerant:
         total = n + len(errors)

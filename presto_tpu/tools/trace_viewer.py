@@ -1,0 +1,141 @@
+"""Terminal viewer for the engine's Chrome ``trace_event`` exports
+(GET /v1/query/{id}/trace, or a LocalRunner result's trace_events) —
+for when chrome://tracing / Perfetto is three hops away and you just
+want to see where the time went.
+
+Spans nest by (ts, dur) containment per thread — the same rule the
+Chrome viewer applies — so the tree below IS the span hierarchy:
+
+    query                                 1172.8ms
+      op:scan:lineitem.get_output           44.4ms
+      kernel:filter_project [compile]       26.2ms
+      ...
+
+Usage:
+    python -m presto_tpu.tools.trace_viewer trace.json
+    python -m presto_tpu.tools.trace_viewer --url \\
+        http://127.0.0.1:8080/v1/query/<id>/trace
+    ... [--top 20] (flat top-N spans by duration instead of the tree)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional
+
+
+def load_trace(doc) -> List[dict]:
+    """Accept the export dict, a bare event list, or JSON text."""
+    if isinstance(doc, (str, bytes)):
+        doc = json.loads(doc)
+    if isinstance(doc, dict):
+        return list(doc.get("traceEvents", []))
+    return list(doc)
+
+
+def build_tree(events: List[dict]) -> List[dict]:
+    """Nest complete ("X") spans by containment per tid. Returns the
+    roots, each {"ev", "children": [...]}. Instant events attach as
+    zero-length children of their narrowest containing span."""
+    by_tid: Dict[int, List[dict]] = {}
+    for ev in events:
+        if ev.get("ph") in ("X", "i"):
+            by_tid.setdefault(ev.get("tid", 0), []).append(ev)
+    roots: List[dict] = []
+    for tid_events in by_tid.values():
+        # wider-first at equal start => parents precede children
+        tid_events.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[dict] = []
+        for ev in tid_events:
+            node = {"ev": ev, "children": []}
+            end = ev["ts"] + ev.get("dur", 0.0)
+            while stack:
+                top = stack[-1]["ev"]
+                if ev["ts"] >= top["ts"] + top.get("dur", 0.0) - 1e-9:
+                    stack.pop()
+                    continue
+                break
+            if stack:
+                stack[-1]["children"].append(node)
+            else:
+                roots.append(node)
+            if ev.get("ph") == "X":
+                stack.append(node)
+    roots.sort(key=lambda n: n["ev"]["ts"])
+    return roots
+
+def render_tree(roots: List[dict], max_depth: int = 10,
+                min_ms: float = 0.0) -> str:
+    lines: List[str] = []
+
+    def walk(node: dict, depth: int) -> None:
+        ev = node["ev"]
+        dur_ms = ev.get("dur", 0.0) / 1e3
+        if depth > max_depth or (dur_ms < min_ms
+                                 and ev.get("ph") == "X"):
+            return
+        marker = "" if ev.get("ph") == "X" else " (instant)"
+        lines.append(f"{'  ' * depth}{ev['name']}"
+                     f" [{ev.get('cat', '')}]{marker}"
+                     f"  {dur_ms:.2f}ms")
+        for c in node["children"]:
+            walk(c, depth + 1)
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines)
+
+
+def render_top(events: List[dict], top: int = 20) -> str:
+    spans = [e for e in events if e.get("ph") == "X"]
+    spans.sort(key=lambda e: -e.get("dur", 0.0))
+    lines = [f"{'dur ms':>10}  {'cat':<10} name"]
+    for e in spans[:top]:
+        lines.append(f"{e.get('dur', 0.0) / 1e3:>10.2f}  "
+                     f"{e.get('cat', ''):<10} {e['name']}")
+    return "\n".join(lines)
+
+
+def summarize(events: List[dict]) -> str:
+    by_cat: Dict[str, float] = {}
+    for e in events:
+        if e.get("ph") == "X":
+            by_cat[e.get("cat", "?")] = by_cat.get(
+                e.get("cat", "?"), 0.0) + e.get("dur", 0.0)
+    parts = [f"{k}: {v / 1e3:.1f}ms"
+             for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1])]
+    return f"{len(events)} events; span ms by category: " \
+           + ", ".join(parts)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render a presto-tpu query trace in the terminal")
+    p.add_argument("file", nargs="?", help="trace JSON file")
+    p.add_argument("--url", help="fetch the trace from a "
+                                 "coordinator /v1/query/{id}/trace")
+    p.add_argument("--top", type=int, default=0,
+                   help="flat top-N spans instead of the tree")
+    p.add_argument("--min-ms", type=float, default=0.0,
+                   help="hide tree spans shorter than this")
+    p.add_argument("--max-depth", type=int, default=10)
+    args = p.parse_args(argv)
+    if args.url:
+        from presto_tpu.server.node import http_get
+        events = load_trace(http_get(args.url, timeout=30))
+    elif args.file:
+        with open(args.file) as f:
+            events = load_trace(f.read())
+    else:
+        p.error("give a trace file or --url")
+    print(summarize(events))
+    if args.top:
+        print(render_top(events, args.top))
+    else:
+        print(render_tree(build_tree(events), args.max_depth,
+                          args.min_ms))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,424 @@
+"""Engine telemetry (presto_tpu/telemetry): per-operator stats with
+conservation oracles, XLA compile-vs-execute attribution at the
+kernel-cache boundary, hierarchical trace spans in the Chrome
+trace_event schema, the Prometheus /v1/metrics surface, and the
+disabled-telemetry equivalence guarantee."""
+
+import json
+import re
+import time
+
+import pytest
+
+from test_distributed import cluster, local_rows  # noqa: F401
+
+
+@pytest.fixture()
+def runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", "tiny")
+
+
+JOIN_SQL = ("select l.returnflag, count(*) c from lineitem l "
+            "join orders o on l.orderkey = o.orderkey "
+            "where l.quantity > 10 group by l.returnflag "
+            "order by l.returnflag")
+
+
+# ---------------------------------------------------------------- stats
+
+
+def test_stats_conservation_rows(runner):
+    """Sum of an operator's output rows == the downstream operator's
+    input rows, for every adjacent pair of a profiled pipeline (the
+    driver moves every output batch into the next operator)."""
+    runner.execute("explain analyze " + JOIN_SQL)
+    snap = runner.operator_stats_history[-1]["pipelines"]
+    assert snap, "no operator stats recorded"
+    checked = 0
+    for ops in snap:
+        for a, b in zip(ops, ops[1:]):
+            if b["input_batches"] == 0:
+                continue  # sink never received anything
+            assert a["output_rows"] == b["input_rows"], (a, b)
+            assert a["output_batches"] == b["input_batches"], (a, b)
+            checked += 1
+    assert checked >= 3
+
+
+def test_stats_bytes_and_busy_populated(runner):
+    runner.execute("explain analyze " + JOIN_SQL)
+    snap = runner.operator_stats_history[-1]["pipelines"]
+    flat = [s for ops in snap for s in ops]
+    assert any(s["output_bytes"] > 0 for s in flat)
+    assert sum(s["busy_seconds"] for s in flat) > 0
+
+
+def test_compile_ns_cold_then_zero_on_warm_kernel_cache(runner):
+    """Cache-miss trace = compile; a warm kernel-cache hit must report
+    execute only. The filter literal is unique so the first run cannot
+    ride an earlier test's kernel; fragment/plan caches are off so the
+    second run actually re-dispatches the kernels."""
+    props = {"fragment_result_cache_enabled": False,
+             "plan_cache_enabled": False}
+    runner.session.properties.update(props)
+    sql = "select returnflag from lineitem where quantity > 47.1259"
+    runner.execute(sql)
+    cold = runner.query_history[-1]
+    assert cold["compile_ms"] > 0, cold
+    runner.execute(sql)
+    warm = runner.query_history[-1]
+    assert warm["compile_ms"] == 0, warm
+    assert warm["execute_ms"] > 0, warm
+
+
+def test_explain_analyze_annotates_plan_nodes(runner):
+    res = runner.execute("explain analyze " + JOIN_SQL)
+    text = "\n".join(row[0] for row in res.rows())
+    # the plan TREE carries per-node stat lines (| prefixed), joined
+    # from the operators each node planned into
+    assert re.search(r"TableScan\[tpch\.tiny\.lineitem\].*\n\s+\| "
+                     r"scan:lineitem \[id=\d+\]  rows: 0 -> [\d,]+",
+                     text), text
+    assert "compile:" in text and "execute:" in text
+    assert re.search(r"kernel time: compile [\d.]+ms \+ execute "
+                     r"[\d.]+ms", text), text
+    # legacy pipeline table still present (tooling greps it)
+    assert "Pipeline 0:" in text
+    m = re.search(r"wall: ([\d.]+)ms, operator busy sum:", text)
+    assert m
+    # compile + execute never exceeds what the profiled operators
+    # were actually charged (busy is device-inclusive wall)
+    wall = float(m.group(1))
+    k = re.search(r"kernel time: compile ([\d.]+)ms \+ execute "
+                  r"([\d.]+)ms", text)
+    assert float(k.group(1)) + float(k.group(2)) <= wall * 1.05
+
+
+def test_system_runtime_operator_stats_table(runner):
+    runner.execute("explain analyze " + JOIN_SQL)
+    rows = runner.execute(
+        "select name, input_rows, output_rows, busy_ms, compile_ms "
+        "from system.runtime.operator_stats "
+        "where output_rows > 0 order by busy_ms desc").rows()
+    assert rows
+    names = {r[0] for r in rows}
+    assert any(n.startswith("scan:") for n in names)
+    assert all(r[3] >= 0 for r in rows)
+
+
+def test_system_runtime_queries_new_columns(runner):
+    held = runner.execute("select count(*) from nation")  # noqa: F841
+    # (held alive: rows_out resolves from the weakly-held result)
+    rows = runner.execute(
+        "select query_id, state, wall_ms, queued_ms, compile_ms, "
+        "rows_out from system.runtime.queries "
+        "where state = 'FINISHED' order by query_id").rows()
+    assert rows
+    first = rows[0]
+    assert first[2] > 0           # wall_ms
+    assert first[3] == 0.0        # queued_ms (no queue on a runner)
+    assert first[4] >= 0          # compile_ms
+    assert first[5] == 1          # rows_out of the count(*)
+
+
+def test_driver_stall_is_structured(runner):
+    """max_steps exhaustion raises QueryError(kind='driver_stall')
+    carrying the per-operator snapshot (satellite fix — it used to be
+    a bare RuntimeError with no diagnosis)."""
+    from presto_tpu.batch import Batch
+    from presto_tpu.operators.base import (
+        DriverContext, Operator, OperatorContext,
+    )
+    from presto_tpu.operators.core import OutputCollectorOperator
+    from presto_tpu.operators.driver import Driver
+    from presto_tpu.runner.local import QueryError
+    from presto_tpu.types import BIGINT
+
+    class EndlessSource(Operator):
+        def needs_input(self):
+            return False
+
+        def add_input(self, batch):
+            raise RuntimeError
+
+        def get_output(self):
+            return self._count_out(
+                Batch.from_pydict({"x": ([1, 2], BIGINT)}))
+
+        def finish(self):
+            pass
+
+        def is_finished(self):
+            return False
+
+    dctx = DriverContext()
+    src = EndlessSource(OperatorContext(1, "endless", dctx))
+    sink = OutputCollectorOperator(OperatorContext(2, "output", dctx),
+                                   [])
+    d = Driver([src, sink])
+    with pytest.raises(QueryError) as ei:
+        d.run_to_completion(max_steps=25)
+    assert ei.value.kind == "driver_stall"
+    snap = ei.value.operator_stats
+    assert [s["name"] for s in snap] == ["endless", "output"]
+    assert snap[0]["output_batches"] > 0
+    assert "endless" in str(ei.value)
+
+
+# ---------------------------------------------------------------- trace
+
+
+def test_trace_spans_nest_and_export_chrome_schema(runner):
+    runner.session.properties["query_trace_enabled"] = True
+    res = runner.execute(JOIN_SQL)
+    events = res.trace_events
+    assert events, "tracing enabled but no spans recorded"
+    # schema: X/i events with name/cat/ts(+dur) — json round-trips
+    doc = json.loads(json.dumps({"traceEvents": events}))
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "i")
+        assert "name" in ev and "ts" in ev and "cat" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    roots = [e for e in events if e["name"] == "query"]
+    assert len(roots) == 1
+    q = roots[0]
+    # hierarchy oracle: every operator/kernel span fits INSIDE the
+    # query span (child wall <= parent span wall, by containment)
+    children = [e for e in events
+                if e["ph"] == "X" and e is not q]
+    assert children
+    for ev in children:
+        assert ev["ts"] >= q["ts"] - 1e-3
+        assert ev["ts"] + ev["dur"] <= q["ts"] + q["dur"] + 1e-3
+        assert ev["dur"] <= q["dur"] + 1e-3
+    cats = {e["cat"] for e in events}
+    assert "operator" in cats
+    # kernel spans carry the compile/execute classification
+    assert any(e["cat"] in ("compile", "execute") for e in events)
+
+
+def test_failed_traced_query_keeps_its_trace(runner):
+    """The failure case is exactly when the timeline matters: a
+    traced query that fails carries its events (root span included)
+    on the exception instead of dropping them."""
+    from presto_tpu.runner.local import QueryError
+    runner.session.properties["query_trace_enabled"] = True
+    with pytest.raises(QueryError) as ei:
+        runner.execute("select no_such_column from nation")
+    events = getattr(ei.value, "trace_events", None)
+    assert events is not None
+    assert any(e["name"] == "query" and e.get("args", {}).get("failed")
+               for e in events)
+    from presto_tpu.telemetry import trace
+    assert trace.ACTIVE is False  # recorder fully deactivated
+
+
+def test_untraced_run_records_nothing(runner):
+    from presto_tpu.telemetry import trace
+    res = runner.execute("select count(*) from region")
+    assert res.trace_events is None
+    assert trace.ACTIVE is False
+
+
+def test_trace_viewer_renders(runner):
+    from presto_tpu.tools.trace_viewer import (
+        build_tree, load_trace, render_top, render_tree, summarize,
+    )
+    runner.session.properties["query_trace_enabled"] = True
+    res = runner.execute("select count(*) from nation")
+    doc = json.dumps({"traceEvents": res.trace_events})
+    events = load_trace(doc)
+    tree = render_tree(build_tree(events))
+    assert "query" in tree
+    assert "ms" in tree
+    assert "query" in render_top(events, 5)
+    assert "events" in summarize(events)
+
+
+# -------------------------------------------------------------- metrics
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Strict-ish parse: every non-comment line is `series value`."""
+    out = {}
+    for line in text.strip().split("\n"):
+        if not line or line.startswith("#"):
+            continue
+        m = re.fullmatch(
+            r'([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})?) '
+            r'(-?[0-9.e+-]+)', line)
+        assert m, f"unparseable metrics line: {line!r}"
+        out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def test_metrics_endpoint_parses_as_prometheus_text():
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+    from presto_tpu.server.node import http_get
+    coord = Coordinator([], "tpch", "tiny", single_node=True)
+    coord.start()
+    try:
+        StatementClient(coord.url, user="m").execute(
+            "select count(*) from nation")
+        body = http_get(f"{coord.url}/v1/metrics",
+                        timeout=30).decode()
+    finally:
+        coord.stop()
+    series = _parse_prometheus(body)
+    assert any(k.startswith("presto_tpu_queries_total") and v > 0
+               for k, v in series.items()), series
+    assert any(k.startswith("presto_tpu_kernel_calls_total")
+               for k in series)
+    assert any(k.startswith("presto_tpu_cache_hits_total")
+               for k in series)
+    assert "# TYPE" in body and "# HELP" in body
+
+
+def test_query_stats_tree_and_trace_endpoint_single_node():
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+    from presto_tpu.server.node import http_get
+    coord = Coordinator([], "tpch", "tiny", single_node=True,
+                        properties={"query_trace_enabled": True})
+    coord.start()
+    try:
+        c = StatementClient(coord.url, user="stats")
+        _, rows = c.execute("select count(*) from nation")
+        assert rows == [[25]]
+        qrows = json.loads(http_get(f"{coord.url}/v1/query",
+                                    timeout=30))
+        qid = next(r["id"] for r in qrows if r["user"] == "stats")
+        detail = json.loads(http_get(
+            f"{coord.url}/v1/query/{qid}", timeout=30))
+        stats = detail["stats"]
+        for key in ("wall_ms", "queued_ms", "compile_ms",
+                    "execute_ms", "rows_out", "tasks"):
+            assert key in stats, key
+        assert stats["rows_out"] == 1
+        assert stats["wall_ms"] >= stats["queued_ms"]
+        assert stats["tasks"][0]["pipelines"]
+        assert "totals" in stats["tasks"][0]
+        trace_doc = json.loads(http_get(
+            f"{coord.url}/v1/query/{qid}/trace", timeout=30))
+        assert trace_doc["traceEvents"]
+        assert any(e["name"] == "query"
+                   for e in trace_doc["traceEvents"])
+    finally:
+        coord.stop()
+
+
+def test_event_listener_receives_query_stats():
+    """query_completed carries the SAME QueryStats payload that
+    /v1/query/{id} serves (satellite: external sinks, one code
+    path)."""
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+    events = []
+    coord = Coordinator([], "tpch", "tiny", single_node=True)
+    coord.event_listeners.append(events.append)
+    coord.start()
+    try:
+        StatementClient(coord.url, user="sink").execute(
+            "select count(*) from region")
+    finally:
+        coord.stop()
+    done = next(e for e in events if e["event"] == "query_completed"
+                and e.get("user") == "sink")
+    stats = done["stats"]
+    assert stats["state"] == "FINISHED"
+    assert stats["rows_out"] == 1
+    assert stats["wall_ms"] > 0
+    assert "compile_ms" in stats and "tasks" in stats
+
+
+# ------------------------------------------------- disabled telemetry
+
+
+def test_disabled_telemetry_byte_identical_and_cheap(runner):
+    """With kernel timing AND tracing off, results are byte-identical
+    to a telemetry-on run, nothing is recorded, and the disabled path
+    is not slower (generous bound — CI wall clocks are noisy)."""
+    from presto_tpu.telemetry import kernels
+
+    def run():
+        t0 = time.perf_counter()
+        rows = runner.execute(JOIN_SQL).rows()
+        return rows, time.perf_counter() - t0
+
+    def median3():
+        samples = [run() for _ in range(3)]
+        samples.sort(key=lambda s: s[1])
+        return samples[0][0], samples[1][1]
+
+    runner.execute(JOIN_SQL)  # warm kernels for both sides
+    rows_on, wall_on = median3()
+    assert kernels.ENABLED
+    kernels.ENABLED = False
+    try:
+        rows_off, wall_off = median3()
+        entry = runner.query_history[-1]
+        assert entry["compile_ms"] == 0 and entry["execute_ms"] == 0
+    finally:
+        kernels.ENABLED = True
+    assert rows_off == rows_on
+    # "<2% overhead" is the design target; asserting it exactly on a
+    # noisy shared CI box flakes, so gate on a 2x envelope instead
+    assert wall_off <= wall_on * 2 + 0.05, (wall_off, wall_on)
+
+
+# ------------------------------------------------------- distributed
+
+
+def test_distributed_explain_analyze(cluster):  # noqa: F811
+    """EXPLAIN ANALYZE over the worker topology: fragment tree + one
+    operator-stats section per task (coordinator + remote workers)
+    with the compile-vs-execute split."""
+    from presto_tpu.server.coordinator import StatementClient
+    _, rows = StatementClient(cluster.url, user="dexp").execute(
+        "explain analyze select n.name, count(*) c from nation n "
+        "join region r on n.regionkey = r.regionkey "
+        "group by n.name order by n.name", timeout=300)
+    text = "\n".join(r[0] for r in rows)
+    assert "Fragment" in text or "fragment" in text
+    assert ".coordinator @" in text
+    # every dispatched worker task reported a stats section
+    assert re.search(r"Task \w+\.\d+\.\d+ @ http", text), text
+    assert "rows:" in text and "busy:" in text
+    assert re.search(r"query wall: [\d.]+ms, compile sum: [\d.]+ms, "
+                     r"execute sum: [\d.]+ms", text), text
+
+
+def test_distributed_query_stats_tree(cluster):  # noqa: F811
+    from presto_tpu.server.coordinator import StatementClient
+    from presto_tpu.server.node import http_get
+    StatementClient(cluster.url, user="dstats").execute(
+        "select count(*) from lineitem", timeout=300)
+    qrows = json.loads(http_get(f"{cluster.url}/v1/query",
+                                timeout=30))
+    qid = next(r["id"] for r in qrows
+               if r["user"] == "dstats" and r["state"] == "FINISHED")
+    detail = json.loads(http_get(f"{cluster.url}/v1/query/{qid}",
+                                 timeout=30))
+    stats = detail["stats"]
+    assert stats["wall_ms"] > 0 and stats["rows_out"] == 1
+    tasks = stats["tasks"]
+    # coordinator task + one task per worker for the distributed scan
+    assert any(t["task_id"].endswith(".coordinator") for t in tasks)
+    assert sum(1 for t in tasks
+               if not t["task_id"].endswith(".coordinator")) \
+        == len(cluster.worker_urls)
+    for t in tasks:
+        assert "totals" in t
+
+
+def test_worker_serves_metrics(cluster):  # noqa: F811
+    from presto_tpu.server.node import http_get
+    for url in cluster.worker_urls:
+        body = http_get(f"{url}/v1/metrics", timeout=30).decode()
+        _parse_prometheus(body)  # must parse; content may be sparse
