@@ -154,10 +154,13 @@ def _child_main() -> int:
         queries = {q: queries[q] for q in subset.split(",")
                    if q in queries}
     import jax
+    from presto_tpu.telemetry.metrics import METRICS
     backend = jax.default_backend()
     ok = True
     for name, sql in queries.items():
         try:
+            fam0 = METRICS.by_label(
+                "presto_tpu_kernel_compiles_total", "kernel")
             t0 = time.perf_counter()
             result = runner.execute(sql)  # warmup: compile + first run
             nrows = len(result.rows())    # forces the device fetch
@@ -173,6 +176,12 @@ def _child_main() -> int:
                 times.append(time.perf_counter() - t0)
                 print(f"{name} run: {times[-1]:.3f}s", file=sys.stderr)
             best = min(times)
+            # distinct_compiles per kernel family (cold + warm runs):
+            # the compile-amortization trajectory, tracked per round
+            # like rows/sec (shape bucketing should drive the warm-run
+            # share to zero)
+            distinct = METRICS.delta_by_label(
+                "presto_tpu_kernel_compiles_total", "kernel", fam0)
         except Exception:  # noqa: BLE001 - report, keep going
             ok = False
             traceback.print_exc()
@@ -180,6 +189,7 @@ def _child_main() -> int:
         print(json.dumps({"q": name,
                           "rows_per_sec": round(rows_of[name] / best, 1),
                           "wall_s": round(best, 3),
+                          "distinct_compiles": distinct,
                           "backend": backend}), flush=True)
     return 0 if ok else 1
 
@@ -188,11 +198,17 @@ def _combine(per_query: dict, platform: str) -> dict:
     denom, baseline_label = _load_baseline()
     suite = {}
     speedups = []
+    distinct_compiles = {}
     for name, r in per_query.items():
         sp = r["rows_per_sec"] / denom[name]
         suite[name] = {"rows_per_sec": r["rows_per_sec"],
                        "wall_s": r["wall_s"],
                        "vs_baseline": round(sp, 4)}
+        if r.get("distinct_compiles"):
+            suite[name]["distinct_compiles"] = r["distinct_compiles"]
+            for fam, n in r["distinct_compiles"].items():
+                distinct_compiles[fam] = \
+                    distinct_compiles.get(fam, 0) + n
         speedups.append(sp)
     q1 = per_query.get("q1", {"rows_per_sec": 0.0})
     line = {
@@ -203,6 +219,7 @@ def _combine(per_query: dict, platform: str) -> dict:
         "baseline": baseline_label,
         "platform": platform,
         "suite": suite,
+        "distinct_compiles": distinct_compiles,
     }
     if speedups:
         line["geomean_vs_baseline"] = round(

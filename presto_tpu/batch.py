@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -57,6 +58,83 @@ def bucket_capacity(n: int) -> int:
     while cap < n:
         cap *= 2
     return cap
+
+
+# -- kernel shape bucketing (the compile-wall lever) -------------------
+#
+# Every distinct batch capacity a kernel sees is a fresh XLA trace +
+# compile; splits, scale factors, and intermediate live counts mint
+# capacities freely. When the gate is on, every batch entering an
+# operator kernel is padded up to the coarse `quantized_capacity`
+# ladder (power-of-4, floor 4096) with dead lanes — masked-lane
+# semantics already hold everywhere (selection-vector execution; the
+# build-side invalid-tail clip of ops/join.py is the template), so
+# padded rows are indistinguishable from post-filter dead rows. The
+# whole TPC-H serving mix then compiles against a handful of shapes
+# instead of one per (split x query x scale factor).
+
+#: process default for kernel shape bucketing; per-statement override
+#: rides a thread-local set by the runner from the
+#: `kernel_shape_buckets` session property
+SHAPE_BUCKETS_DEFAULT = True
+_SHAPE_TL = threading.local()
+
+
+def set_shape_buckets(on: Optional[bool]):
+    """Set this thread's bucket gate (None = revert to the process
+    default). Returns the previous override so callers can restore."""
+    prev = getattr(_SHAPE_TL, "on", None)
+    _SHAPE_TL.on = on
+    return prev
+
+
+def shape_buckets_on() -> bool:
+    on = getattr(_SHAPE_TL, "on", None)
+    return SHAPE_BUCKETS_DEFAULT if on is None else bool(on)
+
+
+def kernel_capacity(n: int) -> int:
+    """THE capacity ladder kernel-facing shapes land on when bucketing
+    is enabled (quantized_capacity: power-of-4, floor 4096)."""
+    return quantized_capacity(max(int(n), 1))
+
+
+def operator_capacity(n: int, floor: int = MIN_CAPACITY) -> int:
+    """THE gate-aware capacity choice for operator-built shapes
+    (build tables, sort/window concats, compaction targets): the
+    kernel ladder when bucketing is on, the exact power-of-two bucket
+    (not below `floor`) when off. One definition so the ladder policy
+    can never drift per operator."""
+    if shape_buckets_on():
+        return kernel_capacity(n)
+    return max(floor, bucket_capacity(max(n, 1)))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _pad_batch(batch: "Batch", pad: int) -> "Batch":
+    """Append `pad` dead lanes (mask False, row_valid False, data 0)
+    to every column. One tiny fused kernel per (schema, pad) pair —
+    deliberately NOT instrumented as an engine kernel family: it is
+    shape plumbing, not operator work."""
+    cols = {
+        n: Column(jnp.pad(c.data, (0, pad)), jnp.pad(c.mask, (0, pad)),
+                  c.type, c.dictionary)
+        for n, c in batch.columns.items()
+    }
+    return Batch(cols, jnp.pad(batch.row_valid, (0, pad)))
+
+
+def pad_for_kernel(batch: "Batch") -> "Batch":
+    """Round a batch up to its kernel-capacity bucket (no-op when the
+    gate is off or the capacity is already on the ladder). The pad
+    lanes are dead rows; every operator kernel treats them exactly
+    like filtered-out rows."""
+    if not shape_buckets_on():
+        return batch
+    tgt = kernel_capacity(batch.capacity)
+    if tgt <= batch.capacity:
+        return batch
+    return _pad_batch(batch, tgt - batch.capacity)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -340,7 +418,7 @@ def empty_batch(schema_cols: Sequence[Tuple],
 
 
 @jax.jit
-def _compact(batch: Batch) -> Batch:
+def _compact_jit(batch: Batch) -> Batch:
     from presto_tpu.ops.common import partition_perm
     order = partition_perm(batch.row_valid)
     cols = {
@@ -352,7 +430,7 @@ def _compact(batch: Batch) -> Batch:
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
-def _compact_shrink(batch: Batch, capacity: int) -> Batch:
+def _compact_shrink_jit(batch: Batch, capacity: int) -> Batch:
     """Pack live rows into a SMALLER batch: indices of the first
     `capacity` live rows via bounded nonzero, then a capacity-sized
     gather per column (the caller guarantees live <= capacity)."""
@@ -364,6 +442,15 @@ def _compact_shrink(batch: Batch, capacity: int) -> Batch:
         for n, c in batch.columns.items()
     }
     return Batch(cols, live)
+
+
+# compile-vs-execute attribution for the compaction family (module-
+# level jits previously landed in "execute" via operator busy time)
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+_compact = _instr(_compact_jit, "compact")
+_compact_shrink = _instr(_compact_shrink_jit, "compact",
+                         jits=[_compact_shrink_jit])
 
 
 #: Outputs at or under this capacity skip the deferred count/compact
@@ -405,11 +492,13 @@ def begin_deferred_compact(batch: "Batch", total=None):
 def end_deferred_compact(batch: "Batch", total) -> "Batch":
     """Consume the count started by begin_deferred_compact (normally a
     cache hit, not a fresh roundtrip) and pack the batch down to its
-    live bucket."""
+    live bucket. Under kernel shape bucketing the shrink target sits
+    on the coarse kernel ladder, so downstream operators never re-pad
+    what this just shrank."""
     if total is None:
         return batch
     n = int(np.asarray(total))
-    cap = max(COMPACT_MIN, bucket_capacity(max(n, 1)))
+    cap = operator_capacity(n, floor=COMPACT_MIN)
     if cap < batch.capacity:
         return batch.compact(cap, known_valid=n)
     return batch

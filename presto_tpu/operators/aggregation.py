@@ -49,8 +49,13 @@ class AggSpec:
 # AggFunction instances are frozen dataclasses -> hashable static
 # args; the factories are lru_cached so the same spec hits the jit
 # cache across queries.
-#: log-depth tree merge of buffered per-batch partials (sort path)
+#: log-depth tree merge of buffered per-batch partials (sort path),
+#: instrumented as its own kernel family (previously its compile time
+#: landed in busy as "execute" — the attribution gap flagged in
+#: CHANGES.md after the telemetry PR)
 _jit_merge = jax.jit(hashagg.merge_partials, static_argnums=(1, 2))
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+_merge_instr = _instr(_jit_merge, "hashagg_merge")
 
 
 def merge_states(states, aggs, out_cap: int):
@@ -59,7 +64,7 @@ def merge_states(states, aggs, out_cap: int):
     merge flushes the driver's async overlap, costing more than the
     in-jit sort saves — the split only pays at operator points that
     already sync, like the join build's finish().)"""
-    return _jit_merge(tuple(states), aggs, out_cap)
+    return _merge_instr(tuple(states), aggs, out_cap)
 #: buffered partials per merge round: each merge sorts FANIN x P rows,
 #: so the per-input-row sort cost stays ~(1 + 1/FANIN + ...) ~ 1.15x
 _MERGE_FANIN = 8
@@ -307,7 +312,12 @@ class AggregationOperator(Operator):
         return not self._finishing
 
     def add_input(self, batch: Batch) -> None:
+        from presto_tpu.batch import pad_for_kernel
         self._count_in(batch)
+        # kernel shape bucketing: the step kernel keys its jit cache on
+        # the batch CAPACITY — padding to the coarse ladder makes every
+        # split/scale-factor variant of this query hit one trace
+        batch = pad_for_kernel(batch)
         # ONE dispatch per batch: expression eval + grouping are fused,
         # and no per-batch overflow sync — the flag accumulates on
         # device and is checked ONCE at get_output. A blocking
@@ -343,9 +353,12 @@ class AggregationOperator(Operator):
     def _live_cap(self, lives: int) -> int:
         """Capacity for a merge of states with `lives` total live
         groups: distinct(union) <= sum of live counts, so this can only
-        flag overflow when max_groups truly overflows."""
-        return min(self._cap, max(_SHRINK_FLOOR,
-                                  bucket_capacity(max(lives, 1))))
+        flag overflow when max_groups truly overflows. Under kernel
+        shape bucketing the target sits on the coarse ladder so merge
+        and finalize shapes stay within a handful of specializations."""
+        from presto_tpu.batch import operator_capacity
+        return min(self._cap,
+                   operator_capacity(lives, floor=_SHRINK_FLOOR))
 
     def _enqueue(self, st) -> None:
         from presto_tpu.batch import start_async_copy
@@ -549,8 +562,8 @@ class AggregationOperator(Operator):
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
-def _stream_step(carry: "hashagg.GroupByState",
-                 partial: "hashagg.GroupByState", aggs):
+def _stream_step_jit(carry: "hashagg.GroupByState",
+                     partial: "hashagg.GroupByState", aggs):
     """One streaming-aggregation round, all arithmetic — NO re-grouping
     sort (the round-4 formulation merged carry+partial through the full
     sort-based merge_partials: a second 1M-row variadic sort per batch).
@@ -616,6 +629,10 @@ def _stream_step(carry: "hashagg.GroupByState",
     return carry_emit, emit, carry_out, last
 
 
+#: streaming boundary-fold, attributed like the other agg kernels
+_stream_step = _instr(_stream_step_jit, "agg_stream")
+
+
 class StreamingAggregationOperator(Operator):
     """Aggregation over an input ALREADY SORTED by the group keys
     (ascending, nulls last — the canonical packing order of the
@@ -658,8 +675,9 @@ class StreamingAggregationOperator(Operator):
             None, names, aggs)
 
     def add_input(self, batch: Batch) -> None:
-        from presto_tpu.batch import start_async_copy
+        from presto_tpu.batch import pad_for_kernel, start_async_copy
         self._count_in(batch)
+        batch = pad_for_kernel(batch)
         aggs = tuple(s.function for s in self.specs)
         c0 = bucket_capacity(batch.capacity)
         partial = self._kernel(c0, batch)

@@ -225,9 +225,13 @@ class FilterProjectOperator(Operator):
             and not self._finishing
 
     def add_input(self, batch: Batch) -> None:
-        from presto_tpu.batch import begin_deferred_compact
+        from presto_tpu.batch import begin_deferred_compact, \
+            pad_for_kernel
         self._count_in(batch)
-        out = self._kernel(batch)
+        # kernel shape bucketing: the fused expression kernel's jit
+        # cache keys on the batch capacity — pad to the coarse ladder
+        # so every split size of every scale factor reuses one trace
+        out = self._kernel(pad_for_kernel(batch))
         if self._selective:
             self._pending.append(begin_deferred_compact(out))
         else:
@@ -297,6 +301,8 @@ class LimitOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        # n rides as a TRACED operand (like _emitted): LIMIT 10 and
+        # LIMIT 500 share one compiled kernel per batch shape
         out = sort_ops.limit_batch(batch, self._n, self._emitted)
         self._emitted = self._emitted + jnp.sum(out.row_valid)
         self._flag = self._emitted >= self._n

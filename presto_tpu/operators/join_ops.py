@@ -17,7 +17,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from presto_tpu.batch import Batch, Column, bucket_capacity, remap_column
+from presto_tpu.batch import (
+    Batch, Column, bucket_capacity, operator_capacity, pad_for_kernel,
+    remap_column,
+)
 from presto_tpu.operators.base import (
     DriverContext, Operator, OperatorContext, OperatorFactory,
 )
@@ -160,6 +163,9 @@ class HashBuildOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        # bucket build inputs too: the dynamic-filter bounds fold and
+        # the finish-time concat both key jit caches on batch shapes
+        batch = pad_for_kernel(batch)
         batch = _remap_keys(batch, self.key_names, self.key_dicts)
         for key, df_id, _reg in self._df_publish:
             from presto_tpu.execution import dynamic_filters as df
@@ -262,7 +268,12 @@ class HashBuildOperator(Operator):
         # one device->host sync for the whole build side (not per batch)
         total = int(np.asarray(self._total)) if self._total is not None \
             else 0
-        cap = bucket_capacity(max(total, 1))
+        # shape bucketing: the probe kernel's jit cache keys on the
+        # BUILD table shape too — landing build capacities on the
+        # coarse ladder lets different tables/scale factors reuse one
+        # compiled probe (padding-clip keeps the dead tail out of
+        # every search span, see ops/join.py)
+        cap = operator_capacity(total)
         if self._batches:
             merged = Batch.concat(self._batches, cap, live_rows=total)
         elif self.schema_cols is not None:
@@ -520,6 +531,9 @@ class LookupJoinOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        # pad BEFORE remap/probe: the probe kernel (and its output
+        # capacity) key on the probe batch shape
+        batch = pad_for_kernel(batch)
         batch = _remap_keys(batch, self.key_names, self.key_dicts)
         if self.bridge.table is not None:
             self._pending.append(self._probe(self.bridge.table, batch))
@@ -649,6 +663,9 @@ class SemiJoinOperator(Operator):
     def add_input(self, batch: Batch) -> None:
         from presto_tpu.batch import begin_deferred_compact
         self._count_in(batch)
+        # pad first so the mark kernel keys on the bucket AND the
+        # filtered output batch shares the padded capacity
+        batch = pad_for_kernel(batch)
         probe = _remap_keys(batch, self.key_names, self.key_dicts)
         found, valid = join_ops.semi_mark(self.bridge.table, probe,
                                           self.key_names, self.build_keys)

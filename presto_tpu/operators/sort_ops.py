@@ -7,7 +7,10 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
-from presto_tpu.batch import Batch, bucket_capacity
+from presto_tpu.batch import (
+    Batch, kernel_capacity, operator_capacity, pad_for_kernel,
+    shape_buckets_on,
+)
 from presto_tpu.operators.base import (
     DriverContext, Operator, OperatorContext, OperatorFactory,
 )
@@ -44,9 +47,11 @@ class OrderByOperator(Operator):
             return None
         # one deferred device-side count for ALL batches (a single host
         # sync), so selective queries sort only live rows, not the full
-        # padded scan capacity
+        # padded scan capacity; under shape bucketing the sort capacity
+        # sits on the kernel ladder so one compiled sort serves every
+        # input size in its bucket
         total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
-        merged = Batch.concat(self._batches, bucket_capacity(max(total, 1)),
+        merged = Batch.concat(self._batches, operator_capacity(total),
                               live_rows=total)
         self._batches = []
         out = sort_kernels.sort_batch(merged, self.key_names,
@@ -120,8 +125,11 @@ class TopNOperator(Operator):
         self.key_names = key_names
         self.descending = descending
         self.nulls_first = nulls_first
-        cap = bucket_capacity(max(n, 1))
-        self._state = sort_kernels.distinct_state(schema_cols, cap)
+        # state capacity depends on n only through its BUCKET: with
+        # shape bucketing on, every top-k constant under 4096 shares
+        # one state shape (and n itself rides as a traced operand)
+        self._state = sort_kernels.distinct_state(
+            schema_cols, operator_capacity(n))
         self._finishing = False
         self._emitted = False
 
@@ -131,8 +139,8 @@ class TopNOperator(Operator):
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
         self._state = sort_kernels.topn_step(
-            self._state, batch, self.n, self.key_names, self.descending,
-            self.nulls_first)
+            self._state, pad_for_kernel(batch), self.n, self.key_names,
+            self.descending, self.nulls_first)
 
     def get_output(self) -> Optional[Batch]:
         if not self._finishing or self._emitted:
@@ -164,16 +172,21 @@ class DistinctOperator(Operator):
 
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
+        batch = pad_for_kernel(batch)
         # grow until the merged distinct set fits with headroom: if the
         # state fills to capacity we cannot tell kept from dropped rows,
         # so re-merge at a larger capacity before accepting the batch
+        # (growth lands on the kernel ladder under shape bucketing)
         while True:
             new_state = sort_kernels.distinct_step(self._state, batch)
             if new_state.num_valid() < new_state.capacity:
                 self._state = new_state
                 return
+            grown = self._state.capacity * 2
+            if shape_buckets_on():
+                grown = kernel_capacity(grown)
             bigger = sort_kernels.distinct_state(
-                self._schema_cols, self._state.capacity * 2)
+                self._schema_cols, grown)
             self._state = sort_kernels.distinct_step(bigger, self._state)
 
     def get_output(self) -> Optional[Batch]:

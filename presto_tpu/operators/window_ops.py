@@ -9,7 +9,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
-from presto_tpu.batch import Batch, bucket_capacity
+from presto_tpu.batch import Batch, operator_capacity
 from presto_tpu.operators.base import (
     DriverContext, Operator, OperatorContext, OperatorFactory,
 )
@@ -48,8 +48,7 @@ class WindowOperator(Operator):
         if not self._batches:
             return None
         total = int(sum(jnp.sum(b.row_valid) for b in self._batches))
-        merged = Batch.concat(self._batches,
-                              bucket_capacity(max(total, 1)),
+        merged = Batch.concat(self._batches, operator_capacity(total),
                               live_rows=total)
         self._batches = []
         out = window_kernel(merged, self.part_names, self.order_names,

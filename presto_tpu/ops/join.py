@@ -135,6 +135,22 @@ def choose_radix_bits(capacity: int) -> int:
                       MAX_RADIX_BITS))
 
 
+def _bucket_depth(depth: int) -> int:
+    """Round the measured bounded-search depth up to a power of two
+    when kernel shape bucketing is on: the depth is a STATIC arg of
+    every probe kernel, and the exact data-measured value would mint a
+    fresh trace per build-side skew profile. A rounded depth costs at
+    most 2x search levels (each a cheap gather round) and collapses
+    the trace count to ~6 variants."""
+    from presto_tpu.batch import shape_buckets_on
+    if not shape_buckets_on():
+        return depth
+    p = 1
+    while p < depth:
+        p *= 2
+    return p
+
+
 @functools.lru_cache(maxsize=None)
 def _partition_bounds_np(k: int) -> np.ndarray:
     """The 2^k signed-int64 bucket boundary values (bucket p = top-k
@@ -252,7 +268,8 @@ def build_for_backend(batch: Batch, key_names: Tuple[str, ...],
         max_span, max_run = (int(x) for x in np.asarray(spans))
         return BuildTable(sh, h2, part_starts, run_len, vc, sbatch,
                           radix_bits=k,
-                          search_depth=common.search_iters(max_span),
+                          search_depth=_bucket_depth(
+                              common.search_iters(max_span)),
                           unique_runs=max_run <= 1)
     h, h2 = _build_hash(batch, key_names)
     hn = np.asarray(h)
@@ -295,7 +312,8 @@ def build_for_backend(batch: Batch, key_names: Tuple[str, ...],
     return BuildTable(sh, sh2, jnp.asarray(part_starts),
                       jnp.asarray(run_len), vc, sbatch,
                       radix_bits=k,
-                      search_depth=common.search_iters(max_span),
+                      search_depth=_bucket_depth(
+                          common.search_iters(max_span)),
                       unique_runs=max_run <= 1)
 
 

@@ -94,9 +94,9 @@ def _lex_count_below(b_ops: List[jnp.ndarray],
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
-def merge_pair(a: Batch, b: Batch, key_names: Tuple[str, ...],
-               descending: Tuple[bool, ...],
-               nulls_first: Tuple[bool, ...]) -> Batch:
+def _merge_pair_jit(a: Batch, b: Batch, key_names: Tuple[str, ...],
+                    descending: Tuple[bool, ...],
+                    nulls_first: Tuple[bool, ...]) -> Batch:
     """Merge two lex-sorted batches into one sorted batch of capacity
     |A|+|B| (invalid rows sort to the end in both, so they land at the
     end of the output too)."""
@@ -119,6 +119,12 @@ def merge_pair(a: Batch, b: Batch, key_names: Tuple[str, ...],
     rv = jnp.zeros((out_cap,), bool)
     rv = rv.at[pos_a].set(a.row_valid).at[pos_b].set(b.row_valid)
     return Batch(cols, rv)
+
+
+# compile-vs-execute attribution for the sorted-run merge family
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+merge_pair = _instr(_merge_pair_jit, "merge")
 
 
 def merge_runs(runs: Sequence[Batch], key_names: Sequence[str],

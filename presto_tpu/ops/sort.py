@@ -19,10 +19,9 @@ from presto_tpu.batch import Batch, Column
 from presto_tpu.ops import common
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def sort_batch(batch: Batch, key_names: Tuple[str, ...],
-               descending: Tuple[bool, ...],
-               nulls_first: Tuple[bool, ...]) -> Batch:
+def _sort_batch_impl(batch: Batch, key_names: Tuple[str, ...],
+                     descending: Tuple[bool, ...],
+                     nulls_first: Tuple[bool, ...]) -> Batch:
     """Reorder rows into key order, invalid rows compacted to the end.
 
     ONE variadic sort HLO carries every column (data + mask) through
@@ -47,14 +46,22 @@ def sort_batch(batch: Batch, key_names: Tuple[str, ...],
     return Batch({n: cols[n] for n in batch.names}, svalid)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
-def topn_step(state: Batch, batch: Batch, n: int,
-              key_names: Tuple[str, ...], descending: Tuple[bool, ...],
-              nulls_first: Tuple[bool, ...]) -> Batch:
+#: the jit (internal callers compose the impl inside their own traces)
+_sort_batch = functools.partial(
+    jax.jit, static_argnums=(1, 2, 3))(_sort_batch_impl)
+
+
+def _topn_step_impl(state: Batch, batch: Batch, n,
+                    key_names: Tuple[str, ...],
+                    descending: Tuple[bool, ...],
+                    nulls_first: Tuple[bool, ...]) -> Batch:
     """Fold step: keep the N smallest (per ordering) of state ++ batch.
 
-    `state` has capacity >= n; output reuses that capacity.
-    """
+    `state` has capacity >= n; output reuses that capacity. `n` is a
+    TRACED operand (not a static arg): every distinct top-k constant
+    used to mint a fresh trace — now LIMIT 10 and LIMIT 50 share one
+    compiled kernel per shape (the state capacity, which does depend
+    on n, stays a shape)."""
     cap = state.capacity
     merged_cols = {}
     for name, sc in state.columns.items():
@@ -64,7 +71,7 @@ def topn_step(state: Batch, batch: Batch, n: int,
             jnp.concatenate([sc.mask, bc.mask]), sc.type, sc.dictionary)
     merged = Batch(merged_cols,
                    jnp.concatenate([state.row_valid, batch.row_valid]))
-    s = sort_batch(merged, key_names, descending, nulls_first)
+    s = _sort_batch_impl(merged, key_names, descending, nulls_first)
     keep = jnp.arange(merged.capacity) < n
     live = s.row_valid & keep
     cols = {n_: Column(c.data[:cap], c.mask[:cap] & live[:cap], c.type,
@@ -73,14 +80,20 @@ def topn_step(state: Batch, batch: Batch, n: int,
     return Batch(cols, live[:cap])
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def limit_batch(batch: Batch, n: int, already_emitted) -> Batch:
+_topn_step = functools.partial(
+    jax.jit, static_argnums=(3, 4, 5))(_topn_step_impl)
+
+
+def _limit_batch_impl(batch: Batch, n, already_emitted) -> Batch:
     """Keep the first (n - already_emitted) live rows of this batch.
-    `already_emitted` is a traced scalar so per-batch progress never
-    triggers a recompile."""
+    Both `n` and `already_emitted` are traced scalars so neither the
+    LIMIT constant nor per-batch progress triggers a recompile."""
     rank = jnp.cumsum(batch.row_valid) - 1  # rank among live rows
     keep = batch.row_valid & (rank < (n - already_emitted))
     return Batch(batch.columns, keep)
+
+
+_limit_batch = jax.jit(_limit_batch_impl)
 
 
 def distinct_state(schema_cols, capacity: int) -> Batch:
@@ -91,7 +104,7 @@ def distinct_state(schema_cols, capacity: int) -> Batch:
 
 
 @jax.jit
-def distinct_step(state: Batch, batch: Batch) -> Batch:
+def _distinct_step_jit(state: Batch, batch: Batch) -> Batch:
     """Fold step for SELECT DISTINCT / set-union dedup: re-group
     state ++ batch by all columns, keep one representative per group
     (hashagg._group_reduce with zero aggregates — one variadic sort,
@@ -113,3 +126,17 @@ def distinct_step(state: Batch, batch: Batch) -> Batch:
         sc = merged_cols[name]
         cols[name] = Column(d, m, sc.type, sc.dictionary)
     return Batch(cols, gr.valid)
+
+
+# -- instrumented public entry points ---------------------------------
+#
+# Operators call these; compile-vs-execute attribution (and the
+# retrace counter) ride the wrapper exactly like the three engine
+# kernel-cache families — closing the "module-level jits land in
+# execute" gap flagged after the telemetry PR.
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+sort_batch = _instr(_sort_batch, "sort")
+topn_step = _instr(_topn_step, "topn")
+limit_batch = _instr(_limit_batch, "limit")
+distinct_step = _instr(_distinct_step_jit, "distinct")

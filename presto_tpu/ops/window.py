@@ -161,7 +161,7 @@ def _segment_positions(bnd: jnp.ndarray) -> jnp.ndarray:
     jax.jit,
     static_argnames=("part_names", "order_names", "descending",
                      "nulls_first", "calls"))
-def window_kernel(batch: Batch,
+def _window_kernel_jit(batch: Batch,
                   part_names: Tuple[str, ...],
                   order_names: Tuple[str, ...],
                   descending: Tuple[bool, ...],
@@ -458,6 +458,13 @@ def window_kernel(batch: Batch,
         cols[n] = Column(unsorted[2 * i], unsorted[2 * i + 1],
                          c.out_type, dic)
     return Batch(cols, valid)
+
+
+# compile-vs-execute attribution for the window family (previously an
+# uninstrumented module-level jit whose compile time landed in busy)
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+window_kernel = _instr(_window_kernel_jit, "window")
 
 
 def _minmax_ident(fn: str, dtype):

@@ -327,7 +327,17 @@ class MaterializedResult:
 class LocalRunner:
     def __init__(self, catalog: str = "tpch", schema: str = "tiny",
                  properties: Optional[Dict[str, Any]] = None,
-                 user: str = "", access_control=None):
+                 user: str = "", access_control=None,
+                 compilation_cache_dir: Optional[str] = None):
+        # persistent XLA compilation cache: explicit arg wins, else
+        # the PRESTO_TPU_COMPILATION_CACHE_DIR env surface (both
+        # process-global — jax holds one cache dir)
+        from presto_tpu.execution import compile_cache
+        if compilation_cache_dir is not None:
+            compile_cache.configure_compilation_cache(
+                compilation_cache_dir)
+        else:
+            compile_cache.configure_from_env()
         from presto_tpu.connectors.memory import (
             BlackholeConnector, MemoryConnector,
         )
@@ -394,6 +404,15 @@ class LocalRunner:
 
     def register_connector(self, name: str, connector: Connector):
         self.catalogs.register(name, connector)
+
+    def prewarm(self, statements, user: str = "prewarm") -> Dict:
+        """AOT-compile the kernels `statements` need (see
+        execution/compile_cache.prewarm): with a persistent
+        compilation cache configured, a restarted process re-traces
+        against disk-cached executables in ~ms each, so serving
+        traffic after prewarm performs zero fresh compiles."""
+        from presto_tpu.execution import compile_cache
+        return compile_cache.prewarm(self, statements, user=user)
 
     # ------------------------------------------------------------------
 
@@ -559,6 +578,14 @@ class LocalRunner:
         prev = getattr(self._session_tl, "lifecycle", None)
         self._session_tl.lifecycle = (cancel, deadline)
         self._session_tl.op_stats = None  # this statement's snapshots
+        # kernel shape bucketing rides a thread-local gate (operators
+        # have no session access): honored by every drive loop this
+        # statement runs on THIS thread — remote tasks use the process
+        # default
+        from presto_tpu import batch as _batch
+        prev_sb = _batch.set_shape_buckets(
+            bool(get_property(self.session.properties,
+                              "kernel_shape_buckets")))
         t0 = _time.perf_counter()
         t0_ns = _time.perf_counter_ns()
         try:
@@ -589,6 +616,7 @@ class LocalRunner:
             raise
         finally:
             self._session_tl.lifecycle = prev
+            _batch.set_shape_buckets(prev_sb)
             counters = _tk.end_query(prev_q)
             if recorder is not None:
                 recorder.add("query", "query", t0_ns,

@@ -14,6 +14,7 @@ queued/executing client protocol.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -164,11 +165,30 @@ class Coordinator(Node):
                  max_concurrent_queries: int = 4,
                  max_queued_queries: int = 100,
                  resource_groups=None, selectors=None,
-                 access_control=None, single_node: bool = False):
+                 access_control=None, single_node: bool = False,
+                 prewarm_sql: Optional[List[str]] = None,
+                 compilation_cache_dir: Optional[str] = None):
+        from presto_tpu.execution import compile_cache
         from presto_tpu.execution.resource_groups import (
             GroupSpec, ResourceGroupManager,
         )
         super().__init__(host, port)
+        # compile-amortization config (docs/COMPILATION.md): a
+        # persistent XLA cache dir (arg > env > unset) and an optional
+        # warmup statement list replayed at start() BEFORE the server
+        # takes traffic, so restart-warm serving compiles nothing
+        if compilation_cache_dir is not None:
+            compile_cache.configure_compilation_cache(
+                compilation_cache_dir)
+        else:
+            compile_cache.configure_from_env()
+        if prewarm_sql is None:
+            prewarm_sql = compile_cache.parse_prewarm_sql(
+                os.environ.get(compile_cache.ENV_PREWARM_SQL))
+        self.prewarm_sql = list(prewarm_sql or [])
+        #: prewarm(...) report from the last start(), for /v1/info
+        #: consumers and the serving bench
+        self.prewarm_report: Optional[dict] = None
         self.worker_urls = list(worker_urls)
         #: single-node serving mode: no workers — every query runs on
         #: ONE shared in-process LocalRunner behind the same HTTP
@@ -208,6 +228,28 @@ class Coordinator(Node):
                                         daemon=True)
 
     def start(self) -> None:
+        # AOT prewarm completes BEFORE the HTTP thread serves (the
+        # whole point: the first client query after a restart finds
+        # warm kernels, never races the warmup for the shared
+        # runner). Single-node topology only for now — distributed
+        # prewarm would have to replay on every WORKER's kernel
+        # caches, which this coordinator cannot reach; configured-but-
+        # skipped is reported loudly, never swallowed
+        if self.prewarm_sql:
+            if self.single_node:
+                from presto_tpu.execution import compile_cache
+                self.prewarm_report = compile_cache.prewarm(
+                    self._runner(), self.prewarm_sql)
+            else:
+                import sys
+                from presto_tpu.telemetry.metrics import METRICS
+                METRICS.inc("presto_tpu_prewarm_statements_total",
+                            value=len(self.prewarm_sql),
+                            status="skipped_multi_node")
+                print("presto_tpu: prewarm_sql configured but this "
+                      "coordinator has workers — distributed prewarm "
+                      "is not implemented; workers start cold",
+                      file=sys.stderr)
         super().start()
         self._pruner.start()
 

@@ -172,6 +172,15 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "tools/trace_viewer.py. Off = zero recording overhead "
         "(telemetry/trace.py)"),
     PropertyDef(
+        "kernel_shape_buckets", "boolean", True,
+        "Pad every batch entering an operator kernel up to the coarse "
+        "power-of-four capacity ladder (floor 4096) so splits, scale "
+        "factors, and LIMIT constants reuse one compiled XLA kernel "
+        "per bucket instead of minting a trace per exact shape; "
+        "results are byte-identical (dead pad lanes = filtered rows). "
+        "Off = exact power-of-two shapes, the pre-bucketing behavior "
+        "(docs/COMPILATION.md)"),
+    PropertyDef(
         "cache_memory_bytes", "bigint", 4 << 30,
         "Shared byte budget of the fragment-result + page-source "
         "caches, charged to the cache manager's tagged MemoryPool; "

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Dict, Optional
 
 from presto_tpu.telemetry.metrics import METRICS
@@ -52,6 +53,22 @@ from presto_tpu.telemetry import trace as _trace
 ENABLED = True
 
 _TL = threading.local()
+
+#: live instrumented wrappers, for reset_retrace_state (weak: kernels
+#: evicted from the engine LRUs must stay collectable)
+_WRAPPERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def reset_retrace_state() -> None:
+    """Forget which kernels have traced: after a kernel-cache wipe
+    (execution/compile_cache.clear_kernel_caches — the restart
+    simulation) the next compile of each kernel IS a first trace
+    again, and must classify as reason="new_kernel", not "shape"."""
+    for w in list(_WRAPPERS):
+        st = w._retrace_state
+        with st["lock"]:
+            st["traced"] = False
+            st["accounted"] = 0
 
 
 def set_current_op(stats) -> None:
@@ -92,9 +109,14 @@ def _cache_sizes(jits) -> int:
     return total
 
 
-def record(name: str, dur_ns: int, compiled: bool) -> None:
+def record(name: str, dur_ns: int, compiled: bool,
+           reason: Optional[str] = None) -> None:
     """Credit one kernel call to the current operator, the current
-    query, and the process counters."""
+    query, and the process counters. `reason` classifies a compile for
+    the retrace counter: "new_kernel" (this kernel object's FIRST
+    trace — a genuinely new program) vs "shape" (an already-traced
+    kernel re-traced for a new input signature: the bucketing gap the
+    kernel_shape_buckets property exists to close)."""
     op = getattr(_TL, "op", None)
     if op is not None:
         if compiled:
@@ -114,6 +136,13 @@ def record(name: str, dur_ns: int, compiled: bool) -> None:
         METRICS.inc("presto_tpu_kernel_compiles_total", kernel=name)
         METRICS.inc("presto_tpu_kernel_compile_ns_total", dur_ns,
                     kernel=name)
+        # reason None = this growth event was already booked by a
+        # concurrent racer (see instrument_kernel): the compile TIME
+        # still counts (blocking on jax's compile lock is compile
+        # cost) but the retrace counter charges each trace once
+        if reason is not None:
+            METRICS.inc("presto_tpu_kernel_retrace_total",
+                        kernel=name, reason=reason)
     else:
         METRICS.inc("presto_tpu_kernel_execute_ns_total", dur_ns,
                     kernel=name)
@@ -139,6 +168,16 @@ def instrument_kernel(kernel, name: str, jits=None):
     if jits is None:
         jits = [kernel] if hasattr(kernel, "_cache_size") else []
     jits = [j for j in jits if hasattr(j, "_cache_size")]
+    # retrace classification state: once this kernel object has
+    # compiled, any LATER compile is a re-trace for a new input
+    # signature ("shape") — the thing shape bucketing eliminates.
+    # `accounted` is the largest jit-cache size whose growth the
+    # retrace counter has already charged: two threads racing ONE
+    # first trace both observe the cache grow, but only the first to
+    # take the lock books it — the loser passes reason=None (compile
+    # time still recorded, no phantom "shape" retrace)
+    state = {"traced": False, "accounted": 0,
+             "lock": threading.Lock()}
 
     def wrapped(*args, **kwargs):
         if not ENABLED:
@@ -147,8 +186,17 @@ def instrument_kernel(kernel, name: str, jits=None):
         t0 = time.perf_counter_ns()
         out = kernel(*args, **kwargs)
         dur = time.perf_counter_ns() - t0
-        compiled = before >= 0 and _cache_sizes(jits) > before
-        record(name, dur, compiled)
+        after = _cache_sizes(jits)
+        compiled = before >= 0 and after > before
+        reason = None
+        if compiled:
+            with state["lock"]:
+                if after > state["accounted"]:
+                    reason = "shape" if state["traced"] \
+                        else "new_kernel"
+                    state["traced"] = True
+                    state["accounted"] = after
+        record(name, dur, compiled, reason)
         if _trace.ACTIVE:
             rec = _trace.current()
             if rec is not None:
@@ -159,4 +207,6 @@ def instrument_kernel(kernel, name: str, jits=None):
 
     wrapped.__wrapped__ = kernel
     wrapped._kernel_name = name
+    wrapped._retrace_state = state
+    _WRAPPERS.add(wrapped)
     return wrapped

@@ -42,6 +42,28 @@ class MetricsRegistry:
             return sum(v for (n, _), v in self._counters.items()
                        if n == name)
 
+    def by_label(self, name: str, label: str) -> Dict[str, float]:
+        """{label value -> summed count} for one counter family —
+        the bench tools' per-kernel-family delta source."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for (n, labels), v in self._counters.items():
+                if n != name:
+                    continue
+                lv = dict(labels).get(label, "")
+                out[lv] = out.get(lv, 0.0) + v
+        return out
+
+    def delta_by_label(self, name: str, label: str,
+                       before: Dict[str, float]) -> Dict[str, int]:
+        """Positive per-label-value growth since a by_label snapshot
+        — THE `distinct_compiles` shape every bench tool reports
+        (serving_bench phases, kernel_bench entries, bench.py)."""
+        now = self.by_label(name, label)
+        return {k: int(v - before.get(k, 0))
+                for k, v in sorted(now.items())
+                if v - before.get(k, 0) > 0}
+
     def snapshot(self) -> Dict[str, float]:
         """{name{label="v",...}: value} — tests and bench deltas."""
         with self._lock:
@@ -111,6 +133,13 @@ METRICS.describe("presto_tpu_kernel_compile_ns_total",
                  "Wall ns spent in calls that compiled (trace+XLA)")
 METRICS.describe("presto_tpu_kernel_execute_ns_total",
                  "Wall ns spent dispatching already-compiled kernels")
+METRICS.describe("presto_tpu_kernel_retrace_total",
+                 "Kernel compiles by reason: new_kernel = first trace "
+                 "of a program, shape = an existing kernel re-traced "
+                 "for a new input signature (the retrace source "
+                 "kernel_shape_buckets bounds)")
+METRICS.describe("presto_tpu_prewarm_statements_total",
+                 "AOT prewarm statements by status")
 METRICS.describe("presto_tpu_expr_compile_ns_total",
                  "Host ns building expression closures (expr/compile)")
 METRICS.describe("presto_tpu_exchange_pages_total",

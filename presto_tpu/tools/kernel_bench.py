@@ -204,14 +204,24 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import jax
+    from presto_tpu.telemetry.metrics import METRICS
     results = {}
     suite = build_suite(args.rows)
     for name, (fn, blk, nrows) in suite.items():
         try:
+            # distinct_compiles: instrumented-kernel compiles this
+            # bench entry triggered (warmup included) — the compile-
+            # amortization trajectory is tracked per round like
+            # rows_per_sec. 0 = fully served from warm caches.
+            fam0 = METRICS.by_label(
+                "presto_tpu_kernel_compiles_total", "kernel")
             secs = _bench(fn, blk)
+            distinct = METRICS.delta_by_label(
+                "presto_tpu_kernel_compiles_total", "kernel", fam0)
             results[name] = {
                 "ms": round(secs * 1e3, 2),
                 "rows_per_sec": round(nrows / secs, 1),
+                "distinct_compiles": distinct,
             }
             print(f"{name:18s} {secs * 1e3:9.2f} ms  "
                   f"{nrows / secs / 1e6:8.1f}M rows/s", file=sys.stderr)

@@ -22,6 +22,14 @@ Protocol:
           taxonomy alongside QPS, and every query that SUCCEEDS under
           chaos must still be byte-identical to the warm phase —
           faults may cost availability, never correctness.
+  restart-warm — (--restart-warm) the process-restart story: kernel
+          LRUs + jax jit caches wiped (everything a coordinator
+          reboot loses), caches cleared, then a NEW coordinator comes
+          up with the mix as its AOT prewarm list against the
+          persistent XLA compilation cache populated by the earlier
+          phases. The measured phase must perform ZERO fresh compiles
+          (fresh_compiles, from the attribution counters) and land
+          within ~1.2x of warm QPS.
 
 Every phase checksums each query's result rows; the run fails loudly
 if warm results are not byte-identical to cold and to caches-off (or
@@ -124,10 +132,14 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
 
     # per-phase XLA attribution: the process-wide kernel counters are
     # monotonic and phases run sequentially, so before/after deltas
-    # are exactly this phase's compile-vs-execute split
+    # are exactly this phase's compile-vs-execute split — including
+    # DISTINCT COMPILES PER KERNEL FAMILY, the compile-amortization
+    # trajectory metric (a phase that re-uses every kernel shows {})
     from presto_tpu.telemetry.metrics import METRICS
     compile0 = METRICS.total("presto_tpu_kernel_compile_ns_total")
     execute0 = METRICS.total("presto_tpu_kernel_execute_ns_total")
+    fam0 = METRICS.by_label("presto_tpu_kernel_compiles_total",
+                            "kernel")
     threads = [threading.Thread(target=client, args=(i, work))
                for i, work in enumerate(assignments)]
     for t in threads:
@@ -140,6 +152,8 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
     if errors and not tolerant:
         raise RuntimeError("serving bench query failed: "
                            + "; ".join(errors))
+    distinct = METRICS.delta_by_label(
+        "presto_tpu_kernel_compiles_total", "kernel", fam0)
     n = len(latencies)
     stats = {
         "queries": n,
@@ -156,6 +170,8 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
         "kernel_execute_ms": round(
             (METRICS.total("presto_tpu_kernel_execute_ns_total")
              - execute0) / 1e6, 1),
+        "distinct_compiles": distinct,
+        "fresh_compiles": int(sum(distinct.values())),
     }
     if tolerant:
         total = n + len(errors)
@@ -185,11 +201,49 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       chaos: bool = False,
                       chaos_rounds: int = 2,
                       chaos_spec: str = DEFAULT_CHAOS_SPEC,
+                      restart_warm: bool = False,
+                      cache_dir: Optional[str] = None,
                       host: str = "127.0.0.1") -> dict:
+    """Thin wrapper owning the auto-created compilation-cache dir:
+    a --restart-warm run without --cache-dir gets a tmpdir that is
+    removed (and unconfigured) when the bench finishes, success or
+    not — repeated CI runs must not accumulate populated XLA caches
+    under /tmp."""
+    auto_cache_dir = None
+    if restart_warm and cache_dir is None:
+        import tempfile
+        cache_dir = auto_cache_dir = tempfile.mkdtemp(
+            prefix="presto_tpu_xla_cache_")
+    try:
+        return _serving_bench(
+            clients=clients, schema=schema, mix=mix,
+            warm_rounds=warm_rounds, verify_off=verify_off,
+            chaos=chaos, chaos_rounds=chaos_rounds,
+            chaos_spec=chaos_spec, restart_warm=restart_warm,
+            cache_dir=cache_dir, host=host)
+    finally:
+        if auto_cache_dir is not None:
+            import shutil
+            from presto_tpu.execution import compile_cache
+            compile_cache.configure_compilation_cache(None)
+            shutil.rmtree(auto_cache_dir, ignore_errors=True)
+
+
+def _serving_bench(clients: int, schema: str, mix: Sequence[str],
+                   warm_rounds: int, verify_off: bool, chaos: bool,
+                   chaos_rounds: int, chaos_spec: str,
+                   restart_warm: bool, cache_dir: Optional[str],
+                   host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
+    from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
     sqls = _load_mix(mix)
     work = list(sqls.items())
+
+    if cache_dir:
+        # the cold/warm phases populate this persistent cache; the
+        # restart-warm phase re-traces against it after the wipe
+        compile_cache.configure_compilation_cache(cache_dir)
 
     mgr = get_cache_manager()
     mgr.clear()
@@ -271,6 +325,46 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             off_coord.stop()
         identical = identical and _consistent(cold_checks, off_checks)
 
+    restart = None
+    if restart_warm:
+        # simulate a coordinator process restart: every in-process
+        # compiled-kernel layer is wiped (engine LRUs + jax jit
+        # caches) along with the serving caches — the ONLY warm thing
+        # left is the persistent XLA cache on disk. The new
+        # coordinator AOT-prewarms the mix at start(), so the measured
+        # phase must perform zero fresh compiles.
+        mgr.clear()
+        compile_cache.clear_kernel_caches()
+        coord2 = Coordinator(
+            [], "tpch", schema, host=host, port=0,
+            max_concurrent_queries=clients, single_node=True,
+            prewarm_sql=[sql for _, sql in work])
+        t0 = time.perf_counter()
+        coord2.start()  # blocks through the prewarm pass
+        startup_s = time.perf_counter() - t0
+        try:
+            rw_assign = [list(work) * warm_rounds
+                         for _ in range(clients)]
+            rw, rw_checks = _run_phase(coord2.url, rw_assign)
+        finally:
+            coord2.stop()
+        identical = identical and _consistent(warm_checks, rw_checks)
+        restart = {
+            **rw,
+            "startup_s": round(startup_s, 3),
+            "prewarm": coord2.prewarm_report,
+            "qps_vs_warm": round(rw["qps"] / warm["qps"], 3)
+            if warm.get("qps") else None,
+            "compilation_cache_dir": cache_dir,
+        }
+        if rw["fresh_compiles"] != 0:
+            # the restart-warm CONTRACT: prewarm + the persistent
+            # cache absorb every re-trace before traffic — a compile
+            # in the measured phase means a shape escaped the ladder
+            raise RuntimeError(
+                "restart-warm phase performed fresh compiles: "
+                + json.dumps(restart["distinct_compiles"]))
+
     cache_stats = {name: level.stats.snapshot() for name, level in
                    (("plan", mgr.plan), ("fragment", mgr.fragment),
                     ("page", mgr.page))}
@@ -290,6 +384,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
         "cold": cold,
         "warm": warm,
         "caches_off": off,
+        "restart_warm": restart,
         "results_identical": identical,
         "cache": cache_stats,
         "chaos": chaos_doc,
@@ -321,6 +416,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--chaos-rounds", type=int, default=2)
     p.add_argument("--chaos-spec", default=DEFAULT_CHAOS_SPEC,
                    help="fault spec (site:trigger[:arg][:seed];...)")
+    p.add_argument("--restart-warm", action="store_true",
+                   help="wipe every in-process kernel cache, rebuild "
+                        "the coordinator with AOT prewarm against the "
+                        "persistent XLA cache, and measure the "
+                        "restart-warm phase (must show zero fresh "
+                        "compiles)")
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(default: a fresh tmpdir when --restart-warm)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
     doc = run_serving_bench(
@@ -328,7 +432,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         mix=[m.strip() for m in args.mix.split(",") if m.strip()],
         warm_rounds=args.warm_rounds, verify_off=not args.skip_off,
         chaos=args.chaos, chaos_rounds=args.chaos_rounds,
-        chaos_spec=args.chaos_spec)
+        chaos_spec=args.chaos_spec, restart_warm=args.restart_warm,
+        cache_dir=args.cache_dir)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

@@ -56,6 +56,34 @@ def test_serving_bench_chaos_phase():
     assert sum(chaos["errors"].values()) == chaos["failed"]
 
 
+def test_serving_bench_restart_warm_phase(tmp_path):
+    """--restart-warm: after the kernel-cache wipe (the process-
+    restart simulation) the rebuilt coordinator AOT-prewarms the mix
+    against the persistent XLA cache, and the measured phase performs
+    ZERO fresh compiles with byte-identical answers."""
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.tools.serving_bench import run_serving_bench
+    reset_cache_manager()
+    doc = run_serving_bench(
+        clients=2, schema="tiny", mix=("q6",), warm_rounds=1,
+        verify_off=False, restart_warm=True,
+        cache_dir=str(tmp_path / "xla_cache"))
+    rw = doc["restart_warm"]
+    for key in ("qps", "startup_s", "prewarm", "fresh_compiles",
+                "distinct_compiles", "qps_vs_warm"):
+        assert key in rw, key
+    # the prewarm pass re-traced the wiped kernels (so it compiled);
+    # the measured phase then compiled NOTHING
+    assert rw["prewarm"]["statements"] == 1
+    assert rw["prewarm"]["failed"] == []
+    assert rw["fresh_compiles"] == 0, rw["distinct_compiles"]
+    assert doc["results_identical"] is True
+    # the persistent cache really persisted executables to disk
+    import os
+    assert len(os.listdir(tmp_path / "xla_cache")) > 0
+    reset_cache_manager()
+
+
 @pytest.mark.slow
 def test_serving_bench_full_capture_shape():
     """The committed-capture configuration end to end (small scale)."""
