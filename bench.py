@@ -165,6 +165,14 @@ def _child_main() -> int:
             result = runner.execute(sql)  # warmup: compile + first run
             nrows = len(result.rows())    # forces the device fetch
             cold = time.perf_counter() - t0
+            # whole-fragment fusion coverage of this query (planner
+            # pass report; chains fused vs fallen back — see
+            # tools/fusion_report.py for the per-fragment detail,
+            # embedded wholesale under --fusion-report)
+            fr = getattr(result, "fusion_report", None) or {}
+            fused_fragments = fr.get("fused", 0)
+            fusion_detail = fr if os.environ.get(
+                "PRESTO_TPU_BENCH_FUSION") else None
             print(f"{name} cold (compile + datagen + transfer): "
                   f"{cold:.3f}s, {nrows} result rows", file=sys.stderr)
             # adaptive: a slow (CPU-fallback/contended) query gets one
@@ -186,11 +194,15 @@ def _child_main() -> int:
             ok = False
             traceback.print_exc()
             continue
-        print(json.dumps({"q": name,
-                          "rows_per_sec": round(rows_of[name] / best, 1),
-                          "wall_s": round(best, 3),
-                          "distinct_compiles": distinct,
-                          "backend": backend}), flush=True)
+        line = {"q": name,
+                "rows_per_sec": round(rows_of[name] / best, 1),
+                "wall_s": round(best, 3),
+                "distinct_compiles": distinct,
+                "fused_fragments": fused_fragments,
+                "backend": backend}
+        if fusion_detail is not None:
+            line["fusion"] = fusion_detail
+        print(json.dumps(line), flush=True)
     return 0 if ok else 1
 
 
@@ -209,6 +221,10 @@ def _combine(per_query: dict, platform: str) -> dict:
             for fam, n in r["distinct_compiles"].items():
                 distinct_compiles[fam] = \
                     distinct_compiles.get(fam, 0) + n
+        if "fused_fragments" in r:
+            suite[name]["fused_fragments"] = r["fused_fragments"]
+        if "fusion" in r:
+            suite[name]["fusion"] = r["fusion"]
         speedups.append(sp)
     q1 = per_query.get("q1", {"rows_per_sec": 0.0})
     line = {
@@ -282,6 +298,13 @@ def _run_one(qname: str, env: dict, timeout_s: float):
 def main() -> int:
     if os.environ.get("PRESTO_TPU_BENCH_CHILD") == "1":
         return _child_main()
+
+    # --fusion-report: embed the per-query whole-fragment fusion
+    # coverage (fused chains + fallback reasons, planner/fusion.py) in
+    # each suite entry — rides an env var so the per-query children
+    # see it too
+    if "--fusion-report" in sys.argv[1:]:
+        os.environ["PRESTO_TPU_BENCH_FUSION"] = "1"
 
     deadline = time.time() + TOTAL_BUDGET_S
     attempts = [

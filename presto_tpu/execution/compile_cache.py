@@ -98,11 +98,14 @@ def clear_kernel_caches() -> None:
     LRUs AND jax's in-memory jit caches. This is the process-restart
     simulation (tests, serving_bench --restart-warm): afterwards the
     only warm layer left is the persistent on-disk cache."""
-    from presto_tpu.operators import aggregation, core, join_ops
+    from presto_tpu.operators import (
+        aggregation, core, fused_fragment, join_ops,
+    )
     core._FP_KERNEL_CACHE.clear()
     aggregation._AGG_STEP_CACHE.clear()
     aggregation._AGG_FIN_CACHE.clear()
     join_ops._PROBE_KERNEL_CACHE.clear()
+    fused_fragment.clear_fused_kernel_cache()
     import jax
     jax.clear_caches()
     # post-wipe compiles are FIRST traces again — the retrace counter

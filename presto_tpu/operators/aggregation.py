@@ -105,25 +105,36 @@ _AGG_STEP_CACHE_MAX = 256
 def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                          specs: Sequence["AggSpec"], mode: str,
                          domains: Optional[Tuple[int, ...]],
-                         input_dicts=None, presorted: bool = False):
+                         input_dicts=None, presorted: bool = False,
+                         pre=None, pre_key=None):
     """Build (or fetch) the jitted (state, batch) -> state fold step.
 
     `input_dicts` is the (name, dictionary) token of the dict-encoded
     input columns the expressions were compiled against — compiled
     closures bake those dictionaries into lookup-table constants, so
     the same IR against different dictionaries is a DIFFERENT kernel
-    (same rule as the filter/project cache)."""
+    (same rule as the filter/project cache).
+
+    `pre` is an optional traceable batch -> batch body composed ahead
+    of the expression eval INSIDE the same trace — the whole-fragment
+    fusion path (operators/fused_fragment.py) passes the upstream
+    filter/project chain here, so scan -> filter -> project -> agg
+    step runs as ONE jitted program per batch. `pre_key` is its
+    structural fingerprint; a pre without a key is uncacheable (the
+    planner only fuses fingerprintable chains). Fused kernels report
+    under the `fragment` telemetry family."""
     aggs = tuple(s.function for s in specs)
     exprs = list(key_exprs) + [s.input for s in specs
                                if s.input is not None] \
         + [s.mask for s in specs if s.mask is not None]
     key = None
-    if all(e.ir is not None for e in exprs):
+    if all(e.ir is not None for e in exprs) \
+            and (pre is None or pre_key is not None):
         try:
             # fingerprints, not raw IR: see operators/core.py — IR
             # hash/eq is exponential on lambda-produced DAGs
             from presto_tpu.expr.ir import fingerprint as _fp
-            key = (mode, domains, input_dicts, presorted,
+            key = (mode, domains, input_dicts, presorted, pre_key,
                    tuple((_fp(ke.ir), ke.dictionary)
                          for ke in key_exprs),
                    tuple((s.out_name if mode == "final" else None,
@@ -140,6 +151,8 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
             key = None
 
     def _batch_parts(batch: Batch):
+        if pre is not None:
+            batch = pre(batch)
         env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
         cap = batch.capacity
         key_cols = []
@@ -171,14 +184,19 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                 w = w & jnp.broadcast_to(fd & fm, (cap,))
             agg_weights.append(w)
             merge.append(False)
-        return key_cols, agg_inputs, agg_weights, tuple(merge)
+        # row_valid must come from the CHAINED batch: a fused upstream
+        # filter narrows it inside this trace, and groups must not
+        # form from rows the chain filtered out
+        return (batch.row_valid, key_cols, agg_inputs, agg_weights,
+                tuple(merge))
 
     if domains is not None:
         @jax.jit
         def kernel(state, batch: Batch):
-            key_cols, agg_inputs, agg_weights, merge = _batch_parts(batch)
+            row_valid, key_cols, agg_inputs, agg_weights, merge = \
+                _batch_parts(batch)
             return hashagg.direct_step(
-                state, batch.row_valid, key_cols, domains, agg_inputs,
+                state, row_valid, key_cols, domains, agg_inputs,
                 agg_weights, aggs, merge)
     else:
         # sort path: expression eval + per-batch compaction fused into
@@ -191,16 +209,19 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
 
         @functools.partial(jax.jit, static_argnums=(0,))
         def kernel(out_cap: int, batch: Batch):
-            key_cols, agg_inputs, agg_weights, merge = \
+            row_valid, key_cols, agg_inputs, agg_weights, merge = \
                 _batch_parts(batch)
             return group_fn(
-                batch.row_valid, key_cols, agg_inputs, agg_weights,
+                row_valid, key_cols, agg_inputs, agg_weights,
                 aggs, out_cap, merge)
 
     # compile-vs-execute attribution rides the cached kernel (same
-    # contract as core's filter_project instrumentation)
+    # contract as core's filter_project instrumentation); a kernel
+    # with a fused upstream chain is a whole-fragment program and
+    # reports under the `fragment` family
     from presto_tpu.telemetry.kernels import instrument_kernel
-    kernel = instrument_kernel(kernel, "agg_step")
+    kernel = instrument_kernel(
+        kernel, "fragment" if pre is not None else "agg_step")
 
     if key is not None:
         _AGG_STEP_CACHE[key] = kernel
@@ -730,10 +751,24 @@ class StreamingAggregationOperatorFactory(OperatorFactory):
         self.key_exprs = key_exprs
         self.specs = specs
         self.mode = mode
+        self._input_dicts = input_dicts
+        self._created = False
         self._step_kernel = make_agg_step_kernel(
             key_exprs, specs, mode, None, input_dicts, presorted=True)
 
+    def fuse_pre(self, pre, pre_key, name: str) -> None:
+        """Whole-fragment fusion: rebuild the step kernel with the
+        upstream filter/project chain traced ahead of the key eval
+        (planner/fusion.py; only legal before the first create)."""
+        assert not self._created, "fuse_pre() after create()"
+        self._step_kernel = make_agg_step_kernel(
+            self.key_exprs, self.specs, self.mode, None,
+            self._input_dicts, presorted=True, pre=pre,
+            pre_key=pre_key)
+        self.name = name
+
     def create(self, driver_context: DriverContext) -> Operator:
+        self._created = True
         return StreamingAggregationOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.key_names, self.key_exprs, self.specs,
@@ -751,11 +786,25 @@ class AggregationOperatorFactory(OperatorFactory):
         self.specs = specs
         self.mode = mode
         self.max_groups = max_groups
+        self._input_dicts = input_dicts
+        self._created = False
         self._step_kernel = make_agg_step_kernel(
             key_exprs, specs, mode, _direct_domains(key_exprs),
             input_dicts)
 
+    def fuse_pre(self, pre, pre_key, name: str) -> None:
+        """Whole-fragment fusion: rebuild the step kernel with the
+        upstream filter/project chain traced ahead of the key eval
+        (planner/fusion.py; only legal before the first create)."""
+        assert not self._created, "fuse_pre() after create()"
+        self._step_kernel = make_agg_step_kernel(
+            self.key_exprs, self.specs, self.mode,
+            _direct_domains(self.key_exprs), self._input_dicts,
+            pre=pre, pre_key=pre_key)
+        self.name = name
+
     def create(self, driver_context: DriverContext) -> Operator:
+        self._created = True
         return AggregationOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.key_names, self.key_exprs, self.specs, self.mode,

@@ -176,21 +176,15 @@ def make_filter_project_kernel(
         except TypeError:  # unhashable literal somewhere — just don't cache
             key = None
 
-    @jax.jit
-    def kernel(batch: Batch) -> Batch:
-        env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
-        cap = batch.capacity
-        rv = batch.row_valid
-        if filter_expr is not None:
-            d, m = filter_expr.fn(env)
-            rv = rv & jnp.broadcast_to(d & m, (cap,))
-        cols: Dict[str, Column] = {}
-        for name, ce in projections:
-            d, m = ce.fn(env)
-            d = jnp.broadcast_to(jnp.asarray(d, ce.type.np_dtype), (cap,))
-            m = jnp.broadcast_to(m, (cap,))
-            cols[name] = Column(d, m, ce.type, ce.dictionary)
-        return Batch(cols, rv)
+    # the traced body is the whole-fragment compiler's single-stage
+    # chain (operators/fused_fragment.py) — ONE definition of the
+    # filter/project semantics, so fused and unfused results cannot
+    # drift (lazy import: fused_fragment imports this module)
+    from presto_tpu.operators.fused_fragment import (
+        ChainStage, make_chain_body,
+    )
+    kernel = jax.jit(make_chain_body(
+        [ChainStage(filter_expr, tuple(projections), input_dicts)]))
 
     # compile-vs-execute attribution travels WITH the cached kernel:
     # an LRU hit keeps its warm jit cache, so its calls report execute
@@ -256,11 +250,24 @@ class FilterProjectOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int,
                  filter_expr: Optional[CompiledExpr],
                  projections: Sequence[Tuple[str, CompiledExpr]],
-                 input_dicts: Optional[Tuple[Tuple[str, tuple], ...]] = None):
+                 input_dicts: Optional[Tuple[Tuple[str, tuple], ...]] = None,
+                 selectivity: Optional[float] = None):
         super().__init__(operator_id, "filter_project")
         self._kernel = make_filter_project_kernel(filter_expr, projections,
                                                   input_dicts)
         self._selective = filter_expr is not None
+        # kept for the whole-fragment fusion pass (planner/fusion.py):
+        # adjacent FilterProjects collapse into the downstream
+        # terminal's trace, which needs the expression forest — not
+        # the already-jitted kernel — plus the planner's estimated
+        # fraction of surviving rows (None = unknown), which gates
+        # fold-terminal fusion: a highly selective chain keeps its
+        # deferred compaction instead of handing the fold full-width
+        # dead lanes
+        self.filter_expr = filter_expr
+        self.projections = tuple(projections)
+        self.input_dicts = input_dicts
+        self.selectivity = selectivity
 
     def create(self, driver_context: DriverContext) -> Operator:
         return FilterProjectOperator(
@@ -299,12 +306,20 @@ class LimitOperator(Operator):
         return self._pending is None and not self._finishing \
             and not self._done
 
-    def add_input(self, batch: Batch) -> None:
-        self._count_in(batch)
+    def _step(self, batch: Batch):
+        """(truncated batch, new emitted count) — the whole-fragment
+        compiler overrides this with a kernel that folds the upstream
+        chain AND the count update into the same dispatch
+        (operators/fused_fragment.py); the early-termination protocol
+        around it is shared."""
         # n rides as a TRACED operand (like _emitted): LIMIT 10 and
         # LIMIT 500 share one compiled kernel per batch shape
         out = sort_ops.limit_batch(batch, self._n, self._emitted)
-        self._emitted = self._emitted + jnp.sum(out.row_valid)
+        return out, self._emitted + jnp.sum(out.row_valid)
+
+    def add_input(self, batch: Batch) -> None:
+        self._count_in(batch)
+        out, self._emitted = self._step(batch)
         self._flag = self._emitted >= self._n
         try:
             self._flag.copy_to_host_async()

@@ -318,7 +318,8 @@ def make_probe_kernel(key_names: Tuple[str, ...], join_type: str,
                       fused_filter=None,
                       fused_projections=None,
                       input_dicts=None,
-                      verify: str = "hash"):
+                      verify: str = "hash",
+                      pre=None, pre_key=None, pre_key_dicts=None):
     """Build the jitted fused probe->project kernel:
 
         kernel(table, batch, matched, out_capacity[static])
@@ -330,17 +331,29 @@ def make_probe_kernel(key_names: Tuple[str, ...], join_type: str,
     probe and then re-read by a separate FilterProject pass over the
     same out_capacity-wide arrays. `matched` is the FULL join's
     per-build-row flag array (pass None otherwise; it passes through
-    untouched)."""
+    untouched).
+
+    `pre` extends the fusion UPSTREAM (the whole-fragment compiler,
+    operators/fused_fragment.py): a traceable batch -> batch chain —
+    the scan-side filter/project forest — applied inside the probe
+    dispatch before hashing, including the unified-dictionary key
+    remap (`pre_key_dicts`, parallel to key_names) that the operator
+    otherwise performs host-side per batch. The remap tables bake in
+    as constants: the chain output's dictionaries are static column
+    metadata at trace time. `pre_key` fingerprints the chain for the
+    kernel cache."""
     rename = tuple(sorted((build_rename or {}).items()))
     fused_projections = tuple(fused_projections or ())
     exprs = ([fused_filter] if fused_filter is not None else []) \
         + [ce for _, ce in fused_projections]
     key = None
-    if all(ce.ir is not None for ce in exprs):
+    if all(ce.ir is not None for ce in exprs) \
+            and (pre is None or pre_key is not None):
         try:
             from presto_tpu.expr.ir import fingerprint
             key = (key_names, join_type, probe_output, build_output,
                    build_keys, rename, verify, input_dicts,
+                   pre_key, tuple(pre_key_dicts or ()),
                    fingerprint(fused_filter.ir)
                    if fused_filter is not None else None,
                    tuple((n, fingerprint(ce.ir), ce.dictionary)
@@ -353,6 +366,14 @@ def make_probe_kernel(key_names: Tuple[str, ...], join_type: str,
             key = None
 
     rn_map = dict(rename)
+
+    _pre_batch = None
+    if pre is not None:
+        def _pre_batch(b: Batch) -> Batch:
+            # same unified-dictionary alignment the unfused operator
+            # performs host-side per batch — here it traces into the
+            # fragment program (the remap tables bake in as constants)
+            return _remap_keys(pre(b), key_names, pre_key_dicts)
 
     def _project(out: Batch):
         """Rename + fused filter/projections over the expanded batch
@@ -387,6 +408,8 @@ def make_probe_kernel(key_names: Tuple[str, ...], join_type: str,
         out, live = _project(out)
         return out, overflow, live, matched
 
+    family = "fragment" if pre is not None else "join_probe"
+    jit_list = None
     if ops_common.cpu_backend():
         # two dispatches: the candidate search materializes ONCE (see
         # ops/join.py on XLA:CPU fusion re-materialization); the probe
@@ -394,30 +417,51 @@ def make_probe_kernel(key_names: Tuple[str, ...], join_type: str,
         stage2 = functools.partial(jax.jit, static_argnums=(5,))(
             _expand_project)
 
-        def kernel(table, batch, matched, out_capacity: int):
-            h, h2 = join_ops._hash_jit(batch, key_names)
-            lo_enc = join_ops._search_jit(table, h, h2, verify)
-            return stage2(table, batch, lo_enc, h2, matched,
-                          out_capacity)
+        if _pre_batch is None:
+            def kernel(table, batch, matched, out_capacity: int):
+                h, h2 = join_ops._hash_jit(batch, key_names)
+                lo_enc = join_ops._search_jit(table, h, h2, verify)
+                return stage2(table, batch, lo_enc, h2, matched,
+                              out_capacity)
+            jit_list = [stage2, join_ops._hash_jit,
+                        join_ops._search_jit]
+        else:
+            # the upstream chain + remap fold into the HASH dispatch
+            # (stage0): still two probe-side materializations, but
+            # the former FilterProject dispatch — and its deferred
+            # count/compact round — are gone
+            @jax.jit
+            def stage0(batch):
+                b = _pre_batch(batch)
+                h, h2 = join_ops._probe_hashes(b, key_names)
+                return b, h, h2
+
+            def kernel(table, batch, matched, out_capacity: int):
+                b, h, h2 = stage0(batch)
+                lo_enc = join_ops._search_jit(table, h, h2, verify)
+                return stage2(table, b, lo_enc, h2, matched,
+                              out_capacity)
+            jit_list = [stage0, stage2, join_ops._search_jit]
     else:
         @functools.partial(jax.jit, static_argnums=(3,))
         def kernel(table, batch, matched, out_capacity: int):
+            if _pre_batch is not None:
+                batch = _pre_batch(batch)
             lo_enc = join_ops._candidates_enc(table, batch, key_names,
                                               verify)
             return _expand_project(table, batch, lo_enc, None, matched,
                                    out_capacity)
 
     # compile-vs-execute attribution rides the cached kernel. The CPU
-    # form is a host wrapper over THREE jits — the per-probe stage2
-    # plus the shared module-level hash/search jits — so all three
-    # executable caches are polled for compile detection
+    # form is a host wrapper over THREE jits — the per-probe stages
+    # plus the shared module-level search jit — so all executable
+    # caches are polled for compile detection. A probe with a fused
+    # upstream chain is a whole-fragment program (`fragment` family).
     from presto_tpu.telemetry.kernels import instrument_kernel
-    if ops_common.cpu_backend():
-        kernel = instrument_kernel(
-            kernel, "join_probe",
-            jits=[stage2, join_ops._hash_jit, join_ops._search_jit])
+    if jit_list is not None:
+        kernel = instrument_kernel(kernel, family, jits=jit_list)
     else:
-        kernel = instrument_kernel(kernel, "join_probe")
+        kernel = instrument_kernel(kernel, family)
 
     if key is not None:
         _PROBE_KERNEL_CACHE[key] = kernel
@@ -445,9 +489,14 @@ class LookupJoinOperator(Operator):
                  key_dicts: Optional[List[Optional[tuple]]] = None,
                  expansion_factor: int = 1,
                  probe_schema: Optional[Sequence[tuple]] = None,
-                 probe_kernel=None, tail_kernel=None):
+                 probe_kernel=None, tail_kernel=None,
+                 pre_fused: bool = False):
         super().__init__(ctx)
         self.bridge = bridge
+        #: the upstream filter/project chain (and the unified-dict key
+        #: remap) are traced INSIDE the probe kernel — the host-side
+        #: per-batch remap must not run twice
+        self.pre_fused = pre_fused
         self.key_names = key_names
         self.build_keys = build_keys  # None -> kernel defaults
         self.key_dicts = key_dicts
@@ -534,7 +583,8 @@ class LookupJoinOperator(Operator):
         # pad BEFORE remap/probe: the probe kernel (and its output
         # capacity) key on the probe batch shape
         batch = pad_for_kernel(batch)
-        batch = _remap_keys(batch, self.key_names, self.key_dicts)
+        if not self.pre_fused:
+            batch = _remap_keys(batch, self.key_names, self.key_dicts)
         if self.bridge.table is not None:
             self._pending.append(self._probe(self.bridge.table, batch))
             return
@@ -542,6 +592,9 @@ class LookupJoinOperator(Operator):
         # rest of the batch's rows on the host per partition
         assert self.join_type != "full", \
             "full join builds are planned non-spillable"
+        assert not self.pre_fused, \
+            "fusion pass must not pre-fuse a spillable join probe " \
+            "(the spill partitioner reads key columns host-side)"
         import jax
         sp = self.bridge.spilled
         if self._probe_bufs is None:
@@ -743,12 +796,17 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self._fused_filter = None
         self._fused_projections = None
         self._fused_dicts = None
+        self._pre = None        # (body, chain_key) upstream chain
         self._kernels = None
 
     @property
     def fused(self) -> bool:
         return self._fused_filter is not None \
             or self._fused_projections is not None
+
+    @property
+    def pre_fused(self) -> bool:
+        return self._pre is not None
 
     def fuse(self, filter_expr, projections, input_dicts=None) -> None:
         """Planner peephole: absorb the FilterProject that would
@@ -762,13 +820,33 @@ class LookupJoinOperatorFactory(OperatorFactory):
             else None
         self._fused_dicts = input_dicts
 
+    def fuse_pre(self, pre, pre_key, name: str) -> None:
+        """Whole-fragment fusion (planner/fusion.py): absorb the
+        UPSTREAM filter/project chain, so scan -> chain -> probe [->
+        fused projections] runs as one traced program per batch (the
+        unified-dictionary key remap moves into the trace with it).
+        Only legal before the first create(); the pass excludes full
+        joins and spill-eligible builds."""
+        assert self._kernels is None, "fuse_pre() after create()"
+        assert self._pre is None, "join already fused an upstream chain"
+        assert self.join_type != "full", \
+            "full-join probes keep the host-side remap (outer tail)"
+        self._pre = (pre, pre_key)
+        self.name = name
+
     def _build_kernels(self):
+        pre, pre_key = self._pre if self._pre is not None \
+            else (None, None)
+        pre_key_dicts = tuple(d if d is not None else None
+                              for d in (self.key_dicts or ())) \
+            if pre is not None and self.key_dicts else None
         probe_kernel = make_probe_kernel(
             self.key_names, self.join_type, tuple(self.probe_output),
             tuple(self.build_output),
             self.build_keys if self.build_keys else self.key_names,
             self.build_rename, self._fused_filter,
-            self._fused_projections, self._fused_dicts)
+            self._fused_projections, self._fused_dicts,
+            pre=pre, pre_key=pre_key, pre_key_dicts=pre_key_dicts)
         tail_kernel = None
         if self.join_type == "full" and self.fused:
             from presto_tpu.operators.core import (
@@ -788,7 +866,8 @@ class LookupJoinOperatorFactory(OperatorFactory):
             self.bridge, self.key_names, self.join_type,
             self.probe_output, self.build_output, self.build_rename,
             self.build_keys, self.key_dicts, self.expansion_factor,
-            self.probe_schema, probe_kernel, tail_kernel)
+            self.probe_schema, probe_kernel, tail_kernel,
+            pre_fused=self.pre_fused)
 
 
 class SemiJoinOperatorFactory(OperatorFactory):

@@ -136,11 +136,17 @@ class TopNOperator(Operator):
     def needs_input(self) -> bool:
         return not self._finishing
 
+    def _step(self, batch: Batch) -> Batch:
+        """Fold one padded batch into the top-N state — the whole-
+        fragment compiler overrides this with a kernel that traces the
+        upstream chain into the same dispatch (fused_fragment.py)."""
+        return sort_kernels.topn_step(
+            self._state, batch, self.n, self.key_names,
+            self.descending, self.nulls_first)
+
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
-        self._state = sort_kernels.topn_step(
-            self._state, pad_for_kernel(batch), self.n, self.key_names,
-            self.descending, self.nulls_first)
+        self._state = self._step(pad_for_kernel(batch))
 
     def get_output(self) -> Optional[Batch]:
         if not self._finishing or self._emitted:
@@ -170,6 +176,14 @@ class DistinctOperator(Operator):
     def needs_input(self) -> bool:
         return not self._finishing
 
+    def _step(self, batch: Batch) -> Batch:
+        """Merge one padded INPUT batch into the distinct state — the
+        whole-fragment compiler overrides this with a kernel tracing
+        the upstream chain into the same dispatch (fused_fragment.py).
+        The grow-on-full re-merge below stays on the PLAIN kernel in
+        both: the chain must apply to incoming batches exactly once."""
+        return sort_kernels.distinct_step(self._state, batch)
+
     def add_input(self, batch: Batch) -> None:
         self._count_in(batch)
         batch = pad_for_kernel(batch)
@@ -178,7 +192,7 @@ class DistinctOperator(Operator):
         # so re-merge at a larger capacity before accepting the batch
         # (growth lands on the kernel ladder under shape bucketing)
         while True:
-            new_state = sort_kernels.distinct_step(self._state, batch)
+            new_state = self._step(batch)
             if new_state.num_valid() < new_state.capacity:
                 self._state = new_state
                 return

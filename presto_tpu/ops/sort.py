@@ -103,12 +103,14 @@ def distinct_state(schema_cols, capacity: int) -> Batch:
     return Batch(cols, jnp.zeros(capacity, bool))
 
 
-@jax.jit
-def _distinct_step_jit(state: Batch, batch: Batch) -> Batch:
+def _distinct_step_impl(state: Batch, batch: Batch) -> Batch:
     """Fold step for SELECT DISTINCT / set-union dedup: re-group
     state ++ batch by all columns, keep one representative per group
     (hashagg._group_reduce with zero aggregates — one variadic sort,
-    packed representatives, no argsort/gather chains)."""
+    packed representatives, no argsort/gather chains). Kept as a
+    plain traceable body so the whole-fragment compiler can chain a
+    filter/project forest ahead of it inside ONE trace
+    (operators/fused_fragment.py)."""
     from presto_tpu.ops import hashagg
     cap = state.capacity
     names = state.names
@@ -128,12 +130,17 @@ def _distinct_step_jit(state: Batch, batch: Batch) -> Batch:
     return Batch(cols, gr.valid)
 
 
+_distinct_step_jit = jax.jit(_distinct_step_impl)
+
+
 # -- instrumented public entry points ---------------------------------
 #
 # Operators call these; compile-vs-execute attribution (and the
 # retrace counter) ride the wrapper exactly like the three engine
 # kernel-cache families — closing the "module-level jits land in
-# execute" gap flagged after the telemetry PR.
+# execute" gap flagged after the telemetry PR. The *_impl bodies above
+# stay importable so operators/fused_fragment.py can compose them into
+# whole-fragment traces.
 from presto_tpu.telemetry.kernels import instrument_kernel as _instr
 
 sort_batch = _instr(_sort_batch, "sort")

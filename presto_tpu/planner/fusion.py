@@ -1,0 +1,315 @@
+"""Whole-fragment fusion pass (the planner half of the fragment
+compiler; operators/fused_fragment.py is the kernel half).
+
+Runs over the freshly-planned operator-factory pipelines and collapses
+every maximal run of adjacent FilterProject factories into the trace of
+the operator that consumes it:
+
+    scan -> fp -> fp -> aggregation   =>  scan -> fused[fp*2+aggregation]
+    scan -> fp -> topn|limit|distinct =>  scan -> fused[fp+<terminal>]
+    scan -> fp -> lookup_join(probe)  =>  scan -> fused[fp+lookup_join]
+    ... -> fp -> fp -> <barrier>      =>  ... -> fused[fp*2] -> <barrier>
+
+The Driver chain for an eligible leaf fragment then degenerates to
+`scan batch -> fused_kernel(batch) -> emit/fold`: one jitted XLA
+program per batch where the unfused pipeline paid one dispatch per
+operator plus a deferred count/compact host round per selective stage.
+
+The pass is deliberately a PIPELINE rewrite, not a plan-tree rewrite:
+it runs after every visitor (so fragment-cache record/replay operators,
+spools, and exchange sinks are already in place and act as natural
+barriers), and falling back is simply not rewriting — the unfused
+operator chain IS the fallback path.
+
+Every declined candidate records an explicit fallback reason, surfaced
+per query through `tools/fusion_report.py` and process-wide on
+/v1/metrics as `presto_tpu_fused_fragments_total{status,reason}` —
+silent coverage loss is the failure mode this report exists to catch
+(docs/FRAGMENT_COMPILATION.md)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from presto_tpu.operators import fused_fragment as ff
+from presto_tpu.operators.aggregation import (
+    AggregationOperatorFactory, StreamingAggregationOperatorFactory,
+)
+from presto_tpu.operators.core import (
+    FilterProjectOperatorFactory, LimitOperatorFactory,
+)
+from presto_tpu.operators.join_ops import LookupJoinOperatorFactory
+from presto_tpu.operators.sort_ops import (
+    DistinctOperatorFactory, TopNOperatorFactory,
+)
+
+#: fallback reasons (stable strings — tests and the report tool grep
+#: them; see docs/FRAGMENT_COMPILATION.md for the catalogue)
+R_UNCACHEABLE = "uncacheable_expr"
+R_NO_TERMINAL = "single_stage_no_terminal"
+R_FULL_JOIN = "full_join_probe"
+R_SPILLABLE = "spillable_build"
+R_ALREADY_PRE = "probe_already_prefused"
+R_SELECTIVE = "selective_chain"
+
+#: fold-terminal gate: when the chain's estimated surviving-row
+#: fraction drops below a quarter, live rows fall at least one
+#: power-of-four bucket on the kernel-capacity ladder — the deferred
+#: compaction between the chain and its consumer then shrinks every
+#: downstream fold's working width, which beats saving the compact
+#: round (measured: q6's ~2%-selective filter fused into its agg ran
+#: 1.5x SLOWER than compact-then-fold). At or above a quarter the
+#: compacted batch pads back to the same bucket anyway, so fusion is
+#: pure win. The estimate is planner/stats.py's, which falls back to
+#: the reference's default per-conjunct selectivities (0.33 each)
+#: when column stats are absent — so a stats-less multi-conjunct
+#: filter ALSO gates, deliberately: such filters are usually
+#: selective, a wrong gate costs ~3% (the chain still collapses; only
+#: the fold stays out), a wrong fuse costs the 1.5x above. Only a
+#: filter with NO estimate at all (no row counts, estimator error)
+#: contributes nothing and leaves fusion on.
+SELECTIVE_CHAIN_THRESHOLD = 0.25
+
+
+def _constituent_label(names: Sequence[str]) -> str:
+    """fused[filter_project*2+aggregation(single)] — consecutive
+    duplicates compress so EXPLAIN ANALYZE lines stay readable."""
+    parts: List[str] = []
+    for n in names:
+        if parts and parts[-1].split("*")[0] == n:
+            base, _, cnt = parts[-1].partition("*")
+            parts[-1] = f"{base}*{int(cnt or 1) + 1}"
+        else:
+            parts.append(n)
+    return "fused[" + "+".join(parts) + "]"
+
+
+@dataclasses.dataclass
+class _Candidate:
+    pipeline: int
+    start: int              # index of the first FP of the run
+    stages: list            # ChainStage per FP
+    names: List[str]        # FP factory names
+    ids: List[int]          # FP operator ids
+    #: estimated surviving-row fraction: product over stages carrying
+    #: a planner estimate (real stats or the reference's default
+    #: per-conjunct heuristics — see SELECTIVE_CHAIN_THRESHOLD); a
+    #: stage with no estimate at all contributes 1.0 (fusion stays on)
+    sel: float = 1.0
+
+
+def fuse_pipelines(pipelines: List[List], node_ops=None,
+                   spill_enabled: bool = False) -> Dict:
+    """Mutates `pipelines` (and the planner's node->operator-id map,
+    for EXPLAIN ANALYZE) in place; returns the fusion report dict.
+
+    `spill_enabled` mirrors the planner's build-side spill decision:
+    a spill-eligible join build may hand the probe a host-partitioned
+    table at runtime, whose partitioner reads key columns host-side —
+    upstream chains must not disappear into the probe trace then."""
+    from presto_tpu.telemetry.metrics import METRICS
+    entries: List[Dict] = []
+    id_remap: Dict[int, int] = {}
+
+    def record(cand: _Candidate, terminal: Optional[str],
+               fused_name: Optional[str],
+               reason: Optional[str]) -> None:
+        entries.append({
+            "pipeline": cand.pipeline,
+            "source": pipelines[cand.pipeline][0].name
+            if pipelines[cand.pipeline] else "?",
+            "chain": list(cand.names),
+            "terminal": terminal,
+            "fused": fused_name,
+            "reason": reason,
+        })
+        if fused_name is not None:
+            # a fused entry MAY still carry a reason: partial fusion,
+            # where the chain collapsed but its fold terminal was
+            # deliberately kept out (e.g. selective_chain)
+            METRICS.inc("presto_tpu_fused_fragments_total",
+                        status="partial" if reason else "fused",
+                        reason=reason or "")
+        else:
+            METRICS.inc("presto_tpu_fused_fragments_total",
+                        status="fallback", reason=reason or "")
+
+    for pi, pipe in enumerate(pipelines):
+        i = 0
+        while i < len(pipe):
+            f = pipe[i]
+            stages = ff.stages_from_factory(f) \
+                if isinstance(f, FilterProjectOperatorFactory) \
+                else None
+            if stages is None:
+                i += 1
+                continue
+            cand = _Candidate(pi, i, list(stages), [f.name],
+                              [f.operator_id])
+            if getattr(f, "selectivity", None) is not None:
+                cand.sel *= f.selectivity
+            j = i + 1
+            while j < len(pipe):
+                nxt = pipe[j]
+                more = ff.stages_from_factory(nxt) \
+                    if isinstance(nxt, FilterProjectOperatorFactory) \
+                    else None
+                if more is None:
+                    break
+                cand.stages.extend(more)
+                cand.names.append(nxt.name)
+                cand.ids.append(nxt.operator_id)
+                if getattr(nxt, "selectivity", None) is not None:
+                    cand.sel *= nxt.selectivity
+                j += 1
+            terminal = pipe[j] if j < len(pipe) else None
+            i = _apply(pipe, cand, terminal, j, record,
+                       id_remap, spill_enabled)
+
+    if node_ops is not None and id_remap:
+        for nid, ids in node_ops.items():
+            seen = set()
+            out = []
+            for op_id in ids:
+                mapped = id_remap.get(op_id, op_id)
+                if mapped not in seen:
+                    seen.add(mapped)
+                    out.append(mapped)
+            node_ops[nid] = out
+
+    fallback: Dict[str, int] = {}
+    for e in entries:
+        if e["fused"] is None:
+            r = e["reason"] or "?"
+            fallback[r] = fallback.get(r, 0) + 1
+    return {
+        "fragments": entries,
+        "fused": sum(1 for e in entries if e["fused"] is not None),
+        "fallback": fallback,
+    }
+
+
+def _collapse_chain(pipe: List, cand: _Candidate, end: int,
+                    chain_key, id_remap: Dict[int, int]) -> str:
+    """Collapse a multi-stage run into one FusedChainOperatorFactory
+    (the deferred-compact protocol runs once, at the chain's tail).
+    Returns the fused label."""
+    name = _constituent_label(cand.names)
+    fused = ff.FusedChainOperatorFactory(
+        cand.ids[0], name, cand.stages, chain_key)
+    for rid in cand.ids[1:]:
+        id_remap[rid] = cand.ids[0]
+    pipe[cand.start:end] = [fused]
+    return name
+
+
+_FOLD_TERMINALS = (AggregationOperatorFactory,
+                   StreamingAggregationOperatorFactory,
+                   LookupJoinOperatorFactory, TopNOperatorFactory,
+                   DistinctOperatorFactory, LimitOperatorFactory)
+
+
+def _apply(pipe: List, cand: _Candidate, terminal, end: int,
+           record, id_remap: Dict[int, int],
+           spill_enabled: bool) -> int:
+    """Fuse one candidate run (or record why not). Returns the
+    pipeline index to resume scanning at."""
+    tname = getattr(terminal, "name", None)
+    chain_key = ff.chain_fingerprint(cand.stages)
+    if chain_key is None:
+        record(cand, tname, None, R_UNCACHEABLE)
+        return end
+
+    # -- selectivity gate: a chain estimated to keep < 1/4 of its
+    # rows does NOT fold into its terminal — compacting first drops
+    # the fold's working width at least one power-of-four bucket,
+    # which beats saving the compact round. The chain itself still
+    # collapses (compaction runs once, at its tail). ----------------
+    if isinstance(terminal, _FOLD_TERMINALS) \
+            and ff.chain_selective(cand.stages) \
+            and cand.sel < SELECTIVE_CHAIN_THRESHOLD:
+        if len(cand.names) >= 2:
+            name = _collapse_chain(pipe, cand, end, chain_key,
+                                   id_remap)
+            record(cand, tname, name, R_SELECTIVE)
+            return cand.start + 1
+        record(cand, tname, None, R_SELECTIVE)
+        return end
+
+    # -- fold terminals: the chain traces INTO the terminal's kernel --
+    if isinstance(terminal, (AggregationOperatorFactory,
+                             StreamingAggregationOperatorFactory)):
+        name = _constituent_label(cand.names + [terminal.name])
+        terminal.fuse_pre(ff.make_chain_body(cand.stages), chain_key,
+                          name)
+        for rid in cand.ids:
+            id_remap[rid] = terminal.operator_id
+        del pipe[cand.start:end]
+        record(cand, tname, name, None)
+        return cand.start + 1
+
+    if isinstance(terminal, LookupJoinOperatorFactory):
+        if terminal.join_type == "full":
+            reason = R_FULL_JOIN
+        elif spill_enabled:
+            reason = R_SPILLABLE
+        elif terminal.pre_fused:
+            reason = R_ALREADY_PRE
+        else:
+            name = _constituent_label(cand.names + [terminal.name])
+            terminal.fuse_pre(ff.make_chain_body(cand.stages),
+                              chain_key, name)
+            for rid in cand.ids:
+                id_remap[rid] = terminal.operator_id
+            del pipe[cand.start:end]
+            record(cand, tname, name, None)
+            return cand.start + 1
+        record(cand, tname, None, reason)
+        return end
+
+    if isinstance(terminal, TopNOperatorFactory):
+        n, keys, desc, nf, schema_cols = terminal.args
+        name = _constituent_label(cand.names + [terminal.name])
+        fused = ff.FusedTopNOperatorFactory(
+            terminal.operator_id, name, cand.stages, chain_key,
+            n, keys, desc, nf, schema_cols)
+        for rid in cand.ids:
+            id_remap[rid] = terminal.operator_id
+        pipe[cand.start:end + 1] = [fused]
+        record(cand, tname, name, None)
+        return cand.start + 1
+
+    if isinstance(terminal, DistinctOperatorFactory):
+        name = _constituent_label(cand.names + [terminal.name])
+        fused = ff.FusedDistinctOperatorFactory(
+            terminal.operator_id, name, cand.stages, chain_key,
+            terminal.schema_cols, terminal.capacity)
+        for rid in cand.ids:
+            id_remap[rid] = terminal.operator_id
+        pipe[cand.start:end + 1] = [fused]
+        record(cand, tname, name, None)
+        return cand.start + 1
+
+    if isinstance(terminal, LimitOperatorFactory):
+        name = _constituent_label(cand.names + [terminal.name])
+        fused = ff.FusedLimitOperatorFactory(
+            terminal.operator_id, name, cand.stages, chain_key,
+            terminal.n)
+        for rid in cand.ids:
+            id_remap[rid] = terminal.operator_id
+        pipe[cand.start:end + 1] = [fused]
+        record(cand, tname, name, None)
+        return cand.start + 1
+
+    # -- no fold terminal: collapse multi-stage runs into one chain
+    # program; a lone FilterProject is already a single kernel ------
+    if len(cand.names) >= 2:
+        name = _collapse_chain(pipe, cand, end, chain_key, id_remap)
+        record(cand, tname, name, None)
+        return cand.start + 1
+
+    record(cand, tname, None,
+           R_NO_TERMINAL if terminal is None
+           else f"barrier:{tname}")
+    return end

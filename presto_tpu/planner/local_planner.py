@@ -158,6 +158,11 @@ class LocalExecutionPlanner:
         #: stats back onto the plan tree through this)
         self.node_ops: Dict[int, List[int]] = {}
         self._node_stack: List[int] = []
+        #: whole-fragment fusion report (planner/fusion.py), populated
+        #: by _fuse(); None when the pass is disabled
+        self.fusion_report = None
+        #: lazy stats estimator for _est_selectivity (fusion gating)
+        self._stats = None
 
     def _next_id(self) -> int:
         self._op_id += 1
@@ -189,6 +194,7 @@ class LocalExecutionPlanner:
         pipeline.append(OutputCollectorOperatorFactory(
             self._next_id(), sink))
         self._pipelines.append(pipeline)
+        self._fuse()
         return LocalExecutionPlan(self._pipelines, sink, root.names,
                                   root.output)
 
@@ -211,7 +217,33 @@ class LocalExecutionPlanner:
             self._next_id(), list(sink_exchanges), self.task.index,
             staged=staged_output))
         self._pipelines.append(pipeline)
+        self._fuse()
         return self._pipelines
+
+    def _fuse(self) -> None:
+        """Whole-fragment fusion (planner/fusion.py): collapse
+        adjacent FilterProject runs into their consumer's trace. Runs
+        LAST — after record/replay, spools, and sinks are placed — so
+        every barrier is visible and falling back is simply keeping
+        the unfused chain."""
+        if not bool(get_property(self.session.properties,
+                                 "fragment_fusion_enabled")):
+            return
+        from presto_tpu.planner.fusion import fuse_pipelines
+        # a join build can only spill (handing the probe a host-
+        # partitioned table whose partitioner reads key columns
+        # host-side) when revocation is BOTH allowed and possible — a
+        # finite memory budget exists. Unbudgeted pools never revoke,
+        # so probe pre-fusion stays available in the common case.
+        spill_possible = bool(
+            get_property(self.session.properties, "spill_enabled")) \
+            and bool(get_property(self.session.properties,
+                                  "hbm_budget_bytes")
+                     or get_property(self.session.properties,
+                                     "cluster_memory_bytes"))
+        self.fusion_report = fuse_pipelines(
+            self._pipelines, self.node_ops,
+            spill_enabled=spill_possible)
 
     # ------------------------------------------------------------------
 
@@ -462,18 +494,41 @@ class LocalExecutionPlanner:
         pipe.append(ValuesOperatorFactory(self._next_id(), [batch]))
 
     def _append_filter_project(self, pipe: List, filter_expr,
-                               projections, input_dicts) -> None:
+                               projections, input_dicts,
+                               selectivity=None) -> None:
         """Append a FilterProject — or FUSE it into a lookup join it
         directly follows, so the expression forest evaluates inside
         the probe dispatch and expanded join rows materialize once
-        (the probe->project fusion of the radix-join redesign)."""
+        (the probe->project fusion of the radix-join redesign).
+        `selectivity` is the estimated surviving-row fraction the
+        fusion pass gates fold-terminal fusion on (None = unknown)."""
         tail = pipe[-1] if pipe else None
         if isinstance(tail, LookupJoinOperatorFactory) \
                 and not tail.fused:
             tail.fuse(filter_expr, projections, input_dicts)
             return
         pipe.append(FilterProjectOperatorFactory(
-            self._next_id(), filter_expr, projections, input_dicts))
+            self._next_id(), filter_expr, projections, input_dicts,
+            selectivity=selectivity))
+
+    def _est_selectivity(self, node: N.FilterNode):
+        """Estimated fraction of source rows surviving `node`, from
+        the optimizer's stats estimator (planner/stats.py), or None
+        when it can't say. Stamped on the FilterProject factory so the
+        fusion pass can keep the deferred compaction ahead of a fold
+        terminal when the chain is highly selective — below a quarter,
+        live rows drop a power-of-four kernel bucket and compacting
+        beats folding over full-width dead lanes (planner/fusion.py)."""
+        try:
+            if self._stats is None:
+                from presto_tpu.planner.stats import StatsEstimator
+                self._stats = StatsEstimator(self.catalogs)
+            inner = self._stats.estimate(node.source).rows
+            if inner <= 0:
+                return None
+            return min(1.0, self._stats.estimate(node).rows / inner)
+        except Exception:  # noqa: BLE001 — stats are advisory
+            return None
 
     def _visit_FilterNode(self, node: N.FilterNode, pipe: List):
         self._visit(node.source, pipe)
@@ -484,7 +539,9 @@ class LocalExecutionPlanner:
                                           schema))
             for f in node.output]
         self._append_filter_project(pipe, pred, projections,
-                                    _schema_dicts(schema))
+                                    _schema_dicts(schema),
+                                    selectivity=self._est_selectivity(
+                                        node))
 
     def _visit_ProjectNode(self, node: N.ProjectNode, pipe: List):
         self._visit(node.source, pipe)

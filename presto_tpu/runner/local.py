@@ -578,6 +578,7 @@ class LocalRunner:
         prev = getattr(self._session_tl, "lifecycle", None)
         self._session_tl.lifecycle = (cancel, deadline)
         self._session_tl.op_stats = None  # this statement's snapshots
+        self._session_tl.fusion_report = None  # planner/fusion.py
         # kernel shape bucketing rides a thread-local gate (operators
         # have no session access): honored by every drive loop this
         # statement runs on THIS thread — remote tasks use the process
@@ -637,6 +638,11 @@ class LocalRunner:
             if ops is not None else None)
         result.trace_events = recorder.events() \
             if recorder is not None else None
+        # whole-fragment fusion report (fused chains + fallback
+        # reasons) rides the result for tools/fusion_report.py and
+        # the bench JSON schemas
+        result.fusion_report = getattr(self._session_tl,
+                                       "fusion_report", None)
         return result
 
     def _lifecycle(self):
@@ -1007,6 +1013,7 @@ class LocalRunner:
         while True:
             planner = LocalExecutionPlanner(self.catalogs, session)
             lplan = planner.plan(plan)
+            self._session_tl.fusion_report = planner.fusion_report
             t0 = _time.perf_counter()
             from presto_tpu.session_properties import get_property
             budget = get_property(session.properties,

@@ -820,6 +820,16 @@ th{{background:#222}}
         would report expr_compile_ms = 0 on this topology forever."""
         from presto_tpu.telemetry import build_query_stats
         from presto_tpu.telemetry import kernels as _tk
+        # honor the statement's kernel_shape_buckets on the
+        # coordinator's own root-fragment drive too: this thread plans
+        # and drives pipelines directly, outside LocalRunner.execute
+        # which normally sets the thread-local gate (the PR 6 gap —
+        # workers get the same fix in node.execute_fragment)
+        from presto_tpu import batch as _batch
+        from presto_tpu.session_properties import get_property as _gp
+        prev_sb = _batch.set_shape_buckets(bool(_gp(
+            dict(self.properties if properties is None
+                 else properties), "kernel_shape_buckets")))
         prev_q = _tk.begin_query()
         try:
             return self._execute_attempt_inner(
@@ -837,6 +847,7 @@ th{{background:#222}}
             raise
         finally:
             _tk.end_query(prev_q)
+            _batch.set_shape_buckets(prev_sb)
 
     def _execute_attempt_inner(self, sql: str, worker_urls: List[str],
                                properties: Optional[dict] = None,

@@ -674,6 +674,25 @@ class Node:
         TaskStats the coordinator rolls into QueryStats;
         `spec["profile"]` adds device row counters + device-inclusive
         timing, the distributed EXPLAIN ANALYZE mode."""
+        # the task spec carries the statement's full session
+        # properties; the kernel shape-bucket gate rides a THREAD-
+        # LOCAL that LocalRunner.execute normally sets — this task
+        # thread drives pipelines directly, so set it here or remote
+        # tasks silently follow the process default instead of the
+        # statement's kernel_shape_buckets (the PR 6 gap)
+        from presto_tpu import batch as _batch
+        from presto_tpu.session_properties import get_property
+        prev_sb = _batch.set_shape_buckets(
+            bool(get_property(spec["session"]["properties"],
+                              "kernel_shape_buckets")))
+        try:
+            return self._execute_fragment_inner(spec, cancel)
+        finally:
+            _batch.set_shape_buckets(prev_sb)
+
+    def _execute_fragment_inner(self, spec: dict,
+                                cancel: Optional[threading.Event]
+                                ) -> dict:
         from presto_tpu.planner.local_planner import (
             LocalExecutionPlanner, TaskContext,
         )

@@ -181,6 +181,16 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "Off = exact power-of-two shapes, the pre-bucketing behavior "
         "(docs/COMPILATION.md)"),
     PropertyDef(
+        "fragment_fusion_enabled", "boolean", True,
+        "Whole-fragment XLA compilation (planner/fusion.py): trace "
+        "each maximal scan->filter->project->[probe]->agg/topn/limit/"
+        "distinct leaf chain into ONE jitted program, collapsing the "
+        "per-operator driver hand-offs and deferred count/compact "
+        "host rounds. Results are byte-identical with fusion off "
+        "(the hard correctness bar); fallback reasons per declined "
+        "chain via tools/fusion_report.py "
+        "(docs/FRAGMENT_COMPILATION.md)"),
+    PropertyDef(
         "cache_memory_bytes", "bigint", 4 << 30,
         "Shared byte budget of the fragment-result + page-source "
         "caches, charged to the cache manager's tagged MemoryPool; "

@@ -27,15 +27,21 @@ Attribution targets, all optional per call:
 ``ENABLED`` is the zero-overhead gate (the faults.ARMED pattern): when
 False the instrumented wrapper is a single branch + tail call.
 
-Known limit: compile detection is a heuristic over SHARED jit caches.
-When two threads hit the same kernel object concurrently and one of
-them compiles a new input signature, the other's cache-size poll can
-observe the growth and book its own (execute) wall as compile ns —
-including time spent blocked on jax's internal compile lock, which
-arguably IS compile cost. Attribution is exact for sequential
-workloads (the cold/warm oracle in tests) and statistically sound
-under concurrency; per-call exactness would need a per-call compile
-signal jax does not expose."""
+Concurrency: compile detection is a heuristic over SHARED jit caches,
+hardened for the two-cold-queries race. Every in-flight call registers
+in the wrapper's active set under the state lock; the call that
+ACCOUNTS a cache-size growth marks every other in-flight call of the
+same wrapper, and a marked call classifies its wall as compile even
+when its own before/after samples straddle no growth (the
+misattribution this closes: caller B compiles, the cache grows, caller
+A — blocked on jax's compile lock the whole time — samples `before`
+AFTER the growth and used to book its compile-blocked wall as
+execute). The residual imprecision is in the SAFE direction: a
+concurrent call that overlapped a compile window without blocking
+books compile ns it didn't strictly pay — time adjacent to a compile
+is compile cost for attribution purposes, and warm (steady-state)
+phases never compile, so their execute numbers are untouched. Per-call
+exactness would need a per-call compile signal jax does not expose."""
 
 from __future__ import annotations
 
@@ -175,27 +181,41 @@ def instrument_kernel(kernel, name: str, jits=None):
     # retrace counter has already charged: two threads racing ONE
     # first trace both observe the cache grow, but only the first to
     # take the lock books it — the loser passes reason=None (compile
-    # time still recorded, no phantom "shape" retrace)
+    # time still recorded, no phantom "shape" retrace).
+    # `active` holds every in-flight call (token -> overlapped-a-
+    # compile flag): the accounting call marks the others, so a call
+    # whose `before` sample landed AFTER a concurrent compile's cache
+    # growth still classifies its (compile-lock-blocked) wall as
+    # compile — see the module docstring's concurrency contract
     state = {"traced": False, "accounted": 0,
-             "lock": threading.Lock()}
+             "lock": threading.Lock(), "active": {}}
 
     def wrapped(*args, **kwargs):
         if not ENABLED:
             return kernel(*args, **kwargs)
+        tok = object()
+        with state["lock"]:
+            state["active"][tok] = False
         before = _cache_sizes(jits)
         t0 = time.perf_counter_ns()
-        out = kernel(*args, **kwargs)
+        try:
+            out = kernel(*args, **kwargs)
+        except BaseException:
+            with state["lock"]:
+                state["active"].pop(tok, None)
+            raise
         dur = time.perf_counter_ns() - t0
         after = _cache_sizes(jits)
-        compiled = before >= 0 and after > before
         reason = None
-        if compiled:
-            with state["lock"]:
-                if after > state["accounted"]:
-                    reason = "shape" if state["traced"] \
-                        else "new_kernel"
-                    state["traced"] = True
-                    state["accounted"] = after
+        with state["lock"]:
+            overlapped = state["active"].pop(tok, False)
+            compiled = (before >= 0 and after > before) or overlapped
+            if compiled and after > state["accounted"]:
+                reason = "shape" if state["traced"] else "new_kernel"
+                state["traced"] = True
+                state["accounted"] = after
+                for k in state["active"]:
+                    state["active"][k] = True
         record(name, dur, compiled, reason)
         if _trace.ACTIVE:
             rec = _trace.current()

@@ -140,6 +140,8 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
     execute0 = METRICS.total("presto_tpu_kernel_execute_ns_total")
     fam0 = METRICS.by_label("presto_tpu_kernel_compiles_total",
                             "kernel")
+    fuse0 = METRICS.by_label("presto_tpu_fused_fragments_total",
+                             "status")
     threads = [threading.Thread(target=client, args=(i, work))
                for i, work in enumerate(assignments)]
     for t in threads:
@@ -172,6 +174,11 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
              - execute0) / 1e6, 1),
         "distinct_compiles": distinct,
         "fresh_compiles": int(sum(distinct.values())),
+        # whole-fragment fusion coverage of the phase (planner pass
+        # counters; plan-cache hits re-run the pass per execution, so
+        # every query of the phase contributes)
+        "fused_fragments": METRICS.delta_by_label(
+            "presto_tpu_fused_fragments_total", "status", fuse0),
     }
     if tolerant:
         total = n + len(errors)
@@ -203,6 +210,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       chaos_spec: str = DEFAULT_CHAOS_SPEC,
                       restart_warm: bool = False,
                       cache_dir: Optional[str] = None,
+                      fusion_report: bool = False,
                       host: str = "127.0.0.1") -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
@@ -220,7 +228,8 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             warm_rounds=warm_rounds, verify_off=verify_off,
             chaos=chaos, chaos_rounds=chaos_rounds,
             chaos_spec=chaos_spec, restart_warm=restart_warm,
-            cache_dir=cache_dir, host=host)
+            cache_dir=cache_dir, fusion_report=fusion_report,
+            host=host)
     finally:
         if auto_cache_dir is not None:
             import shutil
@@ -233,7 +242,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    warm_rounds: int, verify_off: bool, chaos: bool,
                    chaos_rounds: int, chaos_spec: str,
                    restart_warm: bool, cache_dir: Optional[str],
-                   host: str) -> dict:
+                   fusion_report: bool, host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
@@ -365,6 +374,19 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                 "restart-warm phase performed fresh compiles: "
                 + json.dumps(restart["distinct_compiles"]))
 
+    fusion = None
+    if fusion_report:
+        # per-query fragments fused vs fallen back (with reasons) —
+        # observed on a caches-off runner so fragment-cache replays
+        # can't hide the chains the pass would have seen
+        from presto_tpu.runner.local import LocalRunner
+        from presto_tpu.tools.fusion_report import build_report
+        fr_runner = LocalRunner("tpch", schema, properties={
+            "plan_cache_enabled": False,
+            "fragment_result_cache_enabled": False,
+            "page_source_cache_enabled": False})
+        fusion = build_report(fr_runner, sqls)
+
     cache_stats = {name: level.stats.snapshot() for name, level in
                    (("plan", mgr.plan), ("fragment", mgr.fragment),
                     ("page", mgr.page))}
@@ -388,6 +410,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "results_identical": identical,
         "cache": cache_stats,
         "chaos": chaos_doc,
+        "fusion": fusion,
     }
     if not identical:
         raise RuntimeError(
@@ -425,6 +448,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--cache-dir", default=None,
                    help="persistent XLA compilation cache directory "
                         "(default: a fresh tmpdir when --restart-warm)")
+    p.add_argument("--fusion-report", action="store_true",
+                   help="embed the per-query whole-fragment fusion "
+                        "coverage (fused chains + fallback reasons, "
+                        "tools/fusion_report.py) in the output JSON")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
     doc = run_serving_bench(
@@ -433,7 +460,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         warm_rounds=args.warm_rounds, verify_off=not args.skip_off,
         chaos=args.chaos, chaos_rounds=args.chaos_rounds,
         chaos_spec=args.chaos_spec, restart_warm=args.restart_warm,
-        cache_dir=args.cache_dir)
+        cache_dir=args.cache_dir, fusion_report=args.fusion_report)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

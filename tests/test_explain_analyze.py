@@ -20,8 +20,10 @@ def test_explain_analyze_local():
     # fewer rows than the table holds
     scanned = int(m.group(1).replace(",", ""))
     assert scanned > 3000
-    # the agg collapses the filtered rows to 3 groups
-    m = re.search(r"aggregation\(single\) \[id=\d+\]  "
+    # the agg collapses the filtered rows to 3 groups; under whole-
+    # fragment fusion the operator renders as
+    # fused[filter_project+aggregation(single)]
+    m = re.search(r"aggregation\(single\)\]? \[id=\d+\]  "
                   r"rows: ([\d,]+) -> 3", text)
     assert m, text
     filtered = int(m.group(1).replace(",", ""))
