@@ -93,6 +93,14 @@ def shape_buckets_on() -> bool:
     return SHAPE_BUCKETS_DEFAULT if on is None else bool(on)
 
 
+def shape_buckets_override():
+    """This thread's raw override (None = process default) — the task
+    executor captures it at statement submit and re-installs it around
+    every quantum, so pool workers honor the statement's
+    `kernel_shape_buckets` exactly like the submitting thread did."""
+    return getattr(_SHAPE_TL, "on", None)
+
+
 def kernel_capacity(n: int) -> int:
     """THE capacity ladder kernel-facing shapes land on when bucketing
     is enabled (quantized_capacity: power-of-4, floor 4096)."""

@@ -17,6 +17,12 @@ seams:
     page_source.next    every batch a connector page source yields
     cache.put           ResultCache.put (absorbed as a rejection —
                         a best-effort cache must never fail a query)
+    executor.quantum    every TaskExecutor time slice, before the
+                        lifecycle checkpoint (fails the owning query
+                        cleanly mid-execution)
+    admission.enqueue   ResourceGroupManager.submit (fails one
+                        query's admission cleanly; the coordinator
+                        absorbs it as a per-query failure)
 
 Zero overhead when disarmed: every site guards its fire() call with
 the module-level ``ARMED`` bool, so the cold path pays one attribute
@@ -52,6 +58,12 @@ _APPLIED_SPEC: Optional[str] = None
 SITES = (
     "exchange.push", "exchange.pop", "task.dispatch",
     "operator.add_input", "page_source.next", "cache.put",
+    # the concurrency seams (execution/task_executor.py +
+    # resource_groups.py): every scheduled time slice crosses
+    # executor.quantum, every query's admission crosses
+    # admission.enqueue — chaos tests fail queries mid-schedule or
+    # at the front door without monkeypatching
+    "executor.quantum", "admission.enqueue",
 )
 
 

@@ -18,6 +18,17 @@ from presto_tpu.telemetry import trace as _trace
 
 
 class Driver:
+    #: quantum results (execution/task_executor.py): FINISHED = no
+    #: more work ever; BLOCKED = an operator reports is_blocked(), the
+    #: worker should park this driver instead of busy-spinning;
+    #: PROGRESS = the quantum expired with work left; IDLE = nothing
+    #: moved and nothing blocked (state machines may need another
+    #: pass — finish propagation, deferred flushes)
+    FINISHED = "finished"
+    BLOCKED = "blocked"
+    PROGRESS = "progress"
+    IDLE = "idle"
+
     def __init__(self, operators: List[Operator]):
         assert operators, "driver needs at least one operator"
         self.operators = operators
@@ -25,6 +36,46 @@ class Driver:
 
     def is_finished(self) -> bool:
         return self._closed or self.operators[-1].is_finished()
+
+    def blocked_reason(self) -> Optional[str]:
+        """Name of the first blocked operator, or None. The executor
+        parks a driver on any blocked operator — the serial loop's
+        per-PAIR skip degenerates to the same thing one level up,
+        because a blocked stage starves its neighbors within a few
+        passes anyway."""
+        for op in self.operators:
+            if op.is_blocked():
+                return op.ctx.name
+        return None
+
+    def process_quantum(self, quantum_s: float):
+        """Run passes over the operator chain until `quantum_s` of
+        wall clock elapses, the driver finishes, blocks, or stops
+        moving. Returns (status, progressed): one of the class status
+        constants plus whether ANY batch moved this quantum — the
+        executor's progress/idle accounting and its wake-parked-
+        siblings signal both key off `progressed`.
+
+        blocked_ns stays correct across quantum suspensions: the
+        open-window marks (`ctx._blocked_since`) live on the operator
+        contexts and are wall-clock anchored, and a driver is owned by
+        at most one worker at a time — parked wall time IS blocked
+        wall time, exactly what the serial loop measured."""
+        deadline = time.perf_counter() + quantum_s
+        progressed = False
+        while True:
+            if self.is_finished():
+                return self.FINISHED, progressed
+            moved = self._process_once()
+            progressed = progressed or moved
+            if self.is_finished():
+                return self.FINISHED, progressed
+            if not moved:
+                if self.blocked_reason() is not None:
+                    return self.BLOCKED, progressed
+                return self.IDLE, progressed
+            if time.perf_counter() >= deadline:
+                return self.PROGRESS, progressed
 
     def process(self, max_iterations: int = 1) -> bool:
         """Run up to `max_iterations` passes over the operator chain

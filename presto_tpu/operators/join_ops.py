@@ -796,6 +796,12 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self._fused_filter = None
         self._fused_projections = None
         self._fused_dicts = None
+        #: estimated surviving-row fraction of the PLANNING-TIME fused
+        #: filter (probe-tail fusion; None = no filter / unknown) —
+        #: read by planner/fusion.py so chains this probe feeds into
+        #: fold terminals inherit the sparsity its in-trace filter
+        #: leaves behind
+        self.fused_selectivity = None
         self._pre = None        # (body, chain_key) upstream chain
         self._kernels = None
 
@@ -808,17 +814,22 @@ class LookupJoinOperatorFactory(OperatorFactory):
     def pre_fused(self) -> bool:
         return self._pre is not None
 
-    def fuse(self, filter_expr, projections, input_dicts=None) -> None:
+    def fuse(self, filter_expr, projections, input_dicts=None,
+             selectivity=None) -> None:
         """Planner peephole: absorb the FilterProject that would
         otherwise follow this join, so the expression forest evaluates
         inside the probe dispatch (expanded rows materialize ONCE).
-        Only legal before the first create()."""
+        `selectivity` is the absorbed filter's estimated surviving
+        fraction (kept on `fused_selectivity` for the fusion pass's
+        selective-chain gate). Only legal before the first create()."""
         assert self._kernels is None, "fuse() after create()"
         assert not self.fused, "join already fused a projection"
         self._fused_filter = filter_expr
         self._fused_projections = list(projections) if projections \
             else None
         self._fused_dicts = input_dicts
+        if filter_expr is not None:
+            self.fused_selectivity = selectivity
 
     def fuse_pre(self, pre, pre_key, name: str) -> None:
         """Whole-fragment fusion (planner/fusion.py): absorb the

@@ -97,6 +97,11 @@ class _Candidate:
     #: per-conjunct heuristics — see SELECTIVE_CHAIN_THRESHOLD); a
     #: stage with no estimate at all contributes 1.0 (fusion stays on)
     sel: float = 1.0
+    #: True when the factory FEEDING this chain is a prefused probe
+    #: whose in-trace filter carries a selectivity estimate: its dead
+    #: lanes ride into the chain uncompacted, so the gate must treat
+    #: the chain as selective even when the chain itself only projects
+    pre_selective: bool = False
 
 
 def fuse_pipelines(pipelines: List[List], node_ops=None,
@@ -149,6 +154,16 @@ def fuse_pipelines(pipelines: List[List], node_ops=None,
                               [f.operator_id])
             if getattr(f, "selectivity", None) is not None:
                 cand.sel *= f.selectivity
+            # a prefused lookup-join probe feeding this chain: its
+            # in-trace filter's survivors estimate multiplies in (the
+            # probe hands the chain uncompacted dead lanes — folding
+            # the chain into a terminal would hand THOSE to the fold)
+            prev = pipe[i - 1] if i > 0 else None
+            if isinstance(prev, LookupJoinOperatorFactory):
+                pre_sel = getattr(prev, "fused_selectivity", None)
+                if pre_sel is not None:
+                    cand.sel *= pre_sel
+                    cand.pre_selective = True
             j = i + 1
             while j < len(pipe):
                 nxt = pipe[j]
@@ -227,7 +242,8 @@ def _apply(pipe: List, cand: _Candidate, terminal, end: int,
     # which beats saving the compact round. The chain itself still
     # collapses (compaction runs once, at its tail). ----------------
     if isinstance(terminal, _FOLD_TERMINALS) \
-            and ff.chain_selective(cand.stages) \
+            and (ff.chain_selective(cand.stages)
+                 or cand.pre_selective) \
             and cand.sel < SELECTIVE_CHAIN_THRESHOLD:
         if len(cand.names) >= 2:
             name = _collapse_chain(pipe, cand, end, chain_key,

@@ -505,7 +505,13 @@ class LocalExecutionPlanner:
         tail = pipe[-1] if pipe else None
         if isinstance(tail, LookupJoinOperatorFactory) \
                 and not tail.fused:
-            tail.fuse(filter_expr, projections, input_dicts)
+            # probe-tail fusion keeps the selectivity estimate: the
+            # in-trace filter leaves its dead lanes to the deferred-
+            # compact protocol, so a chain the probe later feeds into
+            # a fold terminal must inherit this fraction or the
+            # fusion pass's selective-chain gate goes blind here
+            tail.fuse(filter_expr, projections, input_dicts,
+                      selectivity=selectivity)
             return
         pipe.append(FilterProjectOperatorFactory(
             self._next_id(), filter_expr, projections, input_dicts,
@@ -527,6 +533,30 @@ class LocalExecutionPlanner:
             if inner <= 0:
                 return None
             return min(1.0, self._stats.estimate(node).rows / inner)
+        except Exception:  # noqa: BLE001 — stats are advisory
+            return None
+
+    def _est_predicate_selectivity(self, source_node, predicate):
+        """Estimated surviving fraction of a bare predicate over
+        `source_node`'s rows — the join-filter analog of
+        _est_selectivity (a join's residual filter never lives in a
+        FilterNode, but its FilterProject must still carry an
+        estimate or selective join filters always fold into their
+        terminals). StatsEstimator's JoinNode estimate ignores the
+        node's own filter, so estimating the join and applying the
+        predicate's selectivity on top does not double-count."""
+        try:
+            if self._stats is None:
+                from presto_tpu.planner.stats import StatsEstimator
+                self._stats = StatsEstimator(self.catalogs)
+            inner = self._stats.estimate(source_node)
+            if inner.rows <= 0:
+                return None
+            from presto_tpu.planner.stats import (
+                predicate_selectivity,
+            )
+            return min(1.0, max(0.0, predicate_selectivity(
+                predicate, inner)))
         except Exception:  # noqa: BLE001 — stats are advisory
             return None
 
@@ -797,8 +827,10 @@ class LocalExecutionPlanner:
                 (f.symbol, compile_expression(
                     InputRef(f.symbol, f.type), schema))
                 for f in node.output]
-            self._append_filter_project(pipe, pred, projections,
-                                        _schema_dicts(schema))
+            self._append_filter_project(
+                pipe, pred, projections, _schema_dicts(schema),
+                selectivity=self._est_predicate_selectivity(
+                    node, node.filter))
 
     def _cross_df_publish(self, node) -> List[tuple]:
         """Cross-fragment publications this join owes the query-wide

@@ -317,3 +317,13 @@ def _selectivity(pred, inner: PlanStats
 
     s = sel(pred)
     return max(min(s, 1.0), 1e-9), cols
+
+
+def predicate_selectivity(pred, inner: PlanStats) -> float:
+    """Public face of _selectivity for callers holding a bare
+    predicate over an already-estimated input (the planner's
+    join-filter FilterProjects, whose predicate never lives in a
+    FilterNode): the estimated surviving-row fraction, same
+    reference FilterStatsCalculator heuristics — including the 0.33
+    per-conjunct default when column stats are absent."""
+    return _selectivity(pred, inner)[0]

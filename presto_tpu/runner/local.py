@@ -328,7 +328,8 @@ class LocalRunner:
     def __init__(self, catalog: str = "tpch", schema: str = "tiny",
                  properties: Optional[Dict[str, Any]] = None,
                  user: str = "", access_control=None,
-                 compilation_cache_dir: Optional[str] = None):
+                 compilation_cache_dir: Optional[str] = None,
+                 resource_groups=None):
         # persistent XLA compilation cache: explicit arg wins, else
         # the PRESTO_TPU_COMPILATION_CACHE_DIR env surface (both
         # process-global — jax holds one cache dir)
@@ -362,6 +363,15 @@ class LocalRunner:
         self.session = Session(catalog, schema, dict(properties or {}),
                                user=user)
         self.catalogs.access_control = access_control
+        #: optional admission control for EMBEDDED callers (a
+        #: ResourceGroupManager): every execute() then submits through
+        #: per-user fair queueing + caps before planning, and sheds
+        #: with structured QueryError kinds instead of piling up.
+        #: None (the default) = unguarded, the classic local runner.
+        #: The single-node coordinator admits at its HTTP layer and
+        #: builds its embedded runner WITHOUT one — admission must
+        #: gate each query exactly once.
+        self.resource_groups = resource_groups
         self._load_plugins()
 
     def _load_plugins(self) -> None:
@@ -554,6 +564,121 @@ class LocalRunner:
         if limit_ms:
             d = _time.monotonic() + float(limit_ms) / 1000.0
             deadline = d if deadline is None else min(deadline, d)
+        if self.resource_groups is None:
+            return self._execute_admitted(sql, cancel, deadline)
+        # embedded admission control: submit through the runner's
+        # resource groups (per-user fair queueing, caps, shedding)
+        # before any planning work happens; the released slot
+        # dispatches the next queued query weighted-fair
+        group, mem, queued_ms = self._admit(cancel, deadline)
+        self._session_tl.queued_ms = queued_ms
+        try:
+            return self._execute_admitted(sql, cancel, deadline)
+        finally:
+            self._session_tl.queued_ms = 0.0
+            # release EXACTLY the reservation _admit charged — the
+            # statement may have mutated query_memory_bytes (SET
+            # SESSION), and recomputing here would corrupt the
+            # group's memory ledger permanently
+            self.resource_groups.finish(group, mem)
+
+    def _admit(self, cancel, deadline: Optional[float]):
+        """Submit this statement to the runner's ResourceGroupManager
+        under the session identity. Returns (group_path,
+        charged_memory_bytes, queued_ms) once a slot is granted;
+        raises structured QueryErrors for
+        every shed/kill shape: kind="rejected" (no selector match,
+        impossible reservation, admission_queue_timeout_ms shed),
+        kind="queue_full" (queue bound), kind="deadline_exceeded"
+        (query_max_run_time_ms expired WHILE QUEUED — the query never
+        schedules), kind="cancelled" (killed while queued). A query
+        failed here charged no slot, no MemoryPool reservation, and
+        no lifecycle task — there is nothing to leak."""
+        import time as _time
+        from presto_tpu.execution.resource_groups import QueryRejected
+        from presto_tpu.session_properties import get_property
+        from presto_tpu.telemetry.metrics import METRICS
+        s = self.session
+        mem = int(get_property(s.properties,
+                               "query_memory_bytes") or 0)
+        qt_ms = get_property(s.properties,
+                             "admission_queue_timeout_ms")
+        qdeadline = deadline
+        shed_kind = "deadline_exceeded"
+        if qt_ms:
+            qd = _time.monotonic() + float(qt_ms) / 1000.0
+            if qdeadline is None or qd < qdeadline:
+                qdeadline = qd
+                shed_kind = "rejected"
+        ev = _threading.Event()
+        # ONE bound-method object for submit AND cancel_queued: the
+        # manager matches queued entries by callback IDENTITY, and
+        # every `ev.set` attribute access mints a fresh bound method
+        # — passing a second one could never match
+        dispatch = ev.set
+        expired: List[str] = []
+
+        def on_expire():
+            expired.append(shed_kind)
+            ev.set()
+
+        def shed_error():
+            if shed_kind == "rejected":
+                return QueryError(
+                    "query shed: queue wait exceeded "
+                    "admission_queue_timeout_ms", kind="rejected")
+            return QueryError(
+                "query exceeded query_max_run_time_ms while queued",
+                kind="deadline_exceeded")
+
+        try:
+            state, group = self.resource_groups.submit(
+                getattr(s, "user", ""), "", mem,
+                on_dispatch=dispatch,
+                deadline=qdeadline, on_expire=on_expire)
+        except QueryRejected as e:
+            err = QueryError(str(e),
+                             kind=getattr(e, "kind", None)
+                             or "rejected")
+            METRICS.inc("presto_tpu_queries_total", state="FAILED",
+                        error_kind=err.kind)
+            raise err from e
+        if state == "run":
+            return group, mem, 0.0
+        t0 = _time.monotonic()
+        while not ev.wait(0.05):
+            if cancel is not None and cancel():
+                if self.resource_groups.cancel_queued(group,
+                                                      dispatch):
+                    METRICS.inc("presto_tpu_queries_total",
+                                state="FAILED",
+                                error_kind="cancelled")
+                    raise QueryError("query cancelled",
+                                     kind="cancelled")
+            if qdeadline is not None \
+                    and _time.monotonic() > qdeadline:
+                if self.resource_groups.cancel_queued(group,
+                                                      dispatch):
+                    err = shed_error()
+                    METRICS.inc("presto_tpu_queries_total",
+                                state="FAILED", error_kind=err.kind)
+                    raise err
+                # lost the race to a concurrent dispatch: run — the
+                # deadline trips at the first drive checkpoint
+        if expired:
+            # the manager's own sweep dropped the entry (no slot was
+            # ever charged)
+            err = shed_error()
+            METRICS.inc("presto_tpu_queries_total", state="FAILED",
+                        error_kind=err.kind)
+            raise err
+        return group, mem, (_time.monotonic() - t0) * 1000.0
+
+    def _execute_admitted(self, sql: str, cancel,
+                          deadline: Optional[float]
+                          ) -> MaterializedResult:
+        import time as _time
+        from presto_tpu.session_properties import get_property
         # session-property fault channel: applied (or, when the
         # property is empty/absent again, REMOVED) idempotently —
         # ensure_spec never touches API/env-armed injections
@@ -956,7 +1081,12 @@ class LocalRunner:
     def _new_history_entry(self, sql: str) -> Dict[str, Any]:
         entry = {"id": next(self._query_id_mint), "sql": sql.strip(),
                  "state": "RUNNING", "rows": 0, "elapsed_ms": 0.0,
-                 "error_kind": None, "queued_ms": 0.0,
+                 "error_kind": None,
+                 # admission queue wait (embedded resource groups):
+                 # per-query queued_ms attribution rides the history
+                 # entry into system.runtime.queries
+                 "queued_ms": round(float(getattr(
+                     self._session_tl, "queued_ms", 0.0) or 0.0), 3),
                  "compile_ms": 0.0, "execute_ms": 0.0}
         self.query_history.append(entry)
         del self.query_history[:-1000]  # bounded history
@@ -1029,13 +1159,24 @@ class LocalRunner:
             )
             from presto_tpu.execution.memory import MemoryLimitExceeded
             cancel, deadline = self._lifecycle()
+            # the time-sliced executor (default on): every statement
+            # of this process time-shares one worker pool instead of
+            # monopolizing its submitting thread round after round
+            from presto_tpu.execution.task_executor import (
+                executor_for_session,
+            )
+            executor = executor_for_session(session.properties)
+            quantum_ms = get_property(session.properties,
+                                      "task_executor_quantum_ms")
             try:
                 try:
                     drivers = self.drive_pipelines(lplan.pipelines,
                                                    profile=profile,
                                                    pool=pool,
                                                    cancel=cancel,
-                                                   deadline=deadline)
+                                                   deadline=deadline,
+                                                   executor=executor,
+                                                   quantum_ms=quantum_ms)
                 finally:
                     if cm is not None:
                         cm.finish_query(cm_qid)
@@ -1097,10 +1238,15 @@ class LocalRunner:
                         max_idle_s: float = 600.0,
                         profile: bool = False,
                         pool=None, cancel=None,
-                        deadline: Optional[float] = None
-                        ) -> List[Driver]:
-        """Round-robin all drivers to completion (the TaskExecutor
-        stand-in; shared by the local runner and worker tasks).
+                        deadline: Optional[float] = None,
+                        executor=None,
+                        quantum_ms: Optional[float] = None,
+                        abort_check=None) -> List[Driver]:
+        """Drive all pipelines' drivers to completion — on the shared
+        time-sliced TaskExecutor when `executor` is given (the
+        default production path: _run_plan and worker tasks resolve
+        it from the `task_executor_enabled` session property), else
+        on the legacy serial round-robin loop below.
 
         Progress is judged by wall clock, not round count: a task whose
         input arrives over the network exchange (a producer on another
@@ -1108,40 +1254,54 @@ class LocalRunner:
         no-progress rounds sleep briefly and only a `max_idle_s` stretch
         with zero progress is treated as a deadlock.
 
-        `cancel` is an optional () -> bool polled each round — the
-        cooperative kill point shared by task abort, client kill, and
-        query abandonment. `deadline` is an optional time.monotonic()
-        instant checked at the same cadence (per-query
-        query_max_run_time_ms): a runaway query terminates within one
-        drive-loop round of either tripping, releasing its drivers
-        (and their device buffers) through the error path."""
+        `cancel` is an optional () -> bool polled each round/quantum —
+        the cooperative kill point shared by task abort, client kill,
+        and query abandonment. `deadline` is an optional
+        time.monotonic() instant checked at the same cadence
+        (per-query query_max_run_time_ms): a runaway query terminates
+        within one round/quantum of either tripping, releasing its
+        drivers (and their device buffers) through the error path.
+        `abort_check` is an optional () -> exception|None polled at
+        the same checkpoints (the distributed root drive's remote-
+        task-failed signal)."""
         import time as _time
         dctx = DriverContext(profile=profile, memory=pool)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
-        idle_since: Optional[float] = None
-        while True:
-            check_lifecycle(cancel, deadline)
-            all_done = True
-            progress = False
-            for d in drivers:
-                if d.is_finished():
+        if executor is not None:
+            executor.run_drivers(drivers, cancel=cancel,
+                                 deadline=deadline,
+                                 quantum_ms=quantum_ms,
+                                 abort_check=abort_check,
+                                 max_idle_s=max_idle_s)
+        else:
+            idle_since: Optional[float] = None
+            while True:
+                check_lifecycle(cancel, deadline)
+                if abort_check is not None:
+                    exc = abort_check()
+                    if exc is not None:
+                        raise exc
+                all_done = True
+                progress = False
+                for d in drivers:
+                    if d.is_finished():
+                        continue
+                    all_done = False
+                    progress = d.process() or progress
+                if all_done:
+                    break
+                if progress:
+                    idle_since = None
                     continue
-                all_done = False
-                progress = d.process() or progress
-            if all_done:
-                break
-            if progress:
-                idle_since = None
-                continue
-            now = _time.monotonic()
-            if idle_since is None:
-                idle_since = now
-            elif now - idle_since > max_idle_s:
-                raise QueryError(
-                    f"query made no progress for {max_idle_s:.0f}s "
-                    "(deadlock?)")
-            _time.sleep(0.002)
+                now = _time.monotonic()
+                if idle_since is None:
+                    idle_since = now
+                elif now - idle_since > max_idle_s:
+                    raise QueryError(
+                        f"query made no progress for {max_idle_s:.0f}s "
+                        "(deadlock?)")
+                _time.sleep(0.002)
         # sync-free error protocol: ONE host fetch for every deferred
         # device flag (join capacity overflow etc.), after all drivers
         # finished but before results are trusted

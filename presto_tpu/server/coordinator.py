@@ -133,6 +133,10 @@ class _Query:
         self.last_poll = time.monotonic()
         self.created_at = time.monotonic()
         self.run_started_at: Optional[float] = None  # leaves QUEUED
+        #: queue-wait deadline (monotonic) + the structured kind an
+        #: expiry sheds with (see Coordinator._stamp_queue_deadline)
+        self.queue_deadline: Optional[float] = None
+        self.queue_shed_kind: Optional[str] = None
         self.lifecycle = QueryLifecycle()
         #: QueryStats tree (telemetry.build_query_stats) — served by
         #: GET /v1/query/{id} and shipped to event listeners
@@ -297,16 +301,35 @@ class Coordinator(Node):
             # admission decided synchronously AT SUBMIT so queue
             # accounting can't race the worker thread: the resource-
             # group manager either grants a slot, parks the dispatch
-            # callback, or rejects on a full queue
+            # callback, or SHEDS with a structured kind — overload is
+            # absorbed as rejected/queue_full failures, never as
+            # collapse
             dispatched = threading.Event()
             q.dispatch = dispatched.set
+            self._stamp_queue_deadline(q)
             try:
                 state, q.group = self.resource_groups.submit(
                     q.user, q.source, self._query_memory(),
-                    on_dispatch=q.dispatch)
+                    on_dispatch=q.dispatch,
+                    deadline=q.queue_deadline,
+                    on_expire=lambda: self._expire_queued_query(q))
             except QueryRejected as e:
                 q.state = "FAILED"
                 q.error = str(e)
+                q.error_kind = e.kind
+                q.done_at = time.monotonic()
+                self.queries[q.id] = q
+                return json.dumps({
+                    "id": q.id,
+                    "nextUri": f"{self.url}/v1/statement/"
+                               f"executing/{q.id}/0"}).encode()
+            except Exception as e:  # noqa: BLE001 — e.g. an injected
+                # admission fault (faults site admission.enqueue):
+                # still a CLEAN per-query failure, never a 500 that
+                # takes the submit endpoint down
+                q.state = "FAILED"
+                q.error = f"{type(e).__name__}: {e}"
+                q.error_kind = getattr(e, "kind", None)
                 q.done_at = time.monotonic()
                 self.queries[q.id] = q
                 return json.dumps({
@@ -327,6 +350,51 @@ class Coordinator(Node):
                            f"{q.id}/0",
             }).encode()
         return super().handle_post(path, body, headers)
+
+    def _stamp_queue_deadline(self, q: _Query) -> None:
+        """Derive the instant after which a QUEUED query is dead:
+        query_max_run_time_ms (which counts queue time and fails with
+        deadline_exceeded) and/or admission_queue_timeout_ms (pure
+        load shedding, kind="rejected") — the earlier wins, and its
+        kind is remembered for the expiry path."""
+        from presto_tpu.session_properties import get_property
+        q.queue_deadline = None
+        q.queue_shed_kind = None
+        limit_ms = get_property(self.properties,
+                                "query_max_run_time_ms")
+        if limit_ms:
+            q.queue_deadline = q.created_at + float(limit_ms) / 1000.0
+            q.queue_shed_kind = "deadline_exceeded"
+        qt_ms = get_property(self.properties,
+                             "admission_queue_timeout_ms")
+        if qt_ms:
+            qd = q.created_at + float(qt_ms) / 1000.0
+            if q.queue_deadline is None or qd < q.queue_deadline:
+                q.queue_deadline = qd
+                q.queue_shed_kind = "rejected"
+
+    def _expire_queued_query(self, q: _Query) -> bool:
+        """A queued query's deadline passed WITHOUT it ever being
+        scheduled: fail it with the structured kind, release its
+        waiting runner thread, and charge nothing — no slot was held,
+        no MemoryPool entry exists, no lifecycle task ever started.
+        Idempotent (the manager sweep and the waiting thread race to
+        call this)."""
+        if q.done_at is not None or q.state != "QUEUED":
+            return False
+        kind = q.queue_shed_kind or "rejected"
+        q.state = "FAILED"
+        q.error = ("query exceeded query_max_run_time_ms while "
+                   "queued" if kind == "deadline_exceeded" else
+                   "query shed: queue wait exceeded "
+                   "admission_queue_timeout_ms")
+        q.error_kind = kind
+        q.done_at = time.monotonic()
+        q.lifecycle.kill_kind = kind
+        q.lifecycle.cancel.set()
+        if q.dispatch is not None:
+            q.dispatch()  # unblock the waiting runner thread
+        return True
 
     def _query_memory(self) -> int:
         """Declared per-query memory reservation charged against the
@@ -502,6 +570,9 @@ th{{background:#222}}
         client protocol's abandonment semantics in the Presto
         paper)."""
         now = time.monotonic()
+        # queue-wait deadlines must fire on an otherwise-idle
+        # coordinator too (no submit/finish traffic = no sweeps)
+        self.resource_groups.expire_queued()
         for q in list(self.queries.values()):
             if q.done_at is not None:
                 continue
@@ -571,10 +642,16 @@ th{{background:#222}}
         # admission: wait for the group's dispatch callback (QUEUED
         # state is client-visible while waiting). An abandoned queued
         # query (client stopped polling) is cancelled by the pruner —
-        # its queue position frees without running.
+        # its queue position frees without running — and a queue-wait
+        # deadline expires HERE, precisely, without ever scheduling:
+        # the manager sweep drops the entry and _expire_queued_query
+        # marks the failure.
         if not has_slot:
-            dispatched.wait()
-            if q.state == "FAILED":  # cancelled while queued
+            while not dispatched.wait(
+                    0.25 if q.queue_deadline is not None else None):
+                if time.monotonic() > q.queue_deadline:
+                    self.resource_groups.expire_queued()
+            if q.state == "FAILED":  # cancelled/expired while queued
                 return
         q.state = "RUNNING"
         q.run_started_at = time.monotonic()
@@ -1045,7 +1122,8 @@ th{{background:#222}}
             drivers = self._drive_with_failures(
                 pipelines, failure, profile=profile,
                 cancel=lifecycle.cancel.is_set,
-                deadline=lifecycle.deadline)
+                deadline=lifecycle.deadline,
+                properties=properties)
             wall_s = _time.perf_counter() - t0
             # the attempt's counter dict is live on this thread (the
             # shell owns begin/end); snapshot it now so the stats
@@ -1208,17 +1286,36 @@ th{{background:#222}}
                              max_idle_s: float = 600.0,
                              profile: bool = False,
                              cancel=None,
-                             deadline: Optional[float] = None):
+                             deadline: Optional[float] = None,
+                             properties: Optional[dict] = None):
         """The coordinator's OWN drive loop (root + single-partition
         fragments) — it polls the same cancel hook and deadline as
         worker tasks do, so a kill stops the whole topology, not just
-        the remote fringe."""
+        the remote fringe. With the time-sliced executor enabled
+        (default), the drivers run on the process-wide worker pool
+        and the remote-task-failed signal rides the abort_check
+        checkpoint at every quantum boundary."""
         from presto_tpu.operators.base import DriverContext
         from presto_tpu.operators.driver import Driver
         from presto_tpu.runner.local import check_lifecycle
         dctx = DriverContext(profile=profile)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
+        from presto_tpu.execution.task_executor import (
+            executor_for_session,
+        )
+        executor = executor_for_session(properties or {})
+        if executor is not None:
+            from presto_tpu.operators.base import run_deferred_checks
+            from presto_tpu.session_properties import get_property
+            executor.run_drivers(
+                drivers, cancel=cancel, deadline=deadline,
+                quantum_ms=get_property(properties or {},
+                                        "task_executor_quantum_ms"),
+                abort_check=lambda: failure[0] if failure else None,
+                max_idle_s=max_idle_s, label="coordinator-root")
+            run_deferred_checks(dctx)
+            return drivers
         idle_since = None
         while True:
             if failure:

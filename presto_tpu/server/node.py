@@ -513,7 +513,17 @@ class Node:
         self.n_devices = max(1, int(n_devices))
         self.tasks: Dict[str, TaskState] = {}
         handler = type("BoundHandler", (NodeHandler,), {"node": self})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+
+        class _Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog is 5: at 64+
+            # concurrent clients the SYN queue overflows and the
+            # kernel RESETS connections — the exact collapse mode the
+            # overload story exists to prevent. Admission control is
+            # the real gate; the listener must be deep enough that
+            # every client REACHES it (serving_bench --clients 256)
+            request_queue_size = 1024
+            daemon_threads = True
+        self.httpd = _Server((host, port), handler)
         self.port = self.httpd.server_address[1]
         self.url = f"http://{host}:{self.port}"
         self._thread = threading.Thread(
@@ -733,10 +743,20 @@ class Node:
             pipelines.extend(
                 planner.plan_fragment(fragment.root, sinks))
         t0 = time.perf_counter()
+        # worker tasks time-share the node's executor pool too (the
+        # session property gates per statement, like shape buckets)
+        from presto_tpu.execution.task_executor import (
+            executor_for_session,
+        )
+        from presto_tpu.session_properties import get_property
+        props = spec["session"].get("properties") or {}
         drivers = LocalRunner.drive_pipelines(
             pipelines,
             profile=bool(spec.get("profile")),
-            cancel=cancel.is_set if cancel is not None else None)
+            cancel=cancel.is_set if cancel is not None else None,
+            executor=executor_for_session(props),
+            quantum_ms=get_property(props,
+                                    "task_executor_quantum_ms"))
         return {"wall_s": round(time.perf_counter() - t0, 6),
                 "pipelines": LocalRunner.snapshot_driver_stats(drivers)}
 

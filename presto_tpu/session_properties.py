@@ -191,6 +191,31 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "chain via tools/fusion_report.py "
         "(docs/FRAGMENT_COMPILATION.md)"),
     PropertyDef(
+        "task_executor_enabled", "boolean", True,
+        "Drive this statement's pipelines on the process-wide "
+        "time-sliced TaskExecutor (worker pool + multilevel feedback "
+        "queue, execution/task_executor.py) instead of a private "
+        "serial round-robin loop: many queries interleave in bounded "
+        "quanta, cancellation/deadlines fire at quantum boundaries, "
+        "and blocked drivers yield their worker "
+        "(docs/CONCURRENCY.md)"),
+    PropertyDef(
+        "task_executor_quantum_ms", "bigint", 25,
+        "Time slice one driver may hold an executor worker before "
+        "yielding (reference: TaskExecutor's split run quanta). "
+        "Smaller = tighter lifecycle latency and fairer interleave, "
+        "larger = less scheduling overhead per batch", _positive),
+    PropertyDef(
+        "admission_queue_timeout_ms", "bigint", 0,
+        "Maximum wall time a query may wait in its resource-group "
+        "queue before being SHED with the structured rejected kind "
+        "(0 = wait forever). Distinct from query_max_run_time_ms, "
+        "which also counts queue time but fails with "
+        "deadline_exceeded — this is pure load shedding: under "
+        "overload, old queued work is dropped before it wastes a "
+        "slot on an answer nobody is still waiting for",
+        _non_negative),
+    PropertyDef(
         "cache_memory_bytes", "bigint", 4 << 30,
         "Shared byte budget of the fragment-result + page-source "
         "caches, charged to the cache manager's tagged MemoryPool; "

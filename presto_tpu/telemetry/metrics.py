@@ -154,6 +154,18 @@ METRICS.describe("presto_tpu_transfer_bytes_total",
                  "host<->device transfer bytes by direction (d2h at "
                  "exchange device_get, h2d at per-device scan "
                  "placement)")
+METRICS.describe("presto_tpu_executor_quanta_total",
+                 "TaskExecutor time slices by outcome (finished/"
+                 "progress/blocked/idle/failed/stalled)")
+METRICS.describe("presto_tpu_executor_demotions_total",
+                 "Drivers demoted to a lower multilevel-feedback-"
+                 "queue priority level by accumulated scheduled time")
+METRICS.describe("presto_tpu_admission_total",
+                 "Resource-group admission decisions (run/queued/"
+                 "rejected/queue_full) by group")
+METRICS.describe("presto_tpu_admission_sheds_total",
+                 "Queries shed by admission control, by kind "
+                 "(rejected/queue_full/queue_expired) and group")
 
 
 def render_prometheus() -> str:
@@ -190,4 +202,48 @@ def render_prometheus() -> str:
                 "presto_tpu_memory_pool_budget_bytes", "gauge",
                 "Byte budget of the shared cache memory pool",
                 [({"pool": "cache"}, mgr.pool.budget)]))
+    # time-sliced executor gauges (execution/task_executor.py):
+    # sampled live, zero series until the first statement runs on it
+    try:
+        from presto_tpu.execution.task_executor import (
+            get_task_executor,
+        )
+        ex = get_task_executor(create=False)
+    except Exception:  # noqa: BLE001 — metrics must always render
+        ex = None
+    if ex is not None:
+        snap = ex.snapshot()
+        extra.append((
+            "presto_tpu_executor_running_drivers", "gauge",
+            "Drivers currently owned by an executor worker",
+            [({}, snap["running_drivers"])]))
+        extra.append((
+            "presto_tpu_executor_queued_drivers", "gauge",
+            "Runnable drivers waiting per multilevel-queue level",
+            [({"level": str(i)}, n)
+             for i, n in enumerate(snap["queued_drivers"])]))
+        extra.append((
+            "presto_tpu_executor_parked_drivers", "gauge",
+            "Drivers parked blocked/idle awaiting input",
+            [({}, snap["parked_drivers"])]))
+        extra.append((
+            "presto_tpu_executor_tasks", "gauge",
+            "Live tasks (queries/fragments) on the executor",
+            [({}, snap["tasks"])]))
+    # per-group admission gauges (running + queue depth) across every
+    # live ResourceGroupManager of this process
+    try:
+        from presto_tpu.execution.resource_groups import (
+            sample_group_gauges,
+        )
+        running, queued = sample_group_gauges()
+    except Exception:  # noqa: BLE001
+        running = queued = []
+    if running:
+        extra.append((
+            "presto_tpu_resource_group_running", "gauge",
+            "Running queries per resource group", running))
+        extra.append((
+            "presto_tpu_resource_group_queued", "gauge",
+            "Queued queries per resource group", queued))
     return METRICS.render(extra)

@@ -22,6 +22,16 @@ Protocol:
           taxonomy alongside QPS, and every query that SUCCEEDS under
           chaos must still be byte-identical to the warm phase —
           faults may cost availability, never correctness.
+  overload — (--overload) offered load > capacity: every client
+          hammers the mix against a coordinator whose admission caps
+          are deliberately far below the client count. Overload must
+          be ABSORBED as structured rejected/queue_full sheds (never
+          collapse): the phase reports shed counts by kind, per-user
+          p50/p99 (the per-user fair-queueing story), queue-depth
+          peaks sampled live from the resource groups + executor,
+          and the availability of ADMITTED queries — which must stay
+          ~1.0 while sheds soak up the excess. Successes must remain
+          byte-identical to warm.
   restart-warm — (--restart-warm) the process-restart story: kernel
           LRUs + jax jit caches wiped (everything a coordinator
           reboot loses), caches cleared, then a NEW coordinator comes
@@ -192,6 +202,144 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
     return stats, checks
 
 
+#: shed kinds — admission refused the work; everything else that
+#: fails was ADMITTED and counts against availability
+SHED_KINDS = ("rejected", "queue_full")
+
+
+def _run_overload_phase(url: str, resource_groups, clients: int,
+                        work: List[Tuple[str, str]], rounds: int,
+                        timeout_s: float = 180.0) -> Tuple[dict,
+                                                           Dict[str,
+                                                                set]]:
+    """Offered load > capacity through the real HTTP protocol: every
+    client loops the mix `rounds` times with no pacing. Sheds are
+    EXPECTED; admitted queries must succeed. Returns (stats,
+    {query name -> checksums of successes}) like _run_phase, plus
+    per-user latency percentiles and live queue-depth peaks (sampled
+    from the resource groups and the executor at ~25ms)."""
+    from presto_tpu.server.coordinator import StatementClient
+    from presto_tpu.telemetry.metrics import METRICS
+    lock = threading.Lock()
+    checks: Dict[str, set] = {}
+    per_user: Dict[str, dict] = {}
+    taxonomy: Dict[str, int] = {}
+    assignments = [list(work) * rounds for _ in range(clients)]
+    start = threading.Barrier(clients + 1)
+    stop_sampler = threading.Event()
+    depth_peaks = {"queued": 0, "running": 0,
+                   "executor_queued": 0, "queued_last": 0}
+
+    def sampler():
+        from presto_tpu.execution.task_executor import (
+            get_task_executor,
+        )
+        while not stop_sampler.wait(0.025):
+            try:
+                snap = resource_groups.snapshot()
+                queued = max((r["queued"] for r in snap), default=0)
+                running = max((r["running"] for r in snap),
+                              default=0)
+                depth_peaks["queued"] = max(depth_peaks["queued"],
+                                            queued)
+                depth_peaks["queued_last"] = queued
+                depth_peaks["running"] = max(depth_peaks["running"],
+                                             running)
+                ex = get_task_executor(create=False)
+                if ex is not None:
+                    depth_peaks["executor_queued"] = max(
+                        depth_peaks["executor_queued"],
+                        sum(ex.snapshot()["queued_drivers"]))
+            except Exception:  # noqa: BLE001 — sampling best-effort
+                pass
+
+    def client(idx: int, my_work: List[Tuple[str, str]]) -> None:
+        user = f"bench-{idx}"
+        c = StatementClient(url, user=user, source="serving_bench")
+        mine = per_user.setdefault(user, {
+            "latencies": [], "shed": 0, "failed": 0})
+        start.wait()
+        for name, sql in my_work:
+            t0 = time.perf_counter()
+            try:
+                _, data = c.execute(sql, timeout=timeout_s)
+            except Exception as e:  # noqa: BLE001 — recorded
+                kind = getattr(e, "kind", None) \
+                    or str(e).split(":", 1)[0].strip() \
+                    or type(e).__name__
+                with lock:
+                    taxonomy[kind] = taxonomy.get(kind, 0) + 1
+                    if kind in SHED_KINDS:
+                        mine["shed"] += 1
+                    else:
+                        mine["failed"] += 1
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                mine["latencies"].append(dt)
+                checks.setdefault(name, set()).add(_checksum(data))
+
+    quanta0 = METRICS.total("presto_tpu_executor_quanta_total")
+    demo0 = METRICS.total("presto_tpu_executor_demotions_total")
+    threads = [threading.Thread(target=client, args=(i, w))
+               for i, w in enumerate(assignments)]
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stop_sampler.set()
+    sampler_t.join(timeout=2)
+    lat_all: List[float] = []
+    users_doc = {}
+    for user, d in sorted(per_user.items()):
+        xs = d["latencies"]
+        lat_all.extend(xs)
+        users_doc[user] = {
+            "succeeded": len(xs),
+            "shed": d["shed"],
+            "failed": d["failed"],
+            "p50_ms": round(_percentile(xs, 0.50) * 1e3, 1),
+            "p99_ms": round(_percentile(xs, 0.99) * 1e3, 1),
+        }
+    offered = sum(len(w) for w in assignments)
+    shed = sum(taxonomy.get(k, 0) for k in SHED_KINDS)
+    admitted = offered - shed
+    succeeded = len(lat_all)
+    return {
+        "offered": offered,
+        "admitted": admitted,
+        "succeeded": succeeded,
+        "shed": shed,
+        "sheds_by_kind": {k: taxonomy[k] for k in SHED_KINDS
+                          if k in taxonomy},
+        "errors": dict(sorted(taxonomy.items())),
+        # the robustness headline: of the queries admission LET IN,
+        # how many answered (sheds are absorbed overload, not
+        # failures)
+        "availability_admitted": round(succeeded / admitted, 4)
+        if admitted else None,
+        "wall_s": round(wall, 3),
+        "qps": round(succeeded / wall, 3) if wall > 0 else None,
+        "p50_ms": round(_percentile(lat_all, 0.50) * 1e3, 1),
+        "p99_ms": round(_percentile(lat_all, 0.99) * 1e3, 1),
+        "max_ms": round(max(lat_all) * 1e3, 1) if lat_all else 0.0,
+        "per_user": users_doc,
+        "queue_depth_peak": depth_peaks["queued"],
+        "queue_depth_final": depth_peaks["queued_last"],
+        "running_peak": depth_peaks["running"],
+        "executor_queued_peak": depth_peaks["executor_queued"],
+        "executor_quanta": int(METRICS.total(
+            "presto_tpu_executor_quanta_total") - quanta0),
+        "executor_demotions": int(METRICS.total(
+            "presto_tpu_executor_demotions_total") - demo0),
+    }, checks
+
+
 def _load_mix(mix: Sequence[str]) -> Dict[str, str]:
     from presto_tpu.tools.verifier import load_suite
     suite = load_suite("tpch")
@@ -211,6 +359,9 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       restart_warm: bool = False,
                       cache_dir: Optional[str] = None,
                       fusion_report: bool = False,
+                      overload: bool = False,
+                      overload_rounds: int = 2,
+                      overload_concurrency: Optional[int] = None,
                       host: str = "127.0.0.1") -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
@@ -229,6 +380,8 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             chaos=chaos, chaos_rounds=chaos_rounds,
             chaos_spec=chaos_spec, restart_warm=restart_warm,
             cache_dir=cache_dir, fusion_report=fusion_report,
+            overload=overload, overload_rounds=overload_rounds,
+            overload_concurrency=overload_concurrency,
             host=host)
     finally:
         if auto_cache_dir is not None:
@@ -242,7 +395,10 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    warm_rounds: int, verify_off: bool, chaos: bool,
                    chaos_rounds: int, chaos_spec: str,
                    restart_warm: bool, cache_dir: Optional[str],
-                   fusion_report: bool, host: str) -> dict:
+                   fusion_report: bool, overload: bool,
+                   overload_rounds: int,
+                   overload_concurrency: Optional[int],
+                   host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
@@ -301,6 +457,41 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                     "results: " + json.dumps(chaos_doc, indent=1))
     finally:
         coord.stop()
+
+    overload_doc = None
+    if overload:
+        # a FRESH coordinator with admission caps far below the
+        # client count (warm process-wide caches ride along): the
+        # offered load must be absorbed as structured sheds while
+        # admitted queries keep answering byte-identically
+        cap = overload_concurrency or max(2, clients // 8)
+        ov_coord = Coordinator(
+            [], "tpch", schema, host=host, port=0,
+            max_concurrent_queries=cap,
+            max_queued_queries=cap * 2, single_node=True,
+            properties={"admission_queue_timeout_ms": 30_000})
+        ov_coord.start()
+        try:
+            ov_stats, ov_checks = _run_overload_phase(
+                ov_coord.url, ov_coord.resource_groups, clients,
+                work, overload_rounds)
+        finally:
+            ov_coord.stop()
+        ov_consistent = all(
+            len(sums) == 1 and sums == warm_checks.get(name)
+            for name, sums in ov_checks.items())
+        overload_doc = {
+            "clients": clients,
+            "rounds": overload_rounds,
+            "max_concurrent": cap,
+            "max_queued": cap * 2,
+            **ov_stats,
+            "successes_match_warm": ov_consistent,
+        }
+        if not ov_consistent:
+            raise RuntimeError(
+                "overload-phase successes diverged from warm "
+                "results: " + json.dumps(overload_doc, indent=1))
 
     def _consistent(*phases: Dict[str, set]) -> bool:
         """One checksum per query per phase, identical across phases
@@ -407,6 +598,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "warm": warm,
         "caches_off": off,
         "restart_warm": restart,
+        "overload": overload_doc,
         "results_identical": identical,
         "cache": cache_stats,
         "chaos": chaos_doc,
@@ -448,6 +640,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--cache-dir", default=None,
                    help="persistent XLA compilation cache directory "
                         "(default: a fresh tmpdir when --restart-warm)")
+    p.add_argument("--overload", action="store_true",
+                   help="run an offered-load > capacity phase against "
+                        "tight admission caps: sheds by kind, "
+                        "per-user p50/p99, queue-depth peaks, "
+                        "availability of admitted queries")
+    p.add_argument("--overload-rounds", type=int, default=2)
+    p.add_argument("--overload-concurrency", type=int, default=None,
+                   help="hard concurrency cap of the overload "
+                        "coordinator (default: clients // 8)")
     p.add_argument("--fusion-report", action="store_true",
                    help="embed the per-query whole-fragment fusion "
                         "coverage (fused chains + fallback reasons, "
@@ -460,7 +661,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         warm_rounds=args.warm_rounds, verify_off=not args.skip_off,
         chaos=args.chaos, chaos_rounds=args.chaos_rounds,
         chaos_spec=args.chaos_spec, restart_warm=args.restart_warm,
-        cache_dir=args.cache_dir, fusion_report=args.fusion_report)
+        cache_dir=args.cache_dir, fusion_report=args.fusion_report,
+        overload=args.overload, overload_rounds=args.overload_rounds,
+        overload_concurrency=args.overload_concurrency)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

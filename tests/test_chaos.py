@@ -735,3 +735,149 @@ def test_cancel_distributed_query_aborts_worker_tasks(worker):
                    for g in coord.resource_groups.snapshot())
     finally:
         coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# concurrent chaos through the time-sliced executor (PR 8)
+
+
+def test_concurrent_chaos_battery_32_clients():
+    """32 concurrent clients through the single-node coordinator's
+    time-sliced executor under (a) seeded faults at the NEW
+    concurrency seams — executor.quantum (fails a query mid-schedule)
+    and admission.enqueue (fails a query at the front door) — plus
+    (b) a cancel storm killing a random subset mid-flight. Invariants:
+    every failure is CLEAN (structured kind or the injected fault's
+    message — never a hang, never a protocol error), every success is
+    byte-identical to the reference answer, the resource-group ledger
+    and executor drain to zero, and the server still serves."""
+    from presto_tpu.server.coordinator import Coordinator, StatementClient
+    from presto_tpu.execution.task_executor import get_task_executor
+    n_clients = 32
+    coord = Coordinator([], "tpch", "tiny", single_node=True,
+                        max_concurrent_queries=8,
+                        max_queued_queries=64,
+                        properties={"plan_cache_enabled": False,
+                                    "fragment_result_cache_enabled": False,
+                                    "page_source_cache_enabled": False,
+                                    "batch_rows": 2048})
+    coord.start()
+    try:
+        reference = StatementClient(coord.url, user="ref").execute(
+            SQL_AGG, timeout=120)[1]
+        # seeded periodic faults at the two new sites + a light stall
+        # so cancels land mid-execution
+        faults.arm("executor.quantum", trigger="every", n=40, seed=3)
+        faults.arm("admission.enqueue", trigger="every", n=9, seed=5)
+        _stall(0.002)
+        results, errors = [], []
+        lock = threading.Lock()
+        clients = [StatementClient(coord.url, user=f"u{i % 8}",
+                                   source="chaos")
+                   for i in range(n_clients)]
+
+        def run(i):
+            try:
+                _, rows = clients[i].execute(SQL_AGG, timeout=120)
+                with lock:
+                    results.append(rows)
+            except Exception as e:  # noqa: BLE001 — recorded
+                with lock:
+                    errors.append(e)
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        # the cancel storm: kill every 5th client's in-flight query
+        time.sleep(0.2)
+        for i in range(0, n_clients, 5):
+            clients[i].cancel()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "client thread hung"
+        # every failure is structured-or-injected; nothing opaque
+        for e in errors:
+            kind = getattr(e, "kind", None)
+            ok = kind in ("cancelled", "queue_full", "rejected",
+                          "deadline_exceeded", "abandoned") \
+                or "InjectedFault" in str(e) \
+                or "injected fault" in str(e)
+            assert ok, f"unstructured failure: {type(e).__name__}: {e}"
+        # every success is byte-identical to the reference
+        assert all(rows == reference for rows in results), \
+            "chaos success diverged from reference"
+        assert len(results) + len(errors) == n_clients
+        # at least SOME of each fault class actually fired (a chaos
+        # battery that never fires is vacuous)
+        assert faults.fired("admission.enqueue") > 0
+        assert faults.fired("executor.quantum") > 0
+        faults.disarm()
+        # the machine drained: groups zeroed, executor idle, serving
+        _wait_for(lambda: all(
+            g["running"] == 0 and g["queued"] == 0
+            for g in coord.resource_groups.snapshot()),
+            what="resource groups drained")
+        ex = get_task_executor(create=False)
+        if ex is not None:
+            snap = ex.snapshot()
+            assert snap["tasks"] == 0
+            assert snap["running_drivers"] == 0
+        _, rows = StatementClient(coord.url, user="after").execute(
+            SQL_AGG, timeout=120)
+        assert rows == reference
+    finally:
+        faults.disarm()
+        coord.stop()
+
+
+def test_coordinator_queue_wait_expiry_never_schedules():
+    """A query whose queue wait exceeds admission_queue_timeout_ms is
+    SHED with the structured rejected kind WITHOUT ever being
+    scheduled (run_started_at stays unset) — the coordinator-tier
+    face of queue-wait deadlines. (The deadline_exceeded flavor of
+    expiry-while-queued is verified deterministically at the runner
+    tier in tests/test_task_executor.py — at this tier holder and
+    victim would share one query_max_run_time_ms, making dispatch
+    race expiry.) The queue position frees and the ledger drains."""
+    from presto_tpu.server.coordinator import (
+        Coordinator, QueryFailed, StatementClient,
+    )
+    coord = Coordinator([], "tpch", "tiny", single_node=True,
+                        max_concurrent_queries=1,
+                        max_queued_queries=10,
+                        properties={"plan_cache_enabled": False,
+                                    "fragment_result_cache_enabled": False,
+                                    "page_source_cache_enabled": False,
+                                    "batch_rows": 1024,
+                                    "admission_queue_timeout_ms": 400})
+    coord.start()
+    # the holder needs to outlive the 400ms queue timeout by a wide
+    # margin even fully warm: tiny tables are few batches, so the
+    # per-hand-off stall is sized large
+    _stall(0.25)
+    try:
+        errors, results = [], []
+        holder = threading.Thread(
+            target=_client_run,
+            args=(coord, SQL_AGG, errors, results, "holder"))
+        holder.start()
+        _wait_for(lambda: any(q.state == "RUNNING"
+                              for q in coord.queries.values()),
+                  what="slot held")
+        with pytest.raises(QueryFailed) as ei:
+            StatementClient(coord.url, user="queued").execute(
+                SQL_AGG, timeout=60)
+        assert ei.value.kind == "rejected"
+        victim = coord.queries[ei.value.query_id]
+        assert victim.run_started_at is None  # never scheduled
+        assert "queue wait exceeded" in victim.error
+        holder.join(timeout=60)
+        assert results and not errors  # the holder itself finished
+        faults.disarm()
+        _wait_for(lambda: all(
+            g["running"] == 0 and g["queued"] == 0
+            for g in coord.resource_groups.snapshot()),
+            what="groups drained")
+    finally:
+        faults.disarm()
+        coord.stop()

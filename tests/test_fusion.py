@@ -431,3 +431,68 @@ def test_session_property_registered():
     assert validate_set("fragment_fusion_enabled", False) is False
     with pytest.raises(ValueError):
         validate_set("fragment_fusion_enabled", "yes")
+
+
+# ---------------------------------------------------------------------------
+# selectivity stamping beyond FilterNode-derived FPs (PR 8 satellite)
+
+
+SQL_SELECTIVE_JOIN_FILTER = (
+    "select sum(l.extendedprice) from lineitem l join orders o "
+    "on l.orderkey = o.orderkey and l.quantity + o.custkey < 50 "
+    "and l.quantity * o.custkey < 100 "
+    "where l.quantity + o.totalprice < 10000")
+
+SQL_MILD_JOIN_FILTER = (
+    "select sum(l.extendedprice) from lineitem l join orders o "
+    "on l.orderkey = o.orderkey "
+    "and (l.quantity + o.custkey < 1000 or l.quantity >= 1) "
+    "where l.quantity + o.totalprice < 100000 "
+    "or o.totalprice >= 0")
+
+
+def test_join_filter_fp_carries_selectivity(runners):
+    """The JoinNode.filter FilterProject (planner ~775) prefuses into
+    the probe WITH a selectivity estimate — previously None (always
+    fuse), which left the gate blind behind join filters."""
+    from presto_tpu.operators.join_ops import LookupJoinOperatorFactory
+    from presto_tpu.planner.local_planner import LocalExecutionPlanner
+    from presto_tpu.planner.optimizer import optimize
+    on, _ = runners
+    plan = optimize(on.create_plan(SQL_SELECTIVE_JOIN_FILTER),
+                    on.catalogs)
+    lp = LocalExecutionPlanner(on.catalogs, on.session).plan(plan)
+    probes = [f for pipe in lp.pipelines for f in pipe
+              if isinstance(f, LookupJoinOperatorFactory)]
+    assert probes, "query must plan a lookup join"
+    # two default-selectivity conjuncts: 0.33^2, well under the gate
+    assert probes[0].fused_selectivity is not None
+    assert probes[0].fused_selectivity < 0.25
+
+
+def test_selective_join_filter_gates_fold_terminal(runners):
+    """Regression: a selective join filter (prefused into the probe)
+    must gate the chain it feeds into the aggregation — the chain's
+    own mild 0.33 estimate alone would fold (>= 0.25), only the
+    INHERITED probe selectivity trips the gate. Byte-identity with
+    fusion off is the hard bar."""
+    on, off = runners
+    res = on.execute(SQL_SELECTIVE_JOIN_FILTER)
+    gated = [e for e in res.fusion_report["fragments"]
+             if e["terminal"] and "aggregation" in e["terminal"]
+             and e["reason"] == "selective_chain"]
+    assert gated, res.fusion_report
+    assert res.rows() == off.execute(SQL_SELECTIVE_JOIN_FILTER).rows()
+
+
+def test_mild_join_filter_still_folds(runners):
+    """Contrast: with MILD estimates on both the prefused join filter
+    and the WHERE chain (OR predicates, ~0.55 each — product ~0.30),
+    the gate stays open and the chain folds into the aggregation."""
+    on, off = runners
+    res = on.execute(SQL_MILD_JOIN_FILTER)
+    folded = [e for e in res.fusion_report["fragments"]
+              if e["terminal"] and "aggregation" in e["terminal"]
+              and e["fused"]]
+    assert folded, res.fusion_report
+    assert res.rows() == off.execute(SQL_MILD_JOIN_FILTER).rows()

@@ -253,3 +253,115 @@ def test_coordinator_group_isolation(rg_coordinator):
     t2.join(timeout=300)
     assert not isinstance(results["etl1"], Exception)
     assert not isinstance(results["etl2"], Exception)
+
+
+# -- per-user fair queueing, deadlines, structured shedding ----------------
+
+
+def test_per_user_weighted_round_robin_dequeue():
+    """A heavy user's backlog cannot starve a light user: with N
+    heavy entries queued ahead of one light entry, the light entry
+    dispatches on the SECOND release, not the (N+1)-th."""
+    m = ResourceGroupManager(GroupSpec("root", hard_concurrency=1,
+                                       max_queued=20))
+    assert m.submit("heavy")[0] == "run"
+    order = []
+    for i in range(6):
+        m.submit("heavy", on_dispatch=lambda i=i: order.append(
+            f"heavy-{i}"))
+    m.submit("light", on_dispatch=lambda: order.append("light"))
+    m.finish("root")  # 1st release: heavy-0 (oldest head, tie)
+    m.finish("root")  # 2nd release: light (0 dispatched / weight 1)
+    assert order == ["heavy-0", "light"]
+    # the rest drain in heavy's FIFO order
+    for _ in range(5):
+        m.finish("root")
+    assert order == ["heavy-0", "light"] + [f"heavy-{i}"
+                                            for i in range(1, 6)]
+
+
+def test_user_weights_bias_dequeue():
+    """user_weights > 1 buys a user proportionally more dispatches."""
+    m = ResourceGroupManager(GroupSpec(
+        "root", hard_concurrency=1, max_queued=20,
+        user_weights={"vip": 2}))
+    assert m.submit("std")[0] == "run"
+    order = []
+    for i in range(2):
+        m.submit("std", on_dispatch=lambda i=i: order.append("std"))
+        m.submit("vip", on_dispatch=lambda i=i: order.append("vip"))
+    for _ in range(4):
+        m.finish("root")
+    # vip (weight 2) keeps a lower dispatched/weight ratio: after the
+    # tie-broken first std, vip runs BOTH entries before std's second
+    assert order == ["std", "vip", "vip", "std"]
+
+
+def test_rejection_kinds_are_structured():
+    m = two_group_manager()
+    for i in range(4):
+        m.submit("a", "etl-x")
+    with pytest.raises(QueryRejected) as ei:
+        m.submit("a", "etl-x")
+    assert ei.value.kind == "queue_full"
+    m2 = two_group_manager()
+    m2._selectors = [Selector("etl", source="etl.*")]
+    with pytest.raises(QueryRejected) as ei:
+        m2.submit("u", "nomatch")
+    assert ei.value.kind == "rejected"
+
+
+def test_queued_entry_deadline_expires_without_dispatch():
+    """An expired queued entry is dropped by the sweep: on_expire
+    fires (never on_dispatch), the queue position frees, and the slot
+    goes to the live entry behind it."""
+    m = ResourceGroupManager(GroupSpec("root", hard_concurrency=1,
+                                       max_queued=10))
+    assert m.submit("u")[0] == "run"
+    fired = []
+    m.submit("stale", on_dispatch=lambda: fired.append("dispatched"),
+             deadline=time.monotonic() - 0.001,
+             on_expire=lambda: fired.append("expired"))
+    m.submit("live", on_dispatch=lambda: fired.append("live"))
+    # the NEXT submit's sweep already dropped the stale entry; an
+    # explicit sweep finds nothing left
+    assert fired == ["expired"]
+    assert m.expire_queued() == 0
+    m.finish("root")
+    assert fired == ["expired", "live"]
+    snap = {r["group"]: r for r in m.snapshot()}
+    assert snap["root"]["queued"] == 0
+
+
+def test_snapshot_reports_queued_by_user():
+    m = ResourceGroupManager(GroupSpec("root", hard_concurrency=1,
+                                       max_queued=10))
+    m.submit("a")
+    m.submit("a", on_dispatch=lambda: None)
+    m.submit("a", on_dispatch=lambda: None)
+    m.submit("b", on_dispatch=lambda: None)
+    snap = {r["group"]: r for r in m.snapshot()}
+    assert snap["root"]["queued_by_user"] == {"a": 2, "b": 1}
+
+
+def test_shed_leaves_no_residue():
+    """Rejected queries charge nothing: group counters return to
+    zero and the admission metrics count every shed."""
+    from presto_tpu.telemetry.metrics import METRICS
+    before = METRICS.get("presto_tpu_admission_sheds_total",
+                         kind="queue_full", group="root")
+    m = ResourceGroupManager(GroupSpec("root", hard_concurrency=1,
+                                       max_queued=1))
+    m.submit("u")
+    m.submit("u", on_dispatch=lambda: None)
+    with pytest.raises(QueryRejected):
+        m.submit("u")
+    after = METRICS.get("presto_tpu_admission_sheds_total",
+                        kind="queue_full", group="root")
+    assert after == before + 1
+    m.finish("root")  # running entry done; queued one dispatches
+    m.finish("root")
+    snap = {r["group"]: r for r in m.snapshot()}
+    assert snap["root"]["running"] == 0
+    assert snap["root"]["queued"] == 0
+    assert snap["root"]["memory_reserved"] == 0

@@ -128,3 +128,39 @@ def test_single_node_coordinator_enforces_per_user_access():
     finally:
         coord.stop()
     reset_cache_manager()
+
+
+def test_serving_bench_overload_phase():
+    """--overload: offered load far above the admission caps must be
+    ABSORBED — sheds counted by structured kind, admitted queries all
+    answer (availability_admitted ~1.0) byte-identically to warm, and
+    per-user percentiles + live queue-depth peaks are reported."""
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.tools.serving_bench import run_serving_bench
+    reset_cache_manager()
+    doc = run_serving_bench(clients=8, schema="tiny",
+                            mix=("q6", "q1"), warm_rounds=1,
+                            verify_off=False, overload=True,
+                            overload_rounds=2,
+                            overload_concurrency=2)
+    ov = doc["overload"]
+    for key in ("offered", "admitted", "succeeded", "shed",
+                "sheds_by_kind", "availability_admitted", "qps",
+                "p50_ms", "p99_ms", "per_user", "queue_depth_peak",
+                "queue_depth_final", "executor_quanta",
+                "successes_match_warm"):
+        assert key in ov, key
+    assert ov["offered"] == 8 * 2 * 2
+    assert ov["succeeded"] + ov["shed"] \
+        + sum(v for k, v in ov["errors"].items()
+              if k not in ("rejected", "queue_full")) == ov["offered"]
+    # overload is absorbed: whatever was admitted, answered
+    assert ov["availability_admitted"] >= 0.95
+    assert ov["successes_match_warm"] is True
+    # per-user fairness surface: one entry per client with percentiles
+    assert len(ov["per_user"]) == 8
+    assert all("p99_ms" in u for u in ov["per_user"].values())
+    # the queue drained by phase end (no monotonic growth)
+    assert ov["queue_depth_final"] <= ov["queue_depth_peak"]
+    assert ov["executor_quanta"] > 0
+    reset_cache_manager()

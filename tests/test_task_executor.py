@@ -1,0 +1,424 @@
+"""Time-sliced TaskExecutor battery (execution/task_executor.py):
+multilevel-queue semantics, byte-identity against the serial loop,
+cross-driver unblocking on one worker, quantum-boundary lifecycle
+(cancel + deadline land mid-query), blocked-driver yielding,
+embedded admission control with per-query queued_ms attribution,
+and the executor/admission observability surface on /v1/metrics."""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.execution.task_executor import (
+    TaskExecutor, get_task_executor, set_task_executor,
+)
+from presto_tpu.runner.local import LocalRunner, QueryError
+
+NO_CACHE = {"plan_cache_enabled": False,
+            "fragment_result_cache_enabled": False,
+            "page_source_cache_enabled": False}
+
+SQL_AGG = ("select returnflag, count(*) c, sum(quantity) q "
+           "from lineitem group by returnflag order by returnflag")
+SQL_JOIN = ("select n.name, count(*) c from nation n "
+            "join supplier s on n.nationkey = s.nationkey "
+            "group by n.name order by c desc, n.name limit 5")
+SQL_SORT = ("select orderkey, totalprice from orders "
+            "order by totalprice desc limit 10")
+
+#: small batches => many hand-offs, so the per-hand-off stall below
+#: yields a deterministically slow query even with warm kernels
+SLOW_PROPS = {**NO_CACHE, "batch_rows": 1024}
+
+
+def _arm_stall(delay_s=0.02):
+    """A never-firing sleeper on every batch hand-off: turns any query
+    into a deterministically slow one (the chaos battery's idiom), so
+    lifecycle races don't depend on kernel-cache warmth."""
+    from presto_tpu.execution import faults
+
+    def sleeper(ctx):
+        time.sleep(delay_s)
+        return False
+    return faults.arm("operator.add_input", trigger="always",
+                      predicate=sleeper)
+
+
+def _wait_for(pred, timeout_s=30.0, what="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout_s:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.01)
+
+
+@pytest.fixture
+def small_executor():
+    """A private 2-worker executor with tiny demotion thresholds,
+    installed as the process default for the test's duration."""
+    ex = TaskExecutor(workers=2, quantum_ms=5,
+                      level_thresholds_s=(0.0, 0.01, 0.05, 0.2, 1.0))
+    prev = set_task_executor(ex)
+    yield ex
+    set_task_executor(prev)
+    ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# multilevel queue unit semantics
+
+
+def test_level_ladder_and_demotion_counter():
+    ex = TaskExecutor(workers=1, quantum_ms=5,
+                      level_thresholds_s=(0.0, 0.1, 1.0))
+    assert ex._level_of(0) == 0
+    assert ex._level_of(int(0.05e9)) == 0
+    assert ex._level_of(int(0.5e9)) == 1
+    assert ex._level_of(int(5e9)) == 2
+    # young levels carry exponentially more weight
+    assert ex._level_weight[0] > ex._level_weight[1] \
+        > ex._level_weight[2]
+
+
+def test_weighted_poll_prefers_underserved_level():
+    ex = TaskExecutor(workers=1, quantum_ms=5,
+                      level_thresholds_s=(0.0, 0.1, 1.0))
+
+    class _E:
+        def __init__(self, level):
+            self.level = level
+            self.state = "queued"
+    young, old = _E(0), _E(2)
+    ex._runnable[0].append(young)
+    ex._runnable[2].append(old)
+    # level 0 already consumed far beyond its 4x share -> the old
+    # level dequeues first (no starvation), then the young one
+    ex._level_ns[0] = int(1e9)
+    ex._level_ns[2] = 0
+    assert ex._poll_locked() is old
+    assert ex._poll_locked() is young
+
+
+# ---------------------------------------------------------------------------
+# execution correctness
+
+
+def test_executor_results_identical_to_serial():
+    on = LocalRunner("tpch", "tiny", properties=dict(NO_CACHE))
+    off = LocalRunner("tpch", "tiny", properties={
+        **NO_CACHE, "task_executor_enabled": False})
+    for sql in (SQL_AGG, SQL_JOIN, SQL_SORT):
+        assert on.execute(sql).rows() == off.execute(sql).rows(), sql
+
+
+def test_single_worker_unblocks_cross_driver_dependencies(
+        small_executor):
+    """A join query's probe driver blocks on the build bridge: with
+    ONE worker, completion proves a blocked driver yields its worker
+    (a busy-spinning probe would wedge the build forever) and that
+    progress wakes parked siblings."""
+    ex = TaskExecutor(workers=1, quantum_ms=5)
+    prev = set_task_executor(ex)
+    try:
+        r = LocalRunner("tpch", "tiny", properties=dict(NO_CACHE))
+        rows = r.execute(SQL_JOIN).rows()
+        assert rows[0][1] >= 1
+        snap = ex.snapshot()
+        assert snap["quanta"] > 0
+        assert snap["tasks"] == 0 and snap["running_drivers"] == 0
+    finally:
+        set_task_executor(prev)
+        ex.shutdown()
+
+
+def test_concurrent_statements_interleave(small_executor):
+    """Many threads through ONE runner on a 2-worker executor: all
+    finish, all correct — the pool time-shares instead of requiring a
+    worker per statement."""
+    r = LocalRunner("tpch", "tiny", properties=dict(NO_CACHE))
+    expected = r.execute(SQL_AGG).rows()
+    results, errors = [], []
+
+    def go():
+        try:
+            results.append(r.execute(SQL_AGG).rows())
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+    threads = [threading.Thread(target=go) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    assert len(results) == 6
+    assert all(rows == expected for rows in results)
+    # the executor drained completely
+    snap = small_executor.snapshot()
+    assert snap["tasks"] == 0 and snap["running_drivers"] == 0
+    assert sum(snap["queued_drivers"]) == 0
+
+
+def test_demotion_under_load(small_executor):
+    """Tiny thresholds + an artificially slow drive: accumulated
+    scheduled time walks the query down the ladder — the demotion
+    counter must move (the MLFQ really demotes CPU-hungry work)."""
+    from presto_tpu.execution import faults
+    _arm_stall(0.02)
+    try:
+        r = LocalRunner("tpch", "tiny", properties=dict(SLOW_PROPS))
+        r.execute(SQL_AGG)
+    finally:
+        faults.disarm()
+    assert small_executor.snapshot()["demotions"] > 0
+
+
+# ---------------------------------------------------------------------------
+# lifecycle at quantum boundaries
+
+
+def test_cancel_lands_mid_execution(small_executor):
+    """The cancel callable flips while the query is mid-drive; the
+    executor's quantum checkpoint must surface kind="cancelled"."""
+    from presto_tpu.execution import faults
+    flag = threading.Event()
+    inj = _arm_stall(0.05)
+    try:
+        r = LocalRunner("tpch", "tiny", properties=dict(SLOW_PROPS))
+        timer = threading.Timer(0.15, flag.set)
+        timer.start()
+        with pytest.raises(QueryError) as ei:
+            r.execute(SQL_AGG, cancel=flag.is_set)
+        assert ei.value.kind == "cancelled"
+        # cold runs may cancel during planning before any hand-off —
+        # inj.calls is incidental; the structured kind is the point
+    finally:
+        timer.cancel()
+        faults.disarm()
+
+
+def test_deadline_lands_mid_execution(small_executor):
+    from presto_tpu.execution import faults
+    _arm_stall(0.05)
+    try:
+        r = LocalRunner("tpch", "tiny", properties={
+            **SLOW_PROPS, "query_max_run_time_ms": 150})
+        t0 = time.monotonic()
+        with pytest.raises(QueryError) as ei:
+            r.execute(SQL_AGG)
+        assert ei.value.kind == "deadline_exceeded"
+        # within a few quanta of the 300ms budget, not at query end
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        faults.disarm()
+
+
+def test_executor_quantum_fault_site(small_executor):
+    """The executor.quantum fault site fails the owning query cleanly
+    (satellite: chaos coverage of the new concurrency seams)."""
+    from presto_tpu.execution import faults
+    inj = faults.arm("executor.quantum", trigger="nth", n=3)
+    _arm_stall(0.02)
+    try:
+        r = LocalRunner("tpch", "tiny", properties=dict(SLOW_PROPS))
+        with pytest.raises(faults.InjectedFault):
+            r.execute(SQL_AGG)
+        assert inj.fired == 1
+        # the executor survives: the next statement runs clean
+        faults.disarm()
+        assert r.execute("select count(*) from nation").rows() \
+            == [(25,)]
+        snap = small_executor.snapshot()
+        assert snap["tasks"] == 0 and snap["running_drivers"] == 0
+    finally:
+        faults.disarm()
+
+
+def test_blocked_ns_survives_quantum_suspension(small_executor):
+    """EXPLAIN ANALYZE through the executor: the probe side of a join
+    blocks on the build bridge across quantum parks; its blocked
+    window must close (non-negative, bounded by wall) instead of
+    leaking or double-counting."""
+    r = LocalRunner("tpch", "tiny", properties=dict(NO_CACHE))
+    text = "\n".join(
+        x[0] for x in r.execute("explain analyze " + SQL_JOIN).rows())
+    assert "lookup_join" in text
+    ops = r._session_tl.op_stats
+    assert ops is not None
+    wall_ns = 600e9
+    for pipe in ops:
+        for s in pipe:
+            assert 0 <= s["blocked_ns"] < wall_ns, s
+
+
+# ---------------------------------------------------------------------------
+# embedded admission control (LocalRunner + resource groups)
+
+
+def _admitting_runner(**spec_kw):
+    from presto_tpu.execution.resource_groups import (
+        GroupSpec, ResourceGroupManager,
+    )
+    spec = {"hard_concurrency": 1, "max_queued": 2, **spec_kw}
+    mgr = ResourceGroupManager(GroupSpec("root", **spec))
+    runner = LocalRunner("tpch", "tiny",
+                         properties=dict(SLOW_PROPS),
+                         resource_groups=mgr)
+    return runner, mgr
+
+
+def test_runner_admission_caps_and_queue_full():
+    from presto_tpu.execution import faults
+    runner, mgr = _admitting_runner()
+    _arm_stall(0.03)
+    errors, results = [], []
+
+    def go():
+        try:
+            results.append(runner.execute(SQL_AGG).rows())
+        except QueryError as e:
+            errors.append(e)
+    try:
+        threads = [threading.Thread(target=go) for _ in range(5)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)  # deterministic arrival order
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        faults.disarm()
+    # 1 runs + 2 queue; the other 2 shed with the structured kind
+    kinds = sorted(e.kind for e in errors)
+    assert kinds == ["queue_full", "queue_full"]
+    assert len(results) == 3
+    snap = {r["group"]: r for r in mgr.snapshot()}
+    assert snap["root"]["running"] == 0
+    assert snap["root"]["queued"] == 0
+
+
+def test_runner_admission_queued_ms_attribution():
+    """A query that waited in the admission queue reports its wait in
+    system.runtime.queries.queued_ms — the per-query attribution the
+    fairness assertions build on."""
+    from presto_tpu.execution import faults
+    runner, mgr = _admitting_runner()
+    _arm_stall(0.05)
+    done = []
+
+    def first():
+        done.append(runner.execute(SQL_AGG).rows())
+    t = threading.Thread(target=first)
+    try:
+        t.start()
+        _wait_for(lambda: any(r["running"] == 1
+                              for r in mgr.snapshot()),
+                  what="slot held")
+        runner.execute("select count(*) from nation")
+        t.join(timeout=120)
+    finally:
+        faults.disarm()
+    rows = {e["sql"]: e for e in runner.query_history}
+    waited = rows["select count(*) from nation"]
+    assert waited["queued_ms"] > 50.0
+    assert rows[SQL_AGG.strip()]["queued_ms"] == 0.0
+
+
+def test_runner_admission_deadline_expires_while_queued():
+    """query_max_run_time_ms expiring in the admission queue fails
+    with deadline_exceeded WITHOUT the query ever scheduling — and
+    sheds leave no resource-group or MemoryPool residue."""
+    from presto_tpu.execution import faults
+    runner, mgr = _admitting_runner()
+    _arm_stall(0.05)
+    holder_done = []
+
+    def holder():
+        holder_done.append(runner.execute(SQL_AGG).rows())
+    t = threading.Thread(target=holder)
+    try:
+        t.start()
+        _wait_for(lambda: any(r["running"] == 1
+                              for r in mgr.snapshot()),
+                  what="slot held")
+        with pytest.raises(QueryError) as ei:
+            runner.execute_as("select count(*) from nation", "late",
+                              deadline=time.monotonic() + 0.3)
+        assert ei.value.kind == "deadline_exceeded"
+        assert "while queued" in str(ei.value)
+    finally:
+        faults.disarm()
+        t.join(timeout=120)
+    assert holder_done  # the slot holder still finished
+    snap = {r["group"]: r for r in mgr.snapshot()}
+    assert snap["root"]["running"] == 0 and snap["root"]["queued"] == 0
+    # the shed query never planned, so it never touched the history
+    assert not any(e["sql"] == "select count(*) from nation"
+                   for e in runner.query_history)
+
+
+def test_runner_admission_queue_timeout_sheds_rejected():
+    from presto_tpu.execution import faults
+    runner, mgr = _admitting_runner()
+    runner.session.properties["admission_queue_timeout_ms"] = 200
+    _arm_stall(0.05)
+    t = threading.Thread(
+        target=lambda: runner.execute(SQL_AGG))
+    try:
+        t.start()
+        _wait_for(lambda: any(r["running"] == 1
+                              for r in mgr.snapshot()),
+                  what="slot held")
+        with pytest.raises(QueryError) as ei:
+            runner.execute("select 1")
+        assert ei.value.kind == "rejected"
+    finally:
+        faults.disarm()
+        t.join(timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# observability
+
+
+def test_executor_gauges_on_v1_metrics():
+    """Executor gauges + per-group admission depths render on the
+    coordinator's /v1/metrics (acceptance: gauges and queue depths
+    visible)."""
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+    from presto_tpu.server.node import http_get
+    coord = Coordinator([], "tpch", "tiny", single_node=True,
+                        max_concurrent_queries=2)
+    coord.start()
+    try:
+        StatementClient(coord.url, user="m").execute(
+            "select count(*) from nation")
+        body = http_get(f"{coord.url}/v1/metrics")
+        if isinstance(body, bytes):
+            body = body.decode()
+        assert "presto_tpu_executor_quanta_total" in body
+        assert "presto_tpu_executor_running_drivers" in body
+        assert 'presto_tpu_executor_queued_drivers{level="0"}' in body
+        assert "presto_tpu_resource_group_running" in body
+        assert "presto_tpu_resource_group_queued" in body
+        assert 'presto_tpu_admission_total{decision="run"' in body
+    finally:
+        coord.stop()
+
+
+def test_session_property_opts_out():
+    """task_executor_enabled=false keeps the serial loop: the quanta
+    counter must not move for that statement."""
+    from presto_tpu.telemetry.metrics import METRICS
+    r = LocalRunner("tpch", "tiny", properties={
+        **NO_CACHE, "task_executor_enabled": False})
+    before = METRICS.total("presto_tpu_executor_quanta_total")
+    r.execute(SQL_AGG)
+    assert METRICS.total("presto_tpu_executor_quanta_total") == before
+
+
+def test_process_default_executor_singleton():
+    a = get_task_executor()
+    b = get_task_executor()
+    assert a is b and a is not None
