@@ -119,6 +119,7 @@ def operator_capacity(n: int, floor: int = MIN_CAPACITY) -> int:
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
+# lint-ok: TS005 shape plumbing, deliberately not an engine kernel
 def _pad_batch(batch: "Batch", pad: int) -> "Batch":
     """Append `pad` dead lanes (mask False, row_valid False, data 0)
     to every column. One tiny fused kernel per (schema, pad) pair —

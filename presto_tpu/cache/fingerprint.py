@@ -18,12 +18,12 @@ from typing import Any, Dict, List, Optional, Tuple
 from presto_tpu.parser.lexer import LexError, tokenize
 from presto_tpu.planner import nodes as N
 
-#: functions whose result depends on more than their arguments; a
-#: fragment containing one must never be served from cache (the engine
-#: registers none today — the list is the forward guard)
-NONDETERMINISTIC_FUNCTIONS = frozenset({
-    "random", "rand", "uuid", "now", "current_timestamp", "shuffle",
-})
+#: determinism classification is owned by the plan checker — ONE
+#: audited analysis (planner/validation.py) instead of scattered
+#: per-module copies; re-exported here for existing importers
+from presto_tpu.planner.validation import (  # noqa: F401
+    NONDETERMINISTIC_FUNCTIONS, expr_deterministic,
+)
 
 
 def normalize_sql(sql: str) -> str:
@@ -100,12 +100,8 @@ _ELIGIBLE = (N.TableScanNode, N.FilterNode, N.ProjectNode,
              N.DistinctNode)
 
 
-def _expr_deterministic(e) -> bool:
-    from presto_tpu.expr.ir import Call, walk
-    for x in walk(e):
-        if isinstance(x, Call) and x.name in NONDETERMINISTIC_FUNCTIONS:
-            return False
-    return True
+#: the audited analysis, under the name this module always used
+_expr_deterministic = expr_deterministic
 
 
 def _hash_expr(h, e) -> bool:

@@ -198,6 +198,14 @@ def bounds_init(dtype):
     return (jnp.asarray(info.max, dtype), jnp.asarray(info.min, dtype))
 
 
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+# compile-vs-execute attribution for the dynamic-filter family —
+# previously uninstrumented module-level jits whose compiles landed
+# in join-build/scan busy time
+bounds_step = _instr(bounds_step, "dynamic_filter")
+
+
 @jax.jit
 def distinct_set(data, mask):
     """Bounded distinct set of a (merged) build key column: ONE sort +
@@ -246,6 +254,10 @@ def apply_filter(batch: Batch, col: str, mn, mx, has_set: bool,
         keep = keep & (dset_vals[idx] == c.data) \
             & (idx < dset_count)
     return Batch(batch.columns, batch.row_valid & keep)
+
+
+distinct_set = _instr(distinct_set, "dynamic_filter")
+apply_filter = _instr(apply_filter, "dynamic_filter")
 
 
 def apply(batch: Batch, col: str, f: DFilter) -> Batch:

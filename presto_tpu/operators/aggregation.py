@@ -70,7 +70,8 @@ def merge_states(states, aggs, out_cap: int):
 _MERGE_FANIN = 8
 
 #: live-group count of a partial (consumed one round later, async)
-_jit_count = jax.jit(lambda valid: jnp.sum(valid))
+_jit_count = _instr(jax.jit(lambda valid: jnp.sum(valid)),
+                    "agg_count")
 
 #: Smallest state capacity the shrink protocol packs down to. Keeps the
 #: compiled-shape set bounded (tiny partials all land on one bucket) and
@@ -87,6 +88,9 @@ def _shrink_state(st: "hashagg.GroupByState", cap: int):
         [(d[:cap], m[:cap]) for d, m in st.keys],
         [tuple(a[:cap] for a in t) for t in st.states],
         st.valid[:cap], st.overflow)
+
+
+_shrink_state = _instr(_shrink_state, "agg_shrink")
 
 #: Whole-step kernel cache keyed by the expression IRs + agg layout so a
 #: re-executed (or structurally identical) query reuses the compiled XLA

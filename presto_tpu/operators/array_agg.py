@@ -147,6 +147,13 @@ def _collect_kernel(batch: Batch, key_syms: Tuple[str, ...],
     return gkeys, gvalid, outputs, overflow
 
 
+# compile-vs-execute attribution for the array_agg/map_agg family —
+# previously an uninstrumented module-level jit
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+_collect_kernel = _instr(_collect_kernel, "array_agg")
+
+
 class ArrayAggOperator(Operator):
     def __init__(self, ctx: OperatorContext, key_names: Sequence[str],
                  key_exprs: Sequence[CompiledExpr],
@@ -297,7 +304,9 @@ class ArrayAggOperatorFactory(OperatorFactory):
                 if s.mask is not None:
                     cols[f"__f{i}"] = as_col(s.mask, f"f{i}")
             return Batch(cols, batch.row_valid)
-        self._eval = eval_kernel
+        # per-factory eval jit: registered under the same family so
+        # its (per plan shape) compiles attribute to array_agg too
+        self._eval = _instr(eval_kernel, "array_agg")
 
     def create(self, driver_context: DriverContext) -> Operator:
         return ArrayAggOperator(

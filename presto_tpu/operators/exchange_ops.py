@@ -109,6 +109,14 @@ def partition_segments(batch: Batch, partition_keys: Tuple[str, ...],
     return Batch(cols, out[1]), bounds
 
 
+# compile-vs-execute attribution for the repartition family —
+# previously an uninstrumented module-level jit whose compile landed
+# in exchange-push busy time
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+partition_segments = _instr(partition_segments, "exchange_partition")
+
+
 def edge_key_dicts(edge) -> List:
     """Dictionaries of an edge's partition-key fields (in key order)."""
     return [next((f.dictionary for f in edge.fields if f.symbol == k),

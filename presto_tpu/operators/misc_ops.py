@@ -136,6 +136,13 @@ def _cross_product(probe: Batch, build: Batch, out_cap: int) -> Batch:
     return Batch(cols, live)
 
 
+# compile-vs-execute attribution for the nested-loop (cross join)
+# family — previously an uninstrumented module-level jit
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+_cross_product = _instr(_cross_product, "nested_loop")
+
+
 class AssignUniqueIdOperator(Operator):
     """Appends a unique BIGINT row-id column (reference:
     AssignUniqueIdOperator): id = batch_offset + position. Padding rows

@@ -816,3 +816,36 @@ def _semi_scan(table, probe, key_names, lo_enc, build_keys, verify):
     _, found = jax.lax.while_loop(
         cond, body, (jnp.asarray(UNROLL, jnp.int32), found))
     return found & valid, valid
+
+
+# -- instrumented public entry points ---------------------------------
+#
+# Compile-vs-execute attribution for the join kernel families, same
+# contract as ops/sort.py: the operator-facing host entry points wrap
+# with instrument_kernel, and the `jits=[...]` lists name every
+# module-level jit an entry point composes so all executable caches
+# are polled for compile detection (the operator-layer probe kernels
+# in operators/join_ops.py register their own per-plan jits the same
+# way). The *_impl jits above stay unwrapped so they can compose into
+# other traces without double accounting.
+from presto_tpu.telemetry.kernels import instrument_kernel as _instr
+
+build_for_backend = _instr(
+    build_for_backend, "join_build",
+    jits=[_build_sorted, _build_hash, _build_apply_perm])
+probe_join = _instr(
+    probe_join, "join_probe",
+    jits=[_hash_jit, _search_jit, _expand_dispatch,
+          _probe_join_fused, _expand_general_jit])
+probe_join_full = _instr(
+    probe_join_full, "join_probe",
+    jits=[_hash_jit, _search_jit, _expand_dispatch,
+          _probe_join_fused, _expand_general_jit])
+probe_counts = _instr(
+    probe_counts, "join_probe",
+    jits=[_hash_jit, _search_jit, _counts_jit])
+semi_mark = _instr(
+    semi_mark, "semi_join",
+    jits=[_hash_jit, _search_jit, _semi_from_enc, _semi_scan_jit,
+          _semi_fused, _semi_unique_fused])
+unmatched_build = _instr(unmatched_build, "join_outer")

@@ -202,6 +202,11 @@ def fuse_pipelines(pipelines: List[List], node_ops=None,
         "fragments": entries,
         "fused": sum(1 for e in entries if e["fused"] is not None),
         "fallback": fallback,
+        # absorbed operator id -> surviving fused operator id: the
+        # PlanChecker's barrier-legality evidence (validation.py
+        # check_fusion verifies only adjacent FilterProject stages
+        # were absorbed and every barrier survived)
+        "id_remap": dict(id_remap),
     }
 
 

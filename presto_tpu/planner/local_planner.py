@@ -173,6 +173,12 @@ class LocalExecutionPlanner:
 
     def plan(self, root: N.OutputNode) -> LocalExecutionPlan:
         prune_unused_columns(root)
+        # sanity gate at the planner handoff: the pruned plan this
+        # visitor consumes must still resolve (prune mutates output
+        # tuples in place — a bug there used to surface as a KeyError
+        # deep inside an operator, attributed to nothing)
+        from presto_tpu.planner.validation import validate
+        validate(root, "local_planner", session=self.session)
         self._shared = _shared_nodes(root)
         sink: List[Batch] = []
         pipeline: List = []
@@ -210,6 +216,11 @@ class LocalExecutionPlanner:
         from presto_tpu.operators.exchange_ops import (
             ExchangeSinkOperatorFactory,
         )
+        if self.task.index == 0:
+            # one validation per fragment, not per task — every task
+            # of a fragment plans the SAME root
+            from presto_tpu.planner.validation import validate
+            validate(root, "local_planner", session=self.session)
         self._shared = _shared_nodes(root)
         pipeline: List = []
         self._visit(root, pipeline)
@@ -241,9 +252,20 @@ class LocalExecutionPlanner:
                                   "hbm_budget_bytes")
                      or get_property(self.session.properties,
                                      "cluster_memory_bytes"))
+        from presto_tpu.planner import validation as _validation
+        check = _validation.validation_enabled(self.session)
+        snapshot = _validation.CHECKER.snapshot_pipelines(
+            self._pipelines) if check else None
         self.fusion_report = fuse_pipelines(
             self._pipelines, self.node_ops,
             spill_enabled=spill_possible)
+        if check:
+            # barrier legality: fusion may only have absorbed
+            # adjacent FilterProject stages; every record/replay/
+            # spool/exchange barrier of the snapshot must survive
+            _validation.CHECKER.check_fusion(
+                snapshot, self._pipelines,
+                self.fusion_report.get("id_remap", {}))
 
     # ------------------------------------------------------------------
 

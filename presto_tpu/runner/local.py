@@ -887,8 +887,15 @@ class LocalRunner:
             plan = plan_statement(stmt, self.catalogs, self.session)
         except AnalysisError as e:
             raise QueryError(str(e)) from e
+        # sanity checks at every pass boundary (reference:
+        # PlanSanityChecker between optimizer passes): a pass that
+        # corrupts the plan fails HERE, attributed to itself
+        from presto_tpu.planner.validation import validate
+        validate(plan, "analysis", session=self.session)
         from presto_tpu.planner.optimizer import optimize
         plan = optimize(plan, self.catalogs)
+        validate(plan, "optimizer", session=self.session,
+                 catalogs=self.catalogs)
         if key is not None:
             # prune BEFORE publishing: every later execution's
             # planner re-prunes the shared graph, and pruning an
@@ -1331,8 +1338,13 @@ class LocalRunner:
             plan = plan_statement(q, self.catalogs, self.session)
         except AnalysisError as e:
             raise QueryError(str(e)) from e
+        from presto_tpu.planner.validation import validate
+        validate(plan, "analysis", session=self.session)
         from presto_tpu.planner.optimizer import optimize
-        return optimize(plan, self.catalogs)
+        plan = optimize(plan, self.catalogs)
+        validate(plan, "optimizer", session=self.session,
+                 catalogs=self.catalogs)
+        return plan
 
     def _run_write(self, qplan: N.OutputNode, handle, sink,
                    schema, column_sources: Dict[str, Optional[str]]

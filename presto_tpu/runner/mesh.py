@@ -65,7 +65,17 @@ class MeshRunner(LocalRunner):
         from presto_tpu.operators.join_ops import JoinCapacityExceeded
         prune_unused_columns(plan)
         plan = add_exchanges(plan, self.catalogs, self.session)
+        # pass-boundary sanity: the exchanged plan must still resolve
+        # (exchanges.py rewrites in place), and the fragment cut must
+        # keep producer/consumer schemes, schemas and partition keys
+        # consistent — the precondition for sharding-preserving stage
+        # boundaries (reference: PlanSanityChecker after AddExchanges)
+        from presto_tpu.planner.validation import (
+            validate, validate_fragments,
+        )
+        validate(plan, "exchanges", session=self.session)
         fplan = fragment_plan(plan)
+        validate_fragments(fplan, "exchanges", session=self.session)
         session = self.session
         # query-local OOM escalation state: (operator, lifespans at the
         # failure, bytes it asked for) of the previous OOM
@@ -445,6 +455,12 @@ class MeshRunner(LocalRunner):
                 if d.is_finished():
                     continue
                 all_done = False
+                # per-DRIVER checkpoint, the same cadence the
+                # TaskExecutor gives every quantum: a mesh round walks
+                # (fragments x tasks) drivers and each process() may
+                # hide a multi-second XLA compile — a kill/deadline
+                # must land within one driver hand-off, not one round
+                check_lifecycle(cancel, deadline)
                 try:
                     progress = d.process() or progress
                 except RetryableTaskError:

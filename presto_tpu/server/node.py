@@ -779,11 +779,20 @@ def derive_fragments(runner, sql: str, stmt=None):
         stmt = parse_statement(sql)
     if isinstance(stmt, T.Explain):
         stmt = stmt.statement
-    plan = optimize(runner.create_plan(sql, stmt=stmt),
-                    runner.catalogs)
+    from presto_tpu.planner.validation import (
+        validate, validate_fragments,
+    )
+    plan = runner.create_plan(sql, stmt=stmt)
+    validate(plan, "analysis", session=runner.session)
+    plan = optimize(plan, runner.catalogs)
+    validate(plan, "optimizer", session=runner.session,
+             catalogs=runner.catalogs)
     prune_unused_columns(plan)
     plan = add_exchanges(plan, runner.catalogs, runner.session)
-    return fragment_plan(plan)
+    validate(plan, "exchanges", session=runner.session)
+    fplan = fragment_plan(plan)
+    validate_fragments(fplan, "exchanges", session=runner.session)
+    return fplan
 
 
 def build_http_exchanges(query_id: str, fplan,

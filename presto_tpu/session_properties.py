@@ -191,6 +191,17 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "chain via tools/fusion_report.py "
         "(docs/FRAGMENT_COMPILATION.md)"),
     PropertyDef(
+        "plan_validation_enabled", "boolean", True,
+        "Run the PlanChecker (planner/validation.py) after analysis "
+        "and after every planner pass (optimizer, exchanges, fusion, "
+        "local planning handoff): schema/symbol resolution, exchange "
+        "partitioning consistency, fused-chain barrier legality, "
+        "cache-determinism cross-checks. Violations fail the query "
+        "with a structured PlanValidationError naming the pass that "
+        "broke the plan (reference: sql/planner/sanity/"
+        "PlanSanityChecker). Tree walks are cheap next to XLA "
+        "compiles; off = zero checking (docs/STATIC_ANALYSIS.md)"),
+    PropertyDef(
         "task_executor_enabled", "boolean", True,
         "Drive this statement's pipelines on the process-wide "
         "time-sliced TaskExecutor (worker pool + multilevel feedback "

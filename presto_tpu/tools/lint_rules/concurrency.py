@@ -1,0 +1,222 @@
+"""Concurrency rules (CC0xx): the shared-mutable-state hazard class.
+Scope: the layers many threads cross — `execution/`, `runner/`,
+`server/`, `telemetry/`, `cache/`.
+
+Why these exist: the time-sliced TaskExecutor (PR 8) made every
+statement's drivers migrate across a worker pool, and its review
+round caught four shared-state races BY LUCK. Each rule makes one of
+those hazard shapes machine-checked:
+
+  CC001  module-level mutable container mutated outside a lock —
+         the executor runs this code from many workers at once
+  CC002  bare `+=`/`-=` on an attribute inside a lock-owning class,
+         outside its lock — read-modify-write races exactly like the
+         counter merges PR 8 had to move under the task lock
+  CC003  a thread-local attribute read that NO code path installs —
+         getattr defaults silently hide a missing bind() site
+  CC004  a drive loop (`.process()` / `.process_quantum()` in a
+         loop) whose function never runs the shared
+         `check_lifecycle` checkpoint — cancellation/deadline would
+         not land within a bounded number of hand-offs
+
+Conventions the rules honor (docs/STATIC_ANALYSIS.md):
+  * `with <anything lock/cond/mutex-named>:` counts as holding a lock
+  * a function named `*_locked` asserts its caller holds the lock
+  * thread-local ATTRIBUTE writes are their own synchronization
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from presto_tpu.tools.lint_rules import (
+    Finding, ModuleInfo, Project, dotted, in_locked_context,
+    is_threading_ctor, rule, terminal_name, threadlocal_roots,
+)
+
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter", "WeakSet", "WeakValueDictionary"}
+_MUTATING_METHODS = {"append", "add", "update", "pop", "popitem",
+                     "setdefault", "extend", "remove", "clear",
+                     "insert", "discard", "appendleft", "popleft"}
+
+
+def _module_mutables(mod: ModuleInfo) -> Set[str]:
+    """Module-level names bound to mutable containers (thread-local
+    roots excluded — attribute access on them is per-thread)."""
+    out: Set[str] = set()
+    tl = threadlocal_roots(mod)
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        mutable = isinstance(v, (ast.Dict, ast.List, ast.Set,
+                                 ast.DictComp, ast.ListComp,
+                                 ast.SetComp)) \
+            or (isinstance(v, ast.Call)
+                and terminal_name(v.func) in _MUTABLE_CTORS)
+        if not mutable:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id not in tl:
+                out.add(tgt.id)
+    return out
+
+
+def _inside_function(mod: ModuleInfo, node: ast.AST) -> bool:
+    return any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+               for a in mod.ancestors(node))
+
+
+@rule("CC001", "module-level mutable state mutated outside a lock")
+def check_global_mutation(mod: ModuleInfo,
+                          project: Project) -> List[Finding]:
+    globals_ = _module_mutables(mod)
+    if not globals_:
+        return []
+    out: List[Finding] = []
+
+    def root_name(n: ast.AST) -> Optional[str]:
+        while isinstance(n, ast.Subscript):
+            n = n.value
+        return n.id if isinstance(n, ast.Name) else None
+
+    for node in ast.walk(mod.tree):
+        name = None
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    name = root_name(tgt)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, (ast.Subscript, ast.Name)):
+                name = root_name(node.target)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    name = root_name(tgt)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS \
+                and isinstance(node.func.value, ast.Name):
+            name = node.func.value.id
+        if name is None or name not in globals_:
+            continue
+        if not _inside_function(mod, node):
+            continue  # import-time init is single-threaded
+        if in_locked_context(mod, node):
+            continue
+        out.append(mod.finding(
+            "CC001", node,
+            f"module-level mutable {name!r} mutated without holding "
+            "a lock — executor workers run this concurrently"))
+    return out
+
+
+def _lock_owning_classes(mod: ModuleInfo) -> Dict[str, ast.ClassDef]:
+    """Classes that assign a threading.Lock/RLock/Condition to a self
+    attribute anywhere (usually __init__)."""
+    out: Dict[str, ast.ClassDef] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) \
+                    and is_threading_ctor(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        out[node.name] = node
+    return out
+
+
+@rule("CC002", "bare augmented assignment on shared attribute in a "
+               "lock-owning class")
+def check_bare_counter(mod: ModuleInfo,
+                       project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in _lock_owning_classes(mod).values():
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                continue  # construction happens-before sharing
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.AugAssign):
+                    continue
+                if not isinstance(node.target, ast.Attribute):
+                    continue
+                if in_locked_context(mod, node):
+                    continue
+                tgt = dotted(node.target) or node.target.attr
+                out.append(mod.finding(
+                    "CC002", node,
+                    f"{cls.name}.{fn.name} does a bare "
+                    f"read-modify-write on {tgt!r} outside the "
+                    "class's lock — racing quanta lose increments"))
+    return out
+
+
+@rule("CC003", "thread-local attribute read without any install site")
+def check_threadlocal_read(mod: ModuleInfo,
+                           project: Project) -> List[Finding]:
+    roots = threadlocal_roots(mod)
+    if not roots:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        attr = None
+        if isinstance(node, ast.Call) \
+                and terminal_name(node.func) == "getattr" \
+                and len(node.args) >= 2 \
+                and terminal_name(node.args[0]) in roots \
+                and isinstance(node.args[1], ast.Constant):
+            attr = node.args[1].value
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and terminal_name(node.value) in roots:
+            attr = node.attr
+        if attr is None or attr in project.threadlocal_written:
+            continue
+        out.append(mod.finding(
+            "CC003", node,
+            f"thread-local attribute {attr!r} is read but never "
+            "installed anywhere in the tree — a getattr default "
+            "would silently hide the missing bind site"))
+    return out
+
+
+@rule("CC004", "drive loop without the shared check_lifecycle "
+               "checkpoint")
+def check_drive_loop(mod: ModuleInfo,
+                     project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_checkpoint = any(
+            terminal_name(n.func) == "check_lifecycle"
+            for n in ast.walk(fn) if isinstance(n, ast.Call))
+        if has_checkpoint:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            drives = [
+                sub for sub in ast.walk(node)
+                if isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("process", "process_quantum")]
+            if drives:
+                out.append(mod.finding(
+                    "CC004", node,
+                    f"{fn.name!r} drives operators in a loop without "
+                    "running check_lifecycle — cancellation and "
+                    "deadlines would not land within a bounded "
+                    "number of hand-offs"))
+                break  # one finding per function is enough
+    return out
+
+
+CONCURRENCY_RULES = (check_global_mutation, check_bare_counter,
+                     check_threadlocal_read, check_drive_loop)
