@@ -29,6 +29,7 @@ import dataclasses
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from presto_tpu import sanitize
 from presto_tpu.execution.memory import (
     MemoryLimitExceeded, MemoryPool, batch_bytes,
 )
@@ -216,7 +217,7 @@ class PlanCache:
     def __init__(self, max_entries: int = 256):
         self.max_entries = max_entries
         self.stats = CacheStats()
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("cache.plan")
         #: key -> [(plan, [(handle, (token, version))]), ...] newest last
         self._entries: "collections.OrderedDict[Any, list]" = \
             collections.OrderedDict()
@@ -315,8 +316,9 @@ class PlanCache:
 class CacheManager:
     def __init__(self, budget_bytes: Optional[int] = None):
         self.pool = MemoryPool(budget_bytes)
-        lock = threading.Lock()
+        lock = sanitize.lock("cache.results")
         self.plan = PlanCache()
+        sanitize.track("cache_manager", self)
         self.fragment = ResultCache("cache:fragment", self.pool, lock)
         # page entries are whole splits (the successor of the tpch
         # connector's private scan cache, which admitted multi-GB
@@ -372,7 +374,7 @@ class CacheManager:
 # per-server; queries of every session share one cache + one budget)
 
 _MANAGER: Optional[CacheManager] = None
-_MANAGER_LOCK = threading.Lock()
+_MANAGER_LOCK = sanitize.lock("cache.manager")
 
 
 def get_cache_manager(properties: Optional[Dict[str, Any]] = None,

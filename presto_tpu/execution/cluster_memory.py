@@ -16,8 +16,9 @@ landing between task allocations)."""
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
+
+from presto_tpu import sanitize
 
 
 class QueryKilledByMemoryManager(Exception):
@@ -40,7 +41,7 @@ class ClusterMemoryManager:
 
     def __init__(self, budget_bytes: int):
         self.budget = int(budget_bytes)
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("memory.cluster")
         self._reserved: Dict[str, int] = {}
         self._kill: Dict[str, QueryKilledByMemoryManager] = {}
         self.kills = 0

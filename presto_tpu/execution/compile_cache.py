@@ -27,9 +27,10 @@ attribution counters prove it — see tools/serving_bench.py
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
+
+from presto_tpu import sanitize
 
 #: environment surface (the config-file analog): set on the server
 #: process to persist XLA executables across restarts
@@ -38,7 +39,7 @@ ENV_CACHE_DIR = "PRESTO_TPU_COMPILATION_CACHE_DIR"
 #: statement per non-comment line) run at coordinator start
 ENV_PREWARM_SQL = "PRESTO_TPU_PREWARM_SQL"
 
-_LOCK = threading.Lock()
+_LOCK = sanitize.lock("compile_cache.config")
 _CONFIGURED_DIR: Optional[str] = None
 
 

@@ -31,12 +31,12 @@ nothing) while their distinct set prunes hard.
 from __future__ import annotations
 
 import functools
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from presto_tpu import sanitize
 from presto_tpu.batch import Batch
 
 #: Max distinct build keys carried as a set; more degrades to bounds
@@ -83,7 +83,7 @@ class DynamicFilterService:
     merged filter only once complete."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("execution.dynamic_filters")
         self._expected: Dict[int, int] = {}
         #: df_id -> {publisher token: DFilter}. Keyed by token so a
         #: RETRIED recoverable generation re-publishing its partial

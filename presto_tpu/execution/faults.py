@@ -42,14 +42,15 @@ context dict instead of a spec string.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Any, Callable, Dict, List, Optional
+
+from presto_tpu import sanitize
 
 #: fast gate read by every site before calling fire(); kept exactly
 #: in sync with "any injection armed" under _LOCK
 ARMED = False
 
-_LOCK = threading.Lock()
+_LOCK = sanitize.lock("faults.registry")
 _INJECTIONS: Dict[str, List["_Injection"]] = {}
 #: last spec applied by ensure_spec — re-applying the SAME spec is a
 #: no-op so per-execution arming doesn't reset trigger counters

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Optional
 
+from presto_tpu import sanitize
 from presto_tpu.batch import Batch
 
 
@@ -54,6 +55,14 @@ class MemoryPool:
         #: tag -> () -> bytes freed; registered by spillable operators
         self._revocables: Dict[str, Callable[[], int]] = {}
         self.revocations = 0
+        #: ledger mutations are locked: one query's drivers migrate
+        #: across executor workers, and two operators of one query
+        #: reserving concurrently raced the bare `reserved +=` before
+        #: the sanitizer flagged it (CC002 shape). REENTRANT because
+        #: _revoke's spill callbacks free their own reservations from
+        #: inside reserve()'s lock hold.
+        self._lock = sanitize.rlock("memory.pool")
+        sanitize.track("memory_pool", self)
         #: cluster tier (reference: ClusterMemoryManager): when
         #: attached, reservations roll up cross-query and the manager
         #: may kill this query at its next allocation
@@ -71,12 +80,14 @@ class MemoryPool:
 
     def register_revocable(self, tag: str,
                            spill: Callable[[], int]) -> None:
-        self._revocables[tag] = spill
+        with self._lock:
+            self._revocables[tag] = spill
 
     def unregister_revocable(self, tag: str) -> None:
-        self._revocables.pop(tag, None)
+        with self._lock:
+            self._revocables.pop(tag, None)
 
-    def _revoke(self, needed: int, requesting: str) -> None:
+    def _revoke_locked(self, needed: int, requesting: str) -> None:
         """Ask spillable holders (largest first) to move state off the
         device until `needed` more bytes fit. The REQUESTING operator
         is revoked last — its callback then runs re-entrantly inside
@@ -101,18 +112,20 @@ class MemoryPool:
         if self._cluster is not None:
             # the cluster kill lands at the victim's next allocation
             self._cluster.check(self._cluster_qid)
-        if self.budget is not None \
-                and self.reserved + nbytes > self.budget:
-            if self._revocables:
-                self._revoke(nbytes, tag)
-            if self.reserved + nbytes > self.budget:
-                raise MemoryLimitExceeded(tag, nbytes, self.reserved,
-                                          self.budget)
-        self.reserved += nbytes
-        self._by_tag[tag] = self._by_tag.get(tag, 0) + nbytes
-        self.peak = max(self.peak, self.reserved)
-        self.peak_by_tag[tag] = max(self.peak_by_tag.get(tag, 0),
-                                    self._by_tag[tag])
+        with self._lock:
+            if self.budget is not None \
+                    and self.reserved + nbytes > self.budget:
+                if self._revocables:
+                    self._revoke_locked(nbytes, tag)
+                if self.reserved + nbytes > self.budget:
+                    raise MemoryLimitExceeded(tag, nbytes,
+                                              self.reserved,
+                                              self.budget)
+            self.reserved += nbytes
+            self._by_tag[tag] = self._by_tag.get(tag, 0) + nbytes
+            self.peak = max(self.peak, self.reserved)
+            self.peak_by_tag[tag] = max(self.peak_by_tag.get(tag, 0),
+                                        self._by_tag[tag])
         if self._cluster is not None:
             self._cluster_sync()
             # if THIS allocation pushed the cluster over and made this
@@ -123,10 +136,12 @@ class MemoryPool:
     def free(self, tag: str, nbytes: int) -> None:
         if nbytes <= 0:
             return
-        self.reserved -= nbytes
-        self._by_tag[tag] = self._by_tag.get(tag, 0) - nbytes
+        with self._lock:
+            self.reserved -= nbytes
+            self._by_tag[tag] = self._by_tag.get(tag, 0) - nbytes
         self._cluster_sync()
 
     def free_all(self, tag: str) -> None:
-        self.reserved -= self._by_tag.pop(tag, 0)
+        with self._lock:
+            self.reserved -= self._by_tag.pop(tag, 0)
         self._cluster_sync()

@@ -41,10 +41,11 @@ import collections
 import dataclasses
 import itertools
 import re
-import threading
 import time
 import weakref
 from typing import Callable, Dict, List, Optional, Tuple
+
+from presto_tpu import sanitize
 
 
 @dataclasses.dataclass
@@ -267,9 +268,10 @@ class ResourceGroupManager:
                  selectors: Optional[List[Selector]] = None):
         self._root = _Group(root, None)
         self._selectors = selectors or []
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("admission.groups")
         self._seq = itertools.count()
         _MANAGERS.add(self)
+        sanitize.track("resource_groups", self)
 
     # -- routing -----------------------------------------------------------
 

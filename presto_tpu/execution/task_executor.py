@@ -43,8 +43,10 @@ import itertools
 import os
 import threading
 import time
+import weakref
 from typing import Callable, List, Optional
 
+from presto_tpu import sanitize
 from presto_tpu.operators.driver import Driver
 
 #: accumulated-scheduled-time thresholds (seconds) at which a task's
@@ -89,14 +91,17 @@ class _DriverEntry:
     while state == "running" (the executor's single-ownership
     invariant); all transitions happen under the executor lock."""
 
-    __slots__ = ("driver", "task", "state", "level", "scheduled_ns")
+    __slots__ = ("driver", "task", "state", "level", "scheduled_ns",
+                 "idx")
 
-    def __init__(self, driver: Driver, task: "_TaskHandle"):
+    def __init__(self, driver: Driver, task: "_TaskHandle",
+                 idx: int = 0):
         self.driver = driver
         self.task = task
         self.state = "new"      # new|queued|running|parked|done
         self.level = 0
         self.scheduled_ns = 0
+        self.idx = idx          # position within the task (fuzz trace)
 
 
 class _TaskHandle:
@@ -129,7 +134,7 @@ class _TaskHandle:
         #: submitting thread's per-query kernel counter dict (quanta
         #: merge their scratch counters into it under _merge_lock)
         self.counters = _tk.query_counters()
-        self._merge_lock = threading.Lock()
+        self._merge_lock = sanitize.lock("executor.task_merge")
         self.shape_buckets = _batch.shape_buckets_override()
         self.recorder = _trace.current()
 
@@ -178,7 +183,7 @@ class TaskExecutor:
         self.thresholds = tuple(float(t) for t in level_thresholds_s)
         self.n_levels = len(self.thresholds)
         self.poll_interval_s = float(poll_interval_s)
-        self._cond = threading.Condition()
+        self._cond = sanitize.condition("executor.pool")
         self._runnable = [collections.deque()
                           for _ in range(self.n_levels)]
         #: scheduled ns accounted per level; dequeue picks the
@@ -196,6 +201,11 @@ class TaskExecutor:
         self._tasks = 0
         self._quanta = 0
         self._demotions = 0
+        #: tasks with at least one entry not fully drained — what the
+        #: single-ownership auditor sweeps (pruned in
+        #: _check_task_done_locked once every entry is done)
+        self._live: set = set()
+        sanitize.track("executor", self)
 
     # -- submission ----------------------------------------------------
 
@@ -220,8 +230,9 @@ class TaskExecutor:
         with self._cond:
             self._ensure_started_locked()
             self._tasks += 1
+            self._live.add(task)
             for d in live:
-                e = _DriverEntry(d, task)
+                e = _DriverEntry(d, task, idx=len(task.entries))
                 task.entries.append(e)
                 task.pending += 1
             for e in task.entries:
@@ -241,9 +252,16 @@ class TaskExecutor:
         if self._threads or self._stop:
             return
         for i in range(self.workers):
-            t = threading.Thread(target=self._worker_loop,
-                                 name=f"presto-tpu-executor-{i}",
-                                 daemon=True)
+            # the stop signal must not strongly pin the executor (the
+            # leak auditor's owner-collected check relies on the owner
+            # actually being collectable)
+            t = sanitize.thread(
+                target=self._worker_loop,
+                name=f"presto-tpu-executor-{i}",
+                daemon=True, owner=self,
+                stop_signal=lambda ref=weakref.ref(self):
+                    ref() is not None and ref()._stop,
+                purpose="executor-worker")
             t.start()
             self._threads.append(t)
 
@@ -269,13 +287,12 @@ class TaskExecutor:
                 entry.state = "running"
                 entry.task.running += 1
                 self._running += 1
-            try:
-                self._run_quantum(entry)
-            finally:
-                with self._cond:
-                    self._running -= 1
-                    entry.task.running -= 1
-                    self._check_task_done_locked(entry.task)
+            # _run_quantum owns the release: ownership hand-back and
+            # the entry's next-state transition happen in ONE critical
+            # section, so the single-ownership auditor never observes
+            # a half-released driver (a parked entry still counted as
+            # running, or vice versa)
+            self._run_quantum(entry)
 
     def _next_wait_locked(self, now: float) -> float:
         if self._parked:
@@ -335,9 +352,20 @@ class TaskExecutor:
         if others:
             self._level_ns[lvl] = max(self._level_ns[lvl],
                                       min(others))
-        return self._runnable[lvl].popleft()
+        q = self._runnable[lvl]
+        fz = sanitize.FUZZ  # snapshot: a concurrent unfuzz must not
+        if fz is not None and len(q) > 1:  # None out mid-use
+            # schedule fuzz: the level choice (fairness) stays, but
+            # WHICH equal-priority entry runs next is seeded-random
+            q.rotate(-fz.pick(len(q)))
+        return q.popleft()
 
     def _park_locked(self, entry: _DriverEntry, delay: float) -> None:
+        fz = sanitize.FUZZ
+        if fz is not None:
+            # schedule fuzz: jitter the park deadline so blocked
+            # drivers re-poll early/late, racing sibling progress
+            delay = fz.park_jitter(delay)
         entry.state = "parked"
         heapq.heappush(self._parked,
                        (time.monotonic() + delay, next(self._seq),
@@ -366,12 +394,25 @@ class TaskExecutor:
         failed and no worker still holds one of its drivers (the
         submitter must not tear down operator state a sibling quantum
         is still touching)."""
+        if task.pending <= 0 and task.running == 0:
+            # fully drained (a failed task's queued entries finish
+            # through the fail-fast path): drop it from the audit set
+            self._live.discard(task)
         if task.done.is_set():
             return
         if task.pending <= 0 and task.running == 0:
             task.done.set()
         elif task.failure is not None and task.running == 0:
             task.done.set()
+
+    def _release_locked(self, entry: _DriverEntry) -> None:
+        """Hand the worker's ownership of `entry` back to the pool
+        accounting. Must share a critical section with the entry's
+        next-state transition — the single-ownership invariant audit
+        relies on 'state == running' and 'counted in task.running'
+        flipping atomically."""
+        self._running -= 1
+        entry.task.running -= 1
 
     def _run_quantum(self, entry: _DriverEntry) -> None:
         from presto_tpu.telemetry.metrics import METRICS
@@ -380,14 +421,21 @@ class TaskExecutor:
             # fail-fast drain: a failed task's queued drivers never
             # run another quantum
             with self._cond:
+                self._release_locked(entry)
                 self._finish_entry_locked(entry)
             return
         err: Optional[BaseException] = None
         status = Driver.IDLE
         progressed = False
+        quantum_s = task.quantum_s
+        fz = sanitize.FUZZ  # snapshot: survives a concurrent unfuzz
+        if fz is not None:
+            # schedule fuzz: forced preemption — a seeded shrink of
+            # the slice moves every cooperative yield point earlier
+            quantum_s *= fz.quantum_scale()
         t0 = time.perf_counter_ns()
-        token = task.bind()
         try:
+            token = task.bind()
             try:
                 from presto_tpu.execution import faults
                 if faults.ARMED:
@@ -396,6 +444,11 @@ class TaskExecutor:
                     # query mid-execution without monkeypatching
                     faults.fire("executor.quantum", task=task.label,
                                 level=entry.level)
+                if sanitize.ARMED:
+                    # quantum-boundary checkpoint: a violated
+                    # executor invariant fails the owning query
+                    # cleanly through the task-failure path
+                    sanitize.audit_executor(self)
                 from presto_tpu.runner.local import check_lifecycle
                 check_lifecycle(task.cancel, task.deadline)
                 if task.abort_check is not None:
@@ -403,13 +456,14 @@ class TaskExecutor:
                     if exc is not None:
                         raise exc
                 status, progressed = entry.driver.process_quantum(
-                    task.quantum_s)
+                    quantum_s)
             finally:
                 task.unbind(token)
         except BaseException as e:  # noqa: BLE001 — task-scoped fail
             err = e
         dur = time.perf_counter_ns() - t0
         with self._cond:
+            self._release_locked(entry)
             self._quanta += 1
             entry.scheduled_ns += dur
             task.scheduled_ns += dur
@@ -443,6 +497,11 @@ class TaskExecutor:
                 else:  # IDLE: state machines need another pass soon
                     self._park_locked(entry, self.poll_interval_s)
                     outcome = "idle"
+            self._check_task_done_locked(task)
+            if fz is not None:
+                # under the pool lock: the trace order IS the
+                # schedule order (the determinism oracle)
+                fz.note(task.label, entry.idx, outcome)
         METRICS.inc("presto_tpu_executor_quanta_total", status=outcome)
 
     @staticmethod
@@ -474,7 +533,7 @@ class TaskExecutor:
 #: THE process-wide executor (like the cache-manager singleton): every
 #: runner/coordinator/worker task of this process time-shares one pool
 _DEFAULT: Optional[TaskExecutor] = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = sanitize.lock("executor.singleton")
 
 
 def get_task_executor(create: bool = True

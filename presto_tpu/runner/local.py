@@ -57,13 +57,14 @@ def check_lifecycle(cancel, deadline: Optional[float]) -> None:
 _PLUGIN_REGISTRY_CACHE: Dict[str, Any] = {}
 import itertools as _itertools
 import threading as _threading
-_PLUGIN_CACHE_LOCK = _threading.Lock()
+from presto_tpu import sanitize as _sanitize
+_PLUGIN_CACHE_LOCK = _sanitize.lock("runner.plugin_cache")
 #: identity tokens minted for unhashable access-control objects and
 #: STAMPED onto them (like Connector.cache_token) — the token dies
 #: with the policy, so nothing is pinned and a recycled address can
 #: never alias a different policy's cached plans
 _AC_TOKEN_MINT = _itertools.count()
-_AC_TOKEN_LOCK = _threading.Lock()
+_AC_TOKEN_LOCK = _sanitize.lock("runner.ac_token")
 
 
 @dataclasses.dataclass
@@ -426,7 +427,7 @@ class LocalRunner:
 
     # ------------------------------------------------------------------
 
-    _cluster_mgr_lock = _threading.Lock()
+    _cluster_mgr_lock = _sanitize.lock("runner.cluster_mgr")
     #: process-wide query-id mint for cluster-memory tracking
     #: (itertools.count.__next__ is atomic under the GIL)
     _cm_qid_mint = _itertools.count()
@@ -565,7 +566,12 @@ class LocalRunner:
             d = _time.monotonic() + float(limit_ms) / 1000.0
             deadline = d if deadline is None else min(deadline, d)
         if self.resource_groups is None:
-            return self._execute_admitted(sql, cancel, deadline)
+            result = self._execute_admitted(sql, cancel, deadline)
+            if _sanitize.ARMED:
+                # query-finish checkpoint: every tracked ledger must
+                # balance once this statement's drivers closed
+                _sanitize.audit()
+            return result
         # embedded admission control: submit through the runner's
         # resource groups (per-user fair queueing, caps, shedding)
         # before any planning work happens; the released slot
@@ -573,7 +579,7 @@ class LocalRunner:
         group, mem, queued_ms = self._admit(cancel, deadline)
         self._session_tl.queued_ms = queued_ms
         try:
-            return self._execute_admitted(sql, cancel, deadline)
+            result = self._execute_admitted(sql, cancel, deadline)
         finally:
             self._session_tl.queued_ms = 0.0
             # release EXACTLY the reservation _admit charged — the
@@ -581,6 +587,9 @@ class LocalRunner:
             # SESSION), and recomputing here would corrupt the
             # group's memory ledger permanently
             self.resource_groups.finish(group, mem)
+        if _sanitize.ARMED:
+            _sanitize.audit()
+        return result
 
     def _admit(self, cancel, deadline: Optional[float]):
         """Submit this statement to the runner's ResourceGroupManager

@@ -1,0 +1,240 @@
+"""Concurrency sanitizer: lock-order deadlock detection, runtime
+invariant auditors, and deterministic schedule fuzzing
+(docs/SANITIZERS.md).
+
+Same zero-disarmed-overhead gate pattern as `faults.ARMED` and
+`trace.ACTIVE`: every hook in the engine guards on a module attribute
+(`sanitize.ARMED` / `sanitize.FUZZ`), and the primitive factories
+return RAW threading objects when disarmed — the shipping hot path
+pays one attribute load and branch per site, nothing else.
+
+Three surfaces:
+
+  * **Lock classes** — every lock in the covered layers is created by
+    `sanitize.lock("subsystem.name")` (resp. `rlock`, `condition`);
+    armed, the wrappers feed a process-wide lock-order graph that
+    raises :class:`LockOrderViolation` naming both conflicting
+    acquisition sites the first time a reversed ordering is even
+    ATTEMPTED (locks.py — the lockdep idea). CC005 lint-enforces the
+    factory; CC006 enforces `sanitize.thread()` for thread spawns.
+  * **Auditors** — `audit()` sweeps every tracked subsystem
+    (MemoryPool ledgers, cache byte accounting, resource-group
+    counters, executor single-ownership, exchange seq/eos state,
+    leaked threads) and raises structured
+    :class:`SanitizerViolation` with the owning subsystem named
+    (audit.py). The executor additionally self-audits at every
+    quantum boundary when armed, and `LocalRunner.execute` audits at
+    query finish.
+  * **Schedule fuzzer** — `fuzz(seed)` installs a seeded perturbation
+    source the executor consults for pop order, park jitter, and
+    forced preemption (fuzz.py); `tools/sanitize.py --seed-sweep N`
+    replays the chaos battery under N seeds and prints any failing
+    seed as a one-line reproducer.
+
+Arming: `sanitize.arm()` (tests, tools), the `PRESTO_TPU_SANITIZE`
+env var (subprocess workers, full-suite audit runs), plus
+`PRESTO_TPU_SANITIZE_SEED` for the fuzzer. Arming affects primitives
+created AFTER the call — import-time module singletons stay raw, so
+armed tests build their subsystems (executor, caches, coordinator)
+after arming.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+from presto_tpu.sanitize.schedule_fuzz import ScheduleFuzzer
+from presto_tpu.sanitize.locks import (
+    GRAPH, LockOrderViolation, SanitizedCondition, SanitizedLock,
+    SanitizedRLock, SanitizerViolation, WaitWhileHolding, held_names,
+)
+
+__all__ = [
+    "ARMED", "FUZZ", "LockOrderViolation", "SanitizerViolation",
+    "WaitWhileHolding", "arm", "audit", "audit_executor", "condition",
+    "disarm", "fuzz", "held_names", "lock", "lock_order_edges",
+    "rlock", "thread", "track", "tracked",
+]
+
+#: fast gate read by every engine hook before doing sanitizer work;
+#: flipped only by arm()/disarm()
+ARMED = False
+
+#: the installed ScheduleFuzzer, or None (the executor's fuzz hooks
+#: gate on this attribute)
+FUZZ: Optional[ScheduleFuzzer] = None
+
+#: registries of live subsystem objects the auditors sweep. Weak so a
+#: dropped coordinator/pool/executor never haunts a later audit;
+#: populated ALWAYS (a WeakSet.add per constructed subsystem object —
+#: these are per-query/per-server, never per-batch), so objects built
+#: before arming are still auditable.
+_META_LOCK = threading.Lock()  # lint-ok: CC005 registry meta-lock cannot be sanitized
+_TRACKED: Dict[str, "weakref.WeakSet"] = {}
+
+
+# ---------------------------------------------------------------------------
+# primitive factories (CC005/CC006 enforce these in the covered layers)
+
+
+def lock(name: str):
+    """A named mutual-exclusion lock: raw `threading.Lock` when
+    disarmed (identity-checked in tests), a lock-order-tracked
+    SanitizedLock when armed. Names are dotted lock CLASSES
+    ("cache.results", "executor.pool") — instances created by one
+    call site share one node in the order graph."""
+    if ARMED:
+        return SanitizedLock(name)
+    return threading.Lock()  # lint-ok: CC005 the disarmed factory IS the raw path
+
+
+def rlock(name: str):
+    if ARMED:
+        return SanitizedRLock(name)
+    return threading.RLock()  # lint-ok: CC005 the disarmed factory IS the raw path
+
+
+def condition(name: str):
+    if ARMED:
+        return SanitizedCondition(name)
+    return threading.Condition()  # lint-ok: CC005 the disarmed factory IS the raw path
+
+
+def thread(target=None, name: Optional[str] = None, args=(),
+           kwargs=None, daemon: bool = True, owner=None,
+           stop_signal=None, purpose: str = ""):
+    """Construct (not start) a `threading.Thread` registered with the
+    declared-threads registry, so the leak auditor can attribute every
+    engine thread. `owner`/`stop_signal` classify long-lived threads:
+    the auditor flags a registered thread still alive after its owner
+    was garbage-collected or its `stop_signal()` went true (the
+    joined-shutdown contract); ephemeral per-query threads pass
+    neither and are only checked for the daemon flag."""
+    t = threading.Thread(  # lint-ok: CC006 the factory itself constructs the raw thread
+        target=target, name=name, args=args, kwargs=kwargs or {},
+        daemon=daemon)
+    t._sanitize_info = {  # type: ignore[attr-defined]
+        "purpose": purpose or name or "thread",
+        "owner": weakref.ref(owner) if owner is not None else None,
+        "stop_signal": stop_signal,
+    }
+    track("threads", t)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# subsystem tracking
+
+
+def track(kind: str, obj) -> None:
+    """Register a live subsystem object ("memory_pool",
+    "cache_manager", "resource_groups", "executor",
+    "exchange_registry", "coordinator", "threads") for the
+    auditors."""
+    with _META_LOCK:
+        reg = _TRACKED.get(kind)
+        if reg is None:
+            reg = _TRACKED[kind] = weakref.WeakSet()
+        reg.add(obj)
+
+
+def tracked(kind: str) -> list:
+    with _META_LOCK:
+        reg = _TRACKED.get(kind)
+        return list(reg) if reg is not None else []
+
+
+def tracked_summary() -> Dict[str, int]:
+    with _META_LOCK:
+        return {k: len(v) for k, v in sorted(_TRACKED.items())}
+
+
+# ---------------------------------------------------------------------------
+# arming
+
+
+def arm() -> None:
+    """Arm the sanitizer: primitive factories return tracked
+    wrappers, the executor self-audits at quantum boundaries, and
+    `LocalRunner.execute` audits at query finish. Affects primitives
+    created after this call."""
+    global ARMED
+    ARMED = True
+
+
+def disarm() -> None:
+    """Disarm everything: factories return raw primitives again, the
+    fuzzer uninstalls, and the lock-order graph resets (edges relearn
+    on the next armed run)."""
+    global ARMED, FUZZ
+    ARMED = False
+    FUZZ = None
+    GRAPH.reset()
+
+
+def fuzz(seed: Optional[int]) -> Optional[ScheduleFuzzer]:
+    """Install (seed) or uninstall (None) the schedule fuzzer.
+    Returns the installed fuzzer so callers can flip `.record` or
+    read `.perturbations`."""
+    global FUZZ
+    FUZZ = ScheduleFuzzer(seed) if seed is not None else None
+    return FUZZ
+
+
+def lock_order_edges() -> Dict:
+    """The observed lock-order graph {(held, acquired): (held_site,
+    acquire_site)} — the --report surface."""
+    return GRAPH.edges()
+
+
+# ---------------------------------------------------------------------------
+# audit checkpoints (implementations in audit.py, imported lazily so
+# the sanitize package never drags subsystem modules in at import)
+
+
+def audit(raise_: bool = True, include=None,
+          coordinator_check: bool = False
+          ) -> List[SanitizerViolation]:
+    """Run every auditor (or the `include` subset of subsystem names)
+    over the tracked registries. Returns the violations; raises the
+    first (with a count of the rest) when `raise_`.
+    `coordinator_check` adds the quiescent-coordinator ledger
+    cross-check — only meaningful when no query is in flight, so it
+    is opt-in (test teardown, the tools CLI)."""
+    from presto_tpu.sanitize.auditors import run_audit
+    violations = run_audit(include=include,
+                           coordinator_check=coordinator_check)
+    if raise_ and violations:
+        if len(violations) == 1:
+            raise violations[0]
+        raise SanitizerViolation(
+            violations[0].subsystem,
+            f"{len(violations)} violations: "
+            + "; ".join(str(v) for v in violations))
+    return violations
+
+
+def audit_executor(ex) -> None:
+    """The quantum-boundary checkpoint: executor-scoped invariants
+    only (single ownership, queue/park state machine, counter
+    balance). Raises on violation — inside a quantum this fails the
+    owning query cleanly through the task-failure path."""
+    from presto_tpu.sanitize.auditors import audit_executor as _impl
+    violations = _impl(ex)
+    if violations:
+        raise violations[0]
+
+
+# ---------------------------------------------------------------------------
+# env arming (how subprocess workers and full-suite audit runs arm)
+
+if os.environ.get("PRESTO_TPU_SANITIZE", "").strip().lower() \
+        not in ("", "0", "false", "no", "off"):
+    arm()
+    _seed = os.environ.get("PRESTO_TPU_SANITIZE_SEED")
+    if _seed:
+        fuzz(int(_seed))
+    del _seed

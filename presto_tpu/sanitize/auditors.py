@@ -1,0 +1,353 @@
+"""Runtime invariant auditors (PAPER.md's L1 reserve/free memory
+contract and L6 exchange sequencing, turned into executable checks).
+
+Each auditor sweeps one subsystem's TRACKED live objects
+(`sanitize.track` registers them at construction, weakly) under that
+subsystem's own lock, and returns structured
+:class:`SanitizerViolation`s naming the owning subsystem — it never
+raises itself, so one broken subsystem cannot hide another's
+violations from the same sweep.
+
+Catalogue (docs/SANITIZERS.md):
+
+  memory     MemoryPool ledger balance: reserved == Σ per-tag
+             reservations, no negative tags
+  cache      cache-level byte accounting: Σ live entry bytes ==
+             level.bytes == pool tag charge; pool.reserved == Σ levels
+  admission  resource-group counter consistency: leaf queued_count ==
+             Σ queue lengths, interior running/memory == Σ children,
+             nothing negative
+  executor   single ownership: every "running" entry is counted by
+             exactly its task, Σ task.running == pool running, no
+             driver owned twice, no entry both queued and parked
+  exchange   released queries hold no undelivered pages; per-consumer
+             eos producer sets never exceed the expected producer
+             count; accepted sequence numbers non-negative
+  threads    every registered thread is a daemon; no thread alive
+             after its owner was collected or reported stopped (the
+             joined-shutdown contract)
+  (opt-in) coordinator  a QUIESCENT coordinator's resource groups
+             charge zero running/queued — the drained-ledger check
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from presto_tpu.sanitize.locks import SanitizerViolation
+
+AUDITORS = ("memory", "cache", "admission", "executor", "exchange",
+            "threads")
+
+
+def run_audit(include: Optional[Sequence[str]] = None,
+              coordinator_check: bool = False
+              ) -> List[SanitizerViolation]:
+    sel = set(include) if include else set(AUDITORS)
+    out: List[SanitizerViolation] = []
+    if "memory" in sel:
+        out.extend(audit_memory_pools())
+    if "cache" in sel:
+        out.extend(audit_cache_managers())
+    if "admission" in sel:
+        out.extend(audit_resource_groups())
+    if "executor" in sel:
+        out.extend(audit_executors())
+    if "exchange" in sel:
+        out.extend(audit_exchange_registries())
+    if "threads" in sel:
+        out.extend(audit_threads())
+    if coordinator_check:
+        out.extend(audit_coordinators())
+    return out
+
+
+def _v(subsystem: str, message: str) -> SanitizerViolation:
+    return SanitizerViolation(subsystem, message)
+
+
+# ---------------------------------------------------------------------------
+# memory: per-pool ledger balance
+
+
+def audit_memory_pools() -> List[SanitizerViolation]:
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for pool in sanitize.tracked("memory_pool"):
+        with pool._lock:
+            balance = sum(pool._by_tag.values())
+            if pool.reserved != balance:
+                out.append(_v(
+                    "memory",
+                    f"MemoryPool ledger unbalanced: reserved="
+                    f"{pool.reserved:,}B but Σ per-tag="
+                    f"{balance:,}B (tags="
+                    f"{dict(sorted(pool._by_tag.items()))})"))
+            negative = {t: n for t, n in pool._by_tag.items() if n < 0}
+            if negative:
+                out.append(_v(
+                    "memory",
+                    f"MemoryPool tags over-freed (freed more than "
+                    f"reserved): {negative}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cache: level byte accounting vs the shared pool
+
+
+def audit_cache_managers() -> List[SanitizerViolation]:
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for mgr in sanitize.tracked("cache_manager"):
+        # all result levels share one lock; holding it freezes both
+        # levels AND their pool tags (pool mutations for cache tags
+        # only happen under this lock)
+        with mgr.fragment._lock:
+            total = 0
+            for level in (mgr.fragment, mgr.page):
+                entry_bytes = sum(e.nbytes
+                                  for e in level._entries.values())
+                total += entry_bytes
+                if entry_bytes != level.bytes:
+                    out.append(_v(
+                        "cache",
+                        f"{level.tag}: Σ live entry bytes "
+                        f"{entry_bytes:,} != level.bytes "
+                        f"{level.bytes:,}"))
+                charged = mgr.pool._by_tag.get(level.tag, 0)
+                if charged != level.bytes:
+                    out.append(_v(
+                        "cache",
+                        f"{level.tag}: pool tag charge {charged:,}B "
+                        f"!= level.bytes {level.bytes:,}B"))
+            if mgr.pool.reserved != total:
+                out.append(_v(
+                    "cache",
+                    f"cache pool reserved {mgr.pool.reserved:,}B != "
+                    f"Σ live entries {total:,}B across levels"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# admission: resource-group counter consistency
+
+
+def audit_resource_groups() -> List[SanitizerViolation]:
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for mgr in sanitize.tracked("resource_groups"):
+        with mgr._lock:
+            stack = [mgr._root]
+            while stack:
+                g = stack.pop()
+                stack.extend(g.children.values())
+                if g.running < 0 or g.queued_count < 0:
+                    out.append(_v(
+                        "admission",
+                        f"group {g.path!r} counters negative: "
+                        f"running={g.running} "
+                        f"queued={g.queued_count}"))
+                queued = sum(len(q) for q in g.queues.values())
+                if queued != g.queued_count:
+                    out.append(_v(
+                        "admission",
+                        f"group {g.path!r} queued_count="
+                        f"{g.queued_count} != Σ user queues "
+                        f"{queued}"))
+                if g.children:
+                    child_running = sum(c.running
+                                        for c in g.children.values())
+                    if g.running != child_running:
+                        out.append(_v(
+                            "admission",
+                            f"interior group {g.path!r} running="
+                            f"{g.running} != Σ children "
+                            f"{child_running} — a query charged or "
+                            "released off its admission path"))
+                    child_mem = sum(c.memory_reserved
+                                    for c in g.children.values())
+                    if g.memory_reserved != child_mem:
+                        out.append(_v(
+                            "admission",
+                            f"interior group {g.path!r} "
+                            f"memory_reserved={g.memory_reserved} "
+                            f"!= Σ children {child_mem}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# executor: single ownership + state-machine consistency
+
+
+def audit_executor(ex) -> List[SanitizerViolation]:
+    with ex._cond:
+        return _audit_executor_locked(ex)
+
+
+def audit_executors() -> List[SanitizerViolation]:
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for ex in sanitize.tracked("executor"):
+        out.extend(audit_executor(ex))
+    return out
+
+
+def _audit_executor_locked(ex) -> List[SanitizerViolation]:
+    out: List[SanitizerViolation] = []
+    queued_ids = {}
+    for lvl, q in enumerate(ex._runnable):
+        for e in q:
+            if e.state != "queued":
+                out.append(_v(
+                    "executor",
+                    f"entry of task {e.task.label!r} sits in "
+                    f"runnable level {lvl} with state {e.state!r}"))
+            if id(e) in queued_ids:
+                out.append(_v(
+                    "executor",
+                    f"entry of task {e.task.label!r} queued twice "
+                    f"(levels {queued_ids[id(e)]} and {lvl})"))
+            queued_ids[id(e)] = lvl
+    # NOTE: one entry may appear in the parked heap more than once —
+    # park, early wake (state -> queued), run, park again leaves the
+    # stale first tuple behind; _promote_due_locked discards it at
+    # its deadline. Duplicates are therefore NOT a violation; only a
+    # parked-state entry simultaneously sitting in a runnable queue
+    # is (and the state check above already flags it as state !=
+    # "queued").
+    for _, _, e in ex._parked:
+        if e.state == "parked" and id(e) in queued_ids:
+            out.append(_v(
+                "executor",
+                f"entry of task {e.task.label!r} is both queued and "
+                "parked"))
+    running_total = 0
+    for task in ex._live:
+        n_running = sum(1 for e in task.entries
+                        if e.state == "running")
+        if n_running != task.running:
+            out.append(_v(
+                "executor",
+                f"task {task.label!r} ownership skew: {n_running} "
+                f"entries in state 'running' but task.running="
+                f"{task.running} — a driver is on two workers or a "
+                "parked driver still holds one"))
+        n_live = sum(1 for e in task.entries if e.state != "done")
+        if n_live != task.pending:
+            out.append(_v(
+                "executor",
+                f"task {task.label!r} pending={task.pending} but "
+                f"{n_live} entries not done"))
+        driver_ids = [id(e.driver) for e in task.entries]
+        if len(driver_ids) != len(set(driver_ids)):
+            out.append(_v(
+                "executor",
+                f"task {task.label!r} has one driver owned by two "
+                "entries"))
+        running_total += task.running
+    if running_total != ex._running:
+        out.append(_v(
+            "executor",
+            f"executor running count {ex._running} != Σ task.running "
+            f"{running_total} over live tasks"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# exchange: released-query hygiene + sequencing bounds
+
+
+def audit_exchange_registries() -> List[SanitizerViolation]:
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for reg in sanitize.tracked("exchange_registry"):
+        with reg._lock:
+            released = set(reg._released)
+            for (key, consumer), q in reg._queues.items():
+                if q and key.split(":", 1)[0] in released:
+                    out.append(_v(
+                        "exchange",
+                        f"released query still holds {len(q)} "
+                        f"undelivered page(s) on {key!r} consumer "
+                        f"{consumer}"))
+            for (key, consumer), eos in reg._eos.items():
+                expected = reg._expected.get(key)
+                if expected is not None and len(eos) > expected:
+                    out.append(_v(
+                        "exchange",
+                        f"{key!r} consumer {consumer}: {len(eos)} "
+                        f"distinct eos producers but only {expected} "
+                        "expected — a producer id space leak would "
+                        "double-complete the stream"))
+            for (key, consumer, producer), seq in \
+                    reg._last_seq.items():
+                if seq < 0:
+                    out.append(_v(
+                        "exchange",
+                        f"{key!r} ({producer}->{consumer}) accepted "
+                        f"negative sequence {seq} — the dedup "
+                        "monotonicity floor is broken"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# threads: the declared-threads registry vs what is actually alive
+
+
+def audit_threads() -> List[SanitizerViolation]:
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for t in sanitize.tracked("threads"):
+        if not t.is_alive():
+            continue
+        info = getattr(t, "_sanitize_info", None) or {}
+        purpose = info.get("purpose", t.name)
+        if not t.daemon:
+            out.append(_v(
+                "threads",
+                f"thread {t.name!r} ({purpose}) is non-daemon — a "
+                "leaked one would hang interpreter shutdown"))
+        owner_ref = info.get("owner")
+        if owner_ref is not None and owner_ref() is None:
+            out.append(_v(
+                "threads",
+                f"thread {t.name!r} ({purpose}) alive after its "
+                "owner was garbage-collected — the owner never "
+                "joined it on shutdown"))
+            continue
+        stop_signal = info.get("stop_signal")
+        if stop_signal is not None and stop_signal():
+            out.append(_v(
+                "threads",
+                f"thread {t.name!r} ({purpose}) alive after its "
+                "owner reported stopped — shutdown lacks a joined "
+                "path"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# coordinator (opt-in): drained-ledger cross-check
+
+
+def audit_coordinators() -> List[SanitizerViolation]:
+    """Only meaningful when the coordinator is QUIESCENT (every query
+    terminal): then its resource groups must charge zero. Skipped per
+    coordinator with in-flight queries — mid-serving the ledger
+    legitimately leads/lags the query-state machine."""
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for coord in sanitize.tracked("coordinator"):
+        if any(q.done_at is None for q in
+               list(coord.queries.values())):
+            continue
+        rows = coord.resource_groups.snapshot()
+        charged = [(r["group"], r["running"], r["queued"])
+                   for r in rows if r["running"] or r["queued"]]
+        if charged:
+            out.append(_v(
+                "admission",
+                f"quiescent coordinator still charges slots: "
+                f"{charged} — a finished query leaked its "
+                "running/queued position"))
+    return out

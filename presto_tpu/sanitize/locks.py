@@ -1,0 +1,315 @@
+"""Lock-order deadlock detection (the lockdep idea: Linux's
+lockdep.c validates lock CLASSES, not instances — two locks created by
+the same `sanitize.lock("cache.results")` call site share one node in
+the order graph, so an ordering proven wrong between any two instances
+of two classes is reported the FIRST time the reversed order is
+attempted, on any thread, without ever needing the actual interleaving
+that deadlocks).
+
+Armed mode only: `sanitize.lock(name)` returns a :class:`SanitizedLock`
+(resp. rlock/condition) whose acquire path
+
+  1. walks the calling thread's HELD-LOCK stack (a thread-local),
+  2. records a held->acquiring edge per held lock into the process-wide
+     order graph, and
+  3. raises :class:`LockOrderViolation` — naming the acquisition site
+     of BOTH orders — when the new edge closes a cycle, BEFORE
+     blocking on the raw primitive (a detected deadlock must report,
+     not deadlock).
+
+Extras the engine's review rounds asked for:
+
+  * re-acquiring a non-reentrant SanitizedLock on the same thread
+    raises (self-deadlock) instead of hanging;
+  * `SanitizedCondition.wait` while holding ANY other tracked lock
+    raises :class:`WaitWhileHolding` — a parked waiter pinning a
+    second lock is the classic lost-wakeup/deadlock shape the
+    TaskExecutor's park/wake protocol must never grow.
+
+Everything in here deliberately uses RAW threading primitives for its
+own meta-state (a sanitizer that sanitized itself would recurse).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class SanitizerViolation(Exception):
+    """Structured runtime-verification failure. `subsystem` names the
+    owning subsystem ("locks", "memory", "cache", "admission",
+    "executor", "exchange", "threads") so a violation in a 32-client
+    chaos run attributes itself without a debugger."""
+
+    def __init__(self, subsystem: str, message: str):
+        super().__init__(f"[sanitizer:{subsystem}] {message}")
+        self.subsystem = subsystem
+
+
+class LockOrderViolation(SanitizerViolation):
+    def __init__(self, message: str):
+        super().__init__("locks", message)
+
+
+class WaitWhileHolding(SanitizerViolation):
+    def __init__(self, message: str):
+        super().__init__("locks", message)
+
+
+#: per-thread stack of held sanitized locks; entries are mutable
+#: [lock, name, site, depth] records (depth > 1 = rlock re-entry)
+_TL = threading.local()
+
+_SANITIZE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _held() -> List[list]:
+    stack = getattr(_TL, "stack", None)
+    if stack is None:
+        stack = _TL.stack = []
+    return stack
+
+
+def held_names() -> List[str]:
+    """Names of locks the calling thread currently holds (tests and
+    the --report CLI)."""
+    return [e[1] for e in _held()]
+
+
+def _call_site() -> str:
+    """file:line of the first frame OUTSIDE the sanitize package —
+    the engine-side acquisition site a violation report names."""
+    f = sys._getframe(1)
+    while f is not None and os.path.dirname(
+            os.path.abspath(f.f_code.co_filename)) == _SANITIZE_DIR:
+        f = f.f_back
+    if f is None:
+        return "?"
+    return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+
+class _LockOrderGraph:
+    """The process-wide directed graph of observed lock-class
+    orderings. Edge (a, b) = "b was acquired while a was held", with
+    the pair of sites that first established it."""
+
+    def __init__(self):
+        # lint-ok: CC005 the sanitizer's own meta-lock cannot be sanitized
+        self._mutex = threading.Lock()
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+
+    def reset(self) -> None:
+        with self._mutex:
+            self._edges.clear()
+
+    def edges(self) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        with self._mutex:
+            return dict(self._edges)
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Node path src -> ... -> dst through recorded edges, or
+        None. Called under the mutex; graphs are a handful of named
+        classes, so plain BFS is plenty."""
+        if src == dst:
+            return [src]
+        succ: Dict[str, List[str]] = {}
+        for (a, b) in self._edges:
+            succ.setdefault(a, []).append(b)
+        frontier = [[src]]
+        seen = {src}
+        while frontier:
+            path = frontier.pop(0)
+            for nxt in succ.get(path[-1], ()):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(path + [nxt])
+        return None
+
+    def check_acquire(self, held: List[list], name: str,
+                      site: str) -> None:
+        """Record held->name edges for every lock the thread holds;
+        raise LockOrderViolation when any new edge closes a cycle.
+        Runs BEFORE the raw acquire so a detected deadlock reports
+        instead of deadlocking."""
+        with self._mutex:
+            for entry in held:
+                held_name, held_site = entry[1], entry[2]
+                if held_name == name:
+                    continue  # same class nested: not an order fact
+                key = (held_name, name)
+                if key in self._edges:
+                    continue
+                path = self._path(name, held_name)
+                if path is not None:
+                    chain = []
+                    for u, v in zip(path, path[1:]):
+                        hs, as_ = self._edges[(u, v)]
+                        chain.append(
+                            f"'{v}' acquired at {as_} while "
+                            f"holding '{u}' (held at {hs})")
+                    raise LockOrderViolation(
+                        f"lock-order cycle: acquiring {name!r} at "
+                        f"{site} while holding {held_name!r} "
+                        f"(acquired at {held_site}), but the reverse "
+                        f"order is established: "
+                        + "; ".join(chain)
+                        + f" [cycle: {' -> '.join(path)} -> "
+                        f"{path[0]}]")
+                self._edges[key] = (held_site, site)
+
+
+#: THE process-wide order graph (reset by sanitize.disarm())
+GRAPH = _LockOrderGraph()
+
+
+class SanitizedLock:
+    """Drop-in threading.Lock with lock-order tracking. Only ever
+    constructed by `sanitize.lock()` in armed mode — the disarmed
+    factory returns a raw threading.Lock (identity-checked in
+    tests)."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        # lint-ok: CC005 the wrapper's backing primitive is the raw lock itself
+        self._raw = threading.Lock()
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        site = _call_site()
+        held = _held()
+        for entry in held:
+            if entry[0] is self:
+                if not self._reentrant:
+                    raise LockOrderViolation(
+                        f"self-deadlock: re-acquiring non-reentrant "
+                        f"lock {self.name!r} at {site} (first "
+                        f"acquired at {entry[2]})")
+                ok = self._raw.acquire(blocking, timeout)
+                if ok:
+                    entry[3] += 1
+                return ok
+        GRAPH.check_acquire(held, self.name, site)
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            held.append([self, self.name, site, 1])
+        return ok
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                held[i][3] -= 1
+                if held[i][3] == 0:
+                    del held[i]
+                break
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self) -> "SanitizedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class SanitizedRLock(SanitizedLock):
+    _reentrant = True
+
+    def __init__(self, name: str):
+        self.name = name
+        # lint-ok: CC005 the wrapper's backing primitive is the raw lock itself
+        self._raw = threading.RLock()
+
+    def locked(self) -> bool:  # RLock has no locked(); mirror 3.12+
+        acquired = self._raw.acquire(blocking=False)
+        if acquired:
+            self._raw.release()
+        return not acquired
+
+
+class SanitizedCondition:
+    """threading.Condition facade whose lock is a SanitizedRLock (the
+    stdlib default is an RLock too). wait() additionally flags
+    wait-while-holding: a thread parking on a condition while pinning
+    ANY other tracked lock blocks every peer needing that lock for
+    the whole wait — the shape behind classic lost-wakeup
+    deadlocks."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lk = SanitizedRLock(name)
+        # lint-ok: CC005 backing primitive of the sanitized condition
+        self._raw = threading.Condition(self._lk._raw)
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        return self._lk.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._lk.release()
+
+    def __enter__(self) -> "SanitizedCondition":
+        self._lk.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._lk.release()
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        held = _held()
+        others = [e[1] for e in held if e[0] is not self._lk]
+        if others:
+            raise WaitWhileHolding(
+                f"waiting on condition {self.name!r} at "
+                f"{_call_site()} while holding "
+                f"{', '.join(repr(n) for n in others)} — a parked "
+                "waiter must not pin other locks")
+        entry = None
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self._lk:
+                # the raw wait releases the condition lock in full:
+                # drop its stack entry for the duration
+                entry = held.pop(i)
+                break
+        try:
+            return self._raw.wait(timeout)
+        finally:
+            if entry is not None:
+                held.append(entry)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        import time as _time
+        end = None if timeout is None else _time.monotonic() + timeout
+        result = predicate()
+        while not result:
+            remaining = None if end is None \
+                else max(0.0, end - _time.monotonic())
+            if remaining == 0.0:
+                break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._raw.notify(n)
+
+    def notify_all(self) -> None:
+        self._raw.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<SanitizedCondition {self.name!r}>"

@@ -1,0 +1,79 @@
+"""Deterministic schedule fuzzing for the time-sliced TaskExecutor.
+
+The GIL on a 1-core container produces a narrow family of
+interleavings; the fleet/mesh roadmap items will widen it. Rather than
+wait for production to explore the schedule space, the executor
+carries three seeded perturbation hooks (all gated on
+`sanitize.FUZZ is not None`, so the disarmed hot loop pays one
+attribute load per site):
+
+  * ready-queue pop order WITHIN a level is shuffled (`pick`) — the
+    multilevel feedback queue's fairness choice stays intact, but
+    which equal-priority driver runs next is adversarial;
+  * park wake-ups are jittered (`park_jitter`) — blocked drivers
+    re-poll early or late, racing their wake against sibling
+    progress;
+  * quanta are seeded-shrunk (`quantum_scale`) — forced preemption at
+    the executor's instrumented yield points, so drivers interleave
+    at boundaries the default 25ms slice would never produce.
+
+Same seed => same perturbation decisions (one process-wide
+`random.Random(seed)` behind a meta-mutex). With a single worker the
+full quantum order is reproducible — that is the `--seed N`
+one-line-reproducer contract the seed sweep prints for a failing
+seed.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List, Tuple
+
+
+class ScheduleFuzzer:
+    """One seeded perturbation source, installed process-wide via
+    `sanitize.fuzz(seed)`. `record=True` additionally captures the
+    (task label, driver index, outcome) of every quantum — the
+    determinism oracle (same seed => identical trace on a one-worker
+    executor)."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        # lint-ok: CC005 the fuzzer's meta-mutex cannot be sanitized
+        self._mutex = threading.Lock()
+        self.perturbations = 0
+        self.record = False
+        self.trace: List[Tuple[str, int, str]] = []
+
+    def pick(self, n: int) -> int:
+        """Index of the ready-queue entry to pop within a level."""
+        with self._mutex:
+            self.perturbations += 1
+            return self._rng.randrange(n)
+
+    def park_jitter(self, delay: float) -> float:
+        """Perturbed park delay in [0.25x, 2x] of the poll interval."""
+        with self._mutex:
+            self.perturbations += 1
+            return delay * (0.25 + 1.75 * self._rng.random())
+
+    def quantum_scale(self) -> float:
+        """Factor in [0.25, 1.0] shrinking this quantum's time slice
+        (forced preemption: yield points move EARLIER, never later —
+        a fuzzed run keeps every lifecycle-checkpoint latency bound)."""
+        with self._mutex:
+            self.perturbations += 1
+            return 0.25 + 0.75 * self._rng.random()
+
+    def note(self, label: str, idx: int, outcome: str) -> None:
+        """Record one quantum (called under the executor lock, so the
+        trace order is the schedule order)."""
+        if self.record:
+            with self._mutex:
+                self.trace.append((label, idx, outcome))
+
+    def __repr__(self) -> str:
+        return (f"<ScheduleFuzzer seed={self.seed} "
+                f"perturbations={self.perturbations}>")

@@ -20,6 +20,7 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+from presto_tpu import sanitize
 from presto_tpu.execution import faults
 from presto_tpu.server.node import (
     TRANSPORT_RETRIES, Node, build_http_exchanges, derive_fragments,
@@ -203,7 +204,7 @@ class Coordinator(Node):
         #: caches, but session state like PREPARE would not stick).
         self.single_node = single_node
         self._embedded_runner = None
-        self._embedded_lock = threading.Lock()
+        self._embedded_lock = sanitize.lock("coordinator.embedded")
         self.catalog = catalog
         self.schema = schema
         self.properties = dict(properties or {})
@@ -228,8 +229,11 @@ class Coordinator(Node):
         #: statement POSTs, a lone client that submitted and died
         #: would leave its RUNNING query burning to completion
         self._pruner_stop = threading.Event()
-        self._pruner = threading.Thread(target=self._prune_loop,
-                                        daemon=True)
+        self._pruner = sanitize.thread(
+            target=self._prune_loop, daemon=True, owner=self,
+            stop_signal=self._pruner_stop.is_set,
+            purpose="coordinator-pruner")
+        sanitize.track("coordinator", self)
 
     def start(self) -> None:
         # AOT prewarm completes BEFORE the HTTP thread serves (the
@@ -260,6 +264,11 @@ class Coordinator(Node):
     def stop(self) -> None:
         self._pruner_stop.set()
         super().stop()
+        # join the pruner: before this, a stopped coordinator leaked
+        # its pruner thread for up to one 15s sweep period — the
+        # first finding of the armed full-suite thread-leak audit
+        if self._pruner.is_alive():
+            self._pruner.join(timeout=5)
 
     def _prune_loop(self, period_s: float = 15.0) -> None:
         while not self._pruner_stop.wait(period_s):
@@ -341,9 +350,10 @@ class Coordinator(Node):
             self._fire_event({"event": "query_created", "id": q.id,
                               "user": q.user, "source": q.source,
                               "group": q.group, "sql": q.sql})
-            threading.Thread(target=self._run_query,
-                             args=(q, has_slot, dispatched),
-                             daemon=True).start()
+            sanitize.thread(target=self._run_query,
+                            args=(q, has_slot, dispatched),
+                            daemon=True,
+                            purpose="query-runner").start()
             return json.dumps({
                 "id": q.id,
                 "nextUri": f"{self.url}/v1/statement/executing/"
@@ -1118,7 +1128,8 @@ th{{background:#222}}
                             return
                     time.sleep(0.2)
 
-            watcher = threading.Thread(target=watch, daemon=True)
+            watcher = sanitize.thread(target=watch, daemon=True,
+                                      purpose="remote-task-watcher")
             watcher.start()
             t0 = _time.perf_counter()
             drivers = self._drive_with_failures(
@@ -1376,7 +1387,7 @@ class StatementClient:
         #: slot could resolve to None (no-op) or to ANOTHER thread's
         #: query (wrong kill)
         self._inflight: set = set()
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = sanitize.lock("client.inflight")
 
     def __enter__(self) -> "StatementClient":
         return self

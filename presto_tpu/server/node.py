@@ -29,6 +29,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from presto_tpu import sanitize
 from presto_tpu.batch import Batch
 from presto_tpu.execution import faults
 from presto_tpu.operators.exchange_ops import edge_key_dicts
@@ -117,7 +118,8 @@ class ExchangeRegistry:
     _RELEASED_MAX = 4096
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("exchange.registry")
+        sanitize.track("exchange_registry", self)
         self._queues: Dict[Tuple[str, int], collections.deque] = \
             collections.defaultdict(collections.deque)
         self._eos: Dict[Tuple[str, int], set] = \
@@ -526,14 +528,29 @@ class Node:
         self.httpd = _Server((host, port), handler)
         self.port = self.httpd.server_address[1]
         self.url = f"http://{host}:{self.port}"
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, daemon=True)
+        self._stopped = False
+        # weakref-bound stop signal: the closure must not pin the node
+        # (the leak auditor's owner-collected check needs the owner
+        # collectable)
+        import weakref
+        self._thread = sanitize.thread(
+            target=self.httpd.serve_forever, daemon=True,
+            owner=self,
+            stop_signal=lambda ref=weakref.ref(self):
+                ref() is not None and ref()._stopped,
+            purpose="http-server")
 
     def start(self) -> None:
         self._thread.start()
 
     def stop(self) -> None:
+        # shutdown() blocks until serve_forever exits; joining the
+        # thread afterwards is the leak-auditor contract (a stopped
+        # node must leave no live thread behind)
         self.httpd.shutdown()
+        self._stopped = True
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
 
     # -- routing -----------------------------------------------------------
 
@@ -626,8 +643,8 @@ class Node:
         # can't both win
         if self.tasks.setdefault(tid, state) is not state:
             return
-        threading.Thread(target=self._run_task, args=(spec, state),
-                         daemon=True).start()
+        sanitize.thread(target=self._run_task, args=(spec, state),
+                        daemon=True, purpose="fragment-task").start()
 
     def _prune_tasks(self, ttl_s: float = 600.0) -> None:
         """Evict tasks `ttl_s` after they reached a terminal state (the

@@ -50,6 +50,7 @@ import time
 import weakref
 from typing import Dict, Optional
 
+from presto_tpu import sanitize
 from presto_tpu.telemetry.metrics import METRICS
 from presto_tpu.telemetry import trace as _trace
 
@@ -188,7 +189,8 @@ def instrument_kernel(kernel, name: str, jits=None):
     # growth still classifies its (compile-lock-blocked) wall as
     # compile — see the module docstring's concurrency contract
     state = {"traced": False, "accounted": 0,
-             "lock": threading.Lock(), "active": {}}
+             "lock": sanitize.lock("telemetry.kernel_state"),
+             "active": {}}
 
     def wrapped(*args, **kwargs):
         if not ENABLED:

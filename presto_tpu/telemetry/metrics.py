@@ -11,15 +11,16 @@ subsystems having to push."""
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Tuple
+
+from presto_tpu import sanitize
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 
 
 class MetricsRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("telemetry.metrics")
         self._counters: Dict[_Key, float] = {}
         self._help: Dict[str, str] = {}
 

@@ -26,11 +26,13 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from presto_tpu import sanitize
+
 #: fast gate: True iff at least one recorder is active somewhere in
 #: the process. Sites check this before touching the thread-local.
 ACTIVE = False
 
-_LOCK = threading.Lock()
+_LOCK = sanitize.lock("trace.registry")
 _ACTIVE_COUNT = 0
 _TL = threading.local()
 
@@ -46,7 +48,7 @@ class TraceRecorder:
 
     def __init__(self, query_id: str = ""):
         self.query_id = query_id
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("trace.recorder")
         self._events: List[Dict[str, Any]] = []
         #: thread ident -> small sequential lane id. Raw idents are
         #: thread-descriptor ADDRESSES on glibc — their low bits are

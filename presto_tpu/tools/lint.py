@@ -51,7 +51,7 @@ TRACE_SCOPE = (
 CONC_SCOPE = (
     "presto_tpu/execution/", "presto_tpu/runner/",
     "presto_tpu/server/", "presto_tpu/telemetry/",
-    "presto_tpu/cache/",
+    "presto_tpu/cache/", "presto_tpu/sanitize/",
 )
 
 BASELINE_DEFAULT = os.path.join(

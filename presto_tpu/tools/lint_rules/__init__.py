@@ -301,6 +301,20 @@ def is_threading_ctor(value: ast.AST, kinds=("Lock", "RLock",
             f"threading.{k}" for k in kinds) + kinds
 
 
+def is_sanitize_factory(value: ast.AST) -> bool:
+    """`sanitize.lock/rlock/condition(...)` (any alias whose terminal
+    module name mentions sanitize) — the sanitizer's named drop-in
+    primitives count as lock ownership for the CC rules, exactly like
+    a raw threading ctor."""
+    if not isinstance(value, ast.Call) \
+            or not isinstance(value.func, ast.Attribute):
+        return False
+    if value.func.attr not in ("lock", "rlock", "condition"):
+        return False
+    base = terminal_name(value.func.value) or ""
+    return "sanitize" in base
+
+
 class Project:
     """Cross-file facts, built in one pass over every ModuleInfo
     before rules run."""

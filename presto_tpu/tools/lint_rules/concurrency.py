@@ -32,7 +32,8 @@ from typing import Dict, List, Optional, Set
 
 from presto_tpu.tools.lint_rules import (
     Finding, ModuleInfo, Project, dotted, in_locked_context,
-    is_threading_ctor, rule, terminal_name, threadlocal_roots,
+    is_sanitize_factory, is_threading_ctor, rule, terminal_name,
+    threadlocal_roots,
 )
 
 _MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
@@ -114,7 +115,8 @@ def check_global_mutation(mod: ModuleInfo,
 
 
 def _lock_owning_classes(mod: ModuleInfo) -> Dict[str, ast.ClassDef]:
-    """Classes that assign a threading.Lock/RLock/Condition to a self
+    """Classes that assign a threading.Lock/RLock/Condition — or a
+    `sanitize.lock/rlock/condition` factory product — to a self
     attribute anywhere (usually __init__)."""
     out: Dict[str, ast.ClassDef] = {}
     for node in ast.walk(mod.tree):
@@ -122,7 +124,8 @@ def _lock_owning_classes(mod: ModuleInfo) -> Dict[str, ast.ClassDef]:
             continue
         for sub in ast.walk(node):
             if isinstance(sub, ast.Assign) \
-                    and is_threading_ctor(sub.value):
+                    and (is_threading_ctor(sub.value)
+                         or is_sanitize_factory(sub.value)):
                 for tgt in sub.targets:
                     if isinstance(tgt, ast.Attribute):
                         out[node.name] = node
@@ -218,5 +221,90 @@ def check_drive_loop(mod: ModuleInfo,
     return out
 
 
+_SYNC_CTORS = {"Lock", "RLock", "Condition"}
+_SANITIZE_FACTORY = {"Lock": "lock", "RLock": "rlock",
+                     "Condition": "condition"}
+
+
+def _threading_aliases(mod: ModuleInfo) -> Set[str]:
+    """Module-level names the `threading` module is bound to
+    (`import threading`, `import threading as _threading`)."""
+    out = {"threading"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "threading":
+                    out.add(a.asname or "threading")
+    return out
+
+
+def _from_threading(mod: ModuleInfo, wanted: Set[str]) -> Set[str]:
+    """Local names bound by `from threading import X [as Y]`."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "threading":
+            for a in node.names:
+                if a.name in wanted:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _threading_ctor_calls(mod: ModuleInfo, ctors: Set[str]):
+    """(node, ctor name) for every construction of a threading
+    primitive in `ctors`, resolving module aliases and import-from
+    bindings."""
+    aliases = _threading_aliases(mod)
+    bare = _from_threading(mod, ctors)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ctors \
+                and terminal_name(f.value) in aliases:
+            yield node, f.attr
+        elif isinstance(f, ast.Name) and f.id in bare:
+            yield node, f.id
+
+
+@rule("CC005", "raw threading synchronization primitive constructed "
+               "outside sanitize.lock()/rlock()/condition()")
+def check_raw_lock_ctor(mod: ModuleInfo,
+                        project: Project) -> List[Finding]:
+    """The static half of the lock-order sanitizer's contract: a raw
+    `threading.Lock()` in a covered layer is a lock the armed
+    deadlock detector can never see. Deliberate raw locks (the
+    sanitizer's own meta-locks, its disarmed factory path) carry
+    `# lint-ok: CC005 <reason>`."""
+    out: List[Finding] = []
+    for node, ctor in _threading_ctor_calls(mod, _SYNC_CTORS):
+        out.append(mod.finding(
+            "CC005", node,
+            f"raw threading.{ctor} constructed — route it through "
+            f"sanitize.{_SANITIZE_FACTORY[ctor]}('<subsystem.name>') "
+            "so the armed lock-order detector can track this site"))
+    return out
+
+
+@rule("CC006", "thread started without registration in the "
+               "declared-threads registry")
+def check_raw_thread_ctor(mod: ModuleInfo,
+                          project: Project) -> List[Finding]:
+    """The leak auditor attributes every engine thread through
+    `sanitize.thread(...)` (purpose + owner + stop signal); a raw
+    `threading.Thread(...)` in a covered layer is a thread the armed
+    teardown audit cannot attribute or flag when it outlives its
+    owner's shutdown."""
+    out: List[Finding] = []
+    for node, _ in _threading_ctor_calls(mod, {"Thread"}):
+        out.append(mod.finding(
+            "CC006", node,
+            "raw threading.Thread constructed — use "
+            "sanitize.thread(target=..., purpose=..., owner=..., "
+            "stop_signal=...) so the leak auditor can attribute it"))
+    return out
+
+
 CONCURRENCY_RULES = (check_global_mutation, check_bare_counter,
-                     check_threadlocal_read, check_drive_loop)
+                     check_threadlocal_read, check_drive_loop,
+                     check_raw_lock_ctor, check_raw_thread_ctor)

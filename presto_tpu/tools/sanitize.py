@@ -1,0 +1,360 @@
+"""Concurrency-sanitizer CLI (docs/SANITIZERS.md has the workflow):
+
+    python -m presto_tpu.tools.sanitize --audit
+        arm everything, run the serving mix once through a fresh
+        single-node coordinator, audit every tracked subsystem, and
+        report violations + the armed-vs-disarmed wall delta
+
+    python -m presto_tpu.tools.sanitize --seed-sweep 20
+        replay the concurrent chaos battery (N clients, seeded faults
+        at the executor/admission seams, a cancel storm) under N
+        fuzzer seeds; any failing seed prints as a one-line
+        reproducer:  python -m presto_tpu.tools.sanitize --seed 13
+
+    python -m presto_tpu.tools.sanitize --seed 13
+        replay exactly one seed (the reproducer)
+
+    python -m presto_tpu.tools.sanitize --report
+        dump the observed lock-order graph + tracked-registry summary
+
+Exit status: 0 = clean, 1 = violations / divergence / failing seeds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: the battery statement: aggregation over the biggest tiny-schema
+#: table — enough batch hand-offs for faults and cancels to land
+#: mid-execution, small enough that a 20-seed sweep stays minutes
+BATTERY_SQL = ("select returnflag, count(*) c, sum(quantity) q "
+               "from lineitem group by returnflag "
+               "order by returnflag")
+
+#: serving-mix statements the --audit gate runs once each
+AUDIT_MIX: Tuple[Tuple[str, str], ...] = (
+    ("agg", BATTERY_SQL),
+    ("join", "select n.name, count(*) c from nation n "
+             "join region r on n.regionkey = r.regionkey "
+             "group by n.name order by n.name"),
+)
+
+#: seeded faults at the PR 8 concurrency seams (same sites as the
+#: 32-client chaos battery in tests/test_chaos.py)
+BATTERY_FAULT_SPEC = "executor.quantum:every:40:3;" \
+                     "admission.enqueue:every:9:5"
+
+
+def _checksum(rows: List[list]) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for r in rows:
+        h.update(repr(r).encode())
+    return h.hexdigest()
+
+
+def _fresh_executor():
+    """Swap in a brand-new process executor (created AFTER arming, so
+    its condition/locks are sanitized). Returns a restore callable."""
+    from presto_tpu.execution.task_executor import (
+        TaskExecutor, set_task_executor,
+    )
+    fresh = TaskExecutor()
+    prev = set_task_executor(fresh)
+
+    def restore():
+        cur = set_task_executor(prev)
+        if cur is not None and cur is not prev:
+            cur.shutdown()
+    return restore
+
+
+def _drain(coord, timeout_s: float = 15.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(g["running"] == 0 and g["queued"] == 0
+               for g in coord.resource_groups.snapshot()):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# --audit: one armed serving-mix pass
+
+
+def armed_audit(schema: str = "tiny",
+                mix: Sequence[Tuple[str, str]] = AUDIT_MIX) -> dict:
+    """Run the serving mix once disarmed (reference answers + wall),
+    then once with everything armed on a FRESH coordinator/executor
+    built under the sanitizer, audit, and compare byte-identity."""
+    from presto_tpu import sanitize
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+
+    def run_mix(tag: str) -> Tuple[Dict[str, str], float]:
+        coord = Coordinator([], "tpch", schema, single_node=True)
+        coord.start()
+        try:
+            sums = {}
+            t0 = time.perf_counter()
+            c = StatementClient(coord.url, user=f"sanitize-{tag}")
+            for name, sql in mix:
+                _, rows = c.execute(sql, timeout=300)
+                sums[name] = _checksum(rows)
+            wall = time.perf_counter() - t0
+            _drain(coord)
+        finally:
+            coord.stop()
+        return sums, wall
+
+    was_armed = sanitize.ARMED  # an env-armed run must stay armed
+    reset_cache_manager()
+    disarmed_sums, disarmed_wall = run_mix("off")
+    reset_cache_manager()
+    sanitize.arm()
+    restore = _fresh_executor()
+    try:
+        armed_sums, armed_wall = run_mix("armed")
+        violations = [str(v) for v in sanitize.audit(
+            raise_=False, coordinator_check=True)]
+        edges = sanitize.lock_order_edges()
+    finally:
+        restore()
+        if not was_armed:
+            sanitize.disarm()
+        reset_cache_manager()
+    return {
+        "mix": [name for name, _ in mix],
+        "schema": schema,
+        "violations": violations,
+        "identical": armed_sums == disarmed_sums,
+        "armed_wall_s": round(armed_wall, 3),
+        "disarmed_wall_s": round(disarmed_wall, 3),
+        "armed_vs_disarmed": round(armed_wall / disarmed_wall, 3)
+        if disarmed_wall else None,
+        "lock_order_edges": len(edges),
+        "ok": not violations and armed_sums == disarmed_sums,
+    }
+
+
+# ---------------------------------------------------------------------------
+# --seed-sweep / --seed: the chaos battery under the schedule fuzzer
+
+
+def run_battery(seed: int, clients: int = 16, rounds: int = 1,
+                schema: str = "tiny",
+                fault_spec: str = BATTERY_FAULT_SPEC) -> dict:
+    """One fuzzed replay of the concurrent chaos battery: `clients`
+    clients hammer the battery statement through a fresh single-node
+    coordinator with sanitize armed, the schedule fuzzer at `seed`,
+    seeded faults at the executor/admission seams, and a cancel storm
+    killing every 5th client mid-flight. Verdict: every failure
+    structured-or-injected, every success byte-identical to the
+    unfaulted reference, zero audit violations, full drain."""
+    from presto_tpu import sanitize
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.execution import faults
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+    was_armed = sanitize.ARMED  # an env-armed run must stay armed
+    reset_cache_manager()
+    sanitize.arm()
+    sanitize.fuzz(seed)
+    restore = _fresh_executor()
+    problems: List[str] = []
+    taxonomy: Dict[str, int] = {}
+    checksums: set = set()
+    try:
+        coord = Coordinator(
+            [], "tpch", schema, single_node=True,
+            max_concurrent_queries=8,
+            max_queued_queries=max(16, clients * rounds * 2),
+            properties={"plan_cache_enabled": False,
+                        "fragment_result_cache_enabled": False,
+                        "page_source_cache_enabled": False,
+                        "batch_rows": 2048})
+        coord.start()
+        try:
+            reference = StatementClient(
+                coord.url, user="ref").execute(
+                    BATTERY_SQL, timeout=300)[1]
+            for kw in faults.parse_spec(fault_spec):
+                faults.arm(**kw)
+            lock = threading.Lock()
+            clients_objs = [StatementClient(coord.url,
+                                            user=f"u{i % 8}",
+                                            source="sanitize")
+                            for i in range(clients)]
+
+            def run(i: int) -> None:
+                for _ in range(rounds):
+                    try:
+                        _, rows = clients_objs[i].execute(
+                            BATTERY_SQL, timeout=300)
+                        with lock:
+                            checksums.add(_checksum(rows))
+                            if rows != reference:
+                                problems.append(
+                                    f"client {i}: diverged from "
+                                    "reference")
+                    except Exception as e:  # noqa: BLE001 — verdict
+                        kind = getattr(e, "kind", None)
+                        ok = kind in ("cancelled", "queue_full",
+                                      "rejected", "deadline_exceeded",
+                                      "abandoned") \
+                            or "InjectedFault" in str(e) \
+                            or "injected fault" in str(e)
+                        with lock:
+                            taxonomy[kind or type(e).__name__] = \
+                                taxonomy.get(
+                                    kind or type(e).__name__, 0) + 1
+                            if not ok:
+                                problems.append(
+                                    f"client {i}: unstructured "
+                                    f"failure {type(e).__name__}: "
+                                    f"{e}")
+            threads = [sanitize.thread(target=run, args=(i,),
+                                       purpose="battery-client")
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            for i in range(0, clients, 5):  # the cancel storm
+                clients_objs[i].cancel()
+            for t in threads:
+                t.join(timeout=300)
+                if t.is_alive():
+                    problems.append("client thread hung")
+            faults.disarm()
+            if not _drain(coord):
+                problems.append("resource groups never drained")
+        finally:
+            faults.disarm()
+            coord.stop()
+        violations = [str(v) for v in sanitize.audit(
+            raise_=False, coordinator_check=True)]
+        problems.extend(violations)
+        fuzzer = sanitize.FUZZ
+        perturbations = fuzzer.perturbations if fuzzer else 0
+    finally:
+        restore()
+        sanitize.fuzz(None)
+        if not was_armed:
+            sanitize.disarm()
+        reset_cache_manager()
+    return {
+        "seed": seed,
+        "clients": clients,
+        "rounds": rounds,
+        "perturbations": perturbations,
+        "distinct_success_checksums": len(checksums),
+        "errors": dict(sorted(taxonomy.items())),
+        "problems": problems,
+        "ok": not problems and len(checksums) <= 1,
+    }
+
+
+def seed_sweep(seeds: Sequence[int], clients: int = 16,
+               rounds: int = 1, schema: str = "tiny") -> dict:
+    """Replay the battery under every seed; collect failing seeds with
+    their one-line reproducers. `identical` additionally holds the
+    byte-identity across ALL seeds' successes (one checksum total)."""
+    per_seed = []
+    failing = []
+    for seed in seeds:
+        doc = run_battery(seed, clients=clients, rounds=rounds,
+                          schema=schema)
+        per_seed.append(doc)
+        if not doc["ok"]:
+            failing.append(seed)
+            print(f"FAILING SEED {seed} — reproduce with: "
+                  f"python -m presto_tpu.tools.sanitize "
+                  f"--seed {seed} --clients {clients} "
+                  f"--rounds {rounds}")
+    identical = all(d["distinct_success_checksums"] <= 1
+                    for d in per_seed)
+    return {
+        "seeds": list(seeds),
+        "clients": clients,
+        "rounds": rounds,
+        "failing_seeds": failing,
+        "identical": identical,
+        "per_seed": per_seed,
+        "ok": not failing and identical,
+    }
+
+
+# ---------------------------------------------------------------------------
+# --report
+
+
+def report() -> dict:
+    from presto_tpu import sanitize
+    edges = sanitize.lock_order_edges()
+    return {
+        "armed": sanitize.ARMED,
+        "fuzzer": repr(sanitize.FUZZ) if sanitize.FUZZ else None,
+        "tracked": sanitize.tracked_summary(),
+        "lock_order_edges": {
+            f"{a} -> {b}": {"held_at": hs, "acquired_at": as_}
+            for (a, b), (hs, as_) in sorted(edges.items())},
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m presto_tpu.tools.sanitize",
+        description="concurrency sanitizer: armed audit runs, "
+                    "seeded schedule-fuzz sweeps, lock-order report")
+    p.add_argument("--audit", action="store_true",
+                   help="run the serving mix armed and audit")
+    p.add_argument("--seed-sweep", type=int, default=None,
+                   metavar="N", help="replay the chaos battery under "
+                   "N fuzzer seeds (0..N-1)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="replay exactly one seed (the reproducer)")
+    p.add_argument("--clients", type=int, default=16)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--schema", default="tiny")
+    p.add_argument("--report", action="store_true",
+                   help="dump lock-order graph + tracked registries")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    doc: dict = {}
+    ok = True
+    if args.audit:
+        doc["audit"] = armed_audit(schema=args.schema)
+        ok = ok and doc["audit"]["ok"]
+    if args.seed_sweep is not None:
+        doc["sweep"] = seed_sweep(list(range(args.seed_sweep)),
+                                  clients=args.clients,
+                                  rounds=args.rounds,
+                                  schema=args.schema)
+        ok = ok and doc["sweep"]["ok"]
+    if args.seed is not None:
+        doc["battery"] = run_battery(args.seed,
+                                     clients=args.clients,
+                                     rounds=args.rounds,
+                                     schema=args.schema)
+        ok = ok and doc["battery"]["ok"]
+    if args.report or not doc:
+        doc["report"] = report()
+    text = json.dumps(doc, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

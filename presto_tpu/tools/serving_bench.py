@@ -362,6 +362,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       overload: bool = False,
                       overload_rounds: int = 2,
                       overload_concurrency: Optional[int] = None,
+                      sanitize_phase: bool = False,
                       host: str = "127.0.0.1") -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
@@ -382,7 +383,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             cache_dir=cache_dir, fusion_report=fusion_report,
             overload=overload, overload_rounds=overload_rounds,
             overload_concurrency=overload_concurrency,
-            host=host)
+            sanitize_phase=sanitize_phase, host=host)
     finally:
         if auto_cache_dir is not None:
             import shutil
@@ -398,7 +399,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    fusion_report: bool, overload: bool,
                    overload_rounds: int,
                    overload_concurrency: Optional[int],
-                   host: str) -> dict:
+                   sanitize_phase: bool, host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
@@ -492,6 +493,58 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
             raise RuntimeError(
                 "overload-phase successes diverged from warm "
                 "results: " + json.dumps(overload_doc, indent=1))
+
+    sanitize_doc = None
+    if sanitize_phase:
+        # the warm mix once more with the concurrency sanitizer fully
+        # armed on a FRESH coordinator + executor (both built under
+        # the sanitizer so their locks are order-tracked): reports
+        # violations and the armed-vs-disarmed wall delta alongside
+        # QPS, so future fleet/mesh benches carry sanitizer status
+        from presto_tpu import sanitize as _san
+        from presto_tpu.tools.sanitize import _drain, _fresh_executor
+        was_armed = _san.ARMED  # an env-armed run must stay armed
+        _san.arm()
+        restore_executor = _fresh_executor()
+        try:
+            san_coord = Coordinator(
+                [], "tpch", schema, host=host, port=0,
+                max_concurrent_queries=clients, single_node=True)
+            san_coord.start()
+            try:
+                san_stats, san_checks = _run_phase(
+                    san_coord.url,
+                    [list(work) for _ in range(clients)])
+                # settle: the last query's slot release races the
+                # client's final poll — the quiescent audit needs
+                # the ledger drained
+                _drain(san_coord)
+            finally:
+                san_coord.stop()
+            violations = [str(v) for v in _san.audit(
+                raise_=False, coordinator_check=True)]
+            edges = len(_san.lock_order_edges())
+        finally:
+            restore_executor()
+            if not was_armed:
+                _san.disarm()
+        san_consistent = all(
+            len(sums) == 1 and sums == warm_checks.get(name)
+            for name, sums in san_checks.items())
+        sanitize_doc = {
+            **san_stats,
+            "violations": violations,
+            "violation_count": len(violations),
+            "lock_order_edges": edges,
+            "armed_vs_warm_qps": round(
+                san_stats["qps"] / warm["qps"], 3)
+            if warm.get("qps") and san_stats.get("qps") else None,
+            "successes_match_warm": san_consistent,
+        }
+        if violations or not san_consistent:
+            raise RuntimeError(
+                "sanitize phase failed (violations or divergence): "
+                + json.dumps(sanitize_doc, indent=1))
 
     def _consistent(*phases: Dict[str, set]) -> bool:
         """One checksum per query per phase, identical across phases
@@ -602,6 +655,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "results_identical": identical,
         "cache": cache_stats,
         "chaos": chaos_doc,
+        "sanitize": sanitize_doc,
         "fusion": fusion,
     }
     if not identical:
@@ -649,6 +703,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--overload-concurrency", type=int, default=None,
                    help="hard concurrency cap of the overload "
                         "coordinator (default: clients // 8)")
+    p.add_argument("--sanitize", action="store_true",
+                   help="run the warm mix once more with the "
+                        "concurrency sanitizer fully armed (fresh "
+                        "coordinator + executor): reports violations "
+                        "and the armed-vs-disarmed wall delta in the "
+                        "JSON")
     p.add_argument("--fusion-report", action="store_true",
                    help="embed the per-query whole-fragment fusion "
                         "coverage (fused chains + fallback reasons, "
@@ -663,7 +723,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         chaos_spec=args.chaos_spec, restart_warm=args.restart_warm,
         cache_dir=args.cache_dir, fusion_report=args.fusion_report,
         overload=args.overload, overload_rounds=args.overload_rounds,
-        overload_concurrency=args.overload_concurrency)
+        overload_concurrency=args.overload_concurrency,
+        sanitize_phase=args.sanitize)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

@@ -96,6 +96,19 @@ def _clear_xla_caches_between_modules(request):
         # into every later module
         from presto_tpu.execution import faults
         faults.disarm()
+        # armed full-suite audit runs (PRESTO_TPU_SANITIZE=1): every
+        # module boundary is a quiescent checkpoint — ledgers must
+        # balance and no thread may outlive its owner's shutdown
+        # (this is how the coordinator-pruner leak was found). Inert
+        # in the default tier-1 run (sanitize stays disarmed).
+        from presto_tpu import sanitize
+        if sanitize.ARMED:
+            violations = sanitize.audit(raise_=False,
+                                        coordinator_check=True)
+            assert not violations, (
+                f"sanitizer violations at the {_last_module[0]} -> "
+                f"{mod} module boundary:\n"
+                + "\n".join(str(v) for v in violations))
     _last_module[0] = mod
     yield
 

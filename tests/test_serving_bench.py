@@ -56,6 +56,32 @@ def test_serving_bench_chaos_phase():
     assert sum(chaos["errors"].values()) == chaos["failed"]
 
 
+def test_serving_bench_sanitize_phase():
+    """--sanitize: the warm mix once more with the concurrency
+    sanitizer fully armed on a fresh coordinator/executor — zero
+    violations, byte-identity vs warm, and the armed-vs-disarmed
+    delta reported alongside QPS."""
+    from presto_tpu import sanitize
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.tools.serving_bench import run_serving_bench
+    reset_cache_manager()
+    was_armed = sanitize.ARMED
+    doc = run_serving_bench(clients=2, schema="tiny",
+                            mix=("q6", "q1"), warm_rounds=1,
+                            verify_off=False, sanitize_phase=True)
+    # the bench restores the PRIOR gate: disarmed suites stay
+    # disarmed, an env-armed audit run stays armed
+    assert sanitize.ARMED == was_armed
+    san = doc["sanitize"]
+    for key in ("violations", "violation_count", "lock_order_edges",
+                "armed_vs_warm_qps", "successes_match_warm", "qps"):
+        assert key in san, key
+    assert san["violations"] == []
+    assert san["successes_match_warm"] is True
+    assert san["queries"] == 4  # 2 clients x 2 queries
+    reset_cache_manager()
+
+
 def test_serving_bench_restart_warm_phase(tmp_path):
     """--restart-warm: after the kernel-cache wipe (the process-
     restart simulation) the rebuilt coordinator AOT-prewarms the mix

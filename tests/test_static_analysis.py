@@ -65,7 +65,8 @@ def test_mesh_drive_loop_has_lifecycle_checkpoints():
 
 def test_rule_catalogue_complete():
     assert set(RULES) == {"TS001", "TS002", "TS003", "TS004", "TS005",
-                          "CC001", "CC002", "CC003", "CC004"}
+                          "CC001", "CC002", "CC003", "CC004",
+                          "CC005", "CC006"}
 
 
 def test_ts001_traced_branch():
@@ -332,6 +333,118 @@ def test_cc004_drive_loop_without_checkpoint():
                 break
     """
     assert not _rules(clean, "CC004")
+
+
+def test_cc005_raw_lock_ctor():
+    """CC005 closes the static half of the sanitizer loop: every raw
+    threading primitive in a covered layer escapes the armed
+    lock-order detector."""
+    bad = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+    """
+    assert _rules(bad, "CC005")
+    # aliased module import (the runner/local.py `_threading` shape)
+    aliased = """
+    import threading as _threading
+
+    _LOCK = _threading.RLock()
+    _COND = _threading.Condition()
+    """
+    assert len(_rules(aliased, "CC005")) == 2
+    # from-import binding
+    from_import = """
+    from threading import Lock
+
+    _LOCK = Lock()
+    """
+    assert _rules(from_import, "CC005")
+    clean = """
+    from presto_tpu import sanitize
+
+    class Cache:
+        def __init__(self):
+            self._lock = sanitize.lock("cache.fixture")
+            self._cond = sanitize.condition("cache.fixture_cond")
+    """
+    assert not _rules(clean, "CC005")
+    suppressed = """
+    import threading
+
+    _META = threading.Lock()  # lint-ok: CC005 fixture meta-lock
+    """
+    assert not _rules(suppressed, "CC005")
+    # threading.Event is NOT a lock: no finding
+    event = """
+    import threading
+
+    _EV = threading.Event()
+    """
+    assert not _rules(event, "CC005")
+
+
+def test_cc006_raw_thread_ctor():
+    bad = """
+    import threading
+
+    def spawn(fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        return t
+    """
+    assert _rules(bad, "CC006")
+    clean = """
+    from presto_tpu import sanitize
+
+    def spawn(fn, owner):
+        t = sanitize.thread(target=fn, purpose="fixture",
+                            owner=owner)
+        t.start()
+        return t
+    """
+    assert not _rules(clean, "CC006")
+    suppressed = """
+    import threading
+
+    # lint-ok: CC006 fixture thread, joined by the caller
+    t = threading.Thread(target=print)
+    """
+    assert not _rules(suppressed, "CC006")
+
+
+def test_cc002_sanitize_factory_counts_as_lock_ownership():
+    """A class whose lock comes from sanitize.lock() is still a
+    lock-owning class for CC002 — adopting the factory must not
+    silently retire the bare-counter rule."""
+    bad = """
+    from presto_tpu import sanitize
+
+    class Executor:
+        def __init__(self):
+            self._lock = sanitize.lock("executor.fixture")
+            self.quanta = 0
+
+        def bump(self):
+            self.quanta += 1
+    """
+    assert _rules(bad, "CC002")
+
+
+def test_sanitize_package_is_lint_scoped():
+    """The sanitizer's own tree is covered (its deliberate raw
+    primitives ride suppressions with reasons, proving the
+    CC005/CC006 escape hatch is exercised)."""
+    import os
+    from presto_tpu.tools.lint import CONC_SCOPE
+    assert "presto_tpu/sanitize/" in CONC_SCOPE
+    path = os.path.join(repo_root(), "presto_tpu/sanitize/locks.py")
+    result = run_lint([path], explicit=True)
+    cc005 = [f for f in result.findings if f.rule == "CC005"]
+    assert not cc005, "\n".join(f.render() for f in cc005)
+    assert any(f.rule == "CC005" for f in result.suppressed)
 
 
 # ---------------------------------------------------------------------------
