@@ -57,6 +57,15 @@ _TABLES: Dict[str, List] = {
     "runtime.caches": [("level", VARCHAR), ("hits", BIGINT),
                        ("misses", BIGINT), ("evictions", BIGINT),
                        ("entries", BIGINT), ("bytes", BIGINT)],
+    # the history-based-optimization store's live entries
+    # (presto_tpu/history): one row per structural fingerprint with
+    # its decayed measurements — the observable face of every
+    # history-driven planner decision
+    "runtime.plan_history": [
+        ("fingerprint", VARCHAR), ("output_rows", BIGINT),
+        ("input_rows", BIGINT), ("selectivity", DOUBLE),
+        ("wall_ms", DOUBLE), ("peak_bytes", BIGINT),
+        ("observations", BIGINT), ("age_ms", DOUBLE)],
     "metadata.catalogs": [("catalog_name", VARCHAR)],
     "metadata.tables": [("table_catalog", VARCHAR),
                         ("table_schema", VARCHAR),
@@ -224,6 +233,12 @@ def runner_system_connector(runner) -> SystemConnector:
                     for level in ("plan", "fragment", "page")]
         return mgr.snapshot_rows()
 
+    def plan_history():
+        # zero rows (stable schema) when no store exists yet
+        from presto_tpu.history import get_history_store
+        store = get_history_store(create=False)
+        return store.snapshot_rows() if store is not None else []
+
     def tables():
         out = []
         for cat in runner.catalogs.catalogs():
@@ -245,6 +260,7 @@ def runner_system_connector(runner) -> SystemConnector:
         "runtime.nodes": nodes,
         "runtime.queries": queries,
         "runtime.caches": caches,
+        "runtime.plan_history": plan_history,
         "runtime.operator_stats": operator_stats,
         "metadata.catalogs": catalogs,
         "metadata.tables": tables,

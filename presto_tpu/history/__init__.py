@@ -1,0 +1,132 @@
+"""History-based adaptive optimization (reference: the Presto
+optimizer's history-based optimization — prior executions of
+structurally identical plan fragments replace derived statistics).
+
+The measure -> remember -> replan loop, three pieces:
+
+  * **HistoryStore** (store.py) — bounded, thread-safe, disk-backed
+    beside the XLA compile cache (`PRESTO_TPU_HISTORY_DIR` /
+    ``LocalRunner(history_dir=)``), keyed on structural node
+    fingerprints that fold in every scanned table's
+    (cache token, table version) — ingest invalidates by key, exactly
+    like the fragment-result cache.
+  * **Recording tap** (recorder.py) — the drive loops commit measured
+    per-node output rows / selectivity / wall / peak memory on CLEAN
+    completion only; failed, cancelled, shed, and fault-injected runs
+    record nothing.
+  * **Planner feedback** — the stats estimator
+    (planner/stats.py) serves measured cardinalities back with
+    `history` provenance, upgrading the fusion selectivity gate, join
+    order and build-side choice, broadcast-vs-partitioned exchanges,
+    and dynamic-filter planning. EXPLAIN renders the provenance per
+    node; byte-identity with history off is the correctness bar.
+
+Gated by the `history_based_optimization` session property (default
+on). docs/ADAPTIVE.md covers the schema, decay, invalidation and
+tuning story.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from presto_tpu import sanitize
+from presto_tpu.history.fingerprint import node_fingerprint  # noqa: F401
+from presto_tpu.history.recorder import (  # noqa: F401
+    collect_observations, interesting_ops,
+)
+from presto_tpu.history.store import HistoryStore  # noqa: F401
+
+_STORE: Optional[HistoryStore] = None
+_STORE_DIR: Optional[str] = None
+_STORE_LOCK = sanitize.lock("history.singleton")
+
+#: estimate provenance tags (EXPLAIN annotations, factory stamps)
+PROV_STATIC = "static"
+PROV_HISTORY = "history"
+
+
+def configure(history_dir: Optional[str]) -> None:
+    """Pin the process-wide store to `history_dir` (loading any
+    persisted entries). Reconfiguring to a DIFFERENT dir replaces the
+    store — the restart-simulation hook tests and tools use."""
+    global _STORE, _STORE_DIR
+    with _STORE_LOCK:
+        if history_dir == _STORE_DIR and _STORE is not None:
+            return
+        _STORE_DIR = history_dir
+        _STORE = HistoryStore(history_dir)
+
+
+def configure_from_env() -> None:
+    d = os.environ.get("PRESTO_TPU_HISTORY_DIR")
+    if d:
+        configure(d)
+
+
+def get_history_store(create: bool = True) -> Optional[HistoryStore]:
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is None and create:
+            _STORE = HistoryStore(_STORE_DIR)
+        return _STORE
+
+
+def reset_history_store() -> None:
+    """Drop the process-wide store (tests; a restart simulation is
+    reset + configure(dir) — the fresh store loads from disk)."""
+    global _STORE, _STORE_DIR
+    with _STORE_LOCK:
+        _STORE = None
+        _STORE_DIR = None
+
+
+def enabled(properties: Dict[str, Any]) -> bool:
+    from presto_tpu.session_properties import get_property
+    return bool(get_property(properties, "history_based_optimization"))
+
+
+def view_for(catalogs, properties: Dict[str, Any]
+             ) -> Optional["HistoryView"]:
+    """The per-planning-pass lookup handle, or None when history is
+    disabled or the store is empty (an empty store can only miss —
+    skipping it keeps cold planning at zero overhead)."""
+    if not enabled(properties):
+        return None
+    store = get_history_store(create=False)
+    if store is None or len(store) == 0:
+        return None
+    return HistoryView(store, catalogs)
+
+
+class HistoryView:
+    """Memoized node -> history-entry lookups for ONE planning pass.
+    Holds strong references to every fingerprinted node so the id()
+    keys in its memo can never alias a recycled allocation (the stats
+    estimator's memo rule)."""
+
+    def __init__(self, store: HistoryStore, catalogs):
+        self.store = store
+        self.catalogs = catalogs
+        self._memo: Dict[int, object] = {}
+        self._entry_memo: Dict[int, Optional[dict]] = {}
+        self._pins: list = []
+
+    def lookup(self, node) -> Optional[dict]:
+        nid = id(node)
+        if nid in self._entry_memo:
+            return self._entry_memo[nid]
+        self._pins.append(node)
+        fp = node_fingerprint(node, self.catalogs, self._memo)
+        entry = self.store.get(fp[0]) if fp is not None else None
+        self._entry_memo[nid] = entry
+        return entry
+
+    def selectivity(self, node) -> Optional[float]:
+        """Measured surviving-row fraction of a filtering node, when
+        both sides of the ratio were observed."""
+        e = self.lookup(node)
+        if e is None or not e.get("in_rows"):
+            return None
+        return max(0.0, min(1.0, e["rows"] / e["in_rows"]))

@@ -1,0 +1,176 @@
+"""Structural plan-node fingerprints for the history store (reference:
+the canonical plan hashing behind history-based optimization — the
+optimizer consults prior executions of structurally identical plan
+fragments; presto-main's HistoryBasedPlanStatisticsProvider keys on a
+canonicalized subtree the same way).
+
+Unlike cache/fingerprint.fragment_fingerprint, which only accepts the
+deterministic single-pipeline leaf shapes a RESULT cache may replay,
+history keys must cover EVERY node whose cardinality the planner
+estimates — joins, semijoins, aggregations at any step, windows. The
+key covers the node's type, expressions, output schema, its whole
+input subtree, and every scanned table's (cache token, table version)
+pair — so an INSERT anywhere below mints a different key and stale
+measurements become unreachable, exactly the fragment-cache
+invalidation contract.
+
+None always means "not history-keyable" (volatile table, remote
+subtree, nondeterministic expression), never an error: callers fall
+back to static estimates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.cache.fingerprint import table_cache_key
+from presto_tpu.planner import nodes as N
+from presto_tpu.planner.validation import expr_deterministic
+
+
+def _hash_expr(h, e) -> bool:
+    """Mix an expression IR into the digest; False = not keyable. A
+    nondeterministic expression's measured cardinality is a sample,
+    not a property of the plan — recording it would replay noise."""
+    if e is None:
+        h.update(b"~")
+        return True
+    if not expr_deterministic(e):
+        return False
+    from presto_tpu.expr.ir import fingerprint
+    try:
+        h.update(fingerprint(e))
+    except Exception:  # noqa: BLE001 — unhashable literal etc.
+        return False
+    return True
+
+
+def _hash_fields(h, fields) -> None:
+    for f in fields:
+        h.update(repr((f.symbol, f.type.name, f.dictionary)).encode())
+        form = getattr(f, "form", None)
+        if form is not None:
+            h.update(repr(form).encode())
+
+
+def node_fingerprint(node: N.PlanNode, catalogs,
+                     memo: Optional[Dict[int, object]] = None
+                     ) -> Optional[Tuple[str, Tuple]]:
+    """(key, table deps) of the subtree rooted at `node`, or None.
+    `memo` (id(node) -> result|False) amortizes the recursion across a
+    planning pass — the caller must keep the plan nodes referenced
+    while it holds the memo (id() reuse, same rule as the stats
+    estimator's memo)."""
+    if memo is not None:
+        hit = memo.get(id(node))
+        if hit is not None:
+            return None if hit is False else hit
+    out = _fingerprint_uncached(node, catalogs, memo)
+    if memo is not None:
+        memo[id(node)] = out if out is not None else False
+    return out
+
+
+def _fingerprint_uncached(node, catalogs, memo):
+    h = hashlib.blake2b(digest_size=16)
+    deps: List = []
+    if not _visit(node, h, deps, catalogs, memo):
+        return None
+    if not deps:
+        # a constant subtree (VALUES) has nothing data-dependent to
+        # measure — static estimates are already exact
+        return None
+    return ("hist:" + h.hexdigest(), tuple(deps))
+
+
+def _visit(n, h, deps, catalogs, memo) -> bool:
+    h.update(type(n).__name__.encode())
+    _hash_fields(h, n.output)
+    if isinstance(n, N.TableScanNode):
+        tv = table_cache_key(catalogs, n.handle)
+        if tv is None:
+            return False  # volatile/unversioned — never keyed
+        deps.append((n.handle.catalog, n.handle.schema,
+                     n.handle.table, tv))
+        h.update(repr((n.handle.catalog, n.handle.schema,
+                       n.handle.table, tv,
+                       sorted(n.assignments.items()),
+                       n.constraint)).encode())
+        return True
+    if isinstance(n, N.RemoteSourceNode):
+        # the producing subtree lives in another fragment — keying on
+        # the exchange id alone would alias unrelated queries
+        return False
+    if isinstance(n, (N.TableWriterNode, N.TableFinishNode)):
+        return False  # write plans are never history-keyed
+    if isinstance(n, N.FilterNode):
+        if not _hash_expr(h, n.predicate):
+            return False
+    elif isinstance(n, N.ProjectNode):
+        for sym, e in n.assignments:
+            h.update(sym.encode())
+            if not _hash_expr(h, e):
+                return False
+    elif isinstance(n, N.AggregationNode):
+        h.update(n.step.encode())
+        for sym, e in n.keys:
+            h.update(sym.encode())
+            if not _hash_expr(h, e):
+                return False
+        for a in n.aggregates:
+            h.update(repr((a.out_symbol, a.function, a.distinct,
+                           a.params)).encode())
+            for e in (a.argument, getattr(a, "argument2", None),
+                      a.filter):
+                if not _hash_expr(h, e):
+                    return False
+    elif isinstance(n, N.JoinNode):
+        h.update(repr((n.join_type, sorted(n.criteria))).encode())
+        if not _hash_expr(h, n.filter):
+            return False
+    elif isinstance(n, N.SemiJoinNode):
+        h.update(repr((n.source_key, n.filtering_key,
+                       n.negate)).encode())
+    elif isinstance(n, (N.SortNode, N.TopNNode, N.MergeNode)):
+        h.update(repr((getattr(n, "n", None), list(n.keys),
+                       list(n.descending),
+                       list(n.nulls_first))).encode())
+    elif isinstance(n, N.LimitNode):
+        h.update(repr(n.n).encode())
+    elif isinstance(n, N.ValuesNode):
+        try:
+            h.update(repr(n.rows).encode())
+        except Exception:  # noqa: BLE001
+            return False
+    elif isinstance(n, N.TopNRowNumberNode):
+        h.update(repr((n.partition_by, n.order_by, n.descending,
+                       n.nulls_first, n.function,
+                       n.max_rank)).encode())
+    elif isinstance(n, N.WindowNode):
+        h.update(repr((n.partition_by, n.order_by, n.descending,
+                       n.nulls_first,
+                       [(c.out_symbol, c.function, c.argument,
+                         c.frame, c.offset, c.frame_start, c.frame_end,
+                         c.filter) for c in n.calls])).encode())
+    elif isinstance(n, N.GroupIdNode):
+        h.update(repr((n.groupings, n.all_keys, n.gid_symbol,
+                       n.grouping_outputs)).encode())
+    elif isinstance(n, N.UnnestNode):
+        h.update(repr((n.items, n.ordinality_symbol)).encode())
+    elif isinstance(n, N.UnionNode):
+        h.update(repr(n.symbol_maps).encode())
+    elif isinstance(n, N.AssignUniqueIdNode):
+        h.update(n.symbol.encode())
+    # Distinct / EnforceSingleRow / Exchange / Output: type name +
+    # output fields already mixed in
+    for s in n.sources():
+        # child keys recurse through the memo so a DAG-shared subtree
+        # hashes once per planning pass
+        sub = node_fingerprint(s, catalogs, memo)
+        if sub is None:
+            return False
+        key, sub_deps = sub
+        h.update(key.encode())
+        deps.extend(sub_deps)
+    return True

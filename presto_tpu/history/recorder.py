@@ -1,0 +1,198 @@
+"""The recording tap: turn one CLEAN execution's per-operator stats
+into history observations (reference: the completed-query listener
+that feeds HistoryBasedPlanStatisticsTracker).
+
+The planner's node -> operator-id map (telemetry's EXPLAIN ANALYZE
+join, captured BEFORE the fusion pass) ties measured operator rows
+back onto plan nodes; fusion's id_remap tells us which operators were
+absorbed into another node's trace and therefore measured nothing of
+their own this run.
+
+Commit discipline (the contract tests assert): observations are built
+and committed ONLY by the success path of a drive — failed, cancelled,
+shed, and fault-injected runs record nothing, and multi-task fragment
+slices (task.count > 1) are never mistaken for whole-node
+cardinalities.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from presto_tpu.history.fingerprint import node_fingerprint
+from presto_tpu.planner import nodes as N
+
+#: node types whose measured cardinality the estimator can serve back.
+#: Projections / sorts / limits derive their counts trivially from
+#: their input; everything here can SURPRISE a static estimate.
+RECORDED_NODES = (N.TableScanNode, N.FilterNode, N.AggregationNode,
+                  N.DistinctNode, N.JoinNode, N.SemiJoinNode,
+                  N.GroupIdNode, N.UnnestNode, N.TopNRowNumberNode)
+
+#: nodes whose operators preserve row counts — an absorbed (fused)
+#: operator owned by one of these cannot distort a chain measurement
+_ROW_PRESERVING = (N.ProjectNode,)
+
+
+def interesting_ops(plan: N.PlanNode,
+                    node_ops: Dict[int, List[int]],
+                    id_remap: Optional[Dict[int, int]] = None,
+                    catalogs=None) -> set:
+    """Operator ids whose row counters the drive should arm
+    (OperatorStats.count_rows): every operator planned for a node
+    whose cardinality history wants — plus, through fusion's
+    `id_remap`, the surviving operator each absorbed one folded into
+    (the collapsed-chain measurement). Cheap device-side adds per
+    batch, materialized once at drain.
+
+    With `catalogs`, nodes that can never be KEYED (remote/volatile/
+    nondeterministic subtrees — node_fingerprint returns None) are
+    not armed at all: their per-batch counts would be discarded
+    unconditionally at collect time."""
+    out: set = set()
+    memo: Dict[int, object] = {}
+    for node in walk_nodes(plan):
+        if not isinstance(node, RECORDED_NODES):
+            continue
+        if catalogs is not None \
+                and node_fingerprint(node, catalogs, memo) is None:
+            continue
+        out.update(node_ops.get(id(node), ()))
+    if id_remap:
+        out.update(id_remap[i] for i in list(out) if i in id_remap)
+    return out
+
+
+def walk_nodes(root: N.PlanNode):
+    seen = set()
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        yield n
+        stack.extend(n.sources())
+
+
+def collect_observations(plan: N.PlanNode, catalogs,
+                         node_ops: Dict[int, List[int]],
+                         snapshots: List[List[Dict[str, Any]]],
+                         id_remap: Optional[Dict[int, int]] = None
+                         ) -> List[Dict[str, Any]]:
+    """Observations for HistoryStore.commit. `node_ops` must be the
+    PRE-FUSION map (planner.node_ops_prefusion): fusion rewrites the
+    live map in place for EXPLAIN ANALYZE, which would alias absorbed
+    nodes onto their terminal's operator and mis-attribute its rows."""
+    id_remap = id_remap or {}
+    by_id = {s["operator_id"]: s for ops in snapshots for s in ops}
+    op_owner: Dict[int, N.PlanNode] = {}
+    nodes = list(walk_nodes(plan))
+    for node in nodes:
+        for op_id in node_ops.get(id(node), ()):
+            op_owner[op_id] = node
+    # absorption target -> owner nodes of the operators folded into it
+    absorbed_owners: Dict[int, List[N.PlanNode]] = {}
+    for src, tgt in id_remap.items():
+        owner = op_owner.get(src)
+        if owner is not None:
+            absorbed_owners.setdefault(tgt, []).append(owner)
+
+    memo: Dict[int, object] = {}
+    out: List[Dict[str, Any]] = []
+    for node in nodes:
+        if not isinstance(node, RECORDED_NODES):
+            continue
+        ids = node_ops.get(id(node), ())
+        surviving = [i for i in ids if i in by_id]
+        if not surviving:
+            # absorbed into another node's trace this run — but a
+            # FilterNode folded into a COLLAPSED CHAIN (surviving
+            # operator owned by a row-preserving node) still measures:
+            # the chain's in -> out rows ARE this filter's
+            # selectivity, provided it is the chain's only filtering
+            # link
+            obs = _absorbed_filter_obs(node, ids, id_remap, by_id,
+                                       op_owner, absorbed_owners,
+                                       catalogs, memo)
+            if obs is not None:
+                out.append(obs)
+            continue
+        if isinstance(node, N.FilterNode):
+            # the filtering operator itself — by NAME, not position:
+            # a filter over a spooled shared subtree also owns the
+            # spool-source operator, whose pre-filter rows must never
+            # be recorded as this node's output
+            cands = [i for i in surviving
+                     if by_id[i]["name"] == "filter_project"
+                     or by_id[i]["name"].startswith("fused[")]
+            if not cands:
+                continue
+            op = by_id[min(cands)]
+            want_in = True
+        else:
+            # the LAST operator produces the node's output (a join's
+            # probe after its build; a fragment recorder passes rows
+            # through unchanged)
+            op = by_id[max(surviving)]
+            want_in = False
+        if not op.get("rows_counted"):
+            continue  # counters were not armed for this operator
+        tgt_owners = absorbed_owners.get(op["operator_id"], ())
+        foreign = [o for o in tgt_owners if o is not node]
+        if any(not isinstance(o, _ROW_PRESERVING) for o in foreign):
+            # another node's FILTERING operator was fused into this
+            # one — its rows are a chain property, not this node's
+            continue
+        fp = node_fingerprint(node, catalogs, memo)
+        if fp is None:
+            continue
+        # (absorbed projections — the only `foreign` owners allowed
+        # past the check above — preserve counts, so in -> out across
+        # a collapsed run is still this filter's own selectivity)
+        in_rows = op.get("input_rows") if want_in else None
+        out.append({
+            "key": fp[0],
+            "rows": int(op.get("output_rows", 0)),
+            "in_rows": int(in_rows) if in_rows is not None else None,
+            "wall_ms": round(op.get("busy_seconds", 0.0) * 1e3, 3),
+            "peak_bytes": int(op.get("peak_bytes", 0)),
+        })
+    return out
+
+
+def _absorbed_filter_obs(node, ids, id_remap, by_id, op_owner,
+                         absorbed_owners, catalogs, memo
+                         ) -> Optional[Dict[str, Any]]:
+    """Observation for a FilterNode whose operators were all absorbed
+    into one surviving collapsed-chain operator owned by a
+    row-preserving node, and which is the only FILTERING owner folded
+    in — then chain input/output rows measure exactly this filter."""
+    if not isinstance(node, N.FilterNode):
+        return None
+    targets = {id_remap[i] for i in ids if i in id_remap}
+    if len(targets) != 1:
+        return None
+    t = targets.pop()
+    op = by_id.get(t)
+    if op is None or not op.get("rows_counted"):
+        return None
+    if not isinstance(op_owner.get(t), _ROW_PRESERVING):
+        return None  # a fold terminal's in/out is not a selectivity
+    group = absorbed_owners.get(t, [])
+    filters = [o for o in group if isinstance(o, N.FilterNode)]
+    if len(filters) != 1 or filters[0] is not node:
+        return None
+    if any(not isinstance(o, _ROW_PRESERVING + (N.FilterNode,))
+           for o in group):
+        return None
+    fp = node_fingerprint(node, catalogs, memo)
+    if fp is None:
+        return None
+    return {
+        "key": fp[0],
+        "rows": int(op.get("output_rows", 0)),
+        "in_rows": int(op.get("input_rows", 0)),
+        "wall_ms": round(op.get("busy_seconds", 0.0) * 1e3, 3),
+        "peak_bytes": int(op.get("peak_bytes", 0)),
+    }

@@ -1,0 +1,248 @@
+"""HistoryStore: measured per-node execution statistics, keyed by the
+structural fingerprints of history/fingerprint.py (reference:
+history-based optimization — the optimizer replaces derived stats with
+statistics observed on prior executions of structurally identical
+plan fragments).
+
+One bounded, thread-safe, optionally disk-backed store per process
+(the cache-manager singleton pattern): the recording tap commits
+observations after every CLEAN query completion, the planner's stats
+estimator serves them back with `history` provenance on the next plan
+of the same shape.
+
+Entry merge is an exponentially-decayed mean (`HISTORY_DECAY` weight
+on the newest observation), so a table whose data drifts between
+version bumps — INSERTs mint new keys, but same-version drift exists
+for connectors with coarse versioning — converges toward recent truth
+instead of averaging forever.
+
+The store carries a GENERATION counter bumped only on MATERIAL change
+(a new key, or a measurement moving by more than
+`MATERIAL_ROWS_DELTA` relative). The plan cache folds the generation
+into its session key, so cached plans are re-planned exactly when
+history could change a decision — not on every serving repetition's
+near-identical re-measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from presto_tpu import sanitize
+
+#: EWMA weight of the newest observation when merging into an entry
+HISTORY_DECAY = 0.5
+#: relative rows/in_rows movement that counts as a material change
+#: (bumps the generation and re-plans cached statements)
+MATERIAL_ROWS_DELTA = 0.2
+#: bounded store: entries evict LRU past either cap
+HISTORY_MAX_ENTRIES = 8192
+HISTORY_MAX_BYTES = 4 << 20
+#: accounting model: flat per-entry cost + the key text (the audit in
+#: sanitize/auditors.py recomputes bytes from live entries with the
+#: same model and asserts the ledger matches)
+ENTRY_BASE_BYTES = 160
+
+
+def entry_bytes(key: str) -> int:
+    return ENTRY_BASE_BYTES + len(key)
+
+
+class HistoryStore:
+    """key -> {rows, in_rows, wall_ms, peak_bytes, n, updated}.
+
+    `rows`/`in_rows` are the node's measured output/input row counts
+    (selectivity = rows / in_rows); `wall_ms` the operator busy wall;
+    `peak_bytes` the operator's peak memory-pool reservation; `n` the
+    observation count surviving decay."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._lock = sanitize.lock("history.store")
+        self._entries: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.records = 0
+        self.evictions = 0
+        self._generation = 0
+        self.path = path
+        sanitize.track("history_store", self)
+        if path is not None:
+            self._load()
+
+    # -- lookup --------------------------------------------------------
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        from presto_tpu.telemetry.metrics import METRICS
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                METRICS.inc("presto_tpu_history_misses_total")
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            METRICS.inc("presto_tpu_history_hits_total")
+            return dict(e)
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot_rows(self) -> List[tuple]:
+        """system.runtime.plan_history rows: (key, rows, in_rows,
+        selectivity, wall_ms, peak_bytes, observations, updated_ms_ago)."""
+        now = time.time()
+        with self._lock:
+            out = []
+            for key, e in self._entries.items():
+                sel = (e["rows"] / e["in_rows"]) \
+                    if e.get("in_rows") else None
+                out.append((key, int(e["rows"]),
+                            int(e["in_rows"] or 0),
+                            round(sel, 6) if sel is not None else None,
+                            round(e.get("wall_ms", 0.0), 3),
+                            int(e.get("peak_bytes", 0)),
+                            int(e.get("n", 1)),
+                            round((now - e.get("updated", now))
+                                  * 1e3, 1)))
+            return out
+
+    def entries(self) -> List[Tuple[str, Dict[str, Any]]]:
+        with self._lock:
+            return [(k, dict(e)) for k, e in self._entries.items()]
+
+    # -- recording -----------------------------------------------------
+
+    def commit(self, observations: Iterable[Dict[str, Any]]) -> bool:
+        """Merge one clean execution's observations (each carrying
+        `key`, `rows`, and optionally `in_rows`, `wall_ms`,
+        `peak_bytes`). Returns True when anything changed MATERIALLY —
+        the caller's signal to persist and to invalidate cached
+        plans."""
+        from presto_tpu.telemetry.metrics import METRICS
+        material = False
+        n_obs = 0
+        with self._lock:
+            for obs in observations:
+                key = obs["key"]
+                n_obs += 1
+                e = self._entries.get(key)
+                if e is None:
+                    self._entries[key] = {
+                        "rows": float(obs["rows"]),
+                        "in_rows": float(obs["in_rows"])
+                        if obs.get("in_rows") is not None else None,
+                        "wall_ms": float(obs.get("wall_ms", 0.0)),
+                        "peak_bytes": int(obs.get("peak_bytes", 0)),
+                        "n": 1, "updated": time.time(),
+                    }
+                    self.bytes += entry_bytes(key)
+                    material = True
+                    continue
+                material = self._merge(e, obs) or material
+                self._entries.move_to_end(key)
+            if n_obs:
+                self.records += n_obs
+                METRICS.inc("presto_tpu_history_records_total", n_obs)
+            while len(self._entries) > HISTORY_MAX_ENTRIES \
+                    or self.bytes > HISTORY_MAX_BYTES:
+                k, _ = self._entries.popitem(last=False)
+                self.bytes -= entry_bytes(k)
+                self.evictions += 1
+            if material:
+                self._generation += 1
+        if material and self.path is not None:
+            self._save()
+        return material
+
+    @staticmethod
+    def _merge(e: Dict[str, Any], obs: Dict[str, Any]) -> bool:
+        def moved(old, new) -> bool:
+            if old is None or new is None:
+                return old is not new
+            base = max(abs(old), 1.0)
+            return abs(new - old) / base > MATERIAL_ROWS_DELTA
+
+        a = HISTORY_DECAY
+        rows = float(obs["rows"])
+        in_rows = float(obs["in_rows"]) \
+            if obs.get("in_rows") is not None else None
+        material = moved(e["rows"], rows) \
+            or moved(e.get("in_rows"), in_rows)
+        e["rows"] = a * rows + (1 - a) * e["rows"]
+        if in_rows is not None:
+            e["in_rows"] = a * in_rows + (1 - a) * e["in_rows"] \
+                if e.get("in_rows") is not None else in_rows
+        e["wall_ms"] = a * float(obs.get("wall_ms", 0.0)) \
+            + (1 - a) * e.get("wall_ms", 0.0)
+        e["peak_bytes"] = max(int(obs.get("peak_bytes", 0)),
+                              int(e.get("peak_bytes", 0)))
+        e["n"] = int(e.get("n", 1)) + 1
+        e["updated"] = time.time()
+        return material
+
+    # -- persistence ---------------------------------------------------
+    #
+    # One JSON file beside the XLA compilation cache; atomic replace so
+    # a killed process can never leave a torn file. Connector cache
+    # tokens for the built-in tpch/tpcds catalogs are stable across
+    # processes, so a restarted runner re-plans from measured history
+    # with ZERO re-measurement (the restart contract of
+    # docs/ADAPTIVE.md).
+
+    def _file(self) -> str:
+        return os.path.join(self.path, "history.json")
+
+    def _save(self) -> None:
+        try:
+            os.makedirs(self.path, exist_ok=True)
+            with self._lock:
+                payload = {"version": 1,
+                           "generation": self._generation,
+                           "entries": [{"key": k, **e}
+                                       for k, e in
+                                       self._entries.items()]}
+            tmp = self._file() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self._file())
+        except OSError:
+            pass  # persistence is best-effort; memory stays correct
+
+    def _load(self) -> None:
+        try:
+            with open(self._file()) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return
+        if payload.get("version") != 1:
+            return
+        with self._lock:
+            for e in payload.get("entries", []):
+                key = e.pop("key", None)
+                if not isinstance(key, str) \
+                        or not isinstance(e.get("rows"), (int, float)):
+                    continue
+                if key not in self._entries:
+                    self.bytes += entry_bytes(key)
+                self._entries[key] = e
+            # enforce the SAME bounds commit() does: a file written
+            # under different caps (or shared by several processes)
+            # must not load the store permanently over budget — the
+            # sanitizer audits exactly these invariants
+            while len(self._entries) > HISTORY_MAX_ENTRIES \
+                    or self.bytes > HISTORY_MAX_BYTES:
+                k, _ = self._entries.popitem(last=False)
+                self.bytes -= entry_bytes(k)
+                self.evictions += 1
+            self._generation = int(payload.get("generation", 0))

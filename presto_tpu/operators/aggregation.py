@@ -110,7 +110,8 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
                          specs: Sequence["AggSpec"], mode: str,
                          domains: Optional[Tuple[int, ...]],
                          input_dicts=None, presorted: bool = False,
-                         pre=None, pre_key=None):
+                         pre=None, pre_key=None,
+                         pre_compacted: bool = False):
     """Build (or fetch) the jitted (state, batch) -> state fold step.
 
     `input_dicts` is the (name, dictionary) token of the dict-encoded
@@ -126,7 +127,13 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
     step runs as ONE jitted program per batch. `pre_key` is its
     structural fingerprint; a pre without a key is uncacheable (the
     planner only fuses fingerprintable chains). Fused kernels report
-    under the `fragment` telemetry family."""
+    under the `fragment` telemetry family.
+
+    `pre_compacted` marks a HISTORY-SIZED compacting body
+    (fused_fragment.make_compacting_chain_body): `pre` then returns
+    (batch, overflow flag) and the kernel returns (state, flag) — the
+    operator accumulates the flag and the deferred-check protocol
+    fails the run if any batch overflowed its measured bucket."""
     aggs = tuple(s.function for s in specs)
     exprs = list(key_exprs) + [s.input for s in specs
                                if s.input is not None] \
@@ -139,6 +146,7 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
             # hash/eq is exponential on lambda-produced DAGs
             from presto_tpu.expr.ir import fingerprint as _fp
             key = (mode, domains, input_dicts, presorted, pre_key,
+                   pre_compacted,
                    tuple((_fp(ke.ir), ke.dictionary)
                          for ke in key_exprs),
                    tuple((s.out_name if mode == "final" else None,
@@ -155,8 +163,12 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
             key = None
 
     def _batch_parts(batch: Batch):
+        ovf = None
         if pre is not None:
-            batch = pre(batch)
+            if pre_compacted:
+                batch, ovf = pre(batch)
+            else:
+                batch = pre(batch)
         env = {n: (c.data, c.mask) for n, c in batch.columns.items()}
         cap = batch.capacity
         key_cols = []
@@ -192,16 +204,17 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
         # filter narrows it inside this trace, and groups must not
         # form from rows the chain filtered out
         return (batch.row_valid, key_cols, agg_inputs, agg_weights,
-                tuple(merge))
+                tuple(merge), ovf)
 
     if domains is not None:
         @jax.jit
         def kernel(state, batch: Batch):
-            row_valid, key_cols, agg_inputs, agg_weights, merge = \
-                _batch_parts(batch)
-            return hashagg.direct_step(
+            row_valid, key_cols, agg_inputs, agg_weights, merge, \
+                ovf = _batch_parts(batch)
+            out = hashagg.direct_step(
                 state, row_valid, key_cols, domains, agg_inputs,
                 agg_weights, aggs, merge)
+            return (out, ovf) if pre_compacted else out
     else:
         # sort path: expression eval + per-batch compaction fused into
         # ONE dispatch; out_cap is static so one Python kernel serves
@@ -213,11 +226,12 @@ def make_agg_step_kernel(key_exprs: Sequence[CompiledExpr],
 
         @functools.partial(jax.jit, static_argnums=(0,))
         def kernel(out_cap: int, batch: Batch):
-            row_valid, key_cols, agg_inputs, agg_weights, merge = \
-                _batch_parts(batch)
-            return group_fn(
+            row_valid, key_cols, agg_inputs, agg_weights, merge, \
+                ovf = _batch_parts(batch)
+            out = group_fn(
                 row_valid, key_cols, agg_inputs, agg_weights,
                 aggs, out_cap, merge)
+            return (out, ovf) if pre_compacted else out
 
     # compile-vs-execute attribution rides the cached kernel (same
     # contract as core's filter_project instrumentation); a kernel
@@ -294,7 +308,8 @@ class AggregationOperator(Operator):
     def __init__(self, ctx: OperatorContext, key_names: Sequence[str],
                  key_exprs: Sequence[CompiledExpr],
                  specs: Sequence[AggSpec], mode: str,
-                 max_groups: int, step_kernel=None):
+                 max_groups: int, step_kernel=None,
+                 chain_compacted: bool = False):
         super().__init__(ctx)
         self.key_names = list(key_names)
         self.key_exprs = list(key_exprs)
@@ -304,6 +319,15 @@ class AggregationOperator(Operator):
         self._domains = _direct_domains(key_exprs)
         self._kernel = step_kernel if step_kernel is not None else \
             make_agg_step_kernel(key_exprs, specs, mode, self._domains)
+        #: history-sized compacting chain fused ahead of the fold: the
+        #: kernel returns (state, overflow) and any overflow fails the
+        #: run through the deferred-check protocol (sync-free — the
+        #: flag accumulates on device, ONE host read after the drive)
+        self._chain_compacted = chain_compacted
+        self._chain_ovf = None
+        if chain_compacted:
+            ctx.driver_context.deferred_checks.append(
+                self._chain_overflow_check)
         if self._domains is not None:
             slots = 1
             for d in self._domains:
@@ -349,11 +373,39 @@ class AggregationOperator(Operator):
         # device->host read per batch costs a full roundtrip (~190ms on
         # a remote TPU tunnel) and serializes the pipeline.
         if self._domains is not None:
-            self._state = self._kernel(self._state, batch)
+            if self._chain_compacted:
+                self._state, ovf = self._kernel(self._state, batch)
+                self._note_chain_ovf(ovf)
+            else:
+                self._state = self._kernel(self._state, batch)
             return
         c0 = min(self._cap, bucket_capacity(batch.capacity))
-        self._enqueue(self._kernel(c0, batch))
+        if self._chain_compacted:
+            st, ovf = self._kernel(c0, batch)
+            self._note_chain_ovf(ovf)
+        else:
+            st = self._kernel(c0, batch)
+        self._enqueue(st)
         self._drain_pending(keep=1)
+
+    def _note_chain_ovf(self, ovf) -> None:
+        """OR one batch's overflow flag into the accumulator — an
+        async device op, never a host sync."""
+        self._chain_ovf = ovf if self._chain_ovf is None \
+            else self._chain_ovf | ovf
+
+    def _chain_overflow_check(self):
+        from presto_tpu.operators.fused_fragment import (
+            FusedChainCompactOverflow,
+        )
+
+        def make_exc():
+            return FusedChainCompactOverflow(
+                f"{self.ctx.name}: a batch's surviving rows exceeded "
+                "the history-sized compaction bucket (data shifted "
+                "since the measurement) — retrying without "
+                "history-driven fusion")
+        return self._chain_ovf, make_exc
 
     # -- sort-path partial management ---------------------------------
     #
@@ -398,13 +450,15 @@ class AggregationOperator(Operator):
         while len(self._pending) > keep:
             if keep and len(self._pending) <= keep + 2:
                 # a merge output's count may have been dispatched only
-                # this round — give it more overlap time unless the
-                # queue is backing up (bounded at keep+2)
-                try:
-                    if not self._pending[0][1].is_ready():
-                        break
-                except AttributeError:
-                    pass
+                # this round — give it a FIXED backlog of overlap time
+                # (bounded at keep+2). This used to probe
+                # cnt.is_ready(), but which states merge together must
+                # not depend on transfer timing: merge grouping
+                # changes float-sum rounding, so any unrelated device
+                # work (telemetry row counters, a concurrent query)
+                # would perturb low-order result bits — the history
+                # on/off byte-identity oracle caught exactly that.
+                break
             st, cnt = self._pending.pop(0)
             live = int(np.asarray(cnt))
             cap = self._state_cap(st)
@@ -796,15 +850,20 @@ class AggregationOperatorFactory(OperatorFactory):
             key_exprs, specs, mode, _direct_domains(key_exprs),
             input_dicts)
 
-    def fuse_pre(self, pre, pre_key, name: str) -> None:
+    def fuse_pre(self, pre, pre_key, name: str,
+                 compacted: bool = False) -> None:
         """Whole-fragment fusion: rebuild the step kernel with the
         upstream filter/project chain traced ahead of the key eval
-        (planner/fusion.py; only legal before the first create)."""
+        (planner/fusion.py; only legal before the first create).
+        `compacted` marks a history-sized compacting body — `pre`
+        returns (batch, overflow) and the operator runs the deferred
+        overflow check (docs/ADAPTIVE.md)."""
         assert not self._created, "fuse_pre() after create()"
         self._step_kernel = make_agg_step_kernel(
             self.key_exprs, self.specs, self.mode,
             _direct_domains(self.key_exprs), self._input_dicts,
-            pre=pre, pre_key=pre_key)
+            pre=pre, pre_key=pre_key, pre_compacted=compacted)
+        self._chain_compacted = compacted
         self.name = name
 
     def create(self, driver_context: DriverContext) -> Operator:
@@ -812,4 +871,5 @@ class AggregationOperatorFactory(OperatorFactory):
         return AggregationOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.key_names, self.key_exprs, self.specs, self.mode,
-            self.max_groups, self._step_kernel)
+            self.max_groups, self._step_kernel,
+            chain_compacted=getattr(self, "_chain_compacted", False))

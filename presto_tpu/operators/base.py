@@ -56,6 +56,11 @@ class OperatorStats:
     #: fragment replays/recordings) — rendered by EXPLAIN ANALYZE
     cache_hits: int = 0
     cache_misses: int = 0
+    #: row counters armed for THIS operator: always under profile,
+    #: and selectively for history-recorded operators on plain runs
+    #: (DriverContext.count_rows_ops) — the accumulation stays a
+    #: device-side async add either way, one host sync at drain
+    count_rows: bool = False
     input_rows_dev: Any = None
     output_rows_dev: Any = None
 
@@ -88,6 +93,10 @@ class OperatorStats:
             "spilled_bytes": self.spilled_bytes,
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
+            # distinguishes a MEASURED zero from never-counted: the
+            # history recorder must not record 0 rows for an operator
+            # whose counters were simply disarmed
+            "rows_counted": self.count_rows,
         }
 
 
@@ -105,6 +114,10 @@ class DriverContext:
     #: per-batch hot paths free of device->host reads (the join
     #: capacity / group limit pattern).
     deferred_checks: List[Any] = dataclasses.field(default_factory=list)
+    #: operator ids whose row counters the history recorder wants even
+    #: on unprofiled runs (presto_tpu/history.interesting_ops); None =
+    #: profile-only counting, the pre-history behavior
+    count_rows_ops: Any = None
 
 
 def run_deferred_checks(dctx: "DriverContext") -> None:
@@ -135,6 +148,9 @@ class OperatorContext:
         self.name = name
         self.driver_context = driver_context
         self.stats = OperatorStats()
+        self.stats.count_rows = driver_context.profile or (
+            driver_context.count_rows_ops is not None
+            and operator_id in driver_context.count_rows_ops)
         # pool tag must be unique per operator INSTANCE: operator ids
         # restart per planner, and mesh tasks/lifespan generations all
         # share one query pool
@@ -213,25 +229,27 @@ class Operator(abc.ABC):
     def _count_in(self, batch: Batch) -> None:
         s = self.ctx.stats
         s.input_batches += 1
-        if self.ctx.driver_context.profile:
+        if s.count_rows:
             import jax.numpy as jnp
-            from presto_tpu.execution.memory import batch_bytes
             n = jnp.sum(batch.row_valid)
             s.input_rows_dev = n if s.input_rows_dev is None \
                 else s.input_rows_dev + n
-            s.input_bytes += batch_bytes(batch)
+            if self.ctx.driver_context.profile:
+                from presto_tpu.execution.memory import batch_bytes
+                s.input_bytes += batch_bytes(batch)
 
     def _count_out(self, batch: Optional[Batch]) -> Optional[Batch]:
         if batch is not None:
             s = self.ctx.stats
             s.output_batches += 1
-            if self.ctx.driver_context.profile:
+            if s.count_rows:
                 import jax.numpy as jnp
-                from presto_tpu.execution.memory import batch_bytes
                 n = jnp.sum(batch.row_valid)
                 s.output_rows_dev = n if s.output_rows_dev is None \
                     else s.output_rows_dev + n
-                s.output_bytes += batch_bytes(batch)
+                if self.ctx.driver_context.profile:
+                    from presto_tpu.execution.memory import batch_bytes
+                    s.output_bytes += batch_bytes(batch)
         return batch
 
 

@@ -251,7 +251,8 @@ class FilterProjectOperatorFactory(OperatorFactory):
                  filter_expr: Optional[CompiledExpr],
                  projections: Sequence[Tuple[str, CompiledExpr]],
                  input_dicts: Optional[Tuple[Tuple[str, tuple], ...]] = None,
-                 selectivity: Optional[float] = None):
+                 selectivity: Optional[float] = None,
+                 sel_provenance: str = "static"):
         super().__init__(operator_id, "filter_project")
         self._kernel = make_filter_project_kernel(filter_expr, projections,
                                                   input_dicts)
@@ -268,6 +269,11 @@ class FilterProjectOperatorFactory(OperatorFactory):
         self.projections = tuple(projections)
         self.input_dicts = input_dicts
         self.selectivity = selectivity
+        #: "history" when `selectivity` is a MEASURED prior-execution
+        #: fraction, "static" for derived heuristics — the fusion gate
+        #: treats measured selectivity as licence for history-driven
+        #: full fusion with in-trace compaction (planner/fusion.py)
+        self.sel_provenance = sel_provenance
 
     def create(self, driver_context: DriverContext) -> Operator:
         return FilterProjectOperator(

@@ -116,6 +116,74 @@ def chain_selective(stages: Sequence[ChainStage]) -> bool:
     return any(st.filter_expr is not None for st in stages)
 
 
+class FusedChainCompactOverflow(Exception):
+    """A history-sized in-trace compaction saw more surviving rows
+    than its measured bucket (the data shifted since the measurement):
+    the compacted batch DROPPED rows, so the whole execution's output
+    is untrusted. Raised by the deferred-check protocol after the
+    drive completes; the runner retries the query once with
+    history-driven fusion off (the gated PARTIAL path, which is
+    always correct)."""
+
+
+#: headroom multiplier over the measured selectivity when sizing the
+#: in-trace compaction bucket: the smallest power-of-four fraction
+#: >= measured * HEADROOM, so a batch up to HEADROOM x more selective
+#: than history still fits (worse skew trips the overflow retry)
+COMPACT_HEADROOM = 2.0
+
+
+def compact_ratio(sel: float) -> Optional[float]:
+    """Power-of-four fraction of input capacity a measured-selective
+    chain compacts to inside the fused trace, or None when the
+    measurement leaves no whole bucket of certain headroom (compacting
+    would buy nothing — the plain gate decides then)."""
+    if sel is None or sel <= 0:
+        return None
+    target = min(1.0, sel * COMPACT_HEADROOM)
+    r = 1.0
+    while r / 4 >= target:
+        r /= 4
+    return r if r < 1.0 else None
+
+
+def make_compacting_chain_body(stages: Sequence[ChainStage],
+                               ratio: float):
+    """The history-driven full-fusion body: chain -> in-trace
+    compaction to `ratio` x input capacity -> (batch, overflow flag).
+
+    This is what the measured selectivity BUYS: the PARTIAL path pays
+    a host count round-trip + a separate compaction dispatch per batch
+    because it cannot know the surviving-row bucket until runtime;
+    with a measured fraction the bucket is known at plan time, so the
+    compact folds into the SAME program as the chain and the terminal
+    fold — and the fold works over the compacted width, which is why
+    the selectivity gate exists at all. Overflow (live > bucket) drops
+    rows INSIDE the trace, so the flag rides out and the deferred
+    check fails the run before results are trusted."""
+    chain = make_chain_body(stages)
+
+    def body(batch: Batch):
+        out = chain(batch)
+        cap = out.capacity  # static at trace time
+        from presto_tpu.batch import COMPACT_MIN, operator_capacity
+        comp_cap = operator_capacity(int(cap * ratio),
+                                     floor=COMPACT_MIN)
+        live = jnp.sum(out.row_valid)
+        if comp_cap >= cap:
+            return out, jnp.asarray(False)
+        # bounded nonzero + gather, the _compact_shrink_jit shape —
+        # inlined here so it traces into the surrounding program
+        idx, = jnp.nonzero(out.row_valid, size=comp_cap,
+                           fill_value=cap - 1)
+        rv = jnp.arange(comp_cap) < live
+        cols = {n: Column(c.data[idx], c.mask[idx] & rv, c.type,
+                          c.dictionary)
+                for n, c in out.columns.items()}
+        return Batch(cols, rv), live > comp_cap
+    return body
+
+
 def make_chain_body(stages: Sequence[ChainStage]):
     """The traceable chain: batch -> batch, applying each stage's
     filter (narrowing row_valid) and projection forest in sequence —

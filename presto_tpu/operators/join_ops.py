@@ -802,6 +802,8 @@ class LookupJoinOperatorFactory(OperatorFactory):
         #: fold terminals inherit the sparsity its in-trace filter
         #: leaves behind
         self.fused_selectivity = None
+        #: provenance of fused_selectivity ("static" | "history")
+        self.fused_sel_provenance = "static"
         self._pre = None        # (body, chain_key) upstream chain
         self._kernels = None
 
@@ -815,7 +817,7 @@ class LookupJoinOperatorFactory(OperatorFactory):
         return self._pre is not None
 
     def fuse(self, filter_expr, projections, input_dicts=None,
-             selectivity=None) -> None:
+             selectivity=None, sel_provenance: str = "static") -> None:
         """Planner peephole: absorb the FilterProject that would
         otherwise follow this join, so the expression forest evaluates
         inside the probe dispatch (expanded rows materialize ONCE).
@@ -830,6 +832,7 @@ class LookupJoinOperatorFactory(OperatorFactory):
         self._fused_dicts = input_dicts
         if filter_expr is not None:
             self.fused_selectivity = selectivity
+            self.fused_sel_provenance = sel_provenance
 
     def fuse_pre(self, pre, pre_key, name: str) -> None:
         """Whole-fragment fusion (planner/fusion.py): absorb the

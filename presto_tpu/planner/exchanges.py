@@ -76,7 +76,13 @@ class _Exchanger:
         self._memo: Dict[int, Tuple[N.PlanNode, Props]] = {}
         self._shared: set = set()
         from presto_tpu.planner.stats import StatsEstimator
-        self._estimator = StatsEstimator(catalogs)
+        # history feedback upgrades the broadcast-vs-repartition
+        # choice: a build side MEASURED under the threshold broadcasts
+        # even when derived stats said UNKNOWN (presto_tpu/history)
+        from presto_tpu import history as _history
+        self._estimator = StatsEstimator(
+            catalogs,
+            history=_history.view_for(catalogs, session.properties))
 
     def run(self, root: N.OutputNode) -> N.OutputNode:
         self._shared = _shared_nodes(root)

@@ -52,6 +52,10 @@ R_FULL_JOIN = "full_join_probe"
 R_SPILLABLE = "spillable_build"
 R_ALREADY_PRE = "probe_already_prefused"
 R_SELECTIVE = "selective_chain"
+#: not a fallback — the HISTORY-DRIVEN upgrade marker: a measured
+#: (history-provenance) selectivity let a gated chain fold FULLY into
+#: its terminal with an in-trace compaction sized by the measurement
+R_HISTORY_COMPACT = "history_compact"
 
 #: fold-terminal gate: when the chain's estimated surviving-row
 #: fraction drops below a quarter, live rows fall at least one
@@ -102,24 +106,44 @@ class _Candidate:
     #: lanes ride into the chain uncompacted, so the gate must treat
     #: the chain as selective even when the chain itself only projects
     pre_selective: bool = False
+    #: provenance of every selectivity that multiplied into `sel`
+    #: ("static" derived estimate / "history" measured): the chain is
+    #: MEASURED only when every contribution is — one guessed factor
+    #: poisons the product for compaction-sizing purposes
+    sel_provs: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def measured(self) -> bool:
+        return bool(self.sel_provs) \
+            and all(p == "history" for p in self.sel_provs)
 
 
 def fuse_pipelines(pipelines: List[List], node_ops=None,
-                   spill_enabled: bool = False) -> Dict:
+                   spill_enabled: bool = False,
+                   history_fusion: bool = False) -> Dict:
     """Mutates `pipelines` (and the planner's node->operator-id map,
     for EXPLAIN ANALYZE) in place; returns the fusion report dict.
 
     `spill_enabled` mirrors the planner's build-side spill decision:
     a spill-eligible join build may hand the probe a host-partitioned
     table at runtime, whose partitioner reads key columns host-side —
-    upstream chains must not disappear into the probe trace then."""
+    upstream chains must not disappear into the probe trace then.
+
+    `history_fusion` allows a chain whose selectivity is MEASURED
+    (history provenance on every contributing estimate) to fold FULLY
+    into an aggregation terminal despite tripping the selectivity
+    gate: the fused program compacts to the measured power-of-four
+    bucket in-trace, so the fold works over compacted width AND the
+    per-batch host count round disappears — the win the gate was
+    protecting, made safe by knowledge (docs/ADAPTIVE.md)."""
     from presto_tpu.telemetry.metrics import METRICS
     entries: List[Dict] = []
     id_remap: Dict[int, int] = {}
 
     def record(cand: _Candidate, terminal: Optional[str],
                fused_name: Optional[str],
-               reason: Optional[str]) -> None:
+               reason: Optional[str], extra: Optional[Dict] = None
+               ) -> None:
         entries.append({
             "pipeline": cand.pipeline,
             "source": pipelines[cand.pipeline][0].name
@@ -128,6 +152,12 @@ def fuse_pipelines(pipelines: List[List], node_ops=None,
             "terminal": terminal,
             "fused": fused_name,
             "reason": reason,
+            # the gate's inputs, for the history tooling: estimated
+            # surviving fraction + whether it was measured
+            "selectivity": round(cand.sel, 6),
+            "sel_provenance": "history" if cand.measured
+            else "static",
+            **(extra or {}),
         })
         if fused_name is not None:
             # a fused entry MAY still carry a reason: partial fusion,
@@ -154,6 +184,8 @@ def fuse_pipelines(pipelines: List[List], node_ops=None,
                               [f.operator_id])
             if getattr(f, "selectivity", None) is not None:
                 cand.sel *= f.selectivity
+                cand.sel_provs.append(
+                    getattr(f, "sel_provenance", "static"))
             # a prefused lookup-join probe feeding this chain: its
             # in-trace filter's survivors estimate multiplies in (the
             # probe hands the chain uncompacted dead lanes — folding
@@ -164,6 +196,9 @@ def fuse_pipelines(pipelines: List[List], node_ops=None,
                 if pre_sel is not None:
                     cand.sel *= pre_sel
                     cand.pre_selective = True
+                    cand.sel_provs.append(
+                        getattr(prev, "fused_sel_provenance",
+                                "static"))
             j = i + 1
             while j < len(pipe):
                 nxt = pipe[j]
@@ -177,10 +212,12 @@ def fuse_pipelines(pipelines: List[List], node_ops=None,
                 cand.ids.append(nxt.operator_id)
                 if getattr(nxt, "selectivity", None) is not None:
                     cand.sel *= nxt.selectivity
+                    cand.sel_provs.append(
+                        getattr(nxt, "sel_provenance", "static"))
                 j += 1
             terminal = pipe[j] if j < len(pipe) else None
             i = _apply(pipe, cand, terminal, j, record,
-                       id_remap, spill_enabled)
+                       id_remap, spill_enabled, history_fusion)
 
     if node_ops is not None and id_remap:
         for nid, ids in node_ops.items():
@@ -232,7 +269,7 @@ _FOLD_TERMINALS = (AggregationOperatorFactory,
 
 def _apply(pipe: List, cand: _Candidate, terminal, end: int,
            record, id_remap: Dict[int, int],
-           spill_enabled: bool) -> int:
+           spill_enabled: bool, history_fusion: bool = False) -> int:
     """Fuse one candidate run (or record why not). Returns the
     pipeline index to resume scanning at."""
     tname = getattr(terminal, "name", None)
@@ -246,10 +283,36 @@ def _apply(pipe: List, cand: _Candidate, terminal, end: int,
     # the fold's working width at least one power-of-four bucket,
     # which beats saving the compact round. The chain itself still
     # collapses (compaction runs once, at its tail). ----------------
+    #
+    # UNLESS the fraction is MEASURED (history provenance on every
+    # contribution): then the surviving-row bucket is known at plan
+    # time, and the chain folds FULLY into an aggregation terminal
+    # with the compaction traced INSIDE the program, sized to the
+    # measured power-of-four bucket — the fold still works over
+    # compacted width (the gate's whole point) and the per-batch host
+    # count round disappears. A batch overflowing its bucket trips
+    # the deferred check and the query retries with this off.
     if isinstance(terminal, _FOLD_TERMINALS) \
             and (ff.chain_selective(cand.stages)
                  or cand.pre_selective) \
             and cand.sel < SELECTIVE_CHAIN_THRESHOLD:
+        if history_fusion and cand.measured \
+                and isinstance(terminal, AggregationOperatorFactory):
+            ratio = ff.compact_ratio(cand.sel)
+            if ratio is not None:
+                name = _constituent_label(
+                    cand.names + [terminal.name])
+                terminal.fuse_pre(
+                    ff.make_compacting_chain_body(cand.stages,
+                                                  ratio),
+                    (chain_key, "compact", ratio), name,
+                    compacted=True)
+                for rid in cand.ids:
+                    id_remap[rid] = terminal.operator_id
+                del pipe[cand.start:end]
+                record(cand, tname, name, None,
+                       extra={R_HISTORY_COMPACT: ratio})
+                return cand.start + 1
         if len(cand.names) >= 2:
             name = _collapse_chain(pipe, cand, end, chain_key,
                                    id_remap)

@@ -107,6 +107,11 @@ def _schema_of(node: N.PlanNode) -> Dict[str, ColumnSchema]:
 
 _form_leaves = N.form_leaves
 
+#: measured build-side rows past which a dynamic filter is not worth
+#: planning: the distinct set (DF_SET_MAX) has long overflowed to
+#: bounds-only, and wide surrogate-key bounds prune ~nothing
+DF_SKIP_BUILD_ROWS = 1 << 20
+
 
 def _trace_scan_column(node: N.PlanNode, symbol: str, shared=frozenset()):
     """Follow `symbol` down through filters and identity projections to
@@ -157,6 +162,9 @@ class LocalExecutionPlanner:
         #: node was being dispatched (EXPLAIN ANALYZE joins operator
         #: stats back onto the plan tree through this)
         self.node_ops: Dict[int, List[int]] = {}
+        #: node -> operator ids BEFORE the fusion pass remapped them
+        #: (the history recorder's join key; set by _fuse)
+        self.node_ops_prefusion: Dict[int, List[int]] = {}
         self._node_stack: List[int] = []
         #: whole-fragment fusion report (planner/fusion.py), populated
         #: by _fuse(); None when the pass is disabled
@@ -237,6 +245,12 @@ class LocalExecutionPlanner:
         LAST — after record/replay, spools, and sinks are placed — so
         every barrier is visible and falling back is simply keeping
         the unfused chain."""
+        # the PRE-FUSION node -> operator map is what the history
+        # recorder joins measured rows back onto: fusion rewrites
+        # node_ops in place for EXPLAIN ANALYZE, which would alias
+        # absorbed nodes onto their terminal's operator
+        self.node_ops_prefusion = {k: list(v)
+                                   for k, v in self.node_ops.items()}
         if not bool(get_property(self.session.properties,
                                  "fragment_fusion_enabled")):
             return
@@ -256,9 +270,23 @@ class LocalExecutionPlanner:
         check = _validation.validation_enabled(self.session)
         snapshot = _validation.CHECKER.snapshot_pipelines(
             self._pipelines) if check else None
+        # measured (history-provenance) selectivity may upgrade gated
+        # chains to full fusion with in-trace compaction — only when
+        # both history feedback and the fusion upgrade are enabled
+        # (the overflow retry re-plans with the latter off)
+        hist_fusion = bool(get_property(
+            self.session.properties, "history_driven_fusion")) \
+            and bool(get_property(self.session.properties,
+                                  "history_based_optimization")) \
+            and self.task.count == 1 and not self.task.exchanges \
+            and self.task.device is None
+        # (single local task only: a mesh/worker task's compact-
+        # overflow would surface as a task failure the distributed
+        # retry tier cannot fix by re-running the same plan)
         self.fusion_report = fuse_pipelines(
             self._pipelines, self.node_ops,
-            spill_enabled=spill_possible)
+            spill_enabled=spill_possible,
+            history_fusion=hist_fusion)
         if check:
             # barrier legality: fusion may only have absorbed
             # adjacent FilterProject stages; every record/replay/
@@ -517,13 +545,16 @@ class LocalExecutionPlanner:
 
     def _append_filter_project(self, pipe: List, filter_expr,
                                projections, input_dicts,
-                               selectivity=None) -> None:
+                               selectivity=None,
+                               sel_provenance: str = "static") -> None:
         """Append a FilterProject — or FUSE it into a lookup join it
         directly follows, so the expression forest evaluates inside
         the probe dispatch and expanded join rows materialize once
         (the probe->project fusion of the radix-join redesign).
         `selectivity` is the estimated surviving-row fraction the
-        fusion pass gates fold-terminal fusion on (None = unknown)."""
+        fusion pass gates fold-terminal fusion on (None = unknown);
+        `sel_provenance` says whether it was MEASURED on a prior
+        execution ("history") or derived ("static")."""
         tail = pipe[-1] if pipe else None
         if isinstance(tail, LookupJoinOperatorFactory) \
                 and not tail.fused:
@@ -533,30 +564,49 @@ class LocalExecutionPlanner:
             # a fold terminal must inherit this fraction or the
             # fusion pass's selective-chain gate goes blind here
             tail.fuse(filter_expr, projections, input_dicts,
-                      selectivity=selectivity)
+                      selectivity=selectivity,
+                      sel_provenance=sel_provenance)
             return
         pipe.append(FilterProjectOperatorFactory(
             self._next_id(), filter_expr, projections, input_dicts,
-            selectivity=selectivity))
+            selectivity=selectivity, sel_provenance=sel_provenance))
+
+    def _estimator(self):
+        """The lazily-built stats estimator, history-armed when the
+        session enables feedback (planner/stats.py; one estimator —
+        and one fingerprint memo — per planned fragment)."""
+        if self._stats is None:
+            from presto_tpu import history as _history
+            from presto_tpu.planner.stats import StatsEstimator
+            self._stats = StatsEstimator(
+                self.catalogs,
+                history=_history.view_for(self.catalogs,
+                                          self.session.properties))
+        return self._stats
 
     def _est_selectivity(self, node: N.FilterNode):
-        """Estimated fraction of source rows surviving `node`, from
-        the optimizer's stats estimator (planner/stats.py), or None
-        when it can't say. Stamped on the FilterProject factory so the
-        fusion pass can keep the deferred compaction ahead of a fold
-        terminal when the chain is highly selective — below a quarter,
-        live rows drop a power-of-four kernel bucket and compacting
-        beats folding over full-width dead lanes (planner/fusion.py)."""
+        """(estimated fraction of source rows surviving `node`,
+        provenance), or (None, "static") when nothing can be said.
+        A MEASURED fraction (the node's own prior in->out row ratio
+        from the history store) wins over the derived estimate and is
+        tagged "history" — the fusion pass treats it as licence to
+        fold the chain into its terminal with an in-trace compaction
+        sized by the measurement (planner/fusion.py). The derived
+        fallback gates fold-terminal fusion exactly as before: below
+        a quarter, live rows drop a power-of-four kernel bucket and
+        compacting beats folding over full-width dead lanes."""
         try:
-            if self._stats is None:
-                from presto_tpu.planner.stats import StatsEstimator
-                self._stats = StatsEstimator(self.catalogs)
-            inner = self._stats.estimate(node.source).rows
+            est = self._estimator()
+            if est.history is not None:
+                sel = est.history.selectivity(node)
+                if sel is not None:
+                    return sel, "history"
+            inner = est.estimate(node.source).rows
             if inner <= 0:
-                return None
-            return min(1.0, self._stats.estimate(node).rows / inner)
+                return None, "static"
+            return min(1.0, est.estimate(node).rows / inner), "static"
         except Exception:  # noqa: BLE001 — stats are advisory
-            return None
+            return None, "static"
 
     def _est_predicate_selectivity(self, source_node, predicate):
         """Estimated surviving fraction of a bare predicate over
@@ -568,10 +618,8 @@ class LocalExecutionPlanner:
         node's own filter, so estimating the join and applying the
         predicate's selectivity on top does not double-count."""
         try:
-            if self._stats is None:
-                from presto_tpu.planner.stats import StatsEstimator
-                self._stats = StatsEstimator(self.catalogs)
-            inner = self._stats.estimate(source_node)
+            est = self._estimator()
+            inner = est.estimate(source_node)
             if inner.rows <= 0:
                 return None
             from presto_tpu.planner.stats import (
@@ -590,10 +638,11 @@ class LocalExecutionPlanner:
             (f.symbol, compile_expression(InputRef(f.symbol, f.type),
                                           schema))
             for f in node.output]
+        sel, prov = self._est_selectivity(node)
         self._append_filter_project(pipe, pred, projections,
                                     _schema_dicts(schema),
-                                    selectivity=self._est_selectivity(
-                                        node))
+                                    selectivity=sel,
+                                    sel_provenance=prov)
 
     def _visit_ProjectNode(self, node: N.ProjectNode, pipe: List):
         self._visit(node.source, pipe)
@@ -740,10 +789,8 @@ class LocalExecutionPlanner:
         (reference analog: the row-count estimates behind
         DetermineJoinDistributionType)."""
         try:
-            from presto_tpu.planner.stats import (
-                StatsEstimator, UNKNOWN_ROWS,
-            )
-            est = StatsEstimator(self.catalogs)
+            from presto_tpu.planner.stats import UNKNOWN_ROWS
+            est = self._estimator()
             out_rows = est.estimate(node).rows
             probe_rows = est.estimate(probe).rows
         except Exception:
@@ -759,12 +806,12 @@ class LocalExecutionPlanner:
         return factor
 
     def _estimated_groups(self, node: N.AggregationNode):
-        """Estimated distinct groups, or None when unknowable."""
+        """Estimated distinct groups, or None when unknowable. With
+        history armed, a measured prior group count sizes the table
+        exactly instead of by NDV products."""
         try:
-            from presto_tpu.planner.stats import (
-                StatsEstimator, UNKNOWN_ROWS,
-            )
-            est = StatsEstimator(self.catalogs).estimate(node).rows
+            from presto_tpu.planner.stats import UNKNOWN_ROWS
+            est = self._estimator().estimate(node).rows
         except Exception:
             return None
         return est if est < UNKNOWN_ROWS * 0.99 else None
@@ -877,6 +924,19 @@ class LocalExecutionPlanner:
         if not bool(get_property(self.session.properties,
                                  "dynamic_filtering")):
             return None
+        # history-driven aggressiveness: a build side MEASURED far past
+        # the distinct-set bound degrades to bounds-only filters whose
+        # collection cost buys nearly nothing (surrogate keys span the
+        # whole range) — skip planning the filter at all. Results are
+        # unaffected either way; only work moves.
+        try:
+            est = self._estimator()
+            if est.history is not None:
+                e = est.history.lookup(build)
+                if e is not None and e["rows"] > DF_SKIP_BUILD_ROWS:
+                    return None
+        except Exception:  # noqa: BLE001 — stats are advisory
+            pass
         build_fields = {f.symbol: f for f in build.output}
         publish = []
         for l, r in criteria:

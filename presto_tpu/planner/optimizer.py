@@ -17,15 +17,26 @@ from presto_tpu.planner import nodes as N
 from presto_tpu.types import BOOLEAN
 
 
-def optimize(root: N.PlanNode, catalogs=None) -> N.PlanNode:
+def optimize(root: N.PlanNode, catalogs=None,
+             session=None) -> N.PlanNode:
     """`catalogs` enables the cost-based join-order choice (reference:
     ReorderJoins + CostCalculatorUsingExchanges); without it ordering
     falls back to the connectivity heuristic. Estimates are analytic,
-    so distributed nodes re-deriving the plan stay deterministic."""
+    so distributed nodes re-deriving the plan stay deterministic.
+
+    `session` additionally arms history-based feedback: measured
+    cardinalities from prior executions of structurally identical
+    subtrees replace the analytics (presto_tpu/history; still
+    deterministic across nodes — every node of one cluster shares one
+    store generation through the plan-cache key)."""
     estimator = None
     if catalogs is not None:
         from presto_tpu.planner.stats import StatsEstimator
-        estimator = StatsEstimator(catalogs)
+        history = None
+        if session is not None:
+            from presto_tpu import history as _history
+            history = _history.view_for(catalogs, session.properties)
+        estimator = StatsEstimator(catalogs, history=history)
     # Plans are DAGs (decorrelation shares subtrees), and several rules
     # below rewrite IN PLACE. A node with more than one parent must not
     # be mutated on behalf of one parent — the other consumer would

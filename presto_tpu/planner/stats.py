@@ -47,8 +47,19 @@ class PlanStats:
 
 
 class StatsEstimator:
-    def __init__(self, catalogs):
+    """`history` is an optional presto_tpu.history.HistoryView: when a
+    node's structural fingerprint has a measured prior execution, the
+    MEASURED cardinality replaces the derived one (reference:
+    history-based optimization), and `provenance[id(node)]` records
+    "history" so EXPLAIN and the fusion gate can tell truth from
+    heuristic. Column-level stats stay derived — history measures row
+    counts, not per-column NDV."""
+
+    def __init__(self, catalogs, history=None):
         self.catalogs = catalogs
+        self.history = history
+        #: id(node) -> "history" for every overridden estimate
+        self.provenance: Dict[int, str] = {}
         # memo holds (node, stats): keeping the node referenced pins
         # its id() for the estimator's lifetime, so a GC'd throwaway
         # node (join-order probes) can never alias a later allocation
@@ -60,8 +71,22 @@ class StatsEstimator:
             return hit[1]
         m = getattr(self, f"_est_{type(node).__name__}", None)
         st = m(node) if m is not None else self._default(node)
+        if self.history is not None:
+            try:
+                e = self.history.lookup(node)
+            except Exception:  # noqa: BLE001 — stats are advisory
+                e = None
+            if e is not None:
+                st = PlanStats(max(1.0, float(e["rows"])), st.columns)
+                self.provenance[id(node)] = "history"
         self._memo[id(node)] = (node, st)
         return st
+
+    def provenance_of(self, node: N.PlanNode) -> str:
+        """"history" when this node's estimate came from a measured
+        prior execution, else "static". Only meaningful after
+        estimate(node)."""
+        return self.provenance.get(id(node), "static")
 
     def rows(self, node: N.PlanNode) -> float:
         return self.estimate(node).rows

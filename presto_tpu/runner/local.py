@@ -330,7 +330,8 @@ class LocalRunner:
                  properties: Optional[Dict[str, Any]] = None,
                  user: str = "", access_control=None,
                  compilation_cache_dir: Optional[str] = None,
-                 resource_groups=None):
+                 resource_groups=None,
+                 history_dir: Optional[str] = None):
         # persistent XLA compilation cache: explicit arg wins, else
         # the PRESTO_TPU_COMPILATION_CACHE_DIR env surface (both
         # process-global — jax holds one cache dir)
@@ -340,6 +341,16 @@ class LocalRunner:
                 compilation_cache_dir)
         else:
             compile_cache.configure_from_env()
+        # history-based optimization store (presto_tpu/history): same
+        # surface shape as the compile cache — explicit arg wins, else
+        # PRESTO_TPU_HISTORY_DIR; both process-global. A restarted
+        # process loads persisted measurements and plans from them
+        # with zero re-measurement (docs/ADAPTIVE.md)
+        from presto_tpu import history as _history
+        if history_dir is not None:
+            _history.configure(history_dir)
+        else:
+            _history.configure_from_env()
         from presto_tpu.connectors.memory import (
             BlackholeConnector, MemoryConnector,
         )
@@ -866,8 +877,20 @@ class LocalRunner:
                                 # skips the plan cache entirely
                                 return None
                 ac = ("ac-token", tok)
+        # the history-store GENERATION is part of the plan identity: a
+        # cached plan bakes in join order / exchange choices derived
+        # from the store's state, and a MATERIAL history change must
+        # re-plan — while serving repetitions whose re-measurements
+        # merely confirm the store keep hitting the cached plan
+        # (store.py bumps the generation only on material change)
+        hist_gen = None
+        from presto_tpu import history as _history
+        if _history.enabled(s.properties):
+            store = _history.get_history_store(create=False)
+            if store is not None:
+                hist_gen = store.generation()
         return (s.catalog, s.schema, getattr(s, "user", ""), ac,
-                rules_fp, props)
+                rules_fp, props, hist_gen)
 
     def _plan_query(self, stmt: Optional[T.Node], sql: str,
                     cache_text: Optional[str] = None) -> N.OutputNode:
@@ -902,7 +925,8 @@ class LocalRunner:
         from presto_tpu.planner.validation import validate
         validate(plan, "analysis", session=self.session)
         from presto_tpu.planner.optimizer import optimize
-        plan = optimize(plan, self.catalogs)
+        plan = optimize(plan, self.catalogs,
+                        session=self.session)
         validate(plan, "optimizer", session=self.session,
                  catalogs=self.catalogs)
         if key is not None:
@@ -1153,6 +1177,9 @@ class LocalRunner:
         retry cannot duplicate rows."""
         from presto_tpu.execution.memory import MemoryPool
         from presto_tpu.operators.aggregation import GroupLimitExceeded
+        from presto_tpu.operators.fused_fragment import (
+            FusedChainCompactOverflow,
+        )
         from presto_tpu.operators.join_ops import JoinCapacityExceeded
         import time as _time
         session = self.session
@@ -1160,6 +1187,21 @@ class LocalRunner:
             planner = LocalExecutionPlanner(self.catalogs, session)
             lplan = planner.plan(plan)
             self._session_tl.fusion_report = planner.fusion_report
+            # history-based optimization: arm row counters for the
+            # operators whose measured cardinality the store wants
+            # (cheap async device adds; None = profile-only counting).
+            # Fault-armed sessions never record — an injected fault
+            # can truncate an operator's rows mid-stream.
+            from presto_tpu import history as _history
+            hist_ops = None
+            from presto_tpu.execution import faults as _faults
+            if _history.enabled(session.properties) \
+                    and not _faults.ARMED:
+                hist_ops = _history.interesting_ops(
+                    plan, planner.node_ops_prefusion,
+                    id_remap=(planner.fusion_report or {}).get(
+                        "id_remap"),
+                    catalogs=self.catalogs)
             t0 = _time.perf_counter()
             from presto_tpu.session_properties import get_property
             budget = get_property(session.properties,
@@ -1192,7 +1234,8 @@ class LocalRunner:
                                                    cancel=cancel,
                                                    deadline=deadline,
                                                    executor=executor,
-                                                   quantum_ms=quantum_ms)
+                                                   quantum_ms=quantum_ms,
+                                                   count_rows_ops=hist_ops)
                 finally:
                     if cm is not None:
                         cm.finish_query(cm_qid)
@@ -1228,6 +1271,20 @@ class LocalRunner:
                 if on_retry is not None:
                     on_retry()
                 continue
+            except FusedChainCompactOverflow:
+                # the history-sized in-trace compaction saw more
+                # surviving rows than its measured bucket (the data
+                # shifted since the measurement): re-run once with the
+                # fusion upgrade off — the gated PARTIAL path is
+                # always correct, and the re-measurement this clean
+                # retry records re-sizes the bucket for next time
+                session = dataclasses.replace(
+                    session, properties={
+                        **session.properties,
+                        "history_driven_fusion": False})
+                if on_retry is not None:
+                    on_retry()
+                continue
             # snapshot per-operator stats ALWAYS (plain dicts — the
             # driver refs drop here, so no device batches get pinned):
             # lightweight counters (batches, busy, compile/execute,
@@ -1237,6 +1294,12 @@ class LocalRunner:
             )
             snap = snapshot_drivers(drivers, pool)
             self._session_tl.op_stats = snap
+            # the history recording tap: ONLY here — past every
+            # deferred overflow check, after drivers closed cleanly.
+            # Failed/cancelled/shed runs raised out above; fault-armed
+            # runs never armed hist_ops
+            if hist_ops is not None and not _faults.ARMED:
+                self._record_history(plan, planner, snap)
             if profile:
                 self._last_profile = render_operator_stats(
                     snap, _time.perf_counter() - t0, pool)
@@ -1249,6 +1312,23 @@ class LocalRunner:
             return MaterializedResult(lplan.result_names, lplan.result_sink,
                                       lplan.result_fields)
 
+    def _record_history(self, plan: N.OutputNode, planner,
+                        snap: List[List]) -> None:
+        """Commit this clean execution's measured per-node rows to the
+        history store (presto_tpu/history). Advisory: a recording
+        failure must never fail a query that already produced its
+        answer."""
+        try:
+            from presto_tpu import history as _history
+            report = planner.fusion_report or {}
+            obs = _history.collect_observations(
+                plan, self.catalogs, planner.node_ops_prefusion,
+                snap, id_remap=report.get("id_remap"))
+            if obs:
+                _history.get_history_store().commit(obs)
+        except Exception:  # noqa: BLE001 — advisory by contract
+            pass
+
     @staticmethod
     def drive_pipelines(pipelines: List[List],
                         max_idle_s: float = 600.0,
@@ -1257,7 +1337,8 @@ class LocalRunner:
                         deadline: Optional[float] = None,
                         executor=None,
                         quantum_ms: Optional[float] = None,
-                        abort_check=None) -> List[Driver]:
+                        abort_check=None,
+                        count_rows_ops=None) -> List[Driver]:
         """Drive all pipelines' drivers to completion — on the shared
         time-sliced TaskExecutor when `executor` is given (the
         default production path: _run_plan and worker tasks resolve
@@ -1281,7 +1362,8 @@ class LocalRunner:
         the same checkpoints (the distributed root drive's remote-
         task-failed signal)."""
         import time as _time
-        dctx = DriverContext(profile=profile, memory=pool)
+        dctx = DriverContext(profile=profile, memory=pool,
+                             count_rows_ops=count_rows_ops)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
         if executor is not None:
@@ -1350,7 +1432,8 @@ class LocalRunner:
         from presto_tpu.planner.validation import validate
         validate(plan, "analysis", session=self.session)
         from presto_tpu.planner.optimizer import optimize
-        plan = optimize(plan, self.catalogs)
+        plan = optimize(plan, self.catalogs,
+                        session=self.session)
         validate(plan, "optimizer", session=self.session,
                  catalogs=self.catalogs)
         return plan
@@ -1530,8 +1613,20 @@ class LocalRunner:
         plan = plan_statement(inner, self.catalogs, self.session)
         from presto_tpu.planner.local_planner import prune_unused_columns
         from presto_tpu.planner.optimizer import optimize
-        plan = optimize(plan, self.catalogs)
+        plan = optimize(plan, self.catalogs,
+                        session=self.session)
         prune_unused_columns(plan)
+        est_annotate = self._estimate_annotator()
+        # materialize the estimate lines NOW, before any execution:
+        # the ANALYZE run itself commits fresh measurements into the
+        # history store, and lazily-rendered lines would then show
+        # post-run values contradicting the decisions the executed
+        # plan was actually built from
+        from presto_tpu.history.recorder import walk_nodes
+        est_lines = {id(n): est_annotate(n) for n in walk_nodes(plan)}
+
+        def est_cached(node) -> List[str]:
+            return list(est_lines.get(id(node), ()))
         if stmt.analyze:
             import time as _time
             self._last_annotate = None
@@ -1544,11 +1639,22 @@ class LocalRunner:
             t0 = _time.perf_counter()
             try:
                 result = self._run_plan(plan, profile=True)
-                # annotated tree: each plan node carries the rows/
-                # wall/compile/cache of the operators it planned
-                # into, THEN the per-pipeline operator table (the two
-                # views join on id=N)
-                text = N.plan_text(plan, annotate=self._annotator()) \
+                # annotated tree: each plan node carries its estimate
+                # (+ provenance — measured history vs derived static)
+                # and the rows/wall/compile/cache of the operators it
+                # planned into, THEN the per-pipeline operator table
+                # (the two views join on id=N)
+                stats_annotate = self._annotator()
+
+                def combined(node):
+                    # measured stat lines FIRST (their `name [id=N]`
+                    # adjacency to the node line is load-bearing for
+                    # downstream tooling), then the estimate line
+                    out = [] if stats_annotate is None \
+                        else stats_annotate(node)
+                    out.extend(est_cached(node))
+                    return out
+                text = N.plan_text(plan, annotate=combined) \
                     + "\n\n" + self._last_profile + \
                     f"\n-- rows: {result.row_count}"
                 entry["state"] = "FINISHED"
@@ -1561,8 +1667,49 @@ class LocalRunner:
             finally:
                 self._finish_history_entry(entry, t0)
         else:
-            text = N.plan_text(plan)
+            text = N.plan_text(plan, annotate=est_cached)
         return self._text_result("Query Plan", text.split("\n"))
+
+    def _estimate_annotator(self):
+        """plan node -> `est: rows=N [history|static]` lines: the
+        stats estimator's view of the plan with provenance, so a
+        history-driven rewrite is visible in EXPLAIN without reading
+        the store (docs/ADAPTIVE.md). Filters additionally show the
+        estimated surviving fraction the fusion gate consumes."""
+        from presto_tpu import history as _history
+        from presto_tpu.planner.stats import (
+            StatsEstimator, UNKNOWN_ROWS,
+        )
+        est = StatsEstimator(
+            self.catalogs,
+            history=_history.view_for(self.catalogs,
+                                      self.session.properties))
+
+        def annotate(node) -> List[str]:
+            try:
+                st = est.estimate(node)
+            except Exception:  # noqa: BLE001 — stats are advisory
+                return []
+            if st.rows >= UNKNOWN_ROWS * 0.99:
+                return ["est: rows=? [static]"]
+            prov = est.provenance_of(node)
+            sel = ""
+            if isinstance(node, N.FilterNode):
+                frac = None
+                if est.history is not None:
+                    frac = est.history.selectivity(node)
+                if frac is None:
+                    try:
+                        inner = est.estimate(node.source).rows
+                        frac = min(1.0, st.rows / inner) \
+                            if inner > 0 else None
+                    except Exception:  # noqa: BLE001
+                        frac = None
+                if frac is not None:
+                    sel = f" sel={frac:.4f}"
+            return [f"est: rows={int(round(st.rows)):,}{sel} "
+                    f"[{prov}]"]
+        return annotate
 
     def _annotator(self):
         """plan node -> stat lines, from the last profiled run's

@@ -62,6 +62,9 @@ class MeshRunner(LocalRunner):
         write plans drop uncommitted sink appends there."""
         from presto_tpu.execution.memory import MemoryLimitExceeded
         from presto_tpu.operators.aggregation import GroupLimitExceeded
+        from presto_tpu.operators.fused_fragment import (
+            FusedChainCompactOverflow,
+        )
         from presto_tpu.operators.join_ops import JoinCapacityExceeded
         prune_unused_columns(plan)
         plan = add_exchanges(plan, self.catalogs, self.session)
@@ -100,6 +103,16 @@ class MeshRunner(LocalRunner):
                     session, properties={
                         **session.properties,
                         "join_expansion_factor": e.suggested})
+                if on_retry is not None:
+                    on_retry()
+            except FusedChainCompactOverflow:
+                # same contract as the local runner: a history-sized
+                # in-trace compaction overflowed — retry with the
+                # fusion upgrade off (always-correct PARTIAL path)
+                session = dataclasses.replace(
+                    session, properties={
+                        **session.properties,
+                        "history_driven_fusion": False})
                 if on_retry is not None:
                     on_retry()
             except MemoryLimitExceeded as e:
@@ -175,6 +188,24 @@ class MeshRunner(LocalRunner):
 
     def _run_fragments(self, fplan: FragmentedPlan, session,
                        profile: bool = False) -> MaterializedResult:
+        # the kernel shape-bucket gate rides a thread-local that
+        # LocalRunner.execute sets from the ORIGINAL session; the mesh
+        # phased drive re-plans under RETRY-BUMPED sessions (lifespans,
+        # max_groups) on this same thread — install the gate from the
+        # session actually driving this attempt, like
+        # node.execute_fragment and the coordinator root drive do
+        from presto_tpu import batch as _batch
+        prev_sb = _batch.set_shape_buckets(
+            bool(get_property(session.properties,
+                              "kernel_shape_buckets")))
+        try:
+            return self._run_fragments_inner(fplan, session, profile)
+        finally:
+            _batch.set_shape_buckets(prev_sb)
+
+    def _run_fragments_inner(self, fplan: FragmentedPlan, session,
+                             profile: bool = False
+                             ) -> MaterializedResult:
         import time as _time
         from presto_tpu.execution.memory import MemoryPool
         from presto_tpu.operators.base import DriverContext
@@ -513,7 +544,8 @@ class MeshRunner(LocalRunner):
     def explain_text(self, sql: str) -> str:
         """Fragmented EXPLAIN (reference: planPrinter's fragment view)."""
         from presto_tpu.planner.optimizer import optimize
-        plan = optimize(self.create_plan(sql), self.catalogs)
+        plan = optimize(self.create_plan(sql), self.catalogs,
+                        session=self.session)
         prune_unused_columns(plan)
         plan = add_exchanges(plan, self.catalogs, self.session)
         return fragment_plan(plan).text()

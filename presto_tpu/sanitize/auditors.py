@@ -37,7 +37,7 @@ from typing import List, Optional, Sequence
 from presto_tpu.sanitize.locks import SanitizerViolation
 
 AUDITORS = ("memory", "cache", "admission", "executor", "exchange",
-            "threads")
+            "threads", "history")
 
 
 def run_audit(include: Optional[Sequence[str]] = None,
@@ -57,6 +57,8 @@ def run_audit(include: Optional[Sequence[str]] = None,
         out.extend(audit_exchange_registries())
     if "threads" in sel:
         out.extend(audit_threads())
+    if "history" in sel:
+        out.extend(audit_history_stores())
     if coordinator_check:
         out.extend(audit_coordinators())
     return out
@@ -126,6 +128,38 @@ def audit_cache_managers() -> List[SanitizerViolation]:
                     "cache",
                     f"cache pool reserved {mgr.pool.reserved:,}B != "
                     f"Σ live entries {total:,}B across levels"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# history: store byte ledger vs its own accounting model + bounds
+
+
+def audit_history_stores() -> List[SanitizerViolation]:
+    from presto_tpu import sanitize
+    from presto_tpu.history.store import (
+        HISTORY_MAX_BYTES, HISTORY_MAX_ENTRIES, entry_bytes,
+    )
+    out: List[SanitizerViolation] = []
+    for store in sanitize.tracked("history_store"):
+        with store._lock:
+            modeled = sum(entry_bytes(k) for k in store._entries)
+            if modeled != store.bytes:
+                out.append(_v(
+                    "history",
+                    f"history store byte ledger {store.bytes:,}B != "
+                    f"Σ modeled entry bytes {modeled:,}B over "
+                    f"{len(store._entries)} entries"))
+            if store.bytes > HISTORY_MAX_BYTES:
+                out.append(_v(
+                    "history",
+                    f"history store over byte budget: "
+                    f"{store.bytes:,}B > {HISTORY_MAX_BYTES:,}B"))
+            if len(store._entries) > HISTORY_MAX_ENTRIES:
+                out.append(_v(
+                    "history",
+                    f"history store over entry cap: "
+                    f"{len(store._entries)} > {HISTORY_MAX_ENTRIES}"))
     return out
 
 

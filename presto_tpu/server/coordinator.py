@@ -172,8 +172,18 @@ class Coordinator(Node):
                  resource_groups=None, selectors=None,
                  access_control=None, single_node: bool = False,
                  prewarm_sql: Optional[List[str]] = None,
-                 compilation_cache_dir: Optional[str] = None):
+                 compilation_cache_dir: Optional[str] = None,
+                 history_dir: Optional[str] = None):
         from presto_tpu.execution import compile_cache
+        # history-based optimization store (same surface shape as the
+        # compile cache: arg > env > unset); the embedded single-node
+        # runner and the coordinator's own root drives share the ONE
+        # process-wide store through this configuration
+        from presto_tpu import history as _history
+        if history_dir is not None:
+            _history.configure(history_dir)
+        else:
+            _history.configure_from_env()
         from presto_tpu.execution.resource_groups import (
             GroupSpec, ResourceGroupManager,
         )
@@ -1075,6 +1085,9 @@ th{{background:#222}}
             # run single-partition fragments here (root last -> result)
             result = None
             pipelines: List[list] = []
+            root_planner = None
+            root_fragment = None
+            root_span = (0, 0)
             for fid, fragment in fplan.fragments.items():
                 if fragment.partitioning != "single":
                     continue
@@ -1083,15 +1096,41 @@ th{{background:#222}}
                 planner = LocalExecutionPlanner(
                     runner.catalogs, runner.session, task=task)
                 if fid == fplan.root_id:
+                    start = len(pipelines)
                     lplan = planner.plan(fragment.root)
                     pipelines.extend(lplan.pipelines)
                     result = lplan
+                    root_planner, root_fragment = planner, fragment
+                    root_span = (start, len(pipelines))
                 else:
                     sinks = [exchanges[e.exchange_id]
                              for e in fplan.producer_edges(fid)]
                     pipelines.extend(
                         planner.plan_fragment(fragment.root, sinks))
             assert result is not None
+            # history recording tap (coordinator root drive): the root
+            # fragment runs as ONE task here, so its fully-local nodes
+            # (subtrees without a RemoteSource) measure whole-node
+            # truth. Other single fragments are skipped — operator ids
+            # restart per planner, and their snapshots would alias the
+            # root's in one merged id space.
+            from presto_tpu import history as _history
+            hist_ops = None
+            singles = sum(1 for f in fplan.fragments.values()
+                          if f.partitioning == "single")
+            if root_planner is not None and singles == 1 \
+                    and _history.enabled(properties) \
+                    and not faults.ARMED:
+                # singles == 1: operator ids restart per planner, so
+                # with several single fragments in one merged driver
+                # set, arming by id would also count colliding ids of
+                # non-root operators (wasted per-batch device work)
+                hist_ops = _history.interesting_ops(
+                    root_fragment.root,
+                    root_planner.node_ops_prefusion,
+                    id_remap=(root_planner.fusion_report or {}).get(
+                        "id_remap"),
+                    catalogs=runner.catalogs)
             if on_columns is not None and not explain:
                 on_columns([
                     {"name": n, "type": f.type.display()}
@@ -1136,8 +1175,15 @@ th{{background:#222}}
                 pipelines, failure, profile=profile,
                 cancel=lifecycle.cancel.is_set,
                 deadline=lifecycle.deadline,
-                properties=properties)
+                properties=properties,
+                count_rows_ops=hist_ops)
             wall_s = _time.perf_counter() - t0
+            if hist_ops is not None and not failure \
+                    and not faults.ARMED:
+                snap_all = LocalRunner.snapshot_driver_stats(drivers)
+                runner._record_history(
+                    root_fragment.root, root_planner,
+                    snap_all[root_span[0]:root_span[1]])
             # the attempt's counter dict is live on this thread (the
             # shell owns begin/end); snapshot it now so the stats
             # tree can't see a later attempt's accumulation
@@ -1300,7 +1346,8 @@ th{{background:#222}}
                              profile: bool = False,
                              cancel=None,
                              deadline: Optional[float] = None,
-                             properties: Optional[dict] = None):
+                             properties: Optional[dict] = None,
+                             count_rows_ops=None):
         """The coordinator's OWN drive loop (root + single-partition
         fragments) — it polls the same cancel hook and deadline as
         worker tasks do, so a kill stops the whole topology, not just
@@ -1311,7 +1358,8 @@ th{{background:#222}}
         from presto_tpu.operators.base import DriverContext
         from presto_tpu.operators.driver import Driver
         from presto_tpu.runner.local import check_lifecycle
-        dctx = DriverContext(profile=profile)
+        dctx = DriverContext(profile=profile,
+                             count_rows_ops=count_rows_ops)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
         from presto_tpu.execution.task_executor import (

@@ -767,15 +767,34 @@ class Node:
         )
         from presto_tpu.session_properties import get_property
         props = spec["session"].get("properties") or {}
+        # history recording tap (worker tier): only a SINGLE-task
+        # fragment's rows are whole-node cardinalities — a task of a
+        # wider fragment sees its split slice, which must never be
+        # recorded as the node's truth. Fault-armed nodes record
+        # nothing (chaos batteries truncate rows mid-stream).
+        from presto_tpu import history as _history
+        from presto_tpu.execution import faults as _faults
+        hist_ops = None
+        if k == 1 and int(spec["n_tasks"]) == 1 \
+                and _history.enabled(props) and not _faults.ARMED:
+            hist_ops = _history.interesting_ops(
+                fragment.root, planner.node_ops_prefusion,
+                id_remap=(planner.fusion_report or {}).get(
+                    "id_remap"),
+                catalogs=runner.catalogs)
         drivers = LocalRunner.drive_pipelines(
             pipelines,
             profile=bool(spec.get("profile")),
             cancel=cancel.is_set if cancel is not None else None,
             executor=executor_for_session(props),
             quantum_ms=get_property(props,
-                                    "task_executor_quantum_ms"))
+                                    "task_executor_quantum_ms"),
+            count_rows_ops=hist_ops)
+        snap = LocalRunner.snapshot_driver_stats(drivers)
+        if hist_ops is not None and not _faults.ARMED:
+            runner._record_history(fragment.root, planner, snap)
         return {"wall_s": round(time.perf_counter() - t0, 6),
-                "pipelines": LocalRunner.snapshot_driver_stats(drivers)}
+                "pipelines": snap}
 
 
 def derive_fragments(runner, sql: str, stmt=None):
@@ -801,7 +820,7 @@ def derive_fragments(runner, sql: str, stmt=None):
     )
     plan = runner.create_plan(sql, stmt=stmt)
     validate(plan, "analysis", session=runner.session)
-    plan = optimize(plan, runner.catalogs)
+    plan = optimize(plan, runner.catalogs, session=runner.session)
     validate(plan, "optimizer", session=runner.session,
              catalogs=runner.catalogs)
     prune_unused_columns(plan)

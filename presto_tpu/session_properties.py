@@ -227,6 +227,25 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "slot on an answer nobody is still waiting for",
         _non_negative),
     PropertyDef(
+        "history_based_optimization", "boolean", True,
+        "Close the measure->remember->replan loop (presto_tpu/"
+        "history): clean executions record measured per-node "
+        "cardinalities/selectivities keyed on structural plan "
+        "fingerprints + table versions, and the planner's stats "
+        "estimator serves them back (provenance-tagged `history`) to "
+        "the fusion selectivity gate, join order/build-side choice, "
+        "broadcast-vs-partitioned exchanges, and dynamic-filter "
+        "planning. Off = static estimates only, nothing recorded "
+        "(reference: history-based optimization; docs/ADAPTIVE.md)"),
+    PropertyDef(
+        "history_driven_fusion", "boolean", True,
+        "Allow MEASURED (history-provenance) chain selectivity to "
+        "upgrade a gated selective chain to FULL fusion with an "
+        "in-trace compaction sized by the measurement "
+        "(planner/fusion.py); an in-trace compaction overflow "
+        "retries the query once with this off. Requires "
+        "history_based_optimization"),
+    PropertyDef(
         "cache_memory_bytes", "bigint", 4 << 30,
         "Shared byte budget of the fragment-result + page-source "
         "caches, charged to the cache manager's tagged MemoryPool; "

@@ -1,0 +1,196 @@
+"""History-based-optimization inspection tool (docs/ADAPTIVE.md).
+
+Two jobs:
+
+  * **dump** — print the process/persisted HistoryStore's entries
+    (fingerprint, decayed rows/selectivity, wall, peak memory,
+    observation counts) so a history-driven planner decision can be
+    traced to its measurements without a debugger.
+  * **diff** — for each query of a mix: run it twice on a
+    history-armed runner (measure, then replan), render the plan WITH
+    history next to the plan WITHOUT, and summarize what feedback
+    changed — estimate provenance flips, fusion upgrades
+    (gated PARTIAL -> FULL / history_compact), join-order changes.
+
+Usage:
+    python -m presto_tpu.tools.history_report             # mix diff
+    python -m presto_tpu.tools.history_report --dump
+    python -m presto_tpu.tools.history_report --dump \
+        --history-dir /path/to/store
+    python -m presto_tpu.tools.history_report --schema sf0_1 \
+        --mix q1,q3,q6,q13 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional, Sequence
+
+DEFAULT_MIX = ("q1", "q3", "q6", "q13")
+
+
+def dump_store() -> List[dict]:
+    from presto_tpu.history import get_history_store
+    store = get_history_store(create=False)
+    if store is None:
+        return []
+    cols = ("fingerprint", "output_rows", "input_rows", "selectivity",
+            "wall_ms", "peak_bytes", "observations", "age_ms")
+    return [dict(zip(cols, row)) for row in store.snapshot_rows()]
+
+
+def _plan_text(runner, sql: str) -> str:
+    rows = runner.execute(f"explain {sql}").rows()
+    return "\n".join(r[0] for r in rows)
+
+
+def _fusion_summary(report: Optional[dict]) -> List[str]:
+    out = []
+    for e in (report or {}).get("fragments", ()):
+        if e.get("history_compact"):
+            out.append(
+                f"FULL+compact(x{e['history_compact']}) "
+                f"{e.get('fused')}")
+        elif e.get("fused") and not e.get("reason"):
+            out.append(f"FULL {e['fused']}")
+        elif e.get("fused"):
+            out.append(f"PARTIAL {e['fused']} [{e['reason']}]")
+        elif e.get("reason"):
+            out.append(f"fallback [{e['reason']}]")
+    return out
+
+
+def query_diff(runner_on, runner_off, sql: str) -> dict:
+    """Run `sql` on the history-armed runner (recording), then
+    compare its re-planned (second) execution against the
+    history-off plan."""
+    first = runner_on.execute(sql)
+    second = runner_on.execute(sql)
+    plan_with = _plan_text(runner_on, sql)
+    plan_without = _plan_text(runner_off, sql)
+    identical = runner_off.execute(sql).rows() == second.rows()
+    return {
+        "plan_with_history": plan_with,
+        "plan_without_history": plan_without,
+        "plan_changed": plan_with != plan_without,
+        "history_estimates": plan_with.count("[history]"),
+        "fusion_first": _fusion_summary(first.fusion_report),
+        "fusion_second": _fusion_summary(second.fusion_report),
+        "fusion_upgraded":
+            _fusion_summary(first.fusion_report)
+            != _fusion_summary(second.fusion_report),
+        "results_identical": identical,
+    }
+
+
+def build_report(statements: Dict[str, str], catalog: str,
+                 schema: str) -> dict:
+    from presto_tpu.runner.local import LocalRunner
+    # observe real planning + execution, not cache replays; ONE
+    # process-wide store, so the off-runner disables feedback via the
+    # session property rather than a separate store
+    base = {
+        "plan_cache_enabled": False,
+        "fragment_result_cache_enabled": False,
+        "page_source_cache_enabled": False,
+    }
+    on = LocalRunner(catalog, schema, dict(base))
+    off = LocalRunner(catalog, schema,
+                      dict(base, history_based_optimization=False))
+    queries = {name: query_diff(on, off, sql)
+               for name, sql in statements.items()}
+    return {
+        "queries": queries,
+        "plans_changed": sorted(
+            n for n, q in queries.items() if q["plan_changed"]),
+        "fusion_upgraded": sorted(
+            n for n, q in queries.items() if q["fusion_upgraded"]),
+        "all_identical": all(q["results_identical"]
+                             for q in queries.values()),
+        "store": dump_store(),
+    }
+
+
+def render(report: dict) -> str:
+    lines: List[str] = []
+    for name, q in report["queries"].items():
+        tag = "CHANGED" if q["plan_changed"] else "same"
+        lines.append(
+            f"{name}: plan {tag}, "
+            f"{q['history_estimates']} history estimate(s), "
+            f"fusion {q['fusion_first']} -> {q['fusion_second']}, "
+            f"identical={q['results_identical']}")
+        if q["plan_changed"]:
+            lines.append("  with history:")
+            lines.extend("    " + x
+                         for x in q["plan_with_history"].split("\n"))
+            lines.append("  without history:")
+            lines.extend(
+                "    " + x
+                for x in q["plan_without_history"].split("\n"))
+    lines.append(
+        f"plans changed: {report['plans_changed'] or 'none'}; "
+        f"fusion upgraded: {report['fusion_upgraded'] or 'none'}; "
+        f"byte-identity: {report['all_identical']}")
+    lines.append(f"store entries: {len(report['store'])}")
+    return "\n".join(lines)
+
+
+def render_dump(entries: List[dict]) -> str:
+    if not entries:
+        return "history store empty (or not configured)"
+    lines = []
+    for e in entries:
+        sel = f" sel={e['selectivity']:.4f}" \
+            if e["selectivity"] is not None else ""
+        lines.append(
+            f"{e['fingerprint'][:28]}  rows={e['output_rows']:,}"
+            f"{sel}  wall={e['wall_ms']:.1f}ms  "
+            f"peak={e['peak_bytes']:,}B  n={e['observations']}")
+    return "\n".join(lines)
+
+
+def _mix_statements(mix: Sequence[str]) -> Dict[str, str]:
+    from presto_tpu.tools.verifier import load_suite
+    suite = load_suite("tpch")
+    missing = [m for m in mix if m not in suite]
+    if missing:
+        raise ValueError(f"unknown mix queries {missing}")
+    return {m: suite[m] for m in mix}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="History-based optimization: store dump + "
+                    "with/without plan diffs")
+    p.add_argument("--catalog", default="tpch")
+    p.add_argument("--schema", default="tiny")
+    p.add_argument("--mix", default=",".join(DEFAULT_MIX))
+    p.add_argument("--sql", default=None,
+                   help="diff a single ad-hoc statement instead")
+    p.add_argument("--dump", action="store_true",
+                   help="print store entries and exit")
+    p.add_argument("--history-dir", default=None,
+                   help="load a persisted store from this directory")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.history_dir:
+        from presto_tpu import history
+        history.configure(args.history_dir)
+    if args.dump:
+        entries = dump_store()
+        print(json.dumps(entries, indent=1) if args.json
+              else render_dump(entries))
+        return 0
+    statements = {"sql": args.sql} if args.sql else _mix_statements(
+        [m.strip() for m in args.mix.split(",") if m.strip()])
+    report = build_report(statements, args.catalog, args.schema)
+    print(json.dumps(report, indent=1) if args.json
+          else render(report))
+    return 0 if report["all_identical"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

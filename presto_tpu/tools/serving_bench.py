@@ -363,6 +363,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       overload_rounds: int = 2,
                       overload_concurrency: Optional[int] = None,
                       sanitize_phase: bool = False,
+                      history_phase: bool = False,
                       host: str = "127.0.0.1") -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
@@ -383,7 +384,8 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             cache_dir=cache_dir, fusion_report=fusion_report,
             overload=overload, overload_rounds=overload_rounds,
             overload_concurrency=overload_concurrency,
-            sanitize_phase=sanitize_phase, host=host)
+            sanitize_phase=sanitize_phase,
+            history_phase=history_phase, host=host)
     finally:
         if auto_cache_dir is not None:
             import shutil
@@ -399,7 +401,8 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    fusion_report: bool, overload: bool,
                    overload_rounds: int,
                    overload_concurrency: Optional[int],
-                   sanitize_phase: bool, host: str) -> dict:
+                   sanitize_phase: bool, history_phase: bool,
+                   host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
@@ -618,6 +621,45 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                 "restart-warm phase performed fresh compiles: "
                 + json.dumps(restart["distinct_compiles"]))
 
+    history_doc = None
+    if history_phase:
+        # history-based optimization phase: a FRESH (empty) store so
+        # first-vs-second-run deltas are attributable, then each mix
+        # query measured and re-planned — emitting plan deltas,
+        # fusion upgrades, and the history counter growth
+        from presto_tpu import history as _history
+        from presto_tpu.telemetry.metrics import METRICS
+        from presto_tpu.tools.history_report import (
+            build_report as history_build,
+        )
+        _history.reset_history_store()
+        names = ("hits", "misses", "records")
+        before = {k: METRICS.total(f"presto_tpu_history_{k}_total")
+                  for k in names}
+        hr = history_build(sqls, "tpch", schema)
+        history_doc = {
+            "plans_changed": hr["plans_changed"],
+            "fusion_upgraded": hr["fusion_upgraded"],
+            "results_identical": hr["all_identical"],
+            "history_estimates": {
+                n: q["history_estimates"]
+                for n, q in hr["queries"].items()},
+            "fusion_first_vs_second": {
+                n: [q["fusion_first"], q["fusion_second"]]
+                for n, q in hr["queries"].items()},
+            "store_entries": len(hr["store"]),
+            "counters": {
+                f"presto_tpu_history_{k}_total": int(
+                    METRICS.total(f"presto_tpu_history_{k}_total")
+                    - before[k])
+                for k in names},
+        }
+        if not hr["all_identical"]:
+            raise RuntimeError(
+                "history phase diverged (history-on plans must stay "
+                "byte-identical): "
+                + json.dumps(history_doc, indent=1))
+
     fusion = None
     if fusion_report:
         # per-query fragments fused vs fallen back (with reasons) —
@@ -657,6 +699,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "chaos": chaos_doc,
         "sanitize": sanitize_doc,
         "fusion": fusion,
+        "history": history_doc,
     }
     if not identical:
         raise RuntimeError(
@@ -709,6 +752,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "coordinator + executor): reports violations "
                         "and the armed-vs-disarmed wall delta in the "
                         "JSON")
+    p.add_argument("--history", action="store_true",
+                   help="run the history-based-optimization phase: "
+                        "fresh store, measure + re-plan each mix "
+                        "query, emit first-vs-second plan deltas, "
+                        "fusion upgrades, and history counters")
     p.add_argument("--fusion-report", action="store_true",
                    help="embed the per-query whole-fragment fusion "
                         "coverage (fused chains + fallback reasons, "
@@ -724,7 +772,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         cache_dir=args.cache_dir, fusion_report=args.fusion_report,
         overload=args.overload, overload_rounds=args.overload_rounds,
         overload_concurrency=args.overload_concurrency,
-        sanitize_phase=args.sanitize)
+        sanitize_phase=args.sanitize, history_phase=args.history)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

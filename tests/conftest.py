@@ -91,6 +91,12 @@ def _clear_xla_caches_between_modules(request):
         # entries — mirrors the compiled-executable cache handling
         from presto_tpu.cache import reset_cache_manager
         reset_cache_manager()
+        # history-based optimization is process-wide like the caches:
+        # reset between modules so a module asserting plan shapes or
+        # fusion reports never observes another module's measured
+        # history (and recorded entries never leak across modules)
+        from presto_tpu import history
+        history.reset_history_store()
         # fault-injection hygiene: a module that armed the registry
         # and crashed before its own cleanup must not leak faults
         # into every later module

@@ -321,7 +321,7 @@ def test_attribution_optimizer_pass(runner, monkeypatch):
     import presto_tpu.planner.optimizer as opt
     real = opt.optimize
 
-    def breaking_optimize(plan, catalogs=None):
+    def breaking_optimize(plan, catalogs=None, session=None):
         plan = real(plan, catalogs)
         f = _find(plan, N.FilterNode)
         f.predicate = Call("greater_than", (
@@ -343,7 +343,7 @@ def test_attribution_respects_session_gate(runner, monkeypatch):
     import presto_tpu.planner.optimizer as opt
     real = opt.optimize
 
-    def breaking_optimize(plan, catalogs=None):
+    def breaking_optimize(plan, catalogs=None, session=None):
         plan = real(plan, catalogs)
         f = _find(plan, N.FilterNode)
         f.predicate = Call("greater_than", (

@@ -11,8 +11,12 @@ def test_serving_bench_smoke():
     from presto_tpu.cache import reset_cache_manager
     from presto_tpu.tools.serving_bench import run_serving_bench
     reset_cache_manager()
+    # 2 warm rounds: with history-based optimization on (default),
+    # each query's FIRST clean completion materially grows the store,
+    # which re-plans cached statements once by design — round 2 is
+    # the steady serving state whose plan-cache hits this asserts
     doc = run_serving_bench(clients=2, schema="tiny",
-                            mix=("q6", "q1"), warm_rounds=1)
+                            mix=("q6", "q1"), warm_rounds=2)
     # stable headline schema (CI greps these keys)
     for key in ("metric", "value", "unit", "platform", "clients",
                 "schema", "mix", "warm_rounds", "cold", "warm",

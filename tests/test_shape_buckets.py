@@ -210,3 +210,29 @@ def test_session_property_registered():
     assert validate_set("kernel_shape_buckets", False) is False
     with pytest.raises(ValueError):
         validate_set("kernel_shape_buckets", 1)
+
+
+def test_mesh_drive_installs_per_statement_gate(monkeypatch):
+    """The mesh phased drive must honor the STATEMENT's
+    kernel_shape_buckets (set from the retry-session actually driving
+    the attempt), not the process default — the PR 6 gap's last
+    corner. Observed inside _run_fragments_inner, where planning and
+    the phased loop run."""
+    from presto_tpu import batch
+    from presto_tpu.runner.mesh import MeshRunner
+    seen = []
+    inner = MeshRunner._run_fragments_inner
+
+    def spy(self, fplan, session, profile=False):
+        seen.append(batch.shape_buckets_on())
+        return inner(self, fplan, session, profile)
+
+    monkeypatch.setattr(MeshRunner, "_run_fragments_inner", spy)
+    r = MeshRunner("tpch", "tiny",
+                   properties={"kernel_shape_buckets": False})
+    rows = r.execute("select count(*) from nation").rows()
+    assert rows == [(25,)]
+    assert seen == [False]  # process default is True
+    r2 = MeshRunner("tpch", "tiny")
+    r2.execute("select count(*) from nation")
+    assert seen[-1] is True
