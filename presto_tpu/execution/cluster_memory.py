@@ -95,3 +95,74 @@ class ClusterMemoryManager:
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._reserved)
+
+
+class FleetMemoryExceeded(Exception):
+    """Structured fleet-admission shed (reference: the cluster-wide
+    limit of ClusterMemoryManager, expressed as admission-time load
+    shedding rather than a mid-flight kill): the coordinator refuses
+    to dispatch more work onto an over-budget fleet. `kind` rides the
+    client protocol like queue_full/rejected — sheds are absorbed
+    overload, never collapse."""
+
+    kind = "cluster_memory"
+
+    def __init__(self, reserved: int, requested: int, budget: int):
+        super().__init__(
+            f"fleet memory budget exhausted: workers report "
+            f"{reserved:,}B reserved (+{requested:,}B requested) "
+            f"against a {budget:,}B fleet budget")
+        self.reserved = reserved
+        self.requested = requested
+
+
+class FleetMemoryEnforcer:
+    """Cluster-wide reservation gate over the WORKER FLEET, fed by
+    the heartbeat's per-worker memory reports (server/scheduler.py's
+    HeartbeatMonitor calls :meth:`report` with each /v1/info
+    response). The stage scheduler calls :meth:`admit` before
+    dispatching a query's tasks; an over-budget fleet sheds the query
+    structurally instead of letting a worker OOM.
+
+    Distinct from :class:`ClusterMemoryManager`, which arbitrates
+    IN-PROCESS queries over one runner's pools mid-flight — this tier
+    gates at dispatch over remotely-reported totals."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = int(budget_bytes)
+        self._lock = sanitize.lock("memory.fleet")
+        self._by_worker: Dict[str, int] = {}
+        self.sheds = 0
+
+    def report(self, worker: str, reserved_bytes: int) -> None:
+        with self._lock:
+            self._by_worker[worker] = int(reserved_bytes)
+
+    def drop(self, worker: str) -> None:
+        """A removed member's stale report must not keep gating
+        dispatch onto the survivors."""
+        with self._lock:
+            self._by_worker.pop(worker, None)
+
+    def reserved(self) -> int:
+        with self._lock:
+            return sum(self._by_worker.values())
+
+    def admit(self, requested_bytes: int = 0) -> None:
+        """Gate one query's dispatch: raises the structured
+        :class:`FleetMemoryExceeded` when the fleet's reported
+        reservations plus the query's declared memory would exceed
+        the budget."""
+        with self._lock:
+            total = sum(self._by_worker.values())
+            if total + int(requested_bytes) <= self.budget:
+                return
+            self.sheds += 1
+        from presto_tpu.telemetry.metrics import METRICS
+        METRICS.inc("presto_tpu_fleet_memory_sheds_total")
+        raise FleetMemoryExceeded(total, int(requested_bytes),
+                                  self.budget)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._by_worker)

@@ -23,6 +23,18 @@ seams:
     admission.enqueue   ResourceGroupManager.submit (fails one
                         query's admission cleanly; the coordinator
                         absorbs it as a per-query failure)
+    worker.heartbeat    every membership probe the coordinator's
+                        HeartbeatMonitor sends (a fired fault counts
+                        as one failed probe — suspicion accrues
+                        exactly like a real dropped /v1/info)
+    task.status_poll    every task status GET of the stage scheduler
+                        (and the legacy watcher) — a persistent fault
+                        on one worker's polls models an unreachable
+                        worker without killing a process
+    spool.read          every committed page read back out of the
+                        coordinator's TaskOutputSpool during input
+                        replay (fails the replaying task attempt,
+                        which the task-retry tier absorbs)
 
 Zero overhead when disarmed: every site guards its fire() call with
 the module-level ``ARMED`` bool, so the cold path pays one attribute
@@ -65,6 +77,10 @@ SITES = (
     # admission.enqueue — chaos tests fail queries mid-schedule or
     # at the front door without monkeypatching
     "executor.quantum", "admission.enqueue",
+    # the fleet seams (server/scheduler.py): membership probes, task
+    # status polls, and spooled-exchange read-back — the chaos battery
+    # fails workers, polls, and replay without killing processes
+    "worker.heartbeat", "task.status_poll", "spool.read",
 )
 
 

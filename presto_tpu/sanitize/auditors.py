@@ -23,6 +23,11 @@ Catalogue (docs/SANITIZERS.md):
   exchange   released queries hold no undelivered pages; per-consumer
              eos producer sets never exceed the expected producer
              count; accepted sequence numbers non-negative
+  fleet      task-output spool byte ledger balances its pages and no
+             ORPHAN spool file exists on disk; stage-scheduler task
+             ledgers hold at most ONE live attempt per task and a
+             committed task never has a live attempt (the
+             no-double-schedule invariant)
   threads    every registered thread is a daemon; no thread alive
              after its owner was collected or reported stopped (the
              joined-shutdown contract)
@@ -37,7 +42,7 @@ from typing import List, Optional, Sequence
 from presto_tpu.sanitize.locks import SanitizerViolation
 
 AUDITORS = ("memory", "cache", "admission", "executor", "exchange",
-            "threads", "history")
+            "threads", "history", "fleet")
 
 
 def run_audit(include: Optional[Sequence[str]] = None,
@@ -59,6 +64,8 @@ def run_audit(include: Optional[Sequence[str]] = None,
         out.extend(audit_threads())
     if "history" in sel:
         out.extend(audit_history_stores())
+    if "fleet" in sel:
+        out.extend(audit_fleet())
     if coordinator_check:
         out.extend(audit_coordinators())
     return out
@@ -357,6 +364,78 @@ def audit_threads() -> List[SanitizerViolation]:
                 f"thread {t.name!r} ({purpose}) alive after its "
                 "owner reported stopped — shutdown lacks a joined "
                 "path"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fleet: task-output spool hygiene + stage-scheduler ledger
+
+
+def audit_fleet() -> List[SanitizerViolation]:
+    import os as _os
+
+    from presto_tpu import sanitize
+    out: List[SanitizerViolation] = []
+    for spool in sanitize.tracked("task_spool"):
+        with spool._lock:
+            mem_bytes = 0
+            disk = 0
+            referenced = set()
+            for pages in list(spool._pending.values()) \
+                    + list(spool._pages.values()):
+                for p in pages:
+                    if p["tier"] == "mem":
+                        mem_bytes += p["nbytes"]
+                    else:
+                        disk += 1
+                        referenced.add(p["payload"])
+            if mem_bytes != spool.bytes:
+                out.append(_v(
+                    "fleet",
+                    f"task spool byte ledger {spool.bytes:,}B != Σ "
+                    f"memory-tier page bytes {mem_bytes:,}B"))
+            if disk != spool.disk_pages:
+                out.append(_v(
+                    "fleet",
+                    f"task spool disk-page count {spool.disk_pages} "
+                    f"!= {disk} disk-tier pages held"))
+            if spool._dir is not None:
+                try:
+                    on_disk = {
+                        _os.path.join(spool._dir, f)
+                        for f in _os.listdir(spool._dir)}
+                except OSError:
+                    on_disk = set()
+                # in-flight writes (path allocated, file being
+                # written outside the lock) are not orphans
+                orphans = on_disk - referenced \
+                    - set(spool._inflight_paths)
+                if orphans:
+                    out.append(_v(
+                        "fleet",
+                        f"{len(orphans)} ORPHAN spool file(s) not "
+                        f"referenced by any live page: "
+                        f"{sorted(orphans)[:3]}"))
+    for sched in sanitize.tracked("stage_scheduler"):
+        with sched._lock:
+            for rec in sched.records.values():
+                if rec.committed_attempt is not None \
+                        and rec.live_attempt is not None:
+                    out.append(_v(
+                        "fleet",
+                        f"task {sched.query_id}.{rec.fragment}."
+                        f"{rec.slot} is COMMITTED (attempt "
+                        f"{rec.committed_attempt}) yet still has "
+                        f"live attempt {rec.live_attempt} — a "
+                        "double-schedule"))
+                if rec.live_attempt is not None \
+                        and rec.live_attempt > rec.attempts:
+                    out.append(_v(
+                        "fleet",
+                        f"task {sched.query_id}.{rec.fragment}."
+                        f"{rec.slot} live attempt "
+                        f"{rec.live_attempt} exceeds launched "
+                        f"count {rec.attempts}"))
     return out
 
 

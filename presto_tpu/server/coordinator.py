@@ -26,6 +26,9 @@ from presto_tpu.server.node import (
     TRANSPORT_RETRIES, Node, build_http_exchanges, derive_fragments,
     http_delete, http_get, http_post,
 )
+from presto_tpu.server.scheduler import (
+    HeartbeatMonitor, StageScheduler, TaskOutputSpool,
+)
 
 
 class TaskFailed(RuntimeError):
@@ -173,7 +176,8 @@ class Coordinator(Node):
                  access_control=None, single_node: bool = False,
                  prewarm_sql: Optional[List[str]] = None,
                  compilation_cache_dir: Optional[str] = None,
-                 history_dir: Optional[str] = None):
+                 history_dir: Optional[str] = None,
+                 heartbeat_interval_s: float = 1.0):
         from presto_tpu.execution import compile_cache
         # history-based optimization store (same surface shape as the
         # compile cache: arg > env > unset); the embedded single-node
@@ -243,42 +247,100 @@ class Coordinator(Node):
             target=self._prune_loop, daemon=True, owner=self,
             stop_signal=self._pruner_stop.is_set,
             purpose="coordinator-pruner")
+        # -- fleet control plane (server/scheduler.py) -----------------
+        #: durable stage-boundary exchange store for fault-tolerant
+        #: task retries (session property task_retries > 0)
+        self.task_spool = TaskOutputSpool()
+        #: cluster-wide memory gate fed by heartbeat reports (session
+        #: property fleet_memory_bytes); None = unenforced
+        from presto_tpu.session_properties import get_property
+        fleet_budget = get_property(self.properties,
+                                    "fleet_memory_bytes")
+        self.fleet_memory = None
+        if fleet_budget:
+            from presto_tpu.execution.cluster_memory import (
+                FleetMemoryEnforcer,
+            )
+            self.fleet_memory = FleetMemoryEnforcer(int(fleet_budget))
+        #: background heartbeat failure detector over the worker
+        #: fleet — a LIVE membership view instead of the static
+        #: worker_urls list checked once; started with the server
+        self.membership: Optional[HeartbeatMonitor] = None
+        if self.worker_urls and not self.single_node:
+            self.membership = HeartbeatMonitor(
+                self.worker_urls, interval_s=heartbeat_interval_s,
+                memory_sink=self.fleet_memory)
         sanitize.track("coordinator", self)
 
     def start(self) -> None:
         # AOT prewarm completes BEFORE the HTTP thread serves (the
         # whole point: the first client query after a restart finds
         # warm kernels, never races the warmup for the shared
-        # runner). Single-node topology only for now — distributed
-        # prewarm would have to replay on every WORKER's kernel
-        # caches, which this coordinator cannot reach; configured-but-
-        # skipped is reported loudly, never swallowed
+        # runner). On the worker topology the statements fan out to
+        # every worker's /v1/prewarm so ITS kernel caches warm too —
+        # per-worker compile counts land in the aggregate report and
+        # on each worker's /v1/info
         if self.prewarm_sql:
             if self.single_node:
                 from presto_tpu.execution import compile_cache
                 self.prewarm_report = compile_cache.prewarm(
                     self._runner(), self.prewarm_sql)
             else:
-                import sys
-                from presto_tpu.telemetry.metrics import METRICS
-                METRICS.inc("presto_tpu_prewarm_statements_total",
-                            value=len(self.prewarm_sql),
-                            status="skipped_multi_node")
-                print("presto_tpu: prewarm_sql configured but this "
-                      "coordinator has workers — distributed prewarm "
-                      "is not implemented; workers start cold",
-                      file=sys.stderr)
+                self.prewarm_report = self._prewarm_workers()
         super().start()
         self._pruner.start()
+        if self.membership is not None:
+            self.membership.start()
 
     def stop(self) -> None:
         self._pruner_stop.set()
+        if self.membership is not None:
+            self.membership.stop()
         super().stop()
         # join the pruner: before this, a stopped coordinator leaked
         # its pruner thread for up to one 15s sweep period — the
         # first finding of the armed full-suite thread-leak audit
         if self._pruner.is_alive():
             self._pruner.join(timeout=5)
+        # spool files must not outlive the coordinator
+        self.task_spool.close()
+
+    def _prewarm_workers(self) -> dict:
+        """Distributed AOT prewarm (closes the 'workers start cold'
+        gap): POST the warmup statements to every worker's
+        /v1/prewarm concurrently; each replays them through a local
+        runner against ITS kernel caches. Per-worker failures are
+        recorded, never raised — the fleet must come up even if one
+        member's warmup rots."""
+        from concurrent.futures import ThreadPoolExecutor
+        from presto_tpu.telemetry.metrics import METRICS
+        body = json.dumps({
+            "statements": self.prewarm_sql,
+            "catalog": self.catalog, "schema": self.schema,
+            "properties": self.properties,
+        }).encode()
+
+        def warm(url):
+            try:
+                report = json.loads(http_post(
+                    f"{url}/v1/prewarm", body, timeout=600))
+                METRICS.inc("presto_tpu_prewarm_statements_total",
+                            value=len(self.prewarm_sql),
+                            status="worker_ok")
+                return url, report
+            except Exception as e:  # noqa: BLE001 — best-effort
+                METRICS.inc("presto_tpu_prewarm_statements_total",
+                            value=len(self.prewarm_sql),
+                            status="worker_failed")
+                return url, {"error": f"{type(e).__name__}: {e}"}
+        with ThreadPoolExecutor(
+                max_workers=max(len(self.worker_urls), 1)) as pool:
+            workers = dict(pool.map(warm, self.worker_urls))
+        return {
+            "statements": len(self.prewarm_sql),
+            "workers": workers,
+            "failed": [u for u, r in workers.items() if "error" in r],
+        }
 
     def _prune_loop(self, period_s: float = 15.0) -> None:
         while not self._pruner_stop.wait(period_s):
@@ -297,12 +359,36 @@ class Coordinator(Node):
     # -- health / membership (reference: failureDetector/
     # HeartbeatFailureDetector pinging discovered nodes) ---------------
 
-    def check_workers(self) -> None:
-        for url in self.worker_urls:
-            info = json.loads(http_get(f"{url}/v1/info", timeout=10))
-            if info.get("state") != "active":
-                raise RuntimeError(f"worker {url} is not active: "
-                                   f"{info}")
+    def check_workers(self, require_all: bool = False,
+                      timeout: float = 5.0) -> Dict[str, str]:
+        """Probe every worker CONCURRENTLY (a dead worker costs the
+        caller at most one timeout, not one per worker) and return
+        {url: state} with the dead ones reported as
+        "unreachable: ...". Degradation-tolerant by default — the
+        coordinator starts with the live majority; it raises only
+        when NO worker is active (or, with `require_all`, when any
+        is not)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def probe(url):
+            try:
+                info = json.loads(http_get(f"{url}/v1/info",
+                                           timeout=timeout))
+                return url, info.get("state", "unknown")
+            except Exception as e:  # noqa: BLE001 — reported, and
+                return url, f"unreachable: {e}"  # raised below if
+                # nothing at all answered
+        if not self.worker_urls:
+            return {}
+        with ThreadPoolExecutor(
+                max_workers=len(self.worker_urls)) as pool:
+            report = dict(pool.map(probe, self.worker_urls))
+        dead = {u: s for u, s in report.items() if s != "active"}
+        if dead and require_all:
+            raise RuntimeError(f"workers not active: {dead}")
+        if len(dead) == len(report):
+            raise RuntimeError(f"no active workers: {dead}")
+        return report
 
     # -- client protocol ---------------------------------------------------
 
@@ -369,6 +455,22 @@ class Coordinator(Node):
                 "nextUri": f"{self.url}/v1/statement/executing/"
                            f"{q.id}/0",
             }).encode()
+        if path.startswith("/v1/spool/"):
+            # fault-tolerant task output pages land HERE (tagged by
+            # task attempt) instead of streaming to consumers — see
+            # server/scheduler.py TaskOutputSpool
+            import urllib.parse as _up
+            rest = path[len("/v1/spool/"):]
+            params: Dict[str, str] = {}
+            if "?" in rest:
+                rest, qs = rest.split("?", 1)
+                params = dict(_up.parse_qsl(qs))
+            key, consumer_s = rest.rsplit("/", 1)
+            self.task_spool.put(
+                key, int(consumer_s), params["task"],
+                int(params["attempt"]), int(params["producer"]),
+                int(params["seq"]), body)
+            return b"{}"
         return super().handle_post(path, body, headers)
 
     def _stamp_queue_deadline(self, q: _Query) -> None:
@@ -448,6 +550,22 @@ class Coordinator(Node):
         return sorted(out, key=lambda r: -r["elapsed_ms"])
 
     def handle_get(self, path: str) -> bytes:
+        if path == "/v1/info":
+            # the coordinator's info adds the live MEMBERSHIP view
+            # (heartbeat states, load/memory feedback, flap counts)
+            # and the spool/fleet gauges to the node basics
+            info = json.loads(super().handle_get(path))
+            if self.membership is not None:
+                info["workers"] = self.membership.snapshot()
+                info["membership"] = self.membership.counts()
+            info["spool"] = self.task_spool.stats()
+            if self.fleet_memory is not None:
+                info["fleet_memory"] = {
+                    "budget_bytes": self.fleet_memory.budget,
+                    "reserved_bytes": self.fleet_memory.reserved(),
+                    "sheds": self.fleet_memory.sheds,
+                }
+            return json.dumps(info).encode()
         if path == "/v1/query":
             return json.dumps(self._query_rows()).encode()
         if path.startswith("/v1/query/") and path.endswith("/trace"):
@@ -830,9 +948,12 @@ th{{background:#222}}
                     return result
                 except Exception as e:  # noqa: BLE001 — inspect+retry
                     # a killed/expired query must NOT burn the elastic
-                    # retry budget re-running work nobody wants
+                    # retry budget re-running work nobody wants, and a
+                    # fleet-memory shed is structural admission
+                    # control, not a failure to retry around
                     if getattr(e, "kind", None) in ("cancelled",
-                                                    "deadline_exceeded"):
+                                                    "deadline_exceeded",
+                                                    "cluster_memory"):
                         raise
                     # sync-free overflow protocol: re-run the WHOLE
                     # query with the suggested setting (any fragment
@@ -850,6 +971,10 @@ th{{background:#222}}
                     bad = getattr(e, "worker", None)
                     if bad:
                         blacklist.add(bad)
+                        if self.membership is not None:
+                            # inline failure evidence accelerates the
+                            # heartbeat tier's suspicion
+                            self.membership.report_failure(bad)
                     alive = []
                     for url in workers:
                         if url in blacklist:
@@ -993,6 +1118,18 @@ th{{background:#222}}
                 on_columns([{"name": "Query Plan",
                              "type": "varchar"}])
             return result
+        from presto_tpu.session_properties import get_property as _gp
+        if not explain and int(_gp(properties, "task_retries")) > 0:
+            # fault-tolerant execution (server/scheduler.py): each
+            # distributed fragment runs as independently retryable
+            # tasks over the live membership with outputs spooled at
+            # stage boundaries — a dead worker re-runs only its
+            # unfinished tasks. This attempt tier remains above it as
+            # the LAST resort (and the overflow-bump protocol rides
+            # the TaskFailed kinds unchanged).
+            return StageScheduler(
+                self, sql, fplan, runner, worker_urls, properties,
+                lifecycle, on_columns=on_columns).run()
         if not worker_urls and any(
                 f.partitioning == "distributed"
                 for f in fplan.fragments.values()):
@@ -1147,12 +1284,23 @@ th{{background:#222}}
                 # response doesn't escalate to a whole-query retry —
                 # only a worker that stays unreachable does (and it
                 # gets blacklisted for this query's later attempts)
+                from presto_tpu.server.node import _retry_transient
                 while not stop.is_set():
                     for task_id, wurl in remote:
-                        try:
-                            st = json.loads(http_get(
+                        def poll(task_id=task_id, wurl=wurl):
+                            # the fault site sits INSIDE the retry
+                            # loop: a transient injected drop is
+                            # absorbed like a real one — only a
+                            # PERSISTENT fault models an unreachable
+                            # worker and escalates
+                            if faults.ARMED:
+                                faults.fire("task.status_poll",
+                                            url=wurl, task=task_id)
+                            return http_get(
                                 f"{wurl}/v1/task/{task_id}",
-                                timeout=10, retries=2))
+                                timeout=10)
+                        try:
+                            st = json.loads(_retry_transient(poll, 2))
                         except Exception as e:  # noqa: BLE001
                             failure.append(TaskFailed(
                                 f"worker {wurl} unreachable: {e}",

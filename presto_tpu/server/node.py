@@ -139,7 +139,13 @@ class ExchangeRegistry:
         self._last_seq: Dict[Tuple[str, int, int], int] = {}
 
     def _is_released(self, key: str) -> bool:
-        return key.split(":", 1)[0] in self._released
+        # fault-tolerant task attempts namespace their exchange keys
+        # as "<query_id>.<fragment>.<slot>.<attempt>:<xid>" — releasing
+        # the base query id must cover every attempt namespace, and a
+        # released single attempt must not shadow its siblings
+        qpart = key.split(":", 1)[0]
+        return qpart in self._released \
+            or qpart.split(".", 1)[0] in self._released
 
     def expect_producers(self, key: str, count: int) -> None:
         with self._lock:
@@ -209,21 +215,24 @@ class ExchangeRegistry:
 
     def drop_query(self, query_id: str) -> None:
         """Release every queue/eos/expectation of a finished or failed
-        query (keys are "<query_id>:<exchange_id>") and remember the id
-        so straggler pages still in flight are discarded on arrival."""
-        prefix = f"{query_id}:"
+        query (keys are "<query_id>:<exchange_id>", plus the
+        fault-tolerant attempt namespaces "<query_id>.<task>…:<xid>")
+        and remember the id so straggler pages still in flight are
+        discarded on arrival."""
+        prefixes = (f"{query_id}:", f"{query_id}.")
         with self._lock:
             self._released[query_id] = None
             while len(self._released) > self._RELEASED_MAX:
                 self._released.popitem(last=False)
             for d in (self._queues, self._eos):
-                for k in [k for k in d if k[0].startswith(prefix)]:
+                for k in [k for k in d
+                          if k[0].startswith(prefixes)]:
                     del d[k]
             for k in [k for k in self._expected
-                      if k.startswith(prefix)]:
+                      if k.startswith(prefixes)]:
                 del self._expected[k]
             for k in [k for k in self._last_seq
-                      if k[0].startswith(prefix)]:
+                      if k[0].startswith(prefixes)]:
                 del self._last_seq[k]
 
 
@@ -265,7 +274,9 @@ class HttpExchange:
                  partition_keys, hash_dicts, key_dictionaries,
                  consumer_urls: List[str], n_producers: int,
                  registry: ExchangeRegistry,
-                 self_url: Optional[str] = None):
+                 self_url: Optional[str] = None,
+                 spool_to: Optional[dict] = None,
+                 canonical_key: Optional[str] = None):
         from presto_tpu.operators.exchange_ops import build_remap_tables
         self.exchange_id = exchange_key
         self.scheme = scheme
@@ -274,6 +285,14 @@ class HttpExchange:
         self.n_consumers = len(consumer_urls)
         self.registry = registry
         self.self_url = self_url
+        #: fault-tolerant mode (server/scheduler.py): pushes go to the
+        #: coordinator's TaskOutputSpool — {"url", "task", "attempt"}
+        #: — tagged so a failed attempt's pages are discardable and a
+        #: committed task's pages are replayable to any worker. The
+        #: spool is addressed by the CANONICAL exchange key while pops
+        #: keep the task attempt's private key namespace.
+        self.spool_to = spool_to
+        self.canonical_key = canonical_key or exchange_key
         registry.expect_producers(exchange_key, n_producers)
         self._rr = 0
         self._remaps = build_remap_tables(hash_dicts, key_dictionaries)
@@ -286,6 +305,12 @@ class HttpExchange:
     # -- producer side (outgoing HTTP) -------------------------------------
 
     def _is_local(self, consumer: int) -> bool:
+        # a spooling producer NEVER short-circuits locally: its pages
+        # must land in the durable spool (tagged by task attempt), not
+        # in this process's live queues — even when the coordinator
+        # itself runs the producing fragment
+        if self.spool_to is not None:
+            return False
         return self.self_url is not None \
             and self.consumer_urls[consumer] == self.self_url
 
@@ -300,9 +325,32 @@ class HttpExchange:
         sk = (producer, consumer)
         seq = self._seq.get(sk, -1) + 1
         self._seq[sk] = seq
-        url = (f"{self.consumer_urls[consumer]}/v1/exchange/"
-               f"{self.exchange_id}/{consumer}"
-               f"?producer={producer}&seq={seq}")
+        if self.spool_to is not None:
+            store = self.spool_to.get("store")
+            if store is not None:
+                # the spool lives in THIS process (coordinator-run
+                # fragments): put directly — durability is the spool
+                # object, the loopback HTTP hop + re-parse buys
+                # nothing (the self-delivery lesson, applied to the
+                # durable tier)
+                METRICS.inc("presto_tpu_exchange_pages_total",
+                            direction="push")
+                METRICS.inc("presto_tpu_exchange_bytes_total",
+                            len(payload), direction="push")
+                store.put(self.canonical_key, consumer,
+                          self.spool_to["task"],
+                          self.spool_to["attempt"], producer, seq,
+                          payload)
+                return
+            url = (f"{self.spool_to['url']}/v1/spool/"
+                   f"{self.canonical_key}/{consumer}"
+                   f"?task={self.spool_to['task']}"
+                   f"&attempt={self.spool_to['attempt']}"
+                   f"&producer={producer}&seq={seq}")
+        else:
+            url = (f"{self.consumer_urls[consumer]}/v1/exchange/"
+                   f"{self.exchange_id}/{consumer}"
+                   f"?producer={producer}&seq={seq}")
 
         def send():
             if faults.ARMED:
@@ -394,6 +442,12 @@ class HttpExchange:
                                producer)
 
     def producer_done(self, producer: int) -> None:
+        if self.spool_to is not None:
+            # spooled streams complete by TASK COMMIT (the scheduler
+            # observes the finished status and commits the attempt's
+            # pages atomically); replay synthesizes consumer-side eos
+            # for every producer slot, so no eos travels here
+            return
         # eos is naturally idempotent (producer-set union), so the
         # retried POST needs no sequence number
         for c in range(self.n_consumers):
@@ -514,6 +568,10 @@ class Node:
         self.registry = ExchangeRegistry()
         self.n_devices = max(1, int(n_devices))
         self.tasks: Dict[str, TaskState] = {}
+        #: compile_cache.prewarm report of the last /v1/prewarm replay
+        #: (the distributed prewarm path), served on /v1/info
+        self.prewarm_report: Optional[dict] = None
+        self._prewarm_lock = sanitize.lock("node.prewarm")
         handler = type("BoundHandler", (NodeHandler,), {"node": self})
 
         class _Server(ThreadingHTTPServer):
@@ -556,7 +614,20 @@ class Node:
 
     def handle_get(self, path: str) -> bytes:
         if path == "/v1/info":
-            info = {"state": "active", "devices": self.n_devices}
+            info = {"state": "active", "devices": self.n_devices,
+                    # load feedback for the heartbeat tier: the
+                    # scheduler prefers lightly-loaded members and the
+                    # fleet memory enforcer gates dispatch on the
+                    # reported reservations
+                    "load": self._load_report(),
+                    "memory": {"reserved_bytes":
+                               self._memory_reserved()}}
+            if self.prewarm_report is not None:
+                # per-worker prewarm compile counts (the distributed
+                # prewarm satellite): /v1/prewarm stores the report,
+                # /v1/info serves it so the coordinator and benches
+                # can prove workers start warm
+                info["prewarm"] = self.prewarm_report
             if faults.ARMED:
                 # observability for env-armed subprocess workers:
                 # chaos tests assert the fault FIRED, not just that
@@ -608,6 +679,17 @@ class Node:
             spec = json.loads(body.decode())
             self.create_task(spec)
             return json.dumps({"taskId": spec["task_id"]}).encode()
+        if path == "/v1/prewarm":
+            # distributed AOT prewarm (closes the "workers start
+            # cold" gap): the coordinator forwards its prewarm_sql
+            # here at start; this node replays it through a local
+            # runner so ITS kernel caches are warm before traffic.
+            # Serialized under a lock — two coordinators prewarming
+            # one worker must not interleave reports
+            spec = json.loads(body.decode()) if body else {}
+            with self._prewarm_lock:
+                report = self._prewarm(spec)
+            return json.dumps(report).encode()
         if path.startswith("/v1/query/") and path.endswith("/release"):
             # end-of-query resource release (reference: TaskResource
             # DELETE /v1/task/{taskId}): abort the query's tasks and
@@ -629,6 +711,48 @@ class Node:
             return json.dumps({"taskId": tid,
                                "state": t.state}).encode()
         raise KeyError(path)
+
+    def _load_report(self) -> dict:
+        """Live load gauges for the heartbeat: running tasks on this
+        node plus the shared executor's queue depth (when one exists
+        in this process) — the scheduler's placement feedback."""
+        out = {"tasks_running": sum(
+            1 for t in list(self.tasks.values())
+            if t.state == "running")}
+        try:
+            from presto_tpu.execution.task_executor import (
+                get_task_executor,
+            )
+            ex = get_task_executor(create=False)
+            if ex is not None:
+                snap = ex.snapshot()
+                out["executor_running"] = snap["running_drivers"]
+                out["executor_queued"] = sum(snap["queued_drivers"])
+        except Exception:  # noqa: BLE001 — load report is best-effort
+            pass
+        return out
+
+    def _memory_reserved(self) -> int:
+        """Total reserved bytes across this process's tracked memory
+        pools (per-query pools + the cache pool) — the heartbeat's
+        fleet-memory report."""
+        total = 0
+        for pool in sanitize.tracked("memory_pool"):
+            try:
+                total += int(pool.reserved)
+            except Exception:  # noqa: BLE001 — a dying pool mid-sweep
+                pass
+        return total
+
+    def _prewarm(self, spec: dict) -> dict:
+        from presto_tpu.execution import compile_cache
+        from presto_tpu.runner.local import LocalRunner
+        statements = list(spec.get("statements") or [])
+        runner = LocalRunner(spec.get("catalog", "tpch"),
+                             spec.get("schema", "tiny"),
+                             dict(spec.get("properties") or {}))
+        self.prewarm_report = compile_cache.prewarm(runner, statements)
+        return self.prewarm_report
 
     # -- task execution ----------------------------------------------------
 
@@ -735,7 +859,12 @@ class Node:
             spec.get("consumer_urls_by_edge"), spec["worker_urls"],
             spec["coordinator_url"], self.registry,
             n_producers_by_edge=spec.get("n_producers_by_edge"),
-            self_url=self.url)
+            self_url=self.url,
+            # fault-tolerant task specs (server/scheduler.py) carry a
+            # private key namespace per attempt and spool their output
+            # pages at the coordinator instead of streaming downstream
+            key_ns=spec.get("exchange_ns"),
+            spool=spec.get("spool"))
         k = int(spec.get("local_count", 1))
         base = int(spec.get("local_base", spec.get("task_index", 0)))
         devices = [None] * k
@@ -837,15 +966,25 @@ def build_http_exchanges(query_id: str, fplan,
                          coordinator_url: str,
                          registry: ExchangeRegistry,
                          n_producers_by_edge=None,
-                         self_url: Optional[str] = None
+                         self_url: Optional[str] = None,
+                         key_ns: Optional[str] = None,
+                         spool: Optional[dict] = None
                          ) -> Dict[int, HttpExchange]:
     """One HttpExchange per edge. The coordinator pre-computes a
     GLOBAL consumer URL table per edge (one slot per consumer TASK —
     a mesh-per-worker node's url appears once per device) plus the
     global producer count, and ships both in the task spec so every
     node agrees; when absent (legacy/single-device callers) the table
-    degenerates to one slot per worker."""
+    degenerates to one slot per worker.
+
+    Fault-tolerant mode (server/scheduler.py): `key_ns` namespaces
+    the CONSUMER-side registry keys per task attempt (a retried task
+    must never see a failed sibling's half-drained queues) while
+    `spool` = {"url", "task", "attempt"} redirects every producer
+    push into the coordinator's TaskOutputSpool under the canonical
+    "<query_id>:<xid>" key."""
     out: Dict[int, HttpExchange] = {}
+    ns = key_ns or query_id
     for xid, edge in fplan.edges.items():
         consumer = fplan.fragments[edge.consumer]
         producer = fplan.fragments[edge.producer]
@@ -863,9 +1002,10 @@ def build_http_exchanges(query_id: str, fplan,
             n_producers = 1 if producer.partitioning == "single" \
                 else len(worker_urls)
         out[xid] = HttpExchange(
-            f"{query_id}:{xid}", edge.scheme, edge.partition_keys,
+            f"{ns}:{xid}", edge.scheme, edge.partition_keys,
             edge.hash_dicts, edge_key_dicts(edge), consumer_urls,
-            n_producers, registry, self_url=self_url)
+            n_producers, registry, self_url=self_url,
+            spool_to=spool, canonical_key=f"{query_id}:{xid}")
     return out
 
 

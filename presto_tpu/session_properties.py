@@ -116,6 +116,39 @@ SESSION_PROPERTIES: Dict[str, PropertyDef] = {p.name: p for p in [
         "(reference: per-section retries, max_stage_retries)",
         _non_negative),
     PropertyDef(
+        "task_retries", "bigint", 0,
+        "Per-task retry budget of the fault-tolerant stage scheduler "
+        "(server/scheduler.py): > 0 schedules each distributed "
+        "fragment as independently retryable tasks whose outputs "
+        "spool at the coordinator, so a dead worker re-runs only its "
+        "unfinished tasks and every finished task's spooled pages "
+        "are reused; 0 = the streaming path with whole-query elastic "
+        "retry only (reference: Trino fault-tolerant execution / "
+        "Project Tardigrade task retries)", _non_negative),
+    PropertyDef(
+        "task_partitions", "bigint", 0,
+        "Fixed partition (task) count per distributed fragment under "
+        "fault-tolerant execution; 0 derives one task per live "
+        "worker device at query start. A fixed count keeps hash "
+        "routing — and therefore results — byte-identical across "
+        "membership changes (reference: fault-tolerant-execution-"
+        "partition-count)", _non_negative),
+    PropertyDef(
+        "task_dispatch_stagger_ms", "bigint", 0,
+        "Artificial delay between consecutive task dispatches of the "
+        "stage scheduler (0 = none). A chaos/test knob: widens the "
+        "window in which a worker death lands mid-stage so recovery "
+        "tests are deterministic instead of racing dispatch",
+        _non_negative),
+    PropertyDef(
+        "fleet_memory_bytes", "bigint", None,
+        "Cluster-wide memory budget over the WORKER FLEET: per-worker "
+        "reserved bytes ride the heartbeat into the coordinator's "
+        "FleetMemoryEnforcer, and a query whose dispatch would "
+        "exceed the budget is SHED with the structured "
+        "cluster_memory kind instead of OOMing a worker (reference: "
+        "ClusterMemoryManager's cluster-wide limit)", _positive),
+    PropertyDef(
         "cluster_memory_bytes", "bigint", None,
         "Shared memory budget across ALL concurrently running queries "
         "of this runner/coordinator; on exhaustion the largest "

@@ -167,6 +167,25 @@ METRICS.describe("presto_tpu_admission_total",
 METRICS.describe("presto_tpu_admission_sheds_total",
                  "Queries shed by admission control, by kind "
                  "(rejected/queue_full/queue_expired) and group")
+METRICS.describe("presto_tpu_tasks_total",
+                 "Fault-tolerant scheduler tasks by status "
+                 "(dispatched/finished/failed/retried/reused) and "
+                 "attempt number — retried counts rescheduled "
+                 "attempts, reused counts committed tasks whose "
+                 "spooled output survived a worker loss")
+METRICS.describe("presto_tpu_heartbeat_probes_total",
+                 "Membership heartbeat probes by status (ok/failed)")
+METRICS.describe("presto_tpu_membership_transitions_total",
+                 "Worker membership transitions by destination state "
+                 "(suspected/removed/active/readmitted)")
+METRICS.describe("presto_tpu_spool_pages_total",
+                 "Task-output spool pages accepted, by tier "
+                 "(mem/disk)")
+METRICS.describe("presto_tpu_spool_bytes_total",
+                 "Task-output spool payload bytes accepted")
+METRICS.describe("presto_tpu_fleet_memory_sheds_total",
+                 "Queries shed by the fleet memory enforcer "
+                 "(cluster-wide reservation gate at dispatch)")
 
 
 def render_prometheus() -> str:
@@ -231,6 +250,35 @@ def render_prometheus() -> str:
             "presto_tpu_executor_tasks", "gauge",
             "Live tasks (queries/fragments) on the executor",
             [({}, snap["tasks"])]))
+    # fleet control-plane gauges: live membership states per
+    # heartbeat monitor and the task-output spool's footprint
+    try:
+        monitors = sanitize.tracked("heartbeat_monitor")
+    except Exception:  # noqa: BLE001
+        monitors = []
+    if monitors:
+        counts: Dict[str, float] = {}
+        for m in monitors:
+            for state, n in m.counts().items():
+                counts[state] = counts.get(state, 0) + n
+        extra.append((
+            "presto_tpu_workers", "gauge",
+            "Fleet members by membership state",
+            [({"state": s}, n) for s, n in sorted(counts.items())]))
+    try:
+        spools = sanitize.tracked("task_spool")
+    except Exception:  # noqa: BLE001
+        spools = []
+    if spools:
+        stats = [s.stats() for s in spools]
+        extra.append((
+            "presto_tpu_spool_bytes", "gauge",
+            "Memory-tier bytes held by task-output spools",
+            [({}, sum(s["bytes"] for s in stats))]))
+        extra.append((
+            "presto_tpu_spool_committed_tasks", "gauge",
+            "Committed (replayable) tasks across task-output spools",
+            [({}, sum(s["committed_tasks"] for s in stats))]))
     # per-group admission gauges (running + queue depth) across every
     # live ResourceGroupManager of this process
     try:

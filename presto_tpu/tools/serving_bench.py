@@ -32,6 +32,15 @@ Protocol:
           and the availability of ADMITTED queries — which must stay
           ~1.0 while sheds soak up the excess. Successes must remain
           byte-identical to warm.
+  worker-churn — (--worker-churn) the fleet-robustness story: a
+          MULTI-WORKER coordinator (fault-tolerant task retries over
+          spooled exchanges, fixed task partitions) serves the mix
+          while one worker per window is SIGKILLed and respawned on
+          its old port. Admitted availability must stay 1.0 — the
+          task-retry + elastic tiers absorb every death — and every
+          success must stay byte-identical to a pre-churn baseline
+          on the SAME topology; tasks retried vs reused and
+          membership transitions ride the report.
   restart-warm — (--restart-warm) the process-restart story: kernel
           LRUs + jax jit caches wiped (everything a coordinator
           reboot loses), caches cleared, then a NEW coordinator comes
@@ -204,7 +213,8 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
 
 #: shed kinds — admission refused the work; everything else that
 #: fails was ADMITTED and counts against availability
-SHED_KINDS = ("rejected", "queue_full")
+#: (cluster_memory = the fleet memory enforcer's dispatch gate)
+SHED_KINDS = ("rejected", "queue_full", "cluster_memory")
 
 
 def _run_overload_phase(url: str, resource_groups, clients: int,
@@ -340,6 +350,178 @@ def _run_overload_phase(url: str, resource_groups, clients: int,
     }, checks
 
 
+def _spawn_churn_worker(port: int = 0):
+    """One worker subprocess for the churn phase (same spawn shape as
+    tests/test_distributed.py). `port` > 0 re-binds a respawned
+    worker to its predecessor's address so the coordinator's
+    membership view re-admits it in place."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = {**os.environ, "PYTHONPATH": root}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.server.node",
+         "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    url = json.loads(proc.stdout.readline())["url"]
+    return proc, url
+
+
+def _run_worker_churn_phase(schema: str, work: List[Tuple[str, str]],
+                            clients: int, rounds: int,
+                            n_workers: int, kills: int,
+                            period_s: float, host: str) -> dict:
+    """Fault-tolerant fleet serving under worker CHURN: a
+    multi-worker coordinator (task_retries on, fixed task_partitions
+    so results stay byte-identical across membership changes) serves
+    the mix while a churn thread SIGKILLs one worker per window and
+    respawns it on the same port. Reports admitted availability
+    (must stay 1.0 — the task-retry + elastic tiers absorb every
+    death), tasks retried vs reused from the scheduler counters,
+    membership transitions, and the byte-identity oracle against a
+    pre-churn baseline on the SAME topology (a single-node baseline
+    would differ in float summation order)."""
+    import signal as _signal
+    from presto_tpu.server.coordinator import Coordinator
+    from presto_tpu.server.node import http_get
+    from presto_tpu.telemetry.metrics import METRICS
+    workers = [list(_spawn_churn_worker()) for _ in range(n_workers)]
+    urls = [w[1] for w in workers]
+    coord = Coordinator(
+        urls, "tpch", schema, host=host, port=0,
+        max_concurrent_queries=max(clients, 2),
+        properties={"task_retries": 2,
+                    "task_partitions": 2 * n_workers,
+                    "query_retries": 2},
+        heartbeat_interval_s=0.25)
+    stop_churn = threading.Event()
+    churn_log = {"kills": 0, "respawns": 0, "errors": []}
+
+    def churn():
+        for k in range(kills):
+            # between kills: wait for the previous respawn to be
+            # RE-ADMITTED by the heartbeat — the churn story is one
+            # loss at a time, not a cascading double failure
+            deadline = time.monotonic() + max(period_s * 10, 30)
+            while time.monotonic() < deadline \
+                    and not stop_churn.is_set():
+                if coord.membership.counts().get("active", 0) \
+                        == len(workers):
+                    break
+                time.sleep(0.05)
+            # synchronize with live traffic: the kill must land while
+            # the measured phase has a query in flight (the baseline
+            # phase finished before this thread started, so any
+            # RUNNING query here is measured-phase work)
+            deadline = time.monotonic() + max(period_s * 10, 30)
+            while time.monotonic() < deadline \
+                    and not stop_churn.is_set():
+                if any(q.state == "RUNNING"
+                       for q in list(coord.queries.values())
+                       if q.done_at is None):
+                    break
+                time.sleep(0.02)
+            if stop_churn.is_set():
+                return
+            i = k % len(workers)
+            proc, url = workers[i]
+            port = int(url.rsplit(":", 1)[1])
+            try:
+                proc.send_signal(_signal.SIGKILL)
+                proc.wait(timeout=10)
+                churn_log["kills"] += 1
+            except Exception as e:  # noqa: BLE001 — recorded
+                churn_log["errors"].append(repr(e))
+                continue
+            # the respawn is unconditional: a window that outlives
+            # the phase must still restore the fleet (the teardown
+            # SIGTERMs it like any other member)
+            stop_churn.wait(period_s / 2)
+            try:
+                nproc, nurl = _spawn_churn_worker(port)
+                workers[i][0] = nproc
+                churn_log["respawns"] += 1
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        if json.loads(http_get(
+                                f"{nurl}/v1/info", timeout=2)
+                                ).get("state") == "active":
+                            break
+                    except Exception:  # noqa: BLE001 — still booting
+                        time.sleep(0.1)
+            except Exception as e:  # noqa: BLE001 — recorded
+                churn_log["errors"].append(repr(e))
+
+    try:
+        coord.start()
+        coord.check_workers()
+        # pre-churn baseline on the SAME distributed topology: the
+        # byte-identity oracle for every success under churn
+        _, base_checks = _run_phase(coord.url, [list(work)],
+                                    timeout_s=300.0)
+        tasks0 = METRICS.by_label("presto_tpu_tasks_total", "status")
+        trans0 = METRICS.by_label(
+            "presto_tpu_membership_transitions_total", "to")
+        churn_t = threading.Thread(target=churn, daemon=True)
+        churn_t.start()
+        stats, checks = _run_phase(
+            coord.url, [list(work) * rounds for _ in range(clients)],
+            tolerant=True, timeout_s=300.0)
+        stop_churn.set()
+        churn_t.join(timeout=60)
+    finally:
+        stop_churn.set()
+        coord.stop()
+        for proc, _url in workers:
+            try:
+                proc.send_signal(_signal.SIGTERM)
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — last resort
+                try:
+                    proc.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+    shed = sum(v for k, v in stats.get("errors", {}).items()
+               if k in SHED_KINDS)
+    admitted = stats["queries"] - shed
+    consistent = all(
+        len(sums) == 1 and sums == base_checks.get(name)
+        for name, sums in checks.items())
+    doc = {
+        "workers": n_workers,
+        "clients": clients,
+        "rounds": rounds,
+        "churn": churn_log,
+        "offered": stats["queries"],
+        "succeeded": stats["succeeded"],
+        "failed": stats["failed"],
+        "shed": shed,
+        "errors": stats.get("errors", {}),
+        # the robustness headline: of the queries admission let in,
+        # how many answered despite workers dying under them
+        "availability_admitted": round(
+            stats["succeeded"] / admitted, 4) if admitted else None,
+        "wall_s": stats["wall_s"],
+        "qps": stats["qps"],
+        "p50_ms": stats["p50_ms"],
+        "p99_ms": stats["p99_ms"],
+        "tasks": METRICS.delta_by_label(
+            "presto_tpu_tasks_total", "status", tasks0),
+        "membership_transitions": METRICS.delta_by_label(
+            "presto_tpu_membership_transitions_total", "to", trans0),
+        "successes_match_baseline": consistent,
+    }
+    if not consistent:
+        raise RuntimeError(
+            "worker-churn successes diverged from the pre-churn "
+            "baseline: " + json.dumps(doc, indent=1))
+    return doc
+
+
 def _load_mix(mix: Sequence[str]) -> Dict[str, str]:
     from presto_tpu.tools.verifier import load_suite
     suite = load_suite("tpch")
@@ -364,6 +546,11 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       overload_concurrency: Optional[int] = None,
                       sanitize_phase: bool = False,
                       history_phase: bool = False,
+                      worker_churn: bool = False,
+                      churn_workers: int = 2,
+                      churn_rounds: int = 2,
+                      churn_kills: int = 1,
+                      churn_period_s: float = 3.0,
                       host: str = "127.0.0.1") -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
@@ -385,7 +572,10 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             overload=overload, overload_rounds=overload_rounds,
             overload_concurrency=overload_concurrency,
             sanitize_phase=sanitize_phase,
-            history_phase=history_phase, host=host)
+            history_phase=history_phase, worker_churn=worker_churn,
+            churn_workers=churn_workers, churn_rounds=churn_rounds,
+            churn_kills=churn_kills, churn_period_s=churn_period_s,
+            host=host)
     finally:
         if auto_cache_dir is not None:
             import shutil
@@ -402,7 +592,9 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    overload_rounds: int,
                    overload_concurrency: Optional[int],
                    sanitize_phase: bool, history_phase: bool,
-                   host: str) -> dict:
+                   worker_churn: bool, churn_workers: int,
+                   churn_rounds: int, churn_kills: int,
+                   churn_period_s: float, host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
@@ -660,6 +852,15 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                 "byte-identical): "
                 + json.dumps(history_doc, indent=1))
 
+    churn_doc = None
+    if worker_churn:
+        # the fleet-robustness phase: real worker subprocesses dying
+        # and respawning under live traffic, absorbed by the
+        # task-retry tier (server/scheduler.py)
+        churn_doc = _run_worker_churn_phase(
+            schema, work, clients, churn_rounds, churn_workers,
+            churn_kills, churn_period_s, host)
+
     fusion = None
     if fusion_report:
         # per-query fragments fused vs fallen back (with reasons) —
@@ -700,6 +901,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "sanitize": sanitize_doc,
         "fusion": fusion,
         "history": history_doc,
+        "worker_churn": churn_doc,
     }
     if not identical:
         raise RuntimeError(
@@ -757,6 +959,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "fresh store, measure + re-plan each mix "
                         "query, emit first-vs-second plan deltas, "
                         "fusion upgrades, and history counters")
+    p.add_argument("--worker-churn", action="store_true",
+                   help="run the fleet-churn phase: a multi-worker "
+                        "coordinator with task-level retries serves "
+                        "the mix while one worker per window is "
+                        "SIGKILLed and respawned; reports admitted "
+                        "availability, tasks retried vs reused, and "
+                        "the byte-identity oracle")
+    p.add_argument("--churn-workers", type=int, default=2)
+    p.add_argument("--churn-rounds", type=int, default=2)
+    p.add_argument("--churn-kills", type=int, default=1)
+    p.add_argument("--churn-period", type=float, default=3.0,
+                   help="seconds between churn events")
     p.add_argument("--fusion-report", action="store_true",
                    help="embed the per-query whole-fragment fusion "
                         "coverage (fused chains + fallback reasons, "
@@ -772,7 +986,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         cache_dir=args.cache_dir, fusion_report=args.fusion_report,
         overload=args.overload, overload_rounds=args.overload_rounds,
         overload_concurrency=args.overload_concurrency,
-        sanitize_phase=args.sanitize, history_phase=args.history)
+        sanitize_phase=args.sanitize, history_phase=args.history,
+        worker_churn=args.worker_churn,
+        churn_workers=args.churn_workers,
+        churn_rounds=args.churn_rounds,
+        churn_kills=args.churn_kills,
+        churn_period_s=args.churn_period)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

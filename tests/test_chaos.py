@@ -737,6 +737,64 @@ def test_cancel_distributed_query_aborts_worker_tasks(worker):
         coord.stop()
 
 
+def test_fleet_fault_sites_chaos_battery(worker):
+    """The three fleet seams (worker.heartbeat, task.status_poll,
+    spool.read) under periodic seeded faults through a FAULT-TOLERANT
+    coordinator: heartbeat failures flip suspicion without removal,
+    poll drops are absorbed by the poll retry budget, and a spool
+    read-back failure on the ROOT's replay fails the query CLEANLY —
+    the chaos contract (byte-identical or structured, never a hang,
+    never a wrong answer) holds at every seam."""
+    from presto_tpu.runner import LocalRunner
+    from presto_tpu.server.coordinator import (
+        Coordinator, QueryLifecycle,
+    )
+    coord = Coordinator([worker], "tpch", "tiny",
+                        {"task_retries": 2, "task_partitions": 2},
+                        heartbeat_interval_s=0.2)
+    try:
+        coord.start()
+        want = sorted(LocalRunner("tpch", "tiny")
+                      .execute(SQL_AGG).rows())
+        # heartbeat churn (every 2nd probe fails -> suspected, never
+        # removed with the default remove_after=3) + one dropped poll
+        # (the 2nd — every task is polled at least once, so with two
+        # tasks the site always reaches it), both absorbed below the
+        # task-retry tier
+        hb = faults.arm("worker.heartbeat", trigger="every", n=2)
+        poll = faults.arm("task.status_poll", trigger="nth", n=2)
+        lc = QueryLifecycle()
+        got = sorted(coord.execute(SQL_AGG, lifecycle=lc).rows())
+        assert got == want
+        assert lc.attempts == 1
+        time.sleep(0.5)  # let a few heartbeat rounds land
+        assert hb.fired >= 1, "heartbeat fault never fired — vacuous"
+        assert poll.fired >= 1, "poll fault never fired — vacuous"
+        assert coord.membership.is_alive(worker)
+        faults.disarm()
+        # spool.read on the FIRST replayed page: a worker-task replay
+        # absorbs it at the task-retry tier (byte-identical success);
+        # a root replay fails the query CLEANLY with the injected
+        # error — the chaos contract either way, never a wrong answer
+        inj = faults.arm("spool.read", trigger="once")
+        lc2 = QueryLifecycle()
+        try:
+            got = sorted(coord.execute(SQL_AGG,
+                                       lifecycle=lc2).rows())
+            assert got == want  # absorbed below whole-query retry
+            assert lc2.attempts == 1
+        except faults.InjectedFault:
+            pass  # the clean-structured-failure arm
+        assert inj.fired == 1, "spool.read never fired — vacuous"
+        faults.disarm()
+        got = sorted(coord.execute(SQL_AGG).rows())
+        assert got == want  # the machine is clean after the fault
+        assert coord.task_spool.stats()["pages"] == 0
+    finally:
+        faults.disarm()
+        coord.stop()
+
+
 # ---------------------------------------------------------------------------
 # concurrent chaos through the time-sliced executor (PR 8)
 

@@ -114,6 +114,36 @@ def test_serving_bench_restart_warm_phase(tmp_path):
     reset_cache_manager()
 
 
+def test_serving_bench_worker_churn_phase():
+    """--worker-churn: a multi-worker fault-tolerant coordinator
+    serves the mix while one worker is SIGKILLed and respawned
+    mid-phase. Admitted availability must be 1.0 (the task-retry +
+    elastic tiers absorb the death), successes stay byte-identical
+    to the pre-churn baseline on the same topology, and the task
+    counters report retried-vs-reused."""
+    from presto_tpu.cache import reset_cache_manager
+    from presto_tpu.tools.serving_bench import run_serving_bench
+    reset_cache_manager()
+    doc = run_serving_bench(
+        clients=2, schema="tiny", mix=("q6",), warm_rounds=1,
+        verify_off=False, worker_churn=True, churn_workers=2,
+        churn_rounds=2, churn_kills=1, churn_period_s=2.0)
+    churn = doc["worker_churn"]
+    for key in ("workers", "churn", "offered", "succeeded", "shed",
+                "availability_admitted", "qps", "tasks",
+                "membership_transitions",
+                "successes_match_baseline"):
+        assert key in churn, key
+    assert churn["churn"]["kills"] == 1
+    assert churn["churn"]["respawns"] == 1
+    assert churn["offered"] == 2 * 2  # clients x rounds x |mix|
+    # the acceptance bar: every admitted query answered
+    assert churn["availability_admitted"] == 1.0
+    assert churn["successes_match_baseline"] is True
+    assert churn["tasks"].get("finished", 0) > 0
+    reset_cache_manager()
+
+
 @pytest.mark.slow
 def test_serving_bench_full_capture_shape():
     """The committed-capture configuration end to end (small scale)."""
