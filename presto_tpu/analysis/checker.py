@@ -1,0 +1,384 @@
+"""The four machine-checked kernel contracts (docs/KERNEL_CONTRACTS.md):
+
+  KC001 pad-invariance — taint.analyze proves dead-lane inputs reach
+        live outputs only through mask-guarded selects/clips; a leak
+        is reported with the offending jaxpr eqn and source line
+  KC002 retrace budget — fingerprint-identical traces across operand
+        variants per bucket, predicted distinct compiles <= the
+        declared ladder budget, and (unless declared otherwise)
+        bucket-size-independent structure
+  KC003 purity — no host-callback/debug/side-effecting primitives
+        anywhere in a traced body (the semantic upgrade of the
+        syntactic TS002/TS003 lint: this sees through every layer of
+        composition because it reads the IR jax actually emits)
+  KC004 dtype stability — traced output dtypes match the declared
+        operator output schema (Column.data vs Column.type, bool
+        masks), implicit promotions (f32->f64, i32->i64) reported
+  KC005 coverage — every family name registered with
+        instrument_kernel in the source tree carries a contract
+
+All checks trace via jax.make_jaxpr / jax.eval_shape over
+ShapeDtypeStruct inputs: nothing executes, nothing compiles, no data
+exists. A full --all run is host-side Python only."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from presto_tpu.analysis import fingerprint as fp
+from presto_tpu.analysis import taint
+from presto_tpu.analysis.contracts import (
+    CONTRACT_MODULES, KernelContract, all_contracts, flat_roles,
+)
+
+RULES: Dict[str, str] = {
+    "KC001": "pad-invariance: dead-lane garbage escapes into a live "
+             "output",
+    "KC002": "retrace budget: operand variants or ladder points mint "
+             "extra compiles",
+    "KC003": "purity: side-effecting primitive inside a traced body",
+    "KC004": "dtype stability: traced output dtype differs from the "
+             "declared schema",
+    "KC005": "coverage: kernel family has no registered "
+             "KernelContract",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    family: str
+    point: str            # "cap=4096 variant={...}" or ""
+    message: str
+    source: str = ""      # "file:line (fn)" for KC001
+    suppressed: Optional[str] = None
+
+    def fingerprint(self) -> str:
+        """Point-free identity (stable across ladder re-tuning)."""
+        return f"{self.family}::{self.rule}::{self.message[:160]}"
+
+    def render(self) -> str:
+        sup = f"  [suppressed: {self.suppressed}]" \
+            if self.suppressed else ""
+        loc = f" [{self.source}]" if self.source else ""
+        pt = f" @{self.point}" if self.point else ""
+        return f"{self.family}{pt}: {self.rule} {self.message}" \
+               f"{loc}{sup}"
+
+
+@dataclasses.dataclass
+class CheckResult:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    errors: List[str]
+    #: family -> predicted distinct compiles over the sampled grid
+    predicted: Dict[str, int]
+
+
+def load_contract_modules() -> None:
+    for mod in CONTRACT_MODULES:
+        importlib.import_module(mod)
+
+
+# ---------------------------------------------------------------------------
+# per-contract checks
+
+
+def _trace(point):
+    """(ClosedJaxpr, output ShapeDtypeStruct pytree) in ONE trace —
+    the dtype check reuses the shape tree instead of re-tracing."""
+    import jax
+    return jax.make_jaxpr(point.fn, return_shape=True)(*point.args)
+
+
+def _point_label(cap: int, variant: dict) -> str:
+    v = "" if not variant else f" {variant}"
+    return f"cap={cap}{v}"
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for sub in vs:
+                inner = getattr(sub, "jaxpr", None)
+                if inner is None and hasattr(sub, "eqns"):
+                    inner = sub
+                if inner is not None:
+                    yield from _walk_eqns(inner)
+
+
+def _check_pad(c: KernelContract, cap: int, variant: dict,
+               closed, point) -> List[Finding]:
+    roles = flat_roles(point.roles)
+    n_in = len(closed.jaxpr.invars)
+    if len(roles) != n_in:
+        return [Finding(
+            "KC001", c.family, _point_label(cap, variant),
+            f"contract role tree has {len(roles)} leaves for "
+            f"{n_in} traced inputs — the builder's roles twin does "
+            "not mirror its args")]
+    avs = [taint.av_for_role(r) for r in roles]
+    outs, leaks = taint.analyze(closed, avs)
+    out: List[Finding] = []
+    poisoned = [i for i, av in enumerate(outs)
+                if av.taint == taint.POISON]
+    if poisoned:
+        for leak in leaks or [taint.Leak("<propagated>", "<unknown>",
+                                         "poison reached an output")]:
+            out.append(Finding(
+                "KC001", c.family, _point_label(cap, variant),
+                f"dead-lane garbage reaches output(s) {poisoned} "
+                f"via {leak.primitive}: {leak.detail}",
+                source=leak.source))
+    return out
+
+
+def _check_purity(c: KernelContract, cap: int, variant: dict,
+                  closed) -> List[Finding]:
+    out: List[Finding] = []
+    effects = getattr(closed, "effects", None) or \
+        getattr(closed.jaxpr, "effects", None)
+    if effects:
+        out.append(Finding(
+            "KC003", c.family, _point_label(cap, variant),
+            f"traced body carries jax effects {sorted(map(str, effects))!r}"
+            " — kernels must be pure (host callbacks deadlock against "
+            "the driver's blocking reads, see ops/common.py)"))
+    for eqn in _walk_eqns(closed.jaxpr):
+        if eqn.primitive.name in taint.IMPURE_PRIMITIVES:
+            try:
+                from jax._src import source_info_util
+                src = source_info_util.summarize(eqn.source_info)
+            except Exception:  # noqa: BLE001
+                src = "<unknown>"
+            out.append(Finding(
+                "KC003", c.family, _point_label(cap, variant),
+                f"side-effecting primitive {eqn.primitive.name!r} "
+                "inside the traced body", source=src))
+    return out
+
+
+def _dtype_findings(c: KernelContract, cap: int, variant: dict,
+                    out_tree) -> List[Finding]:
+    import numpy as np
+    from presto_tpu.batch import Batch, Column
+    out: List[Finding] = []
+    label = _point_label(cap, variant)
+
+    def visit(x, path: str) -> None:
+        if isinstance(x, Batch):
+            rv = x.row_valid
+            if getattr(rv, "dtype", None) is not None \
+                    and np.dtype(rv.dtype) != np.dtype(bool):
+                out.append(Finding(
+                    "KC004", c.family, label,
+                    f"{path}.row_valid traced as {rv.dtype}, "
+                    "must be bool"))
+            for name, col in x.columns.items():
+                visit(col, f"{path}.{name}")
+            return
+        if isinstance(x, Column):
+            declared = np.dtype(x.type.np_dtype)
+            traced = np.dtype(x.data.dtype)
+            if traced != declared:
+                kind = "implicit promotion" \
+                    if traced.itemsize > declared.itemsize \
+                    else "narrowing"
+                out.append(Finding(
+                    "KC004", c.family, label,
+                    f"{path}: declared {x.type!r} ({declared}) but "
+                    f"traced {traced} — {kind} breaks the schema "
+                    "contract (and doubles exchange bytes for "
+                    "promotions)"))
+            if np.dtype(x.mask.dtype) != np.dtype(bool):
+                out.append(Finding(
+                    "KC004", c.family, label,
+                    f"{path}.mask traced as {x.mask.dtype}, must be "
+                    "bool"))
+            return
+        if isinstance(x, (tuple, list)):
+            for i, e in enumerate(x):
+                visit(e, f"{path}[{i}]")
+            return
+        if isinstance(x, dict):
+            for k, e in x.items():
+                visit(e, f"{path}[{k!r}]")
+            return
+        # non-batch pytrees (states, tables, scalars): dtype drift
+        # across them is caught by KC002's exact fingerprints, which
+        # include every aval
+
+    visit(out_tree, "out")
+    return out
+
+
+def check_contract(c: KernelContract) -> Tuple[List[Finding], int]:
+    """Run KC001..KC004 over the contract's grid. Returns (findings,
+    predicted distinct compiles)."""
+    findings: List[Finding] = []
+    exact_by_bucket: Dict[int, List[Tuple[dict, str]]] = {}
+    normalized: Dict[Tuple[int, str], str] = {}
+    all_exact: Set[str] = set()
+
+    for cap in c.buckets:
+        for variant in c.variants:
+            label = _point_label(cap, variant)
+            try:
+                point = c.build(cap, dict(variant))
+                closed, out_shapes = _trace(point)
+            except Exception as e:  # noqa: BLE001 — surface as finding
+                findings.append(Finding(
+                    "KC002", c.family, label,
+                    f"tracing failed: {type(e).__name__}: {e}"))
+                continue
+            exact = fp.exact_fingerprint(closed)
+            norm = fp.normalized_fingerprint(closed)
+            exact_by_bucket.setdefault(cap, []).append(
+                (variant, exact))
+            normalized[(cap, exact)] = norm
+            all_exact.add(exact)
+            findings.extend(_check_pad(c, cap, variant, closed, point))
+            findings.extend(_check_purity(c, cap, variant, closed))
+            findings.extend(_dtype_findings(c, cap, variant,
+                                            out_shapes))
+
+    # variant stability: at one bucket every operand variant must
+    # share one trace — distinct fingerprints here are exactly the
+    # "LIMIT 10 vs LIMIT 50 compile twice" class
+    for cap, pairs in exact_by_bucket.items():
+        distinct = {e for _, e in pairs}
+        if len(distinct) > 1:
+            norms = {normalized[(cap, e)] for e in distinct}
+            hint = ("normalized structures match: an operand VALUE "
+                    "is baked into the trace — pass it as a traced "
+                    "operand, not a static/python constant"
+                    if len(norms) == 1 else
+                    "trace STRUCTURE differs between variants — the "
+                    "kernel branches at trace time on an operand")
+            byv = ", ".join(f"{v or '{}'}" for v, _ in pairs)
+            findings.append(Finding(
+                "KC002", c.family, f"cap={cap}",
+                f"{len(distinct)} distinct traces across operand "
+                f"variants [{byv}]; {hint}"))
+
+    predicted = len(all_exact)
+    if predicted > c.budget:
+        findings.append(Finding(
+            "KC002", c.family, "",
+            f"predicted {predicted} distinct compiles over "
+            f"{len(c.buckets)} ladder buckets x {len(c.variants)} "
+            f"variants exceeds the declared ladder budget "
+            f"{c.budget}"))
+
+    if not c.structure_varies:
+        norms = {normalized[(cap, e)]
+                 for cap, pairs in exact_by_bucket.items()
+                 for _, e in pairs}
+        if len(norms) > 1:
+            findings.append(Finding(
+                "KC002", c.family, "",
+                "jaxpr structure varies across bucket sizes (eqn "
+                "sequence is not identical-up-to-shape-constants); "
+                "declare structure_varies with a reason if the "
+                "kernel legitimately unrolls per-bucket (log2 "
+                "searches), otherwise a trace-time branch on "
+                "capacity is hiding here"))
+
+    # contract-level reasoned suppressions (the lint-ok analog)
+    for f in findings:
+        reason = c.suppression_for(f.rule)
+        if reason is not None:
+            f.suppressed = reason
+    return findings, predicted
+
+
+# ---------------------------------------------------------------------------
+# coverage: registered telemetry families vs declared contracts
+
+
+def registered_families(root: Optional[str] = None) -> Set[str]:
+    """Family names passed as string literals to instrument_kernel
+    anywhere under presto_tpu/ (AST scan — works on a broken tree,
+    same stance as tools/lint.py)."""
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    fams: Set[str] = set()
+    for dirpath, _, names in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for n in names:
+            if not n.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, n)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                t = fn.attr if isinstance(fn, ast.Attribute) else \
+                    fn.id if isinstance(fn, ast.Name) else None
+                if t not in ("instrument_kernel", "_instr"):
+                    continue
+                if len(node.args) >= 2 and isinstance(
+                        node.args[1], ast.Constant) and isinstance(
+                        node.args[1].value, str):
+                    fams.add(node.args[1].value)
+    return fams
+
+
+def coverage_findings() -> List[Finding]:
+    declared = set(all_contracts())
+    out: List[Finding] = []
+    for fam in sorted(registered_families() - declared):
+        out.append(Finding(
+            "KC005", fam, "",
+            "kernel family is registered with instrument_kernel but "
+            "carries no KernelContract — declare one next to the "
+            "kernel (see docs/KERNEL_CONTRACTS.md)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def check_families(families: Optional[Sequence[str]] = None,
+                   with_coverage: bool = True) -> CheckResult:
+    load_contract_modules()
+    registry = all_contracts()
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    errors: List[str] = []
+    predicted: Dict[str, int] = {}
+    wanted = set(families) if families is not None else None
+    for fam in sorted(registry):
+        if wanted is not None and fam not in wanted:
+            continue
+        for c in registry[fam]:
+            try:
+                got, pred = check_contract(c)
+            except Exception as e:  # noqa: BLE001 — checker bug
+                errors.append(f"{fam}: {type(e).__name__}: {e}")
+                continue
+            predicted[fam] = predicted.get(fam, 0) + pred
+            for f in got:
+                (suppressed if f.suppressed else findings).append(f)
+    if wanted is not None:
+        missing = wanted - set(registry)
+        for fam in sorted(missing):
+            errors.append(f"unknown family {fam!r} (no contract "
+                          "registered)")
+    if with_coverage and wanted is None:
+        findings.extend(coverage_findings())
+    findings.sort(key=lambda f: (f.family, f.rule, f.point))
+    return CheckResult(findings, suppressed, errors, predicted)

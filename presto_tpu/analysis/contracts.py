@@ -1,0 +1,168 @@
+"""KernelContract — the per-family spec the checker enforces.
+
+A contract is declared NEXT TO the kernels it covers (at the bottom of
+each kernel module, beside the instrument_kernel registrations), and
+names everything the checker needs to abstract-interpret the family
+without executing data:
+
+  * `build(cap, variant)` — a TracePoint: the traceable entry point
+    (statics bound in a closure), abstract inputs at capacity `cap`
+    (jax.ShapeDtypeStruct leaves — no data is ever materialized), and
+    a parallel ROLE tree marking which leaves are raw column data
+    (pad-dirty), which are validity masks, and which are
+    garbage-free upstream state
+  * `buckets` — the power-of-four ladder points to sample (>= 3)
+  * `variants` — operand variations that MUST share one compile per
+    bucket (LIMIT values, top-k, modes); the retrace contract fails
+    if any variant's trace fingerprint differs
+  * `ladder_budget` — max distinct compiles over the sampled grid
+    (default: one per bucket — the shape-bucket invariant)
+  * `structure_varies` + reason — declared opt-out of the
+    cross-bucket structural-identity check, for kernels whose eqn
+    count legitimately depends on the bucket (log2-unrolled binary
+    searches); the reason is surfaced in --json output
+  * `suppress` — (rule_id, reason) pairs: the same reasoned-
+    suppression workflow as tools/lint.py, for findings that are
+    analysis imprecision rather than kernel bugs
+
+Registration is import-time and cheap (builders are lazy); the
+checker imports CONTRACT_MODULES to populate the registry, then
+cross-checks it against the instrument_kernel family names found in
+the source tree so an uncovered family is itself a finding."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: modules whose import registers every contract (kept here so the
+#: checker, the CLI and the tests agree on the full set)
+CONTRACT_MODULES = (
+    "presto_tpu.batch",
+    "presto_tpu.ops.sort",
+    "presto_tpu.ops.merge",
+    "presto_tpu.ops.window",
+    "presto_tpu.ops.join",
+    "presto_tpu.operators.core",
+    "presto_tpu.operators.fused_fragment",
+    "presto_tpu.operators.aggregation",
+    "presto_tpu.operators.misc_ops",
+    "presto_tpu.operators.exchange_ops",
+    "presto_tpu.operators.array_agg",
+    "presto_tpu.execution.dynamic_filters",
+)
+
+#: the default ladder sample: three points of the power-of-four
+#: kernel-capacity ladder (batch.quantized_capacity)
+DEFAULT_BUCKETS = (4096, 16384, 65536)
+
+
+@dataclasses.dataclass
+class TracePoint:
+    """One traceable configuration of a family: `fn` takes exactly
+    `args` (statics pre-bound), `roles` mirrors `args`' pytree
+    structure with taint.ROLE_* strings at the leaves."""
+    fn: Callable
+    args: tuple
+    roles: tuple
+
+
+@dataclasses.dataclass
+class KernelContract:
+    family: str
+    module: str                       # dotted defining module
+    build: Callable                   # (cap, variant) -> TracePoint
+    buckets: Tuple[int, ...] = DEFAULT_BUCKETS
+    variants: Tuple[dict, ...] = ({},)
+    ladder_budget: Optional[int] = None   # default: len(buckets)
+    structure_varies: bool = False
+    structure_reason: str = ""
+    suppress: Tuple[Tuple[str, str], ...] = ()
+    notes: str = ""
+
+    def __post_init__(self):
+        if self.structure_varies and not self.structure_reason:
+            raise ValueError(
+                f"contract {self.family!r}: structure_varies requires "
+                "a reason (same rule as lint suppressions)")
+
+    @property
+    def budget(self) -> int:
+        return self.ladder_budget if self.ladder_budget is not None \
+            else len(self.buckets)
+
+    def suppression_for(self, rule_id: str) -> Optional[str]:
+        for rid, reason in self.suppress:
+            if rid == rule_id and reason:
+                return reason
+        return None
+
+
+_REGISTRY: Dict[str, List[KernelContract]] = {}
+
+
+def register_contract(contract: KernelContract) -> KernelContract:
+    _REGISTRY.setdefault(contract.family, []).append(contract)
+    return contract
+
+
+def all_contracts() -> Dict[str, List[KernelContract]]:
+    return dict(_REGISTRY)
+
+
+def contract_for(family: str) -> List[KernelContract]:
+    return list(_REGISTRY.get(family, ()))
+
+
+# ---------------------------------------------------------------------------
+# abstract input builders (no data — ShapeDtypeStruct leaves only)
+
+
+def sds(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def abstract_column(cap: int, typ, dictionary=None):
+    """(Column of abstract arrays, role twin). The twin is a Column of
+    the SAME pytree structure whose leaves are role strings, so
+    flattening both yields aligned (leaf, role) pairs."""
+    from presto_tpu.batch import Column
+    from presto_tpu.analysis import taint
+    import numpy as np
+    col = Column(sds((cap,), np.dtype(typ.np_dtype)),
+                 sds((cap,), np.bool_), typ, dictionary)
+    role = Column(taint.ROLE_DATA, taint.ROLE_MASK, typ, dictionary)
+    return col, role
+
+
+def abstract_batch(cap: int, schema: Sequence[tuple]):
+    """(Batch, role twin) for [(name, Type)] or
+    [(name, Type, dictionary)] schemas."""
+    from presto_tpu.batch import Batch
+    from presto_tpu.analysis import taint
+    import numpy as np
+    cols, roles = {}, {}
+    for entry in schema:
+        name, typ = entry[0], entry[1]
+        dic = entry[2] if len(entry) > 2 else None
+        cols[name], roles[name] = abstract_column(cap, typ, dic)
+    return (Batch(cols, sds((cap,), np.bool_)),
+            Batch(roles, taint.ROLE_MASK))
+
+
+def role_like(tree, role: str):
+    """A role twin marking EVERY leaf of `tree` with one role (state
+    accumulators, build tables: garbage-free upstream by the modular
+    contract — each family is checked against ITS OWN inputs' dead
+    lanes, upstream outputs are assumed canonical because the
+    upstream family's own contract proves them so)."""
+    import jax
+    return jax.tree_util.tree_map(lambda _: role, tree)
+
+
+def flat_roles(args_roles) -> List[str]:
+    """Flatten a roles twin into the leaf-order list the taint seeder
+    consumes; validates alignment against the args tree."""
+    import jax
+    return [r for r in jax.tree_util.tree_leaves(args_roles)]

@@ -1,0 +1,71 @@
+"""Predicted-vs-live compile-count cross-check (the runtime half of
+the kernel contract checker).
+
+The static contracts (checker.py) prove one compile per input
+signature per family: operand values never bake into traces, structure
+never forks on data. If that holds live, then over any workload
+
+    distinct input signatures observed  >=  fresh traces paid
+
+with equality on a cold kernel cache. telemetry/kernels' armed
+signature tracking counts the left side; the kernel_retrace_total
+Prometheus counter counts the right. A family whose live retraces
+EXCEED its observed signatures broke the contract at runtime — some
+retrace source the static grid did not model (a new value-keyed
+static, a dtype drift, a host-side structure fork) — and the serving
+gate (tests/test_kernelcheck.py) fails on it. live < predicted is
+legal: warm jit caches satisfy signatures without retracing.
+
+Usage:
+    snap = runtime.begin_tracking()
+    ... run the workload ...
+    report = runtime.cross_check(snap)   # also disarms
+    assert not report["divergent"], report
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def begin_tracking() -> Dict[str, float]:
+    """Arm signature tracking and snapshot the per-family live
+    retrace counters; returns the snapshot to hand to cross_check."""
+    from presto_tpu.telemetry import kernels
+    from presto_tpu.telemetry.metrics import METRICS
+    kernels.arm_signature_tracking(True)
+    return dict(METRICS.by_label("presto_tpu_kernel_retrace_total",
+                                 "kernel"))
+
+
+def live_retraces(snapshot: Dict[str, float]) -> Dict[str, int]:
+    from presto_tpu.telemetry.metrics import METRICS
+    now = METRICS.by_label("presto_tpu_kernel_retrace_total", "kernel")
+    out: Dict[str, int] = {}
+    for fam, v in now.items():
+        d = int(v - snapshot.get(fam, 0))
+        if d:
+            out[fam] = d
+    return out
+
+
+def cross_check(snapshot: Dict[str, float],
+                disarm: bool = True) -> Dict:
+    """Compare predicted (distinct signatures) against live retrace
+    deltas per family. Returns {"families": {fam: {"predicted": n,
+    "live": n}}, "divergent": [fam...]} — divergent families paid
+    more fresh traces than they saw distinct input signatures."""
+    from presto_tpu.telemetry import kernels
+    predicted = kernels.signature_report()
+    live = live_retraces(snapshot)
+    if disarm:
+        kernels.arm_signature_tracking(False)
+    fams: Dict[str, Dict[str, int]] = {}
+    divergent: List[str] = []
+    for fam in sorted(set(predicted) | set(live)):
+        p = predicted.get(fam, 0)
+        l = live.get(fam, 0)
+        fams[fam] = {"predicted": p, "live": l}
+        if l > p:
+            divergent.append(fam)
+    return {"families": fams, "divergent": divergent}

@@ -462,6 +462,37 @@ _compact_shrink = _instr(_compact_shrink_jit, "compact",
                          jits=[_compact_shrink_jit])
 
 
+# -- kernel contracts (tools/kernelcheck.py) ---------------------------
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch as _abstract_batch,
+    register_contract as _register_contract,
+)
+
+
+def _compact_contract_schema():
+    from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+    return [("a", BIGINT), ("b", DOUBLE), ("s", VARCHAR, ("x", "y"))]
+
+
+def _compact_point(cap, variant):
+    b, rb = _abstract_batch(cap, _compact_contract_schema())
+    return TracePoint(lambda batch: _compact_jit(batch), (b,), (rb,))
+
+
+def _compact_shrink_point(cap, variant):
+    b, rb = _abstract_batch(cap, _compact_contract_schema())
+    return TracePoint(
+        lambda batch: _compact_shrink_jit(batch, cap // 4),
+        (b,), (rb,))
+
+
+_register_contract(KernelContract(
+    family="compact", module=__name__, build=_compact_point))
+_register_contract(KernelContract(
+    family="compact", module=__name__, build=_compact_shrink_point,
+    notes="the bounded-nonzero shrink entry point"))
+
+
 #: Outputs at or under this capacity skip the deferred count/compact
 #: round entirely — the padding is too small to matter downstream.
 COMPACT_FLOOR = 8192

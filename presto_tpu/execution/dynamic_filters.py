@@ -270,3 +270,41 @@ def apply(batch: Batch, col: str, f: DFilter) -> Batch:
 # back-compat alias (pre-set callers)
 def apply_bounds(batch: Batch, col: str, mn, mx) -> Batch:
     return apply_filter(batch, col, mn, mx, False)
+
+
+# -- kernel contracts (tools/kernelcheck.py) ---------------------------
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, register_contract, sds,
+)
+
+
+def _bounds_point(cap, variant):
+    import numpy as np
+    dt = np.int64
+    return TracePoint(
+        lambda s, d, m: bounds_step.__wrapped__(s, d, m),
+        ((sds((), dt), sds((), dt)), sds((cap,), dt),
+         sds((cap,), np.bool_)),
+        (("clean", "clean"), "data", "mask"))
+
+
+def _distinct_set_point(cap, variant):
+    import numpy as np
+    return TracePoint(
+        lambda d, m: distinct_set(d, m),
+        (sds((cap,), np.int64), sds((cap,), np.bool_)),
+        ("data", "mask"))
+
+
+register_contract(KernelContract(
+    family="dynamic_filter", module=__name__, build=_bounds_point))
+register_contract(KernelContract(
+    family="dynamic_filter", module=__name__,
+    build=_distinct_set_point,
+    structure_varies=True,
+    structure_reason="distinct_set packs into the fixed DF_SET_MAX "
+                     "slot count: inputs at or below it take the pad "
+                     "branch, larger ones the slice branch — a "
+                     "deliberate static-shape fork on capacity, one "
+                     "program per side",
+    notes="bounded distinct-set build (sort + boundary dedupe)"))

@@ -873,3 +873,126 @@ class AggregationOperatorFactory(OperatorFactory):
             self.key_names, self.key_exprs, self.specs, self.mode,
             self.max_groups, self._step_kernel,
             chain_compacted=getattr(self, "_chain_compacted", False))
+
+
+# -- kernel contracts (tools/kernelcheck.py) ---------------------------
+#
+# agg_step kernels are built per plan from compiled key/input
+# expressions; the contracts trace the shared hashagg cores the built
+# kernels dispatch to (batch_aggregate / presorted_aggregate /
+# merge_partials / finalize) with representative agg layouts over the
+# dtype lattice. Dead rows must contribute reduce identities — the
+# taint walk proves init/_gate neutralize every contribution before
+# the segment reductions.
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, register_contract, sds,
+)
+
+
+def _contract_aggs():
+    from presto_tpu.types import DOUBLE, REAL
+    return (hashagg.make_count(None), hashagg.make_sum(DOUBLE, DOUBLE),
+            hashagg.make_min(REAL))
+
+
+def _agg_inputs(cap):
+    import numpy as np
+    rv = sds((cap,), np.bool_)
+    kd, km = sds((cap,), np.int64), sds((cap,), np.bool_)
+    sd = sds((cap,), np.float64)
+    md = sds((cap,), np.float32)
+    return (rv, kd, km, sd, rv, md, rv), \
+        ("mask", "data", "mask", "data", "mask", "data", "mask")
+
+
+def _agg_step_point(cap, variant):
+    aggs = _contract_aggs()
+    presorted = variant.get("presorted", False)
+    group = hashagg.presorted_aggregate if presorted \
+        else hashagg.batch_aggregate
+
+    def fn(rv, kd, km, sd, sw, md, mw):
+        return group(rv, [(kd, km)], [None, sd, md], [rv, sw, mw],
+                     aggs, 4096)
+    args, roles = _agg_inputs(cap)
+    return TracePoint(fn, args, roles)
+
+
+def _agg_finalize_point(cap, variant):
+    from presto_tpu.types import BIGINT
+    import jax as _jax
+    aggs = _contract_aggs()
+    st = hashagg.init_state([BIGINT], aggs, min(cap, 65536))
+    rst = _jax.tree_util.tree_map(lambda _: "clean", st)
+    return TracePoint(
+        lambda s: hashagg.finalize(s, ["k"], [BIGINT], [None],
+                                   ["c", "s", "m"], aggs),
+        (st,), (rst,))
+
+
+def _agg_merge_point(cap, variant):
+    from presto_tpu.types import BIGINT
+    import jax as _jax
+    aggs = _contract_aggs()
+    st = hashagg.init_state([BIGINT], aggs, min(cap, 65536))
+    rst = _jax.tree_util.tree_map(lambda _: "clean", st)
+    return TracePoint(
+        lambda a, b: hashagg.merge_partials((a, b), aggs,
+                                            min(cap, 65536)),
+        (st, st), (rst, rst))
+
+
+def _agg_count_point(cap, variant):
+    import numpy as np
+    return TracePoint(lambda v: jnp.sum(v),
+                      (sds((cap,), np.bool_),), ("mask",))
+
+
+def _agg_shrink_point(cap, variant):
+    from presto_tpu.types import BIGINT
+    import jax as _jax
+    aggs = _contract_aggs()
+    st = hashagg.init_state([BIGINT], aggs, cap)
+    rst = _jax.tree_util.tree_map(lambda _: "clean", st)
+    return TracePoint(
+        lambda s: _shrink_state.__wrapped__(s, _SHRINK_FLOOR),
+        (st,), (rst,))
+
+
+register_contract(KernelContract(
+    family="agg_step", module=__name__, build=_agg_step_point,
+    notes="sort-path grouped fold (batch_aggregate core)"))
+register_contract(KernelContract(
+    family="agg_step", module=__name__,
+    build=lambda cap, v: _agg_step_point(cap, {"presorted": True}),
+    notes="streaming (presorted) grouping core"))
+register_contract(KernelContract(
+    family="agg_finalize", module=__name__, build=_agg_finalize_point))
+register_contract(KernelContract(
+    family="hashagg_merge", module=__name__, build=_agg_merge_point))
+register_contract(KernelContract(
+    family="agg_count", module=__name__, build=_agg_count_point))
+# the shrink's source capacity must sit ABOVE its 4096-slot floor on
+# every sampled point — at cap == floor the slices vanish from the
+# trace, which is a different (and never co-resident) program
+register_contract(KernelContract(
+    family="agg_shrink", module=__name__, build=_agg_shrink_point,
+    buckets=(16384, 65536, 262144)))
+
+
+def _agg_stream_point(cap, variant):
+    from presto_tpu.types import BIGINT
+    import jax as _jax
+    aggs = _contract_aggs()
+    carry = hashagg.init_state([BIGINT], aggs, 1)
+    partial = hashagg.init_state([BIGINT], aggs, cap)
+    rc = _jax.tree_util.tree_map(lambda _: "clean", carry)
+    rp = _jax.tree_util.tree_map(lambda _: "clean", partial)
+    return TracePoint(
+        lambda c, p: _stream_step_jit(c, p, aggs),
+        (carry, partial), (rc, rp))
+
+
+register_contract(KernelContract(
+    family="agg_stream", module=__name__, build=_agg_stream_point,
+    notes="streaming boundary fold: carry[1] x partial[cap]"))

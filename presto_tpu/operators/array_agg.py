@@ -314,3 +314,22 @@ class ArrayAggOperatorFactory(OperatorFactory):
                             driver_context),
             self.key_names, self.key_exprs, self.specs, self.width,
             self._eval)
+
+
+# -- kernel contract (tools/kernelcheck.py) ----------------------------
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _collect_point(cap, variant):
+    from presto_tpu.types import BIGINT, DOUBLE
+    b, rb = abstract_batch(cap, [("g", BIGINT), ("x", DOUBLE)])
+    return TracePoint(
+        lambda bb: _collect_kernel.__wrapped__(
+            bb, ("g",), (("x", None, None),), 1024, 16),
+        (b,), (rb,))
+
+
+register_contract(KernelContract(
+    family="array_agg", module=__name__, build=_collect_point))

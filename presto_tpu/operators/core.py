@@ -199,6 +199,45 @@ def make_filter_project_kernel(
     return kernel
 
 
+# -- kernel contract (tools/kernelcheck.py) ----------------------------
+#
+# filter_project kernels are built per plan from compiled expression
+# forests; the contract traces a REPRESENTATIVE forest (comparison
+# filter + arithmetic/conditional projections over the dtype lattice)
+# through the same make_chain_body the production kernel uses, so the
+# checked program is the checked code path, not a stand-in.
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _fp_point(cap, variant):
+    from presto_tpu.expr import ir
+    from presto_tpu.expr.compile import compile_expression
+    from presto_tpu.schema import ColumnSchema
+    from presto_tpu.types import BIGINT, BOOLEAN, DOUBLE
+    schema = {"x": ColumnSchema("x", BIGINT),
+              "y": ColumnSchema("y", DOUBLE)}
+    filt = compile_expression(
+        ir.call("greater_than", BOOLEAN, ir.ref("x", BIGINT),
+                ir.lit(5, BIGINT)), schema)
+    proj = compile_expression(
+        ir.call("multiply", DOUBLE, ir.ref("y", DOUBLE),
+                ir.lit(2.0, DOUBLE)), schema)
+    from presto_tpu.operators.fused_fragment import (
+        ChainStage, make_chain_body,
+    )
+    body = make_chain_body(
+        [ChainStage(filt, (("x", compile_expression(
+            ir.ref("x", BIGINT), schema)), ("y2", proj)), None)])
+    b, rb = abstract_batch(cap, [("x", BIGINT), ("y", DOUBLE)])
+    return TracePoint(body, (b,), (rb,))
+
+
+register_contract(KernelContract(
+    family="filter_project", module=__name__, build=_fp_point))
+
+
 class FilterProjectOperator(Operator):
     """`selective` (a filter is present) enables the one-round-delayed
     count/compact protocol on outputs: a selective filter that emits a

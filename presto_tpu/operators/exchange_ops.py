@@ -631,3 +631,27 @@ class ExchangeSourceOperatorFactory(OperatorFactory):
         return ExchangeSourceOperator(
             OperatorContext(self.operator_id, self.name, driver_context),
             self.exchange, self.consumer, self.device)
+
+
+# -- kernel contract (tools/kernelcheck.py) ----------------------------
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _partition_point(cap, variant):
+    from presto_tpu.types import BIGINT, DOUBLE
+    b, rb = abstract_batch(cap, [("k", BIGINT), ("v", DOUBLE)])
+    return TracePoint(
+        lambda bb: partition_segments.__wrapped__(
+            bb, ("k",), None, 4),
+        (b,), (rb,))
+
+
+register_contract(KernelContract(
+    family="exchange_partition", module=__name__,
+    build=_partition_point,
+    structure_varies=True,
+    structure_reason="fast_searchsorted unrolls ceil(log2(n))+1 "
+                     "gather/compare levels in Python on the CPU "
+                     "backend — eqn count tracks the bucket"))

@@ -403,3 +403,47 @@ class FusedDistinctOperatorFactory(OperatorFactory):
             OperatorContext(self.operator_id, self.name,
                             driver_context),
             self._kernel, self.schema_cols, self.capacity)
+
+
+# -- kernel contract (tools/kernelcheck.py) ----------------------------
+#
+# The fragment family is every whole-fragment composition; the
+# contract traces the chain->limit composition (the FusedLimit builder
+# body, verbatim) — chain semantics are shared with filter_project via
+# make_chain_body, terminal folds are each checked under their own
+# family's contract. LIMIT n and the emitted count MUST ride as traced
+# operands (the variant axis).
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _fragment_point(cap, variant):
+    import numpy as np
+    from presto_tpu.expr import ir
+    from presto_tpu.expr.compile import compile_expression
+    from presto_tpu.schema import ColumnSchema
+    from presto_tpu.types import BIGINT, BOOLEAN, DOUBLE
+    schema = {"x": ColumnSchema("x", BIGINT),
+              "y": ColumnSchema("y", DOUBLE)}
+    filt = compile_expression(
+        ir.call("less_than", BOOLEAN, ir.ref("y", DOUBLE),
+                ir.lit(0.5, DOUBLE)), schema)
+    stages = [ChainStage(
+        filt, (("x", compile_expression(ir.ref("x", BIGINT), schema)),),
+        None)]
+    body = make_chain_body(stages)
+
+    def fn(batch, n, emitted):
+        out = sort_kernels._limit_batch_impl(body(batch), n, emitted)
+        return out, emitted + jnp.sum(out.row_valid)
+
+    b, rb = abstract_batch(cap, [("x", BIGINT), ("y", DOUBLE)])
+    n = np.int64(variant.get("n", 10))
+    return TracePoint(fn, (b, n, np.int64(0)),
+                      (rb, "clean", "clean"))
+
+
+register_contract(KernelContract(
+    family="fragment", module=__name__, build=_fragment_point,
+    variants=({"n": 10}, {"n": 500})))

@@ -647,3 +647,22 @@ def spool_source_factory(op_id: int, spool: Spool):
     return _SimpleFactory(
         op_id, "spool_source",
         lambda ctx: SpoolSourceOperator(ctx, spool, consumer))
+
+
+# -- kernel contract (tools/kernelcheck.py) ----------------------------
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _cross_point(cap, variant):
+    from presto_tpu.types import BIGINT, DOUBLE
+    p, rp = abstract_batch(cap, [("a", BIGINT), ("b", DOUBLE)])
+    bld, rbld = abstract_batch(4096, [("c", BIGINT)])
+    return TracePoint(
+        lambda pp, bb: _cross_product.__wrapped__(pp, bb, cap),
+        (p, bld), (rp, rbld))
+
+
+register_contract(KernelContract(
+    family="nested_loop", module=__name__, build=_cross_point))

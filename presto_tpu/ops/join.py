@@ -849,3 +849,137 @@ semi_mark = _instr(
     jits=[_hash_jit, _search_jit, _semi_from_enc, _semi_scan_jit,
           _semi_fused, _semi_unique_fused])
 unmatched_build = _instr(unmatched_build, "join_outer")
+
+
+# -- kernel contracts (tools/kernelcheck.py) ---------------------------
+#
+# The probe families are checked against the PROBE batch's dead lanes;
+# BuildTable metadata (sorted hashes, bucket offsets, run lengths) is
+# role "clean" by the modular contract — join_build's OWN contract
+# proves those arrays are sentinel-canonical for dead build rows, so
+# the probe may assume it (the invalid-tail clip + _H_INVALID design).
+# Build BATCH columns keep the "data" role: gathered build values must
+# stay mask-guarded in the probe output.
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _abstract_table(n: int, k: int, unique: bool, depth: int = 8):
+    from presto_tpu.analysis.contracts import sds
+    from presto_tpu.types import BIGINT, DOUBLE
+    import numpy as _np
+    batch, rbatch = abstract_batch(n, [("bk", BIGINT), ("bv", DOUBLE)])
+    t = BuildTable(sds((n,), _np.int64), sds((n,), _np.int64),
+                   sds(((1 << k) + 1,), _np.int64),
+                   sds((n,), _np.int64), sds((), _np.int64), batch,
+                   radix_bits=k, search_depth=depth,
+                   unique_runs=unique)
+    rt = BuildTable("clean", "clean", "clean", "clean", "clean",
+                    rbatch, radix_bits=k, search_depth=depth,
+                    unique_runs=unique)
+    return t, rt
+
+
+def _probe_schema():
+    from presto_tpu.types import BIGINT, DOUBLE
+    return [("pk", BIGINT), ("pv", DOUBLE)]
+
+
+def _build_point(cap, variant):
+    b, rb = abstract_batch(cap, _probe_schema())
+    which = variant.get("entry", "sorted")
+    if which == "sorted":
+        return TracePoint(lambda bb: _build_sorted(bb, ("pk",), 8),
+                          (b,), (rb,))
+    return TracePoint(lambda bb: _build_hash(bb, ("pk",)), (b,), (rb,))
+
+
+def _build_perm_point(cap, variant):
+    from presto_tpu.analysis.contracts import sds
+    import numpy as _np
+    b, rb = abstract_batch(cap, _probe_schema())
+    h = sds((cap,), _np.int64)
+    return TracePoint(lambda bb, hh, h2, perm: _build_apply_perm(
+        bb, hh, h2, perm),
+        (b, h, h, sds((cap,), _np.int64)),
+        (rb, "clean", "clean", "clean"))
+
+
+def _probe_point(cap, variant):
+    t, rt = _abstract_table(4096, 8, variant.get("unique", False))
+    p, rp = abstract_batch(cap, _probe_schema())
+    jt = variant.get("join_type", "inner")
+    if jt == "full":
+        from presto_tpu.analysis.contracts import sds
+        import numpy as _np
+        m = sds((4096,), _np.bool_)
+        return TracePoint(
+            lambda tt, pp, mm: _probe_join_fused(
+                tt, pp, ("pk",), mm, cap, "full", ("pk", "pv"),
+                ("bv",), ("bk",), "hash"),
+            (t, p, m), (rt, rp, "clean"))
+    return TracePoint(
+        lambda tt, pp: _probe_join_fused(
+            tt, pp, ("pk",), None, cap, jt, ("pk", "pv"), ("bv",),
+            ("bk",), "hash"),
+        (t, p), (rt, rp))
+
+
+def _semi_point(cap, variant):
+    unique = variant.get("unique", False)
+    t, rt = _abstract_table(4096, 8, unique)
+    p, rp = abstract_batch(cap, _probe_schema())
+    if unique:
+        return TracePoint(
+            lambda tt, pp: _semi_unique_fused(tt, pp, ("pk",)),
+            (t, p), (rt, rp))
+    return TracePoint(
+        lambda tt, pp: _semi_fused(tt, pp, ("pk",), ("bk",), "hash"),
+        (t, p), (rt, rp))
+
+
+def _outer_point(cap, variant):
+    from presto_tpu.analysis.contracts import sds
+    from presto_tpu.types import BIGINT
+    import numpy as _np
+    t, rt = _abstract_table(cap, 8, False)
+    m = sds((cap,), _np.bool_)
+    return TracePoint(
+        lambda tt, mm: unmatched_build.__wrapped__(
+            tt, mm, (("pk", BIGINT, None),), ("bv",)),
+        (t, m), (rt, "clean"))
+
+
+register_contract(KernelContract(
+    family="join_build", module=__name__, build=_build_point,
+    notes="device variadic-sort build (the TPU path; traceable on "
+          "every backend)"))
+register_contract(KernelContract(
+    family="join_build", module=__name__,
+    build=lambda cap, v: _build_point(cap, {"entry": "hash"}),
+    notes="hash stage of the CPU host-argsort build"))
+register_contract(KernelContract(
+    family="join_build", module=__name__, build=_build_perm_point,
+    notes="permutation-apply stage of the CPU host-argsort build"))
+register_contract(KernelContract(
+    family="join_probe", module=__name__, build=_probe_point,
+    notes="inner probe, general (duplicate-run) expand layout"))
+register_contract(KernelContract(
+    family="join_probe", module=__name__,
+    build=lambda cap, v: _probe_point(cap, {"join_type": "left"}),
+    notes="left probe: adds the unmatched-row pass (a distinct "
+          "program per plan shape — join_type is static by design)"))
+register_contract(KernelContract(
+    family="join_probe", module=__name__,
+    build=lambda cap, v: _probe_point(cap, {"join_type": "full"}),
+    notes="FULL probe: matched-flag scatter rides the trace"))
+register_contract(KernelContract(
+    family="semi_join", module=__name__, build=_semi_point,
+    notes="duplicate-run scan path (bounded unroll + while_loop)"))
+register_contract(KernelContract(
+    family="semi_join", module=__name__,
+    build=lambda cap, v: _semi_point(cap, {"unique": True}),
+    notes="unique-run path: verification folded into the search"))
+register_contract(KernelContract(
+    family="join_outer", module=__name__, build=_outer_point))

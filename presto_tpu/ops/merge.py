@@ -127,6 +127,31 @@ from presto_tpu.telemetry.kernels import instrument_kernel as _instr
 merge_pair = _instr(_merge_pair_jit, "merge")
 
 
+# -- kernel contract (tools/kernelcheck.py) ----------------------------
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _merge_point(cap, variant):
+    from presto_tpu.types import BIGINT, DOUBLE
+    schema = [("k", BIGINT), ("v", DOUBLE)]
+    a, ra = abstract_batch(cap, schema)
+    b, rb = abstract_batch(cap, schema)
+    keys, desc, nf = ("k",), (False,), (False,)
+    return TracePoint(
+        lambda x, y: _merge_pair_jit(x, y, keys, desc, nf),
+        (a, b), (ra, rb))
+
+
+register_contract(KernelContract(
+    family="merge", module=__name__, build=_merge_point,
+    structure_varies=True,
+    structure_reason="_lex_count_below unrolls ceil(log2(n))+1 "
+                     "binary-search rounds in Python — eqn count is "
+                     "a function of the bucket by construction"))
+
+
 def merge_runs(runs: Sequence[Batch], key_names: Sequence[str],
                descending: Sequence[bool],
                nulls_first: Sequence[bool]) -> Batch:

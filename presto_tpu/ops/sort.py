@@ -147,3 +147,110 @@ sort_batch = _instr(_sort_batch, "sort")
 topn_step = _instr(_topn_step, "topn")
 limit_batch = _instr(_limit_batch, "limit")
 distinct_step = _instr(_distinct_step_jit, "distinct")
+
+
+# -- kernel contracts (tools/kernelcheck.py; docs/KERNEL_CONTRACTS.md) -
+#
+# Each family is abstract-interpreted at >= 3 points of the
+# power-of-four bucket ladder: pad-invariance taint walk, retrace
+# fingerprints (LIMIT/top-k values MUST share one compile per bucket
+# — they ride as traced operands), purity, output-schema dtypes.
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _contract_schema(variant):
+    """Key/payload schema per dtype-lattice point (types.py)."""
+    from presto_tpu.types import (
+        BIGINT, BOOLEAN, DOUBLE, INTEGER, REAL, VARCHAR,
+    )
+    if variant.get("dtypes") == "float":
+        return [("k1", DOUBLE), ("k2", REAL), ("p", BOOLEAN)]
+    if variant.get("dtypes") == "mixed":
+        return [("k1", VARCHAR, ("a", "b")), ("k2", INTEGER),
+                ("p", DOUBLE)]
+    return [("k1", BIGINT), ("k2", DOUBLE), ("p", BIGINT)]
+
+
+def _state_batch(cap, schema):
+    """(state batch, roles): accumulator state is garbage-free by the
+    modular contract (its own producing step is checked), but its
+    masks still carry dead-lanes-False polarity."""
+    from presto_tpu.batch import Batch, Column
+    from presto_tpu.analysis.contracts import abstract_column, sds
+    import numpy as np
+    cols, roles = {}, {}
+    for entry in schema:
+        name, typ = entry[0], entry[1]
+        dic = entry[2] if len(entry) > 2 else None
+        col, _ = abstract_column(cap, typ, dic)
+        cols[name] = col
+        roles[name] = Column("clean", "mask", typ, dic)
+    return (Batch(cols, sds((cap,), np.bool_)),
+            Batch(roles, "mask"))
+
+
+def _sort_point(cap, variant):
+    schema = _contract_schema(variant)
+    b, rb = abstract_batch(cap, schema)
+    keys, desc, nf = ("k1", "k2"), (False, True), (False, True)
+    return TracePoint(
+        lambda batch: _sort_batch_impl(batch, keys, desc, nf),
+        (b,), (rb,))
+
+
+def _topn_point(cap, variant):
+    import numpy as np
+    schema = _contract_schema(variant)
+    state, rstate = _state_batch(4096, schema)
+    b, rb = abstract_batch(cap, schema)
+    # n is passed exactly as the operator passes it — a host scalar
+    # that must trace as an OPERAND; a kernel that baked it static
+    # would fingerprint differently per variant and fail KC002
+    n = np.int64(variant.get("n", 10))
+    return TracePoint(
+        lambda s, batch, nn: _topn_step_impl(
+            s, batch, nn, ("k1",), (False,), (False,)),
+        (state, b, n), (rstate, rb, "clean"))
+
+
+def _limit_point(cap, variant):
+    import numpy as np
+    b, rb = abstract_batch(cap, _contract_schema(variant))
+    n = np.int64(variant.get("n", 10))
+    return TracePoint(
+        lambda batch, nn, em: _limit_batch_impl(batch, nn, em),
+        (b, n, np.int64(0)), (rb, "clean", "clean"))
+
+
+def _distinct_point(cap, variant):
+    schema = _contract_schema(variant)
+    state, rstate = _state_batch(4096, schema)
+    b, rb = abstract_batch(cap, schema)
+    return TracePoint(
+        lambda s, batch: _distinct_step_impl(s, batch),
+        (state, b), (rstate, rb))
+
+
+# dtype lattice: one contract per point (distinct dtypes are distinct
+# compiles BY DESIGN — they must not be conflated with the operand
+# variants of one compile, which KC002 requires to share a trace)
+register_contract(KernelContract(
+    family="sort", module=__name__, build=_sort_point))
+register_contract(KernelContract(
+    family="sort", module=__name__,
+    build=lambda cap, v: _sort_point(cap, {"dtypes": "float"}),
+    notes="dtype-lattice point: float/real keys, boolean payload"))
+register_contract(KernelContract(
+    family="sort", module=__name__,
+    build=lambda cap, v: _sort_point(cap, {"dtypes": "mixed"}),
+    notes="dtype-lattice point: varchar dictionary + integer keys"))
+register_contract(KernelContract(
+    family="topn", module=__name__, build=_topn_point,
+    variants=({"n": 10}, {"n": 50})))
+register_contract(KernelContract(
+    family="limit", module=__name__, build=_limit_point,
+    variants=({"n": 10}, {"n": 1000})))
+register_contract(KernelContract(
+    family="distinct", module=__name__, build=_distinct_point))

@@ -467,6 +467,36 @@ from presto_tpu.telemetry.kernels import instrument_kernel as _instr
 window_kernel = _instr(_window_kernel_jit, "window")
 
 
+# -- kernel contract (tools/kernelcheck.py) ----------------------------
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _window_point(cap, variant):
+    from presto_tpu.types import BIGINT, DOUBLE
+    b, rb = abstract_batch(
+        cap, [("p", BIGINT), ("o", BIGINT), ("v", DOUBLE)])
+    calls = (
+        WindowCallSpec("rnk", "rank", None, FULL, BIGINT),
+        WindowCallSpec("s", "sum", "v", ROWS_RUNNING, DOUBLE),
+        WindowCallSpec("lg", "lag", "v", FULL, DOUBLE),
+    )
+    return TracePoint(
+        lambda batch: _window_kernel_jit(
+            batch, part_names=("p",), order_names=("o",),
+            descending=(False,), nulls_first=(False,), calls=calls),
+        (b,), (rb,))
+
+
+register_contract(KernelContract(
+    family="window", module=__name__, build=_window_point,
+    structure_varies=True,
+    structure_reason="the _rmq sparse table builds ceil(log2(n))+1 "
+                     "doubling levels in Python — eqn count depends "
+                     "on the bucket by construction"))
+
+
 def _minmax_ident(fn: str, dtype):
     info = jnp.iinfo(dtype) if jnp.issubdtype(dtype, jnp.integer) \
         else jnp.finfo(dtype)

@@ -32,6 +32,10 @@ after the fact.  The `PlanChecker` here makes them machine-checked:
     every other barrier operator must survive byte-identical
     (`fusion-barrier`, `fusion-dropped-operator`,
     `fusion-nonadjacent`)
+  * expression typing: every RowExpression a node evaluates passes
+    the static type/null checker (analysis/expr_types.py) — boolean
+    contexts, comparison/arithmetic promotion, special-form result
+    types (`expr-type`)
   * cache determinism: THE audited determinism analysis lives here
     (`expr_deterministic` / `plan_deterministic`), cache/fingerprint.py
     derives its cacheability from it, and the checker cross-checks the
@@ -351,6 +355,14 @@ class PlanChecker:
 
         def bad(rule: str, detail: str) -> None:
             violations.append(Violation(rule, name, detail))
+
+        # static expression typing (analysis/expr_types): a planner
+        # pass that builds an ill-typed expression is named HERE, at
+        # the pass boundary, instead of failing inside a kernel trace
+        from presto_tpu.analysis.expr_types import check_expression
+        for e in node_expressions(node):
+            for msg in check_expression(e):
+                bad("expr-type", msg)
 
         # duplicate physical output columns
         seen_syms: Set[str] = set()

@@ -786,8 +786,19 @@ class LocalRunner:
         # whole-fragment fusion report (fused chains + fallback
         # reasons) rides the result for tools/fusion_report.py and
         # the bench JSON schemas
-        result.fusion_report = getattr(self._session_tl,
-                                       "fusion_report", None)
+        report = getattr(self._session_tl, "fusion_report", None)
+        from presto_tpu.telemetry import kernels as _tk
+        if report is not None and _tk.SIGNATURE_TRACKING:
+            # kernel-contract cross-check surface: per-family distinct
+            # input signatures observed so far (the PREDICTED compile
+            # ceiling under the static contracts, tools/kernelcheck) —
+            # analysis/runtime.cross_check compares them against the
+            # live kernel_retrace_total deltas, and a divergence fails
+            # the serving gate in tests/test_kernelcheck.py
+            report = dict(report)
+            report["kernel_families"] = _tk.signature_report()
+            self._session_tl.fusion_report = report
+        result.fusion_report = report
         return result
 
     def _lifecycle(self):

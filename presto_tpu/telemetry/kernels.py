@@ -65,6 +65,59 @@ _TL = threading.local()
 #: evicted from the engine LRUs must stay collectable)
 _WRAPPERS: "weakref.WeakSet" = weakref.WeakSet()
 
+#: armed-only input-signature tracking (the runtime half of the
+#: kernel contract checker's retrace prediction, tools/kernelcheck):
+#: when on, every kernel call records its family's distinct input
+#: signatures (pytree structure + leaf shapes/dtypes + static
+#: values). The kernel contracts guarantee one compile per signature,
+#: so len(signatures) is the PREDICTED compile count — compared
+#: against the live kernel_retrace_total deltas by
+#: analysis/runtime.cross_check; live > predicted is a violation
+#: (an undeclared retrace source: value-baking, dtype drift). Off by
+#: default: the per-call tree_flatten is not free.
+SIGNATURE_TRACKING = False
+_SIGNATURES: Dict[str, set] = {}
+_SIG_LOCK = sanitize.lock("telemetry.kernel_signatures")
+
+
+def arm_signature_tracking(on: bool = True) -> None:
+    """Toggle signature tracking (clears collected signatures)."""
+    global SIGNATURE_TRACKING
+    with _SIG_LOCK:
+        _SIGNATURES.clear()
+    SIGNATURE_TRACKING = bool(on)
+
+
+def signature_report() -> Dict[str, int]:
+    """family -> distinct input signatures observed since arming
+    (the predicted compile count under the kernel contracts)."""
+    with _SIG_LOCK:
+        return {k: len(v) for k, v in sorted(_SIGNATURES.items())}
+
+
+def _record_signature(name: str, args, kwargs) -> None:
+    try:
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        parts = [str(treedef)]
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is not None and dtype is not None:
+                parts.append(f"{dtype}{tuple(shape)}")
+            else:
+                # non-array leaves are static-ish values (capacities,
+                # verify modes); their VALUES key compiles. Python
+                # scalars that ride as traced operands (LIMIT n) make
+                # the prediction conservative (predicted >= live),
+                # which the cross-check's direction tolerates.
+                parts.append(repr(leaf)[:80])
+        sig = "|".join(parts)
+    except Exception:  # noqa: BLE001 — tracking is advisory
+        return
+    with _SIG_LOCK:
+        _SIGNATURES.setdefault(name, set()).add(sig)
+
 
 def reset_retrace_state() -> None:
     """Forget which kernels have traced: after a kernel-cache wipe
@@ -195,6 +248,8 @@ def instrument_kernel(kernel, name: str, jits=None):
     def wrapped(*args, **kwargs):
         if not ENABLED:
             return kernel(*args, **kwargs)
+        if SIGNATURE_TRACKING:
+            _record_signature(name, args, kwargs)
         tok = object()
         with state["lock"]:
             state["active"][tok] = False

@@ -1,0 +1,217 @@
+"""presto-tpu kernel contract checker CLI (docs/KERNEL_CONTRACTS.md).
+
+Abstract-interprets every registered kernel family's traces at >= 3
+points of the power-of-four shape-bucket ladder: pad-invariance taint
+walk (KC001), retrace/compile budgets (KC002), purity (KC003), output
+dtype stability (KC004), and contract coverage (KC005). Nothing
+executes and nothing compiles — a full --all run is host-side tracing
+only.
+
+    python -m presto_tpu.tools.kernelcheck --all
+    python -m presto_tpu.tools.kernelcheck --family join_probe
+    python -m presto_tpu.tools.kernelcheck --all --baseline
+    python -m presto_tpu.tools.kernelcheck --changed [REF]
+    python -m presto_tpu.tools.kernelcheck --all --json
+
+Exit status: 0 clean (or nothing beyond the baseline), 1 findings,
+2 usage/infrastructure errors — the same contract as tools/lint.py,
+including the checked-in baseline (`tools/kernelcheck_baseline.json`,
+which ships EMPTY: every accepted deviation is a reasoned suppression
+ON the contract, not a baselined finding)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from presto_tpu.analysis.checker import (
+    CheckResult, Finding, RULES, check_families, load_contract_modules,
+)
+from presto_tpu.analysis.contracts import all_contracts
+
+BASELINE_DEFAULT = os.path.join(
+    os.path.dirname(__file__), "kernelcheck_baseline.json")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- baseline (same shape as tools/lint.py) ----------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {k: int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "findings": dict(sorted(counts.items()))},
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff_baseline(findings: Sequence[Finding],
+                  baseline: Dict[str, int]
+                  ) -> Tuple[List[Finding], List[str]]:
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            new.append(f)
+    stale = sorted(fp for fp, n in remaining.items() if n > 0)
+    return new, stale
+
+
+# -- --changed: families whose defining modules changed vs a ref -------
+
+
+def changed_families(ref: str = "HEAD") -> List[str]:
+    """Families whose contract-declared defining module (or the
+    analysis machinery itself) differs from `ref` — the quick local
+    gate before a full --all run."""
+    root = repo_root()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref],
+            cwd=root, capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return sorted(all_contracts())
+    changed = {p.strip() for p in diff + untracked if p.strip()}
+    if any(p.startswith("presto_tpu/analysis/") for p in changed):
+        return sorted(all_contracts())
+    out: List[str] = []
+    for fam, contracts in all_contracts().items():
+        for c in contracts:
+            rel = c.module.replace(".", "/") + ".py"
+            if rel in changed:
+                out.append(fam)
+                break
+    return sorted(set(out))
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m presto_tpu.tools.kernelcheck",
+        description="presto-tpu jaxpr-level kernel contract checker")
+    p.add_argument("--all", action="store_true",
+                   help="check every registered family (+ coverage)")
+    p.add_argument("--family", action="append", default=[],
+                   metavar="NAME", help="check one family (repeatable)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="check only families whose defining modules "
+                        "changed vs REF (default HEAD)")
+    p.add_argument("--baseline", nargs="?", const=BASELINE_DEFAULT,
+                   default=None, metavar="FILE",
+                   help="compare against the checked-in baseline and "
+                        "fail only on NEW findings")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--show-suppressed", action="store_true")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--list-families", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid]}")
+        return 0
+
+    load_contract_modules()
+    if args.list_families:
+        for fam, contracts in sorted(all_contracts().items()):
+            print(f"{fam}  ({len(contracts)} contract"
+                  f"{'s' if len(contracts) != 1 else ''})")
+        return 0
+
+    families: Optional[List[str]]
+    if args.changed is not None:
+        families = changed_families(args.changed)
+        if not families:
+            print("0 finding(s) (no kernel modules changed)")
+            return 0
+    elif args.family:
+        families = args.family
+    elif args.all:
+        families = None
+    else:
+        p.print_usage()
+        print("error: pick --all, --family NAME, or --changed",
+              file=sys.stderr)
+        return 2
+
+    result: CheckResult = check_families(families)
+    if result.errors:
+        for e in result.errors:
+            print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = args.baseline or BASELINE_DEFAULT
+        write_baseline(path, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to {path}")
+        return 0
+
+    to_report = list(result.findings)
+    stale: List[str] = []
+    if args.baseline is not None:
+        baseline = load_baseline(args.baseline)
+        to_report, stale = diff_baseline(result.findings, baseline)
+        if families is not None:
+            stale = []  # partial runs cannot judge staleness
+
+    if args.json:
+        print(json.dumps({
+            "findings": [dataclasses.asdict(f) for f in to_report],
+            "suppressed": [dataclasses.asdict(f)
+                           for f in result.suppressed],
+            "stale_baseline": stale,
+            "predicted_compiles": result.predicted,
+        }, indent=1))
+    else:
+        for f in to_report:
+            print(f.render())
+        if args.show_suppressed:
+            for f in result.suppressed:
+                print(f.render())
+        for fp in stale:
+            print(f"stale baseline entry (fixed? prune with "
+                  f"--write-baseline): {fp}")
+        new = "new " if args.baseline is not None else ""
+        fams = len(result.predicted)
+        total = sum(result.predicted.values())
+        print(f"{len(to_report)} {new}finding(s), "
+              f"{len(result.suppressed)} suppressed; "
+              f"{fams} families checked, {total} predicted distinct "
+              "compiles over the sampled ladder")
+    return 1 if to_report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -326,11 +326,19 @@ class Project:
         self.instrumented: Set[str] = set()
         #: attribute names written on any thread-local root anywhere
         self.threadlocal_written: Set[str] = set()
+        #: names bound to threading.local() in ANY module (TS006's
+        #: exemption set — a read routed through a thread-local root
+        #: is the sanctioned mutable-state pattern). Project-wide by
+        #: the same cross-file argument as `instrumented`; the union
+        #: is deliberately name-based, so a name that is a TL root in
+        #: one module exempts reads of that name elsewhere too.
+        self.threadlocal_roots: Set[str] = set()
         for m in self.modules:
             self._scan(m)
 
     def _scan(self, mod: ModuleInfo) -> None:
         tl_roots = threadlocal_roots(mod)
+        self.threadlocal_roots |= tl_roots
         # name -> every value expression assigned to it (so a
         # `jits=jit_list` keyword resolves through the local
         # `jit_list = [stage0, stage2, ...]` bindings)

@@ -268,6 +268,137 @@ def check_unregistered_jit(mod: ModuleInfo,
     return out
 
 
+def _module_global_facts(mod: ModuleInfo):
+    """(mutable globals, module-level assignment counts) for TS006:
+    a module global is MUTABLE-RISKY when it is bound to a mutable
+    literal/constructor, rebound more than once at module scope, or
+    declared `global` and assigned inside any function."""
+    assigns: Dict[str, int] = {}
+    mutable: Set[str] = set()
+    for node in mod.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets
+                       if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        is_mut = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp))
+        if isinstance(value, ast.Call):
+            t = terminal_name(value.func)
+            if t in ("dict", "list", "set", "OrderedDict",
+                     "defaultdict", "deque"):
+                is_mut = True
+        for t in targets:
+            assigns[t.id] = assigns.get(t.id, 0) + 1
+            if is_mut:
+                mutable.add(t.id)
+    declared_global: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Global):
+            declared_global.update(node.names)
+    rebound = {n for n, c in assigns.items() if c > 1}
+    return (mutable | rebound | declared_global), set(assigns)
+
+
+@rule("TS006", "jitted body reads a mutable module global or a "
+               "rebound closure variable")
+def check_mutable_capture(mod: ModuleInfo,
+                          project: Project) -> List[Finding]:
+    """A traced body that reads a MUTABLE module global (a dict/list
+    cache, a rebound flag, a `global`-assigned counter) bakes the
+    value it saw at FIRST trace into the compiled program: later
+    mutations are silently ignored on cache hits (staleness) or mint
+    fresh traces the retrace counters cannot attribute (the
+    compile-wall class). Same hazard for a closure variable the
+    enclosing function rebinds after the jitted def. The sanctioned
+    patterns stay clean: reads through a thread-local install site
+    (telemetry's set_current_op shape), single-assignment module
+    CONSTANTS (MAX_RADIX_BITS), and statics passed as arguments."""
+    risky, module_names = _module_global_facts(mod)
+    tl_roots = project.threadlocal_roots
+    out: List[Finding] = []
+    for fn, traced, _ in _jit_bodies(mod):
+        local: Set[str] = {a.arg for a in fn.args.args}
+        local.update(a.arg for a in fn.args.kwonlyargs)
+        if fn.args.vararg:
+            local.add(fn.args.vararg.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                tgt = node.target
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        local.add(sub.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                local.add(node.name)
+            elif isinstance(node, ast.Lambda):
+                local.update(a.arg for a in node.args.args)
+        # closure variables rebound after the jitted def (staleness)
+        rebound_closure: Set[str] = set()
+        for anc in mod.ancestors(fn):
+            if not isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                continue
+            counts: Dict[str, List[int]] = {}
+            for sub in ast.walk(anc):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            counts.setdefault(t.id, []).append(
+                                sub.lineno)
+            for name_, lines in counts.items():
+                if len(lines) > 1 or any(ln > fn.lineno
+                                         for ln in lines):
+                    rebound_closure.add(name_)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Name) \
+                    or not isinstance(node.ctx, ast.Load):
+                continue
+            name_ = node.id
+            if name_ in local or name_ in tl_roots:
+                continue
+            hazard = None
+            if name_ in risky and name_ in module_names:
+                hazard = "mutable module global"
+            elif name_ in rebound_closure \
+                    and name_ not in module_names:
+                hazard = "closure variable rebound in the " \
+                         "enclosing function"
+            if hazard:
+                out.append(mod.finding(
+                    "TS006", node,
+                    f"jitted body {fn.name!r} reads {name_!r} — a "
+                    f"{hazard}: the traced program froze one value "
+                    "(stale on cache hits, an unattributable "
+                    "retrace source otherwise); pass it as an "
+                    "argument or route it through a registered "
+                    "thread-local install site"))
+    # dedupe repeated reads of the same name in the same body
+    seen: Set[str] = set()
+    uniq: List[Finding] = []
+    for f in out:
+        key = f.fingerprint()
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
+
+
 TRACE_RULES = (check_traced_branch, check_host_sync,
                check_numpy_in_jit, check_unhashable_static,
-               check_unregistered_jit)
+               check_unregistered_jit, check_mutable_capture)

@@ -1,0 +1,418 @@
+"""Kernel contract checker: engineered-violation fixtures per
+contract, the all-families clean gate, and the predicted-vs-live
+compile-count cross-check (docs/KERNEL_CONTRACTS.md).
+
+Everything except the serving-mix cross-check is pure in-process
+tracing over ShapeDtypeStruct inputs — no data, no compiles, no
+subprocess workers (tier-1 budget)."""
+
+import json
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from presto_tpu.analysis import runtime as art  # noqa: E402
+from presto_tpu.analysis import taint  # noqa: E402
+from presto_tpu.analysis.checker import (  # noqa: E402
+    RULES, check_contract, check_families, coverage_findings,
+    load_contract_modules, registered_families,
+)
+from presto_tpu.analysis.contracts import (  # noqa: E402
+    KernelContract, TracePoint, abstract_batch, all_contracts, sds,
+)
+from presto_tpu.analysis.expr_types import check_expression  # noqa: E402
+from presto_tpu.batch import Batch, Column  # noqa: E402
+from presto_tpu.tools.kernelcheck import (  # noqa: E402
+    BASELINE_DEFAULT, changed_families, diff_baseline, load_baseline,
+    main, write_baseline,
+)
+from presto_tpu.types import BIGINT, BOOLEAN, DOUBLE, REAL  # noqa: E402
+
+
+def _schema():
+    return [("k", BIGINT), ("v", DOUBLE)]
+
+
+def _contract(build, **kw):
+    kw.setdefault("family", "fixture")
+    kw.setdefault("module", "tests.fixture")
+    return KernelContract(build=build, **kw)
+
+
+def _findings(build, **kw):
+    findings, _ = check_contract(_contract(build, **kw))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# engineered violations: each contract catches its fixture
+
+
+def test_pad_leak_is_caught_with_eqn_attribution():
+    """The canonical leak: an unmasked sum over padded width."""
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+
+        def leaky(batch):
+            return jnp.sum(batch.columns["v"].data)
+        return TracePoint(leaky, (b,), (rb,))
+
+    found = _findings(build)
+    kc1 = [f for f in found if f.rule == "KC001"]
+    assert kc1, found
+    # eqn-level attribution: the offending primitive and its source
+    # line both surface in the finding
+    assert "reduce_sum" in kc1[0].message
+    assert "test_kernelcheck.py" in kc1[0].source
+
+
+def test_masked_sum_is_clean():
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+
+        def ok(batch):
+            c = batch.columns["v"]
+            return jnp.sum(jnp.where(c.mask & batch.row_valid,
+                                     c.data, 0.0))
+        return TracePoint(ok, (b,), (rb,))
+
+    assert not _findings(build)
+
+
+def test_pad_leak_via_sort_key_is_caught():
+    """Sorting by a raw (un-canonicalized) column reorders live rows
+    by dead-lane garbage."""
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+
+        def leaky(batch):
+            d = batch.columns["k"].data
+            return jax.lax.sort((d, batch.row_valid), num_keys=1)
+        return TracePoint(leaky, (b,), (rb,))
+
+    kc1 = [f for f in _findings(build) if f.rule == "KC001"]
+    assert kc1 and "sort" in kc1[0].message
+
+
+def test_shape_branching_kernel_fails_structure_check():
+    """A kernel whose trace-time Python branches on the bucket size
+    emits structurally different programs per bucket."""
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+
+        def forked(batch):
+            d = batch.columns["v"].data
+            m = batch.columns["v"].mask & batch.row_valid
+            x = jnp.where(m, d, 0.0)
+            if cap > 8192:  # the engineered trace-time fork
+                x = x * 2.0 + 1.0
+            return jnp.sum(x)
+        return TracePoint(forked, (b,), (rb,))
+
+    kc2 = [f for f in _findings(build) if f.rule == "KC002"]
+    assert any("structure varies across bucket sizes" in f.message
+               for f in kc2), kc2
+
+
+def test_value_baking_kernel_fails_variant_stability():
+    """A LIMIT-style operand baked into the trace as a Python constant
+    mints one compile per value — the compile-wall class."""
+    def build(cap, variant):
+        n = variant["n"]  # baked: never passed as an operand
+        b, rb = abstract_batch(cap, _schema())
+
+        def baked(batch):
+            keep = jnp.arange(cap) < n
+            return batch.row_valid & keep
+        return TracePoint(baked, (b,), (rb,))
+
+    found = _findings(build, variants=({"n": 10}, {"n": 50}))
+    kc2 = [f for f in found if f.rule == "KC002"]
+    assert any("baked into the trace" in f.message for f in kc2), found
+
+
+def test_host_callback_kernel_fails_purity():
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+
+        def impure(batch):
+            s = jnp.sum(jnp.where(batch.row_valid,
+                                  batch.columns["v"].data, 0.0))
+            jax.debug.print("total={s}", s=s)
+            return s
+        return TracePoint(impure, (b,), (rb,))
+
+    kc3 = [f for f in _findings(build) if f.rule == "KC003"]
+    assert kc3, "host callback not caught"
+
+
+def test_promoting_kernel_fails_dtype_stability():
+    """An f32 column whose kernel emits f64 (the silent promotion
+    class: schema says REAL, exchange pays DOUBLE)."""
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, [("x", REAL)])
+
+        def promoting(batch):
+            c = batch.columns["x"]
+            # the promotion: arithmetic in f64, dtype not restored
+            d = c.data.astype(jnp.float64) * 2.0
+            return Batch({"x": Column(d, c.mask, REAL, None)},
+                         batch.row_valid)
+        return TracePoint(promoting, (b,), (rb,))
+
+    kc4 = [f for f in _findings(build) if f.rule == "KC004"]
+    assert kc4 and "float64" in kc4[0].message
+
+
+def test_ladder_budget_violation():
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+        return TracePoint(lambda batch: batch.row_valid, (b,), (rb,))
+
+    found = _findings(build, ladder_budget=1)
+    assert any(f.rule == "KC002" and "ladder budget" in f.message
+               for f in found)
+
+
+def test_contract_suppression_is_reasoned():
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+        return TracePoint(
+            lambda batch: jnp.sum(batch.columns["v"].data),
+            (b,), (rb,))
+
+    c = _contract(build, suppress=(("KC001", "fixture: deliberate"),))
+    findings, _ = check_contract(c)
+    assert findings and all(f.suppressed for f in findings
+                            if f.rule == "KC001")
+    with pytest.raises(ValueError):
+        _contract(build, structure_varies=True)  # reason required
+
+
+# ---------------------------------------------------------------------------
+# the tier gate: every registered family, >= 3 ladder buckets, clean
+
+
+def test_all_families_clean_gate():
+    result = check_families()
+    assert not result.errors, result.errors
+    new, _ = diff_baseline(result.findings,
+                           load_baseline(BASELINE_DEFAULT))
+    assert not new, "new kernel-contract findings (fix, suppress " \
+        "with a reason on the contract, or re-baseline):\n" \
+        + "\n".join(f.render() for f in new)
+    # the checked-in baseline ships EMPTY: deviations live as
+    # reasoned suppressions on the contracts, never as baseline debt
+    assert load_baseline(BASELINE_DEFAULT) == {}
+    # >= 3 ladder points per contract is the acceptance bar
+    for fam, contracts in all_contracts().items():
+        for c in contracts:
+            assert len(c.buckets) >= 3, (fam, c.buckets)
+
+
+def test_every_registered_family_has_a_contract():
+    load_contract_modules()
+    missing = registered_families() - set(all_contracts())
+    assert not missing, missing
+    assert not coverage_findings()
+
+
+def test_rule_catalogue():
+    assert set(RULES) == {"KC001", "KC002", "KC003", "KC004", "KC005"}
+
+
+# ---------------------------------------------------------------------------
+# baseline + CLI workflow (same contract as tools/lint.py)
+
+
+def test_baseline_roundtrip(tmp_path):
+    def build(cap, variant):
+        b, rb = abstract_batch(cap, _schema())
+        return TracePoint(
+            lambda batch: jnp.sum(batch.columns["v"].data),
+            (b,), (rb,))
+
+    findings = _findings(build)
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, findings)
+    loaded = load_baseline(path)
+    assert sum(loaded.values()) == len(findings)
+    new, stale = diff_baseline(findings, loaded)
+    assert not new and not stale
+    new, stale = diff_baseline([], loaded)
+    assert not new and stale
+
+
+def test_cli_surfaces():
+    assert main(["--list-rules"]) == 0
+    assert main(["--list-families"]) == 0
+    assert main(["--family", "limit", "--family", "sort"]) == 0
+    assert main(["--all", "--baseline"]) == 0
+    assert main(["--family", "no_such_family"]) == 2
+
+
+def test_cli_json(capsys):
+    assert main(["--family", "limit", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["findings"] == []
+    assert out["predicted_compiles"]["limit"] >= 3
+
+
+def test_changed_families_scoped():
+    load_contract_modules()
+    fams = changed_families("HEAD")
+    assert isinstance(fams, list)
+    for f in fams:
+        assert f in all_contracts()
+
+
+# ---------------------------------------------------------------------------
+# expression-IR type checker (the planner/validation satellite)
+
+
+def _ill_typed_and():
+    from presto_tpu.expr import ir
+    return ir.SpecialForm(
+        "and", (ir.ref("x", BIGINT), ir.lit(True, BOOLEAN)), BOOLEAN)
+
+
+def test_expr_types_boolean_context():
+    errs = check_expression(_ill_typed_and())
+    assert errs and "boolean context" in errs[0]
+
+
+def test_expr_types_incomparable_comparison():
+    from presto_tpu.expr import ir
+    from presto_tpu.types import VARCHAR
+    e = ir.call("less_than", BOOLEAN, ir.ref("x", BIGINT),
+                ir.ref("s", VARCHAR))
+    errs = check_expression(e)
+    assert errs and "incomparable" in errs[0]
+
+
+def test_expr_types_arithmetic_over_boolean():
+    from presto_tpu.expr import ir
+    e = ir.call("add", BIGINT, ir.ref("b", BOOLEAN),
+                ir.lit(1, BIGINT))
+    assert check_expression(e)
+
+
+def test_expr_types_clean_expressions_pass():
+    from presto_tpu.expr import ir
+    e = ir.and_(
+        ir.call("less_than", BOOLEAN, ir.ref("x", BIGINT),
+                ir.lit(7, BIGINT)),
+        ir.SpecialForm("is_null", (ir.ref("y", DOUBLE),), BOOLEAN))
+    assert not check_expression(e)
+    # UNKNOWN (bare NULL) coerces everywhere
+    from presto_tpu.types import UNKNOWN
+    e2 = ir.and_(ir.lit(None, UNKNOWN), ir.lit(True, BOOLEAN))
+    assert not check_expression(e2)
+
+
+def test_plan_checker_names_ill_typed_expression():
+    from presto_tpu.planner import nodes as N
+    from presto_tpu.planner.validation import (
+        CHECKER, PlanValidationError,
+    )
+    src = N.ValuesNode(rows=[[1]],
+                       output=(N.Field("x", BIGINT),))
+    proj = N.ProjectNode(
+        source=src, assignments=[("p", _ill_typed_and())],
+        output=(N.Field("p", BOOLEAN),))
+    with pytest.raises(PlanValidationError) as ei:
+        CHECKER.check_plan(proj, "fixture-pass")
+    assert any(v.rule == "expr-type" for v in ei.value.violations)
+
+
+# ---------------------------------------------------------------------------
+# predicted-vs-live compile-count cross-check on the serving mix
+
+
+def test_predicted_vs_live_compiles_on_serving_mix():
+    """The runtime half of KC002: warm the serving mix, then re-run
+    it (with DIFFERENT LIMIT constants) under signature tracking. The
+    contracts say every fresh trace is a new input signature; on the
+    warm pass the signatures repeat, so the live retrace delta must
+    be ZERO — any fresh trace is an undeclared retrace source
+    (value-baking, dtype drift) and fails the gate."""
+    from presto_tpu.runner.local import LocalRunner
+    from tpch_queries import QUERIES
+
+    r = LocalRunner("tpch", "tiny", properties={
+        "plan_cache_enabled": False,
+        "fragment_result_cache_enabled": False,
+        "page_source_cache_enabled": False,
+    })
+    mix = [QUERIES[6],
+           "SELECT orderkey, quantity FROM lineitem "
+           "WHERE quantity > 30 LIMIT 10"]
+    for sql in mix:
+        r.execute(sql)
+
+    snap = art.begin_tracking()
+    try:
+        res = None
+        for sql in mix:
+            res = r.execute(sql.replace("LIMIT 10", "LIMIT 77"))
+        report = art.cross_check(snap, disarm=False)
+        # prediction/reality: no family may retrace beyond its
+        # observed distinct signatures...
+        assert not report["divergent"], report
+        # ...and on a WARM mix the delta is exactly zero — LIMIT 77
+        # shares every compiled kernel with LIMIT 10 (the PR 6
+        # operand-bucketing invariant, now cross-checked live)
+        assert art.live_retraces(snap) == {}, art.live_retraces(snap)
+        # the fusion report surfaces the per-family prediction
+        assert res is not None
+        fams = (res.fusion_report or {}).get("kernel_families")
+        assert fams, "kernel_families missing from fusion report"
+        assert all(n >= 1 for n in fams.values())
+    finally:
+        from presto_tpu.telemetry import kernels
+        kernels.arm_signature_tracking(False)
+
+
+# ---------------------------------------------------------------------------
+# taint interpreter unit coverage (the idiom rules the kernels rely on)
+
+
+def test_taint_polarity_rules():
+    cap = 4096
+    b, rb = abstract_batch(cap, _schema())
+
+    def kernel(batch):
+        c = batch.columns["v"]
+        neutral = jnp.where(c.mask, c.data, 0.0)       # select kill
+        narrowed = batch.row_valid & (c.data > 0)      # and kill
+        return neutral, narrowed
+
+    closed = jax.make_jaxpr(kernel)(b)
+    avs = [taint.av_for_role(r)
+           for r in jax.tree_util.tree_leaves(rb)]
+    outs, leaks = taint.analyze(closed, avs)
+    assert not leaks
+    assert all(o.taint == taint.CLEAN for o in outs)
+
+
+def test_taint_unknown_primitive_is_loud():
+    """A primitive without a transfer rule over tainted operands must
+    fail closed, not pass silently."""
+    cap = 4096
+    b, rb = abstract_batch(cap, [("x", DOUBLE)])
+
+    def kernel(batch):
+        # fft has (deliberately) no transfer rule
+        return jnp.fft.fft(batch.columns["x"].data).real
+
+    closed = jax.make_jaxpr(kernel)(b)
+    avs = [taint.av_for_role(r)
+           for r in jax.tree_util.tree_leaves(rb)]
+    outs, leaks = taint.analyze(closed, avs)
+    assert leaks and any("no transfer rule" in l.detail
+                         for l in leaks)
+    assert any(o.taint == taint.POISON for o in outs)

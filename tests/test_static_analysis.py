@@ -65,6 +65,7 @@ def test_mesh_drive_loop_has_lifecycle_checkpoints():
 
 def test_rule_catalogue_complete():
     assert set(RULES) == {"TS001", "TS002", "TS003", "TS004", "TS005",
+                          "TS006",
                           "CC001", "CC002", "CC003", "CC004",
                           "CC005", "CC006"}
 
@@ -226,6 +227,102 @@ def test_ts005_jits_list_variable_resolves():
         return k
     """
     assert not _rules(clean, "TS005")
+
+
+def test_ts006_mutable_global_read_in_jit():
+    bad = """
+    import jax
+
+    _CACHE = {}
+
+    @jax.jit
+    def kernel(x):  # lint-ok: TS005 fixture kernel
+        return x + len(_CACHE)
+    """
+    assert _rules(bad, "TS006")
+    # rebound module global (a flag flipped at runtime)
+    rebound = """
+    import jax
+
+    SCALE = 1
+    SCALE = 2
+
+    @jax.jit
+    def kernel(x):  # lint-ok: TS005 fixture kernel
+        return x * SCALE
+    """
+    assert _rules(rebound, "TS006")
+    # global-assigned counter
+    declared = """
+    import jax
+
+    _N = 0
+
+    def bump():
+        global _N
+        _N += 1
+
+    @jax.jit
+    def kernel(x):  # lint-ok: TS005 fixture kernel
+        return x + _N
+    """
+    assert _rules(declared, "TS006")
+    # single-assignment module constant: the sanctioned pattern
+    clean = """
+    import jax
+
+    MAX_BITS = 18
+
+    @jax.jit
+    def kernel(x):  # lint-ok: TS005 fixture kernel
+        return x + MAX_BITS
+    """
+    assert not _rules(clean, "TS006")
+
+
+def test_ts006_rebound_closure_variable():
+    bad = """
+    import jax
+
+    def factory():
+        scale = 1.0
+
+        @jax.jit
+        def kernel(x):  # lint-ok: TS005 fixture kernel
+            return x * scale
+
+        scale = 2.0
+        return kernel
+    """
+    assert _rules(bad, "TS006")
+    clean = """
+    import jax
+
+    def factory(scale):
+        @jax.jit
+        def kernel(x):  # lint-ok: TS005 fixture kernel
+            return x * scale
+        return kernel
+    """
+    assert not _rules(clean, "TS006")
+
+
+def test_ts006_threadlocal_install_site_is_exempt():
+    """Reads routed through a registered thread-local install site
+    are the sanctioned pattern (telemetry's set_current_op shape)."""
+    src = """
+    import jax, threading
+
+    _TL = threading.local()
+
+    def install(v):
+        _TL.v = v
+
+    @jax.jit
+    def kernel(x):  # lint-ok: TS005 fixture kernel
+        return x + getattr(_TL, "v", 0)
+    """
+    assert not _rules(src, "TS006")
 
 
 def test_cc001_unlocked_global_mutation():
