@@ -31,8 +31,18 @@ from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
 #: table -> (column, type) list; all VARCHAR dictionaries derive from
 #: the snapshot rows
 _TABLES: Dict[str, List] = {
+    # fleet membership + load feedback: the local node's own gauges
+    # plus one row per heartbeat-monitored worker (executor queue
+    # depth, reserved bytes, prewarm compile counts — the numbers
+    # placement decisions read, now explainable from SQL)
     "runtime.nodes": [("node_id", VARCHAR), ("http_uri", VARCHAR),
-                      ("state", VARCHAR)],
+                      ("state", VARCHAR), ("devices", BIGINT),
+                      ("tasks_running", BIGINT),
+                      ("executor_running", BIGINT),
+                      ("executor_queued", BIGINT),
+                      ("reserved_bytes", BIGINT),
+                      ("prewarm_compiles", BIGINT),
+                      ("rtt_ms", DOUBLE), ("flaps", BIGINT)],
     "runtime.queries": [("query_id", BIGINT), ("state", VARCHAR),
                         ("query", VARCHAR), ("output_rows", BIGINT),
                         ("elapsed_ms", DOUBLE),
@@ -41,10 +51,14 @@ _TABLES: Dict[str, List] = {
                         # mirrors elapsed_ms, queued_ms is admission
                         # wait (0 on a runner — no queue), compile_ms
                         # is the query's XLA-compile share, rows_out
-                        # the lazily-resolved output row count
+                        # the lazily-resolved output row count,
+                        # unattributed_ms the attribution ledger's
+                        # coverage residual (-1 before the ledger
+                        # closed / for non-query statements)
                         ("wall_ms", DOUBLE), ("queued_ms", DOUBLE),
                         ("compile_ms", DOUBLE),
-                        ("rows_out", BIGINT)],
+                        ("rows_out", BIGINT),
+                        ("unattributed_ms", DOUBLE)],
     "runtime.operator_stats": [
         ("query_id", BIGINT), ("pipeline", BIGINT),
         ("operator_id", BIGINT), ("name", VARCHAR),
@@ -175,7 +189,55 @@ def runner_system_connector(runner) -> SystemConnector:
     runner's query history, and its catalog manager."""
 
     def nodes():
-        return [("local-0", "local://in-process", "active")]
+        # local node row: this process's own executor + memory gauges
+        from presto_tpu import sanitize
+        ex_running = ex_queued = 0
+        try:
+            from presto_tpu.execution.task_executor import (
+                get_task_executor,
+            )
+            ex = get_task_executor(create=False)
+            if ex is not None:
+                snap = ex.snapshot()
+                ex_running = snap["running_drivers"]
+                ex_queued = sum(snap["queued_drivers"])
+        except Exception:  # noqa: BLE001 — gauges are best-effort
+            pass
+        reserved = 0
+        for pool in sanitize.tracked("memory_pool"):
+            try:
+                reserved += int(pool.reserved)
+            except Exception:  # noqa: BLE001 — dying pool mid-sweep
+                pass
+        out = [("local-0", "local://in-process", "active", 1, 0,
+                ex_running, ex_queued, reserved, -1, 0.0, 0)]
+        # fleet rows: every heartbeat monitor of this process (the
+        # coordinator's membership view) contributes its workers with
+        # the load/memory feedback their last probe carried
+        for monitor in sanitize.tracked("heartbeat_monitor"):
+            try:
+                rows = monitor.snapshot()
+            except Exception:  # noqa: BLE001
+                continue
+            for w in rows:
+                load = w.get("load") or {}
+                mem = w.get("memory") or {}
+                # node_id derives from the URL — stable across
+                # membership changes and unique across monitors
+                # (an enumeration index would be neither)
+                host = w["url"].split("//", 1)[-1]
+                out.append((
+                    f"worker-{host}", w["url"], w["state"],
+                    w.get("devices", 1),
+                    int(load.get("tasks_running", 0)),
+                    int(load.get("executor_running", 0)),
+                    int(load.get("executor_queued", 0)),
+                    int(mem.get("reserved_bytes", 0)),
+                    w.get("prewarm_compiles")
+                    if w.get("prewarm_compiles") is not None else -1,
+                    w.get("rtt_ms") or 0.0,
+                    int(w.get("flaps", 0))))
+        return out
 
     def queries():
         # ids are the runner's monotonic sequence, stable across the
@@ -192,10 +254,12 @@ def runner_system_connector(runner) -> SystemConnector:
                 rows = q["rows"] = res.row_count \
                     if res is not None else -1
                 q.pop("_result", None)
+            unattr = q.get("unattributed_ms")
             out.append((q["id"], q["state"], q["sql"], rows,
                         q["elapsed_ms"], q.get("error_kind"),
                         q["elapsed_ms"], q.get("queued_ms", 0.0),
-                        q.get("compile_ms", 0.0), rows))
+                        q.get("compile_ms", 0.0), rows,
+                        unattr if unattr is not None else -1.0))
         return out
 
     def operator_stats():

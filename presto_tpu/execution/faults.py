@@ -206,6 +206,12 @@ def fire(site: str, **ctx: Any) -> None:
                 to_raise = inj.error()
                 break
     if to_raise is not None:
+        from presto_tpu.telemetry import flight as _flight
+        if _flight.ENABLED:
+            # a fired fault is exactly what a post-mortem needs to
+            # see next to the failure it caused
+            _flight.record("fault", site,
+                           type(to_raise).__name__)
         raise to_raise
 
 

@@ -358,6 +358,11 @@ class ResourceGroupManager:
                         decision=e.kind, group=e.group)
             METRICS.inc("presto_tpu_admission_sheds_total",
                         kind=e.kind, group=e.group)
+            from presto_tpu.telemetry import flight as _flight
+            if _flight.ENABLED:
+                # flight recorder: sheds are the first thing a
+                # post-mortem of "my query never ran" needs to see
+                _flight.record("shed", e.kind, e.group, user)
             raise
         finally:
             self._fire_expired(expired)
@@ -415,6 +420,9 @@ class ResourceGroupManager:
                     from presto_tpu.telemetry.metrics import METRICS
                     METRICS.inc("presto_tpu_admission_sheds_total",
                                 kind="queue_expired", group=leaf.path)
+                    from presto_tpu.telemetry import flight as _fl
+                    if _fl.ENABLED:
+                        _fl.record("shed", "queue_expired", leaf.path)
 
     @staticmethod
     def _fire_expired(expired: List[_QueuedEntry]) -> None:

@@ -117,6 +117,7 @@ class _TaskHandle:
                  max_idle_s: float):
         from presto_tpu import batch as _batch
         from presto_tpu.telemetry import kernels as _tk
+        from presto_tpu.telemetry import ledger as _ledger
         from presto_tpu.telemetry import trace as _trace
         self.label = label
         self.quantum_s = quantum_s
@@ -137,12 +138,17 @@ class _TaskHandle:
         self._merge_lock = sanitize.lock("executor.task_merge")
         self.shape_buckets = _batch.shape_buckets_override()
         self.recorder = _trace.current()
+        #: the statement's attribution ledger (telemetry/ledger.py),
+        #: re-installed around every quantum like the counters; the
+        #: shared object is thread-safe, nesting state is per-thread
+        self.ledger = _ledger.current()
 
     # -- thread-context install around one quantum ---------------------
 
     def bind(self):
         from presto_tpu import batch as _batch
         from presto_tpu.telemetry import kernels as _tk
+        from presto_tpu.telemetry import ledger as _ledger
         from presto_tpu.telemetry import trace as _trace
         # a FRESH scratch counter dict per quantum: two workers of one
         # task must not race bare `+=` on a shared dict — each merges
@@ -152,15 +158,18 @@ class _TaskHandle:
         prev_rec = None
         if self.recorder is not None:
             prev_rec = _trace.activate(self.recorder)
-        return prev_q, prev_sb, prev_rec
+        prev_led = _ledger.install(self.ledger)
+        return prev_q, prev_sb, prev_rec, prev_led
 
     def unbind(self, token) -> None:
         from presto_tpu import batch as _batch
         from presto_tpu.telemetry import kernels as _tk
+        from presto_tpu.telemetry import ledger as _ledger
         from presto_tpu.telemetry import trace as _trace
-        prev_q, prev_sb, prev_rec = token
+        prev_q, prev_sb, prev_rec, prev_led = token
         scratch = _tk.end_query(prev_q)
         _batch.set_shape_buckets(prev_sb)
+        _ledger.uninstall(prev_led)
         if self.recorder is not None:
             _trace.deactivate(prev_rec)
         if self.counters is not None and scratch:
@@ -227,6 +236,7 @@ class TaskExecutor:
         live = [d for d in drivers if not d.is_finished()]
         if not live:
             return
+        t0_ns = time.perf_counter_ns()
         with self._cond:
             self._ensure_started_locked()
             self._tasks += 1
@@ -243,6 +253,22 @@ class TaskExecutor:
         finally:
             with self._cond:
                 self._tasks -= 1
+                scheduled_ns = task.scheduled_ns
+            # ledger: the SCHEDULING GAP — wall this task spent
+            # runnable-but-unscheduled or parked, i.e. submit wall not
+            # covered by any quantum — charges to `driver` (executor
+            # overhead), and the quantum-covered remainder is ABSORBED
+            # from the submitting thread's enclosing frame: the quanta
+            # charge that wall themselves on worker threads, so the
+            # outer statement span must not also count the wait as
+            # its own self time. Quanta overlapping on a multi-core
+            # pool can make scheduled > wall; the gap clamps at 0 and
+            # finish()'s parallel normalization owns the overhang.
+            from presto_tpu.telemetry import ledger as _ledger
+            wait_ns = time.perf_counter_ns() - t0_ns
+            gap = max(0, wait_ns - scheduled_ns)
+            _ledger.add("driver", gap)
+            _ledger.absorb(wait_ns - gap)
         if task.failure is not None:
             raise task.failure
 
@@ -312,6 +338,11 @@ class TaskExecutor:
             from presto_tpu.telemetry.metrics import METRICS
             METRICS.inc("presto_tpu_executor_demotions_total",
                         level=str(lvl))
+            from presto_tpu.telemetry import flight as _flight
+            if _flight.ENABLED:
+                # flight recorder: demotions are exactly the "why was
+                # my query deprioritized" post-mortem question
+                _flight.record("demotion", lvl, entry.task.label)
         entry.level = lvl
         entry.state = "queued"
         self._runnable[lvl].append(entry)
@@ -437,26 +468,36 @@ class TaskExecutor:
         try:
             token = task.bind()
             try:
-                from presto_tpu.execution import faults
-                if faults.ARMED:
-                    # fault site `executor.quantum`: every scheduled
-                    # time slice crosses here — chaos tests fail any
-                    # query mid-execution without monkeypatching
-                    faults.fire("executor.quantum", task=task.label,
-                                level=entry.level)
-                if sanitize.ARMED:
-                    # quantum-boundary checkpoint: a violated
-                    # executor invariant fails the owning query
-                    # cleanly through the task-failure path
-                    sanitize.audit_executor(self)
-                from presto_tpu.runner.local import check_lifecycle
-                check_lifecycle(task.cancel, task.deadline)
-                if task.abort_check is not None:
-                    exc = task.abort_check()
-                    if exc is not None:
-                        raise exc
-                status, progressed = entry.driver.process_quantum(
-                    quantum_s)
+                # the whole quantum charges to the ledger's `driver`
+                # category by SELF time: kernel/scan/exchange/serde
+                # work inside it subtracts via the nesting discipline,
+                # so `driver` is exactly the drive loop's own overhead
+                from presto_tpu.telemetry import ledger as _ledger
+                with _ledger.span("driver"):
+                    from presto_tpu.execution import faults
+                    if faults.ARMED:
+                        # fault site `executor.quantum`: every
+                        # scheduled time slice crosses here — chaos
+                        # tests fail any query mid-execution without
+                        # monkeypatching
+                        faults.fire("executor.quantum",
+                                    task=task.label,
+                                    level=entry.level)
+                    if sanitize.ARMED:
+                        # quantum-boundary checkpoint: a violated
+                        # executor invariant fails the owning query
+                        # cleanly through the task-failure path
+                        sanitize.audit_executor(self)
+                    from presto_tpu.runner.local import (
+                        check_lifecycle,
+                    )
+                    check_lifecycle(task.cancel, task.deadline)
+                    if task.abort_check is not None:
+                        exc = task.abort_check()
+                        if exc is not None:
+                            raise exc
+                    status, progressed = \
+                        entry.driver.process_quantum(quantum_s)
             finally:
                 task.unbind(token)
         except BaseException as e:  # noqa: BLE001 — task-scoped fail

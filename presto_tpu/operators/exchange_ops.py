@@ -275,21 +275,25 @@ class MeshExchange:
                 self._drop_spill_dir()
 
     def _deliver_spooled(self, bucket) -> None:
+        from presto_tpu.telemetry import ledger as _ledger
         for c, dq in enumerate(bucket):
             dev = self.devices[c] if c < len(self.devices) \
                 else self.devices[0]
             for tier, payload, nbytes in dq:
                 if tier == "disk":
                     from presto_tpu.server.serde import batch_from_bytes
-                    with open(payload, "rb") as f:
-                        host_batch = batch_from_bytes(f.read())
+                    with _ledger.span("spool"):
+                        with open(payload, "rb") as f:
+                            raw = f.read()
+                    host_batch = batch_from_bytes(raw)
                 else:
                     host_batch = payload
                 # pad on the HOST to the quantized capacity ladder:
                 # exact tiny buckets would each compile fresh kernels
                 # downstream; numpy padding costs nothing
                 host_batch = _host_pad_quantized(host_batch)
-                self._enqueue(c, jax.device_put(host_batch, dev))
+                with _ledger.span("h2d"):
+                    self._enqueue(c, jax.device_put(host_batch, dev))
 
     def _discard_bucket(self, bucket) -> None:
         import os
@@ -346,11 +350,13 @@ class MeshExchange:
         import os
         import tempfile
         from presto_tpu.execution.memory import batch_bytes
+        from presto_tpu.telemetry import ledger as _ledger
         nbytes = batch_bytes(part)
         if self._host_bytes + nbytes <= self._host_spool_bytes:
             self._host_bytes += nbytes
-            self._spooled[g][consumer].append(
-                ("mem", jax.device_get(part), nbytes))
+            with _ledger.span("d2h"):
+                host = jax.device_get(part)
+            self._spooled[g][consumer].append(("mem", host, nbytes))
             return
         from presto_tpu.server.serde import batch_to_bytes
         if self._spill_dir is None:
@@ -359,8 +365,10 @@ class MeshExchange:
         path = os.path.join(self._spill_dir,
                             f"{g}-{consumer}-{self._spill_seq}.page")
         self._spill_seq += 1
-        with open(path, "wb") as f:
-            f.write(batch_to_bytes(part, assume_compact=True))
+        payload = batch_to_bytes(part, assume_compact=True)
+        with _ledger.span("spool"):
+            with open(path, "wb") as f:
+                f.write(payload)
         self.spilled_pages += 1
         self._spooled[g][consumer].append(("disk", path, nbytes))
 
@@ -593,7 +601,9 @@ class ExchangeSourceOperator(Operator):
     def get_output(self) -> Optional[Batch]:
         b = self.exchange.pop(self.consumer)
         if b is not None and self.device is not None:
-            b = jax.device_put(b, self.device)
+            from presto_tpu.telemetry import ledger as _ledger
+            with _ledger.span("h2d"):
+                b = jax.device_put(b, self.device)
         return self._count_out(b) if b is not None else None
 
     def finish(self) -> None:

@@ -38,6 +38,10 @@ from presto_tpu.session_properties import get_property
 from presto_tpu.types import DOUBLE, Type
 from presto_tpu.expr.ir import SpecialForm
 
+#: scan-iterator exhaustion sentinel (the ledger's scan span wraps
+#: each __next__, so the loop can't use the for/else idiom)
+_SCAN_DONE = object()
+
 
 @dataclasses.dataclass
 class LocalExecutionPlan:
@@ -461,7 +465,22 @@ class LocalExecutionPlanner:
                         s, columns, batch_rows, constraint)
                     acc = [] if key is not None else None
                 acc_bytes = 0
-                for b in raw:
+                from presto_tpu.telemetry import ledger as _ledger
+                it = iter(raw)
+                exhausted = False
+                while True:
+                    # scan/datagen attribution: the connector's
+                    # __next__ is where per-query datagen, file
+                    # decode, and page assembly burn host time — the
+                    # biggest slice of the caches-off glue gap
+                    if _ledger.current() is not None:
+                        with _ledger.span("scan"):
+                            b = next(it, _SCAN_DONE)
+                    else:
+                        b = next(it, _SCAN_DONE)
+                    if b is _SCAN_DONE:
+                        exhausted = True
+                        break
                     if _faults.ARMED:
                         # fault site `page_source.next`: every batch a
                         # connector yields, cached or fresh
@@ -477,7 +496,8 @@ class LocalExecutionPlanner:
                             acc.append(b)
                     out = b.rename(rename)
                     if task.device is not None:
-                        out = _jax.device_put(out, task.device)
+                        with _ledger.span("h2d"):
+                            out = _jax.device_put(out, task.device)
                         from presto_tpu.telemetry.metrics import (
                             METRICS,
                         )
@@ -485,7 +505,7 @@ class LocalExecutionPlanner:
                             "presto_tpu_transfer_bytes_total",
                             batch_bytes(out), direction="h2d")
                     yield out
-                else:
+                if exhausted:
                     # natural exhaustion only: an abandoned iterator
                     # (downstream LIMIT) must not commit a partial split
                     if acc is not None:

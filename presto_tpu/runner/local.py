@@ -709,7 +709,9 @@ class LocalRunner:
         # on a thread-local), a trace recorder only when the session
         # asks for one (query_trace_enabled)
         from presto_tpu.telemetry import build_query_stats
+        from presto_tpu.telemetry import flight as _flight
         from presto_tpu.telemetry import kernels as _tk
+        from presto_tpu.telemetry import ledger as _ledger
         from presto_tpu.telemetry import trace as _trace
         recorder = None
         prev_rec = None
@@ -720,6 +722,22 @@ class LocalRunner:
             prev_rec = _trace.activate(recorder)
             activated = True
         prev_q = _tk.begin_query()
+        # wall-attribution ledger (telemetry/ledger.py): one per
+        # statement, installed on this thread (executor quanta
+        # re-install it like the kernel counters). Admission-queue
+        # wait happened BEFORE this frame — charge it up front so the
+        # finished wall (queue + execution) is fully decomposed.
+        led = _ledger.QueryLedger()
+        prev_led = _ledger.install(led)
+        queued_ns = int((getattr(self._session_tl, "queued_ms", 0.0)
+                         or 0.0) * 1e6)
+        if queued_ns:
+            led.charge("queued", queued_ns)
+        #: the statement's history entry, set by _new_history_entry so
+        #: the ledger's residual can land on system.runtime.queries;
+        #: cleared here so a SHOW/SET statement never annotates a
+        #: previous SELECT's row
+        self._session_tl.history_entry = None
         prev = getattr(self._session_tl, "lifecycle", None)
         self._session_tl.lifecycle = (cancel, deadline)
         self._session_tl.op_stats = None  # this statement's snapshots
@@ -734,8 +752,19 @@ class LocalRunner:
                               "kernel_shape_buckets")))
         t0 = _time.perf_counter()
         t0_ns = _time.perf_counter_ns()
+        # statement start for sub-renderers that close the ledger
+        # mid-statement (EXPLAIN ANALYZE's wall-attribution section)
+        self._session_tl.statement_t0_ns = t0_ns
         try:
-            result = self._execute_lifecycled(sql)
+            # the whole statement runs under a top-level `driver`
+            # frame: prologue/epilogue host overhead (session setup,
+            # history bookkeeping, GIL preemption inside un-spanned
+            # sections) is driver/executor overhead by definition;
+            # nested planning/scan/kernel/... spans subtract, and the
+            # executor wait is absorbed (run_drivers) so worker-thread
+            # quanta never double-book it
+            with _ledger.span("driver"):
+                result = self._execute_lifecycled(sql)
         except BaseException as e:
             # a FAILED traced query keeps its timeline: events (root
             # span included) ride the exception; servers forward them
@@ -759,6 +788,13 @@ class LocalRunner:
             METRICS.inc("presto_tpu_queries_total", state="FAILED",
                         error_kind=getattr(e, "kind", None)
                         or type(e).__name__)
+            # flight recorder: the failure edge plus the recent window
+            # riding the error payload (the always-on post-mortem)
+            if _flight.ENABLED:
+                _flight.record("query", "FAILED",
+                               getattr(e, "kind", None)
+                               or type(e).__name__, sql[:80])
+                _flight.attach_failure(e)
             raise
         finally:
             self._session_tl.lifecycle = prev
@@ -770,17 +806,47 @@ class LocalRunner:
                              {"sql": sql[:200]})
             if activated:
                 _trace.deactivate(prev_rec)
+            # close the attribution ledger against the full wall
+            # (queue wait + execution) and surface it everywhere the
+            # query's stats go: query_stats (success AND failure —
+            # the exception is live in sys.exc_info here), the
+            # history entry behind system.runtime.queries, and the
+            # process counters + unattributed-ratio histogram
+            _ledger.uninstall(prev_led)
+            from presto_tpu.telemetry.metrics import METRICS
+            led_doc = led.finish(
+                queued_ns + (_time.perf_counter_ns() - t0_ns))
+            for c, ms in led_doc["categories_ms"].items():
+                METRICS.inc("presto_tpu_ledger_ns_total",
+                            ms * 1e6, category=c)
+            METRICS.inc("presto_tpu_ledger_unattributed_ns_total",
+                        max(0.0, led_doc["unattributed_ms"]) * 1e6)
+            METRICS.observe("presto_tpu_ledger_unattributed_ratio",
+                            max(0.0, led_doc["unattributed_frac"]))
+            entry = getattr(self._session_tl, "history_entry", None)
+            if entry is not None:
+                entry["unattributed_ms"] = led_doc["unattributed_ms"]
+                self._session_tl.history_entry = None
+            import sys as _sys
+            _exc = _sys.exc_info()[1]
+            if _exc is not None:
+                qs = getattr(_exc, "query_stats", None)
+                if isinstance(qs, dict):
+                    qs["ledger"] = led_doc
         from presto_tpu.telemetry.metrics import METRICS
         METRICS.inc("presto_tpu_queries_total", state="FINISHED",
                     error_kind="")
         # the full stats tree rides the result so servers (the single-
         # node coordinator) can expose it without reaching back into
         # runner internals
+        if _flight.ENABLED:
+            _flight.record("query", "FINISHED", "", sql[:80])
         ops = getattr(self._session_tl, "op_stats", None)
         result.query_stats = build_query_stats(
             (_time.perf_counter() - t0) * 1000, 0.0, counters,
             tasks=[{"task_id": "local", "pipelines": ops}]
             if ops is not None else None)
+        result.query_stats["ledger"] = led_doc
         result.trace_events = recorder.events() \
             if recorder is not None else None
         # whole-fragment fusion report (fused chains + fallback
@@ -808,25 +874,30 @@ class LocalRunner:
             or (None, None)
 
     def _execute_lifecycled(self, sql: str) -> MaterializedResult:
-        pc = self._plan_cache()
-        skey = self._session_cache_key() if pc is not None else None
-        ntext = None
-        if pc is not None and skey is not None:
-            from presto_tpu.cache import normalize_sql
-            ntext = normalize_sql(sql)
-            if pc.contains(("sql", ntext, skey)):
-                # a repeat statement: skip the parser entirely — the
-                # key can only have been inserted by a T.Query path.
-                # The normalized text rides along so _plan_query's
-                # get() doesn't re-walk the statement (the session
-                # key is NOT forwarded: _plan_query must re-derive it
-                # per execution for the width-retry re-key)
-                return self._run_query_statement(None, sql,
-                                                 cache_text=ntext)
+        from presto_tpu.telemetry import ledger as _ledger
+        with _ledger.span("planning"):
+            pc = self._plan_cache()
+            skey = self._session_cache_key() if pc is not None \
+                else None
+            ntext = None
+            hit = False
+            if pc is not None and skey is not None:
+                from presto_tpu.cache import normalize_sql
+                ntext = normalize_sql(sql)
+                hit = pc.contains(("sql", ntext, skey))
+            stmt = None if hit else parse_statement(sql)
+        if hit:
+            # a repeat statement: skip the parser entirely — the
+            # key can only have been inserted by a T.Query path.
+            # The normalized text rides along so _plan_query's
+            # get() doesn't re-walk the statement (the session
+            # key is NOT forwarded: _plan_query must re-derive it
+            # per execution for the width-retry re-key)
+            return self._run_query_statement(None, sql,
+                                             cache_text=ntext)
         # forward the normalized text on the miss path too: without
         # it a cold SELECT lexes three times (key, parse, put-key)
-        return self._execute_stmt(parse_statement(sql), sql,
-                                  cache_text=ntext)
+        return self._execute_stmt(stmt, sql, cache_text=ntext)
 
     # -- plan cache (presto_tpu/cache level 1) -------------------------
 
@@ -905,6 +976,16 @@ class LocalRunner:
 
     def _plan_query(self, stmt: Optional[T.Node], sql: str,
                     cache_text: Optional[str] = None) -> N.OutputNode:
+        """Attribution shell: parse/analyze/optimize (and the plan-
+        cache lookup) all charge to the ledger's `planning` category —
+        nested kernel/expr work subtracts via the span discipline."""
+        from presto_tpu.telemetry import ledger as _ledger
+        with _ledger.span("planning"):
+            return self._plan_query_inner(stmt, sql, cache_text)
+
+    def _plan_query_inner(self, stmt: Optional[T.Node], sql: str,
+                          cache_text: Optional[str] = None
+                          ) -> N.OutputNode:
         """SELECT text/AST -> OPTIMIZED plan, through the process-wide
         plan cache. Looked up fresh on every (re)execution so the
         width-retry loop — which bumps a session property and thereby
@@ -1138,9 +1219,17 @@ class LocalRunner:
                  # entry into system.runtime.queries
                  "queued_ms": round(float(getattr(
                      self._session_tl, "queued_ms", 0.0) or 0.0), 3),
-                 "compile_ms": 0.0, "execute_ms": 0.0}
+                 "compile_ms": 0.0, "execute_ms": 0.0,
+                 # filled when the statement's attribution ledger
+                 # closes (_execute_admitted finally) — the coverage
+                 # residual surfaced on system.runtime.queries
+                 "unattributed_ms": None}
         self.query_history.append(entry)
         del self.query_history[:-1000]  # bounded history
+        # the ledger close runs OUTSIDE _run_query_statement's
+        # bookkeeping; hand it the entry through the statement-scoped
+        # thread-local
+        self._session_tl.history_entry = entry
         return entry
 
     def _finish_history_entry(self, entry: Dict[str, Any],
@@ -1193,10 +1282,12 @@ class LocalRunner:
         )
         from presto_tpu.operators.join_ops import JoinCapacityExceeded
         import time as _time
+        from presto_tpu.telemetry import ledger as _ledger
         session = self.session
         while True:
-            planner = LocalExecutionPlanner(self.catalogs, session)
-            lplan = planner.plan(plan)
+            with _ledger.span("planning"):
+                planner = LocalExecutionPlanner(self.catalogs, session)
+                lplan = planner.plan(plan)
             self._session_tl.fusion_report = planner.fusion_report
             # history-based optimization: arm row counters for the
             # operators whose measured cardinality the store wants
@@ -1208,11 +1299,12 @@ class LocalRunner:
             from presto_tpu.execution import faults as _faults
             if _history.enabled(session.properties) \
                     and not _faults.ARMED:
-                hist_ops = _history.interesting_ops(
-                    plan, planner.node_ops_prefusion,
-                    id_remap=(planner.fusion_report or {}).get(
-                        "id_remap"),
-                    catalogs=self.catalogs)
+                with _ledger.span("planning"):
+                    hist_ops = _history.interesting_ops(
+                        plan, planner.node_ops_prefusion,
+                        id_remap=(planner.fusion_report or {}).get(
+                            "id_remap"),
+                        catalogs=self.catalogs)
             t0 = _time.perf_counter()
             from presto_tpu.session_properties import get_property
             budget = get_property(session.properties,
@@ -1296,6 +1388,14 @@ class LocalRunner:
                 if on_retry is not None:
                     on_retry()
                 continue
+            # async-dispatch undercount close (docs/OBSERVABILITY.md):
+            # all kernels are dispatched by now — block on the result
+            # batches HERE, inside the measured wall, so dispatch-
+            # then-wait slack lands in the ledger's device_wait
+            # category instead of escaping into the caller's rows()
+            with _ledger.span("device_wait"):
+                import jax as _jax
+                _jax.block_until_ready(lplan.result_sink)
             # snapshot per-operator stats ALWAYS (plain dicts — the
             # driver refs drop here, so no device batches get pinned):
             # lightweight counters (batches, busy, compile/execute,
@@ -1303,14 +1403,15 @@ class LocalRunner:
             from presto_tpu.telemetry import (
                 render_operator_stats, snapshot_drivers,
             )
-            snap = snapshot_drivers(drivers, pool)
-            self._session_tl.op_stats = snap
-            # the history recording tap: ONLY here — past every
-            # deferred overflow check, after drivers closed cleanly.
-            # Failed/cancelled/shed runs raised out above; fault-armed
-            # runs never armed hist_ops
-            if hist_ops is not None and not _faults.ARMED:
-                self._record_history(plan, planner, snap)
+            with _ledger.span("driver"):
+                snap = snapshot_drivers(drivers, pool)
+                self._session_tl.op_stats = snap
+                # the history recording tap: ONLY here — past every
+                # deferred overflow check, after drivers closed
+                # cleanly. Failed/cancelled/shed runs raised out
+                # above; fault-armed runs never armed hist_ops
+                if hist_ops is not None and not _faults.ARMED:
+                    self._record_history(plan, planner, snap)
             if profile:
                 self._last_profile = render_operator_stats(
                     snap, _time.perf_counter() - t0, pool)
@@ -1373,49 +1474,59 @@ class LocalRunner:
         the same checkpoints (the distributed root drive's remote-
         task-failed signal)."""
         import time as _time
+        from presto_tpu.telemetry import ledger as _ledger
         dctx = DriverContext(profile=profile, memory=pool,
                              count_rows_ops=count_rows_ops)
         drivers = [Driver([f.create(dctx) for f in pipe])
                    for pipe in pipelines]
         if executor is not None:
+            # the QUANTA attribute their own wall (executor workers
+            # install this statement's ledger per quantum); the
+            # submitting thread must NOT span its wait here or the
+            # same wall would count twice — the executor charges the
+            # scheduling gap (wait minus scheduled time) to `driver`
             executor.run_drivers(drivers, cancel=cancel,
                                  deadline=deadline,
                                  quantum_ms=quantum_ms,
                                  abort_check=abort_check,
                                  max_idle_s=max_idle_s)
         else:
-            idle_since: Optional[float] = None
-            while True:
-                check_lifecycle(cancel, deadline)
-                if abort_check is not None:
-                    exc = abort_check()
-                    if exc is not None:
-                        raise exc
-                all_done = True
-                progress = False
-                for d in drivers:
-                    if d.is_finished():
+            with _ledger.span("driver"):
+                idle_since: Optional[float] = None
+                while True:
+                    check_lifecycle(cancel, deadline)
+                    if abort_check is not None:
+                        exc = abort_check()
+                        if exc is not None:
+                            raise exc
+                    all_done = True
+                    progress = False
+                    for d in drivers:
+                        if d.is_finished():
+                            continue
+                        all_done = False
+                        progress = d.process() or progress
+                    if all_done:
+                        break
+                    if progress:
+                        idle_since = None
                         continue
-                    all_done = False
-                    progress = d.process() or progress
-                if all_done:
-                    break
-                if progress:
-                    idle_since = None
-                    continue
-                now = _time.monotonic()
-                if idle_since is None:
-                    idle_since = now
-                elif now - idle_since > max_idle_s:
-                    raise QueryError(
-                        f"query made no progress for {max_idle_s:.0f}s "
-                        "(deadlock?)")
-                _time.sleep(0.002)
+                    now = _time.monotonic()
+                    if idle_since is None:
+                        idle_since = now
+                    elif now - idle_since > max_idle_s:
+                        raise QueryError(
+                            f"query made no progress for "
+                            f"{max_idle_s:.0f}s (deadlock?)")
+                    _time.sleep(0.002)
         # sync-free error protocol: ONE host fetch for every deferred
         # device flag (join capacity overflow etc.), after all drivers
-        # finished but before results are trusted
+        # finished but before results are trusted. The fetch blocks on
+        # outstanding device work — that wall is device_wait, not
+        # driver overhead (the async-dispatch undercount)
         from presto_tpu.operators.base import run_deferred_checks
-        run_deferred_checks(dctx)
+        with _ledger.span("device_wait"):
+            run_deferred_checks(dctx)
         for d in drivers:
             d.close()
         return drivers
@@ -1436,18 +1547,20 @@ class LocalRunner:
         return sink
 
     def _plan_for_write(self, q: T.Query) -> N.OutputNode:
-        try:
-            plan = plan_statement(q, self.catalogs, self.session)
-        except AnalysisError as e:
-            raise QueryError(str(e)) from e
-        from presto_tpu.planner.validation import validate
-        validate(plan, "analysis", session=self.session)
-        from presto_tpu.planner.optimizer import optimize
-        plan = optimize(plan, self.catalogs,
-                        session=self.session)
-        validate(plan, "optimizer", session=self.session,
-                 catalogs=self.catalogs)
-        return plan
+        from presto_tpu.telemetry import ledger as _ledger
+        with _ledger.span("planning"):
+            try:
+                plan = plan_statement(q, self.catalogs, self.session)
+            except AnalysisError as e:
+                raise QueryError(str(e)) from e
+            from presto_tpu.planner.validation import validate
+            validate(plan, "analysis", session=self.session)
+            from presto_tpu.planner.optimizer import optimize
+            plan = optimize(plan, self.catalogs,
+                            session=self.session)
+            validate(plan, "optimizer", session=self.session,
+                     catalogs=self.catalogs)
+            return plan
 
     def _run_write(self, qplan: N.OutputNode, handle, sink,
                    schema, column_sources: Dict[str, Optional[str]]
@@ -1668,6 +1781,17 @@ class LocalRunner:
                 text = N.plan_text(plan, annotate=combined) \
                     + "\n\n" + self._last_profile + \
                     f"\n-- rows: {result.row_count}"
+                # the attribution ledger's view of the statement so
+                # far (the final close happens at statement end; this
+                # renders the same categories against elapsed wall)
+                from presto_tpu.telemetry import ledger as _ledger
+                from presto_tpu.telemetry.stats import render_ledger
+                led = _ledger.current()
+                led_t0 = getattr(self._session_tl,
+                                 "statement_t0_ns", None)
+                if led is not None and led_t0 is not None:
+                    text += "\n\n" + render_ledger(led.finish(
+                        _time.perf_counter_ns() - led_t0))
                 entry["state"] = "FINISHED"
                 entry["rows"] = result.row_count
             except Exception as e:

@@ -147,6 +147,10 @@ class _Query:
         self.stats: Optional[dict] = None
         #: Chrome trace_event list when the query was traced
         self.trace: Optional[list] = None
+        #: flight-recorder window snapshotted at failure (the always-
+        #: on post-mortem; served on GET /v1/query/{id} and in the
+        #: FAILED statement payload)
+        self.flight: Optional[list] = None
 
 
 #: result rows per client page (reference: the target-result-size
@@ -589,6 +593,9 @@ class Coordinator(Node):
                     # the full stats tree: wall/queued/compile/execute
                     # rollup + per-task, per-operator detail
                     row["stats"] = q.stats
+                    # the flight-recorder window captured at failure
+                    # (None for healthy queries)
+                    row["flight"] = q.flight
                     return json.dumps(row).encode()
             raise KeyError(qid)
         if path == "/v1/resourceGroups":
@@ -620,6 +627,11 @@ class Coordinator(Node):
             elif q.state == "FAILED":
                 out["error"] = {"message": q.error,
                                 "errorKind": q.error_kind}
+                if q.flight:
+                    # the flight-recorder post-mortem rides the error
+                    # payload itself (bounded window) — no second
+                    # round trip to understand a failure
+                    out["error"]["flight"] = q.flight[-64:]
             else:
                 out["nextUri"] = f"{self.url}/v1/statement/executing/" \
                                  f"{qid}/{token}"
@@ -813,8 +825,14 @@ th{{background:#222}}
             q.columns = [
                 {"name": n, "type": f.type.display()}
                 for n, f in zip(result.names, result.fields)]
+            # result materialization (pylist conversion for the client
+            # protocol) is real host glue INSIDE the query's wall —
+            # measured here so the ledger re-close below can attribute
+            # it instead of leaving it in the residual
+            t_mat = time.monotonic()
             rows = result.rows()
             q.data = [list(r) for r in rows]
+            q.materialize_ms = (time.monotonic() - t_mat) * 1000
             q.state = "FINISHED"
             q.stats = getattr(result, "query_stats", None)
             q.trace = getattr(result, "trace_events", None)
@@ -831,6 +849,17 @@ th{{background:#222}}
             # must survive into the stats tree
             q.trace = getattr(e, "trace_events", None)
             q.stats = getattr(e, "query_stats", None)
+            # flight-recorder post-mortem: the recent window rides the
+            # error payload (attached by the runner tier when the
+            # failure crossed it; snapshot here otherwise so the
+            # distributed path is covered too)
+            from presto_tpu.telemetry import flight as _flight
+            q.flight = getattr(e, "flight_events", None)
+            if q.flight is None and _flight.ENABLED:
+                _flight.record("query", "FAILED",
+                               q.error_kind or type(e).__name__,
+                               q.sql[:80])
+                q.flight = _flight.snapshot_dicts(64)
         finally:
             q.done_at = time.monotonic()
             # QueryStats rollup: the coordinator owns wall/queued (it
@@ -859,6 +888,38 @@ th{{background:#222}}
             q.stats = {**base, **inner,
                        "wall_ms": round(wall_ms, 3),
                        "queued_ms": round(queued_ms, 3)}
+            # re-close the attribution ledger against the FULL query
+            # wall (coordinator queue + execution + result
+            # materialization + protocol overhead): categories come
+            # from the execution tier, queue wait is added here (the
+            # coordinator owns it), and the residual absorbs the
+            # protocol share — Σ categories + unattributed == wall
+            # stays exact at this level too
+            led = q.stats.get("ledger")
+            if led is not None:
+                cats = dict(led.get("categories_ms", {}))
+                if queued_ms > 0:
+                    cats["queued"] = round(
+                        cats.get("queued", 0.0) + queued_ms, 3)
+                mat_ms = getattr(q, "materialize_ms", 0.0)
+                if mat_ms > 0:
+                    cats["driver"] = round(
+                        cats.get("driver", 0.0) + mat_ms, 3)
+                total = sum(cats.values())
+                if total > wall_ms > 0:
+                    # same normalization contract as QueryLedger.
+                    # finish: proportions stay, the invariant stays
+                    # exact
+                    cats = {c: round(v * wall_ms / total, 3)
+                            for c, v in cats.items()}
+                unattr = wall_ms - sum(cats.values())
+                q.stats["ledger"] = {
+                    "wall_ms": round(wall_ms, 3),
+                    "categories_ms": cats,
+                    "unattributed_ms": round(unattr, 3),
+                    "unattributed_frac": round(unattr / wall_ms, 4)
+                    if wall_ms > 0 else 0.0,
+                }
             self.resource_groups.finish(q.group, self._query_memory())
             if not self.single_node:
                 # the worker topology never passes through a
@@ -869,6 +930,13 @@ th{{background:#222}}
                 METRICS.inc("presto_tpu_queries_total",
                             state=q.state,
                             error_kind=q.error_kind or "")
+                if q.state == "FINISHED":
+                    from presto_tpu.telemetry import flight as _fl
+                    if _fl.ENABLED:
+                        # worker-topology lifecycle edge (the runner
+                        # tier records these on single-node paths)
+                        _fl.record("query", "FINISHED", "",
+                                   q.sql[:80])
             # event listeners see the COMPLETED QueryStats payload —
             # the same numbers GET /v1/query/{id} serves (satellite:
             # external sinks must not need a second code path)
@@ -968,6 +1036,10 @@ th{{background:#222}}
                     attempt += 1
                     if attempt > retries:
                         raise
+                    from presto_tpu.telemetry import flight as _fl
+                    if _fl.ENABLED:
+                        _fl.record("retry", "query", attempt,
+                                   f"{type(e).__name__}: {e}"[:120])
                     bad = getattr(e, "worker", None)
                     if bad:
                         blacklist.add(bad)
@@ -1019,6 +1091,29 @@ th{{background:#222}}
                     access_control=self.access_control)
             return self._embedded_runner
 
+    def _worker_clock_offset(self, url: str) -> Optional[int]:
+        """Best clock-offset estimate for merging `url`'s trace spans:
+        the heartbeat's smallest-RTT estimate when membership runs,
+        else one cached direct /v1/info handshake."""
+        if self.membership is not None:
+            off = self.membership.clock_offset(url)
+            if off is not None:
+                return off
+        cache = getattr(self, "_clock_offsets", None)
+        if cache is None:
+            cache = self._clock_offsets = {}
+        if url not in cache:
+            from presto_tpu.telemetry.trace import (
+                estimate_clock_offset,
+            )
+            off = estimate_clock_offset(url, timeout=2.0)
+            if off is None:
+                # transient failure: don't poison the cache — the
+                # next traced query retries the handshake
+                return None
+            cache[url] = off
+        return cache[url]
+
     def _worker_devices(self, worker_urls: List[str]) -> List[int]:
         """Per-worker device counts (mesh-per-worker: a worker's tasks
         expand to one subtask per device)."""
@@ -1041,8 +1136,11 @@ th{{background:#222}}
         compile_expression credits expr_compile_ns while fragments are
         planned, and counters installed only around the drive loop
         would report expr_compile_ms = 0 on this topology forever."""
+        import time as _time
         from presto_tpu.telemetry import build_query_stats
         from presto_tpu.telemetry import kernels as _tk
+        from presto_tpu.telemetry import ledger as _ledger
+        from presto_tpu.telemetry.metrics import METRICS
         # honor the statement's kernel_shape_buckets on the
         # coordinator's own root-fragment drive too: this thread plans
         # and drives pipelines directly, outside LocalRunner.execute
@@ -1054,10 +1152,26 @@ th{{background:#222}}
             dict(self.properties if properties is None
                  else properties), "kernel_shape_buckets")))
         prev_q = _tk.begin_query()
+        # attribution ledger for the ATTEMPT: the coordinator's own
+        # planning/drive/exchange wall decomposes like a local
+        # statement's (remote-task device time is attributed on the
+        # workers; here it shows up as exchange-wait inside driver/
+        # unattributed — the honest cross-process picture)
+        led = _ledger.QueryLedger()
+        prev_led = _ledger.install(led)
+        t0_ns = _time.perf_counter_ns()
+        result = None
         try:
-            return self._execute_attempt_inner(
-                sql, worker_urls, properties, on_columns, user,
-                lifecycle)
+            # top-level `driver` frame, same contract as the runner's
+            # statement shell: attempt-level host overhead (dispatch
+            # bookkeeping, task-status collection) is driver overhead;
+            # nested planning/exchange/serde spans subtract and the
+            # root drive's executor wait is absorbed by run_drivers
+            with _ledger.span("driver"):
+                result = self._execute_attempt_inner(
+                    sql, worker_urls, properties, on_columns, user,
+                    lifecycle)
+            return result
         except BaseException as e:
             # failed attempts keep their kernel attribution (compile
             # time burned before the failure); _run_query's merge
@@ -1071,6 +1185,22 @@ th{{background:#222}}
         finally:
             _tk.end_query(prev_q)
             _batch.set_shape_buckets(prev_sb)
+            _ledger.uninstall(prev_led)
+            led_doc = led.finish(_time.perf_counter_ns() - t0_ns)
+            for c, ms in led_doc["categories_ms"].items():
+                METRICS.inc("presto_tpu_ledger_ns_total",
+                            ms * 1e6, category=c)
+            METRICS.inc("presto_tpu_ledger_unattributed_ns_total",
+                        max(0.0, led_doc["unattributed_ms"]) * 1e6)
+            METRICS.observe("presto_tpu_ledger_unattributed_ratio",
+                            max(0.0, led_doc["unattributed_frac"]))
+            qs = getattr(result, "query_stats", None)
+            if qs is None:
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                qs = getattr(exc, "query_stats", None)
+            if isinstance(qs, dict):
+                qs["ledger"] = led_doc
 
     def _execute_attempt_inner(self, sql: str, worker_urls: List[str],
                                properties: Optional[dict] = None,
@@ -1174,6 +1304,13 @@ th{{background:#222}}
         # the drive loop's next cancel poll
         lifecycle.remote = remote
         stop = threading.Event()
+        # distributed tracing: when this query is traced (the
+        # recorder was activated by execute()), every task spec asks
+        # the worker to record + ship its spans, and dispatch times
+        # anchor coordinator-side task lanes
+        from presto_tpu.telemetry import trace as _trace
+        recorder = _trace.current()
+        dispatch_t0: Dict[str, int] = {}
         try:
             # dispatch distributed fragments: one task per worker
             # (reference: SqlStageExecution.scheduleTask ->
@@ -1200,8 +1337,16 @@ th{{background:#222}}
                         "n_producers_by_edge": n_producers_by_edge,
                         "coordinator_url": self.url,
                         "profile": profile,
+                        "trace": recorder is not None,
+                        "trace_ctx": {
+                            "query_id": query_id,
+                            "task_id": task_id,
+                            "attempt": lifecycle.attempts,
+                            "parent_span": "query"},
                     }
                     body = json.dumps(spec).encode()
+                    dispatch_t0[task_id] = \
+                        _time.perf_counter_ns()
 
                     def dispatch(wurl=wurl, body=body):
                         # fault site + transport retry INSIDE one
@@ -1357,6 +1502,30 @@ th{{background:#222}}
                 tasks += self._collect_task_stats(
                     remote, wait=True,
                     timeout_s=10.0 if profile else 2.0)
+                if recorder is not None:
+                    # merge the workers' shipped spans into one fleet
+                    # timeline (per-worker pids, clock offsets from
+                    # the heartbeat or a direct handshake) + a
+                    # coordinator-side lane per dispatched task. The
+                    # merger is per RECORDER, so a retried attempt
+                    # reuses the first attempt's pid/lane allocations
+                    merger = _trace.FleetTraceMerger.for_recorder(
+                        recorder)
+                    for t in tasks:
+                        ev = t.pop("trace", None)
+                        if ev:
+                            merger.merge(
+                                t["worker"], t["task_id"],
+                                lifecycle.attempts, ev,
+                                self._worker_clock_offset(
+                                    t["worker"]))
+                    now_ns = _time.perf_counter_ns()
+                    for task_id, wurl in remote:
+                        td = dispatch_t0.get(task_id)
+                        if td is not None:
+                            recorder.add(
+                                f"task {task_id}", "task", td,
+                                now_ns - td, {"worker": wurl})
         finally:
             stop.set()
             lifecycle.remote = []
@@ -1364,6 +1533,8 @@ th{{background:#222}}
         if failure:
             raise failure[0]
         from presto_tpu.telemetry import build_query_stats
+        for t in tasks:
+            t.pop("trace", None)  # merged above; not a stats field
         qstats = build_query_stats(wall_s * 1000, 0.0,
                                    kernel_counters, tasks=tasks)
         # top-level compile/execute must mean the same thing on every
@@ -1433,6 +1604,7 @@ th{{background:#222}}
             stats = st.get("stats") or {}
             out = {"task_id": task_id, "worker": wurl,
                    "wall_s": stats.get("wall_s"),
+                   "trace": st.get("trace"),
                    "pipelines": stats.get("pipelines") or []}
             if st.get("stats") is None:
                 # snapshot not published in time: mark the entry so

@@ -34,6 +34,8 @@ from presto_tpu.batch import Batch
 from presto_tpu.execution import faults
 from presto_tpu.operators.exchange_ops import edge_key_dicts
 from presto_tpu.server.serde import batch_from_bytes, batch_to_bytes
+from presto_tpu.telemetry import flight as _flight
+from presto_tpu.telemetry import ledger as _ledger
 from presto_tpu.telemetry import trace as _trace
 from presto_tpu.telemetry.metrics import METRICS
 
@@ -70,6 +72,12 @@ def _retry_transient(fn, retries: int, base_s: float = _BACKOFF_BASE_S,
             METRICS.inc("presto_tpu_transport_retries_total")
             METRICS.inc("presto_tpu_backoff_sleep_ns_total",
                         sleep_s * 1e9)
+            # the backoff sleep is its own ledger category (a leaf:
+            # the enclosing exchange/dispatch span must not absorb
+            # it), and every transport retry leaves a flight event
+            _ledger.add("retry_backoff", int(sleep_s * 1e9))
+            if _flight.ENABLED:
+                _flight.record("retry", "transport", attempt)
             if _trace.ACTIVE:
                 # retry/backoff sleeps show up as spans in a traced
                 # query's timeline (the faults tier's visible cost)
@@ -364,12 +372,16 @@ class HttpExchange:
                     direction="push")
         METRICS.inc("presto_tpu_exchange_bytes_total", len(payload),
                     direction="push")
+        # ledger: the push's transport wall is `exchange` — backoff
+        # sleeps inside the retry loop subtract into retry_backoff
         if _trace.ACTIVE and _trace.current() is not None:
             with _trace.span("exchange.push", "exchange",
                              consumer=consumer, bytes=len(payload)):
-                _retry_transient(send, TRANSPORT_RETRIES)
+                with _ledger.span("exchange"):
+                    _retry_transient(send, TRANSPORT_RETRIES)
         else:
-            _retry_transient(send, TRANSPORT_RETRIES)
+            with _ledger.span("exchange"):
+                _retry_transient(send, TRANSPORT_RETRIES)
 
     def _deliver_whole(self, consumers: List[int], batch: Batch,
                        producer: int) -> None:
@@ -383,8 +395,10 @@ class HttpExchange:
         remote = [c for c in consumers if not self._is_local(c)]
         if local:
             n = batch.num_valid()
-            host = jax.device_get(
-                batch.compact(bucket_capacity(max(n, 1)), known_valid=n))
+            with _ledger.span("d2h"):
+                host = jax.device_get(
+                    batch.compact(bucket_capacity(max(n, 1)),
+                                  known_valid=n))
             from presto_tpu.execution.memory import batch_bytes
             METRICS.inc("presto_tpu_transfer_bytes_total",
                         batch_bytes(host), direction="d2h")
@@ -423,7 +437,8 @@ class HttpExchange:
             dev_sorted, bounds = partition_segments(
                 batch, tuple(self.partition_keys), self._remaps,
                 self.n_consumers)
-            host, hbounds = jax.device_get((dev_sorted, bounds))
+            with _ledger.span("d2h"):
+                host, hbounds = jax.device_get((dev_sorted, bounds))
             from presto_tpu.execution.memory import batch_bytes
             METRICS.inc("presto_tpu_transfer_bytes_total",
                         batch_bytes(host), direction="d2h")
@@ -475,6 +490,13 @@ class TaskState:
     def __init__(self):
         self.state = "running"
         self.error: Optional[str] = None
+        #: distributed tracing (spec["trace"]): the live recorder of a
+        #: running traced task (GET /v1/task/{id}/trace drains it) and
+        #: the final undrained spans shipped with terminal status —
+        #: attached BEFORE the state flips so a poll that observes
+        #: "finished"/"failed" always sees the spans too
+        self.trace_recorder = None
+        self.trace: Optional[list] = None
         #: {"wall_s", "pipelines": per-operator snapshot dicts} of the
         #: finished task — shipped in the /v1/task/{tid} status
         #: response so the coordinator can roll TaskStats into
@@ -615,6 +637,11 @@ class Node:
     def handle_get(self, path: str) -> bytes:
         if path == "/v1/info":
             info = {"state": "active", "devices": self.n_devices,
+                    # clock handshake for fleet trace merge: the
+                    # caller samples its own clock around this GET and
+                    # estimates offset = midpoint - clock_ns (best
+                    # estimate rides the smallest-RTT heartbeat probe)
+                    "clock_ns": time.perf_counter_ns(),
                     # load feedback for the heartbeat tier: the
                     # scheduler prefers lightly-loaded members and the
                     # fleet memory enforcer gates dispatch on the
@@ -645,13 +672,31 @@ class Node:
             return json.dumps({
                 tid: {"state": t.state, "error": t.error}
                 for tid, t in list(self.tasks.items())}).encode()
+        if path == "/v1/flight":
+            # the always-on flight recorder's live ring — the
+            # no-one-pre-armed-anything post-mortem surface
+            return json.dumps({
+                **_flight.stats(),
+                "events": _flight.snapshot_dicts(),
+            }).encode()
+        if path.startswith("/v1/task/") and path.endswith("/trace"):
+            # span drain for LONG tasks: returns the spans buffered so
+            # far and removes them from the recorder — the terminal
+            # status ships only what was never drained
+            tid = path.split("/")[3]
+            t = self.tasks[tid]
+            rec = t.trace_recorder
+            events = rec.drain() if rec is not None else []
+            return json.dumps({"taskId": tid,
+                               "traceEvents": events}).encode()
         if path.startswith("/v1/task/"):
             tid = path.rsplit("/", 1)[1]
             t = self.tasks[tid]
             return json.dumps({"state": t.state, "error": t.error,
                                "error_kind": t.error_kind,
                                "suggested": t.suggested,
-                               "stats": t.stats}).encode()
+                               "stats": t.stats,
+                               "trace": t.trace}).encode()
         raise KeyError(path)
 
     def handle_post(self, path: str, body: bytes,
@@ -789,10 +834,37 @@ class Node:
         self.registry.drop_query(query_id)
 
     def _run_task(self, spec: dict, state: TaskState) -> None:
+        # distributed tracing: a traced task records its OWN spans
+        # (driver/operator/kernel/exchange — the executor re-installs
+        # this recorder per quantum) and ships them with terminal
+        # status; the coordinator merges them into the query timeline
+        # with this node's clock offset applied. The trace context
+        # (query id + parent span + attempt) rides the spec.
+        rec = prev_rec = None
+        ctx = spec.get("trace_ctx") or {}
+        if spec.get("trace"):
+            rec = _trace.TraceRecorder(ctx.get("query_id", ""))
+            state.trace_recorder = rec
+            prev_rec = _trace.activate(rec)
+        t0_ns = time.perf_counter_ns()
+
+        def _close_trace(failed: bool) -> None:
+            if rec is None:
+                return
+            rec.add("task", "task", t0_ns,
+                    time.perf_counter_ns() - t0_ns,
+                    {"task": spec.get("task_id", ""),
+                     "attempt": ctx.get("attempt"),
+                     "parent": ctx.get("parent_span"),
+                     "failed": failed})
+            state.trace = rec.drain()
         try:
-            state.stats = self.execute_fragment(spec, state.cancel)
+            stats = self.execute_fragment(spec, state.cancel)
+            _close_trace(False)
+            state.stats = stats
             state.state = "finished"
         except Exception as e:  # noqa: BLE001
+            _close_trace(True)
             if state.cancel.is_set():
                 state.state = "aborted"
             else:
@@ -812,6 +884,8 @@ class Node:
                 state.error = f"{type(e).__name__}: {e}\n" \
                               f"{traceback.format_exc(limit=8)}"
         finally:
+            if rec is not None:
+                _trace.deactivate(prev_rec)
             state.done_at = time.monotonic()
 
     def execute_fragment(self, spec: dict,
@@ -940,24 +1014,25 @@ def derive_fragments(runner, sql: str, stmt=None):
     )
     from presto_tpu.planner.local_planner import prune_unused_columns
     from presto_tpu.planner.optimizer import optimize
-    if stmt is None:
-        stmt = parse_statement(sql)
-    if isinstance(stmt, T.Explain):
-        stmt = stmt.statement
-    from presto_tpu.planner.validation import (
-        validate, validate_fragments,
-    )
-    plan = runner.create_plan(sql, stmt=stmt)
-    validate(plan, "analysis", session=runner.session)
-    plan = optimize(plan, runner.catalogs, session=runner.session)
-    validate(plan, "optimizer", session=runner.session,
-             catalogs=runner.catalogs)
-    prune_unused_columns(plan)
-    plan = add_exchanges(plan, runner.catalogs, runner.session)
-    validate(plan, "exchanges", session=runner.session)
-    fplan = fragment_plan(plan)
-    validate_fragments(fplan, "exchanges", session=runner.session)
-    return fplan
+    with _ledger.span("planning"):
+        if stmt is None:
+            stmt = parse_statement(sql)
+        if isinstance(stmt, T.Explain):
+            stmt = stmt.statement
+        from presto_tpu.planner.validation import (
+            validate, validate_fragments,
+        )
+        plan = runner.create_plan(sql, stmt=stmt)
+        validate(plan, "analysis", session=runner.session)
+        plan = optimize(plan, runner.catalogs, session=runner.session)
+        validate(plan, "optimizer", session=runner.session,
+                 catalogs=runner.catalogs)
+        prune_unused_columns(plan)
+        plan = add_exchanges(plan, runner.catalogs, runner.session)
+        validate(plan, "exchanges", session=runner.session)
+        fplan = fragment_plan(plan)
+        validate_fragments(fplan, "exchanges", session=runner.session)
+        return fplan
 
 
 def build_http_exchanges(query_id: str, fplan,

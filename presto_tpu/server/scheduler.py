@@ -63,6 +63,9 @@ from presto_tpu.server.node import (
     TRANSPORT_RETRIES, _retry_transient, http_delete, http_get,
     http_post,
 )
+from presto_tpu.telemetry import flight as _flight
+from presto_tpu.telemetry import ledger as _ledger
+from presto_tpu.telemetry import trace as _trace
 from presto_tpu.telemetry.metrics import METRICS
 
 #: consecutive status-poll failures (each already transport-retried)
@@ -83,7 +86,8 @@ class WorkerState:
 
     __slots__ = ("url", "state", "consecutive_failures", "devices",
                  "last_seen", "rtt_ms", "load", "memory", "flaps",
-                 "last_error")
+                 "last_error", "clock_offset_ns", "offset_rtt_ms",
+                 "prewarm_compiles")
 
     def __init__(self, url: str):
         self.url = url
@@ -96,6 +100,15 @@ class WorkerState:
         self.memory: dict = {}
         self.flaps = 0                 # re-admissions after removal
         self.last_error: Optional[str] = None
+        #: clock handshake for the fleet trace merge: coordinator
+        #: perf_counter ns minus this worker's /v1/info clock_ns at
+        #: the probe midpoint, kept from the SMALLEST-RTT probe (the
+        #: tightest bound on the true offset)
+        self.clock_offset_ns: Optional[int] = None
+        self.offset_rtt_ms: Optional[float] = None
+        #: per-worker AOT prewarm compile count (/v1/info "prewarm")
+        #: — surfaced on system.runtime.nodes
+        self.prewarm_compiles: Optional[int] = None
 
 
 class HeartbeatMonitor:
@@ -178,12 +191,13 @@ class HeartbeatMonitor:
             self._probe(url)
 
     def _probe(self, url: str) -> None:
-        t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
         try:
             if faults.ARMED:
                 faults.fire("worker.heartbeat", url=url)
             info = json.loads(http_get(f"{url}/v1/info",
                                        timeout=self.timeout_s))
+            t1_ns = time.perf_counter_ns()
             if info.get("state") != "active":
                 raise RuntimeError(f"worker state {info.get('state')}")
         except Exception as e:  # noqa: BLE001 — every failure mode
@@ -192,11 +206,11 @@ class HeartbeatMonitor:
             self._record_failure(url, f"{type(e).__name__}: {e}")
             return
         METRICS.inc("presto_tpu_heartbeat_probes_total", status="ok")
-        self._record_success(url, info,
-                             (time.perf_counter() - t0) * 1e3)
+        self._record_success(url, info, (t1_ns - t0_ns) / 1e6,
+                             mid_ns=(t0_ns + t1_ns) // 2)
 
-    def _record_success(self, url: str, info: dict,
-                        rtt_ms: float) -> None:
+    def _record_success(self, url: str, info: dict, rtt_ms: float,
+                        mid_ns: Optional[int] = None) -> None:
         with self._lock:
             w = self._workers.get(url)
             if w is None:
@@ -210,12 +224,31 @@ class HeartbeatMonitor:
             w.memory = info.get("memory") or {}
             w.last_error = None
             w.state = "active"
+            prewarm = info.get("prewarm")
+            if isinstance(prewarm, dict):
+                w.prewarm_compiles = prewarm.get("compiles")
+            # clock-offset handshake: keep the estimate from the
+            # smallest-RTT probe — the tightest bound on the true
+            # offset (a re-admitted worker is a NEW process with a
+            # new epoch, so readmission resets the best-so-far)
+            if was == "removed":
+                w.offset_rtt_ms = None
+            remote_clock = info.get("clock_ns")
+            if mid_ns is not None and remote_clock is not None \
+                    and (w.offset_rtt_ms is None
+                         or rtt_ms < w.offset_rtt_ms):
+                w.clock_offset_ns = mid_ns - int(remote_clock)
+                w.offset_rtt_ms = rtt_ms
             if was == "removed":
                 w.flaps += 1
         if was != "active":
             METRICS.inc("presto_tpu_membership_transitions_total",
                         to="readmitted" if was == "removed"
                         else "active")
+            if _flight.ENABLED:
+                _flight.record("membership",
+                               "readmitted" if was == "removed"
+                               else "active", url)
         if self.memory_sink is not None:
             try:
                 self.memory_sink.report(
@@ -243,6 +276,8 @@ class HeartbeatMonitor:
         if now != was:
             METRICS.inc("presto_tpu_membership_transitions_total",
                         to=now)
+            if _flight.ENABLED:
+                _flight.record("membership", now, url, error[:120])
         if removed and self.memory_sink is not None:
             # a removed member's stale reservation must not keep
             # gating dispatch onto the survivors
@@ -275,6 +310,14 @@ class HeartbeatMonitor:
             w = self._workers.get(url)
             return w.devices if w is not None else 1
 
+    def clock_offset(self, url: str) -> Optional[int]:
+        """Best clock-offset estimate (coordinator perf ns - worker
+        clock ns) for the fleet trace merge; None before the first
+        successful probe."""
+        with self._lock:
+            w = self._workers.get(url)
+            return w.clock_offset_ns if w is not None else None
+
     def load_score(self, url: str) -> int:
         """Cheap placement feedback: queued + running work the member
         last reported (0 when unknown)."""
@@ -295,6 +338,8 @@ class HeartbeatMonitor:
                 "rtt_ms": round(w.rtt_ms, 2)
                 if w.rtt_ms is not None else None,
                 "load": dict(w.load), "memory": dict(w.memory),
+                "clock_offset_ns": w.clock_offset_ns,
+                "prewarm_compiles": w.prewarm_compiles,
                 "last_error": w.last_error,
             } for w in self._workers.values()]
 
@@ -355,6 +400,17 @@ class TaskOutputSpool:
 
     def put(self, key: str, consumer: int, task: str, attempt: int,
             producer: int, seq: int, payload: bytes) -> None:
+        # spool I/O is its own ledger category (the drive thread of a
+        # coordinator-run fragment pushes through here directly);
+        # remote tasks' puts arrive on HTTP handler threads, which
+        # carry no query ledger — their spool wall is accounted on
+        # the WORKER side as exchange transport
+        with _ledger.span("spool"):
+            self._put(key, consumer, task, attempt, producer, seq,
+                      payload)
+
+    def _put(self, key: str, consumer: int, task: str, attempt: int,
+             producer: int, seq: int, payload: bytes) -> None:
         sk = (task, attempt, key, consumer, producer)
         nbytes = len(payload)
         page = {"key": key, "consumer": consumer,
@@ -429,6 +485,10 @@ class TaskOutputSpool:
         First commit wins: a later attempt's commit (or the same
         attempt re-observed) publishes nothing and returns False —
         the exactly-once guarantee of the spooled tier."""
+        if _trace.ACTIVE and _trace.current() is not None:
+            _trace.current().instant("spool.commit", "spool",
+                                     {"task": task,
+                                      "attempt": attempt})
         drop: List[dict] = []
         with self._lock:
             if task in self._committed:
@@ -494,6 +554,15 @@ class TaskOutputSpool:
         ``spool.read`` fires per page when armed — a replay failure
         fails the consuming task attempt, which the task-retry tier
         absorbs."""
+        with _ledger.span("spool"):
+            return self._pages_for(key, consumer)
+
+    def _pages_for(self, key: str, consumer: int
+                   ) -> List[Tuple[int, int, bytes]]:
+        if _trace.ACTIVE and _trace.current() is not None:
+            _trace.current().instant("spool.read", "spool",
+                                     {"key": key,
+                                      "consumer": consumer})
         with self._lock:
             pages = sorted(self._pages.get((key, consumer), ()),
                            key=lambda p: (p["producer"], p["seq"]))
@@ -631,7 +700,32 @@ class StageScheduler:
         self.report = {"tasks": 0, "task_attempts": 0, "retried": 0,
                        "reused_after_failure": 0, "workers_lost": 0}
         self._rng = random.Random(0xF1EE7)
+        #: distributed tracing: the query's recorder (current on the
+        #: attempt thread when query_trace_enabled), per-attempt
+        #: coordinator-side span starts, and the worker-shipped span
+        #: lists merged into one fleet timeline at the end of run()
+        self._recorder = _trace.current()
+        self._attempt_started: Dict[tuple, int] = {}
+        self._task_traces: List[tuple] = []
         sanitize.track("stage_scheduler", self)
+
+    def _attempt_span(self, rec_: _TaskRecord, attempt: int,
+                      state: str, worker: Optional[str]) -> None:
+        """Coordinator-side lane for one task ATTEMPT (dispatch ->
+        terminal): guarantees a retried task's dead attempt stays
+        visible in the merged timeline even when its worker died
+        without shipping spans (SIGKILL)."""
+        if self._recorder is None:
+            return
+        key = (rec_.fragment, rec_.slot, attempt)
+        t0 = self._attempt_started.pop(key, None)
+        if t0 is None:
+            return
+        self._recorder.add(
+            f"task {self.query_id}.{rec_.fragment}.{rec_.slot} "
+            f"attempt {attempt}", "task", t0,
+            time.perf_counter_ns() - t0,
+            {"state": state, "worker": worker or ""})
 
     # -- membership helpers ------------------------------------------------
 
@@ -749,6 +843,27 @@ class StageScheduler:
             self.lifecycle.remote = []
             self._release_all()
         assert result is not None
+        # fleet trace merge: every attempt's worker-shipped spans land
+        # in the coordinator recorder as per-worker pids, clock-offset
+        # adjusted (heartbeat estimate; direct handshake fallback) —
+        # one Perfetto document spans the whole fleet, retried
+        # attempts in separate lanes
+        if self._recorder is not None and self._task_traces:
+            # merger per RECORDER (not per attempt): elastic-retry
+            # attempts share pid/lane allocations
+            merger = _trace.FleetTraceMerger.for_recorder(
+                self._recorder)
+            for worker, task, attempt, events in self._task_traces:
+                off = None
+                if self.monitor is not None:
+                    off = self.monitor.clock_offset(worker)
+                if off is None and worker not in self.dead:
+                    # direct handshake fallback ONLY for members we
+                    # still believe alive — a blocking GET to a dead
+                    # worker would stall the query's completion path
+                    off = _trace.estimate_clock_offset(worker,
+                                                       timeout=1.0)
+                merger.merge(worker, task, attempt, events, off)
         wall_s = _time.perf_counter() - t0
         with self._lock:
             for rec in self.records.values():
@@ -1034,12 +1149,16 @@ class StageScheduler:
         # the burned launch counts as a retry so the ledger invariant
         # task_attempts == tasks + retried holds
         self._abort_half_launched(rec, worker)
+        self._attempt_span(rec, rec.attempts, "launch_failed", worker)
         with self._lock:
             rec.live_attempt = None
             rec.last_error = f"{type(e).__name__}: {e}"
             self.report["retried"] += 1
         METRICS.inc("presto_tpu_tasks_total", status="retried",
                     attempt=str(rec.attempts))
+        if _flight.ENABLED:
+            _flight.record("retry", "launch_failed",
+                           f"{rec.fragment}.{rec.slot}", worker)
         pending.appendleft(slot)
         self._worker_lost(worker, recs, pending, running)
 
@@ -1051,6 +1170,12 @@ class StageScheduler:
             self.report["task_attempts"] += 1
         qid = self.query_id
         tid = f"{qid}.{rec.fragment}.{rec.slot}.{attempt}"
+        traced = self._recorder is not None
+        if traced:
+            # attempt lane opens at dispatch; closed by _attempt_span
+            # at whatever terminal the attempt reaches
+            self._attempt_started[(rec.fragment, rec.slot, attempt)] \
+                = time.perf_counter_ns()
         spec = {
             "task_id": tid,
             "query_id": qid,
@@ -1068,6 +1193,13 @@ class StageScheduler:
             "n_producers_by_edge": self._n_producers,
             "coordinator_url": self.coord.url,
             "profile": False,
+            # distributed trace context: the worker records its own
+            # spans under this identity and ships them with terminal
+            # status (merged fleet timeline, docs/OBSERVABILITY.md)
+            "trace": traced,
+            "trace_ctx": {"query_id": qid, "task_id": tid,
+                          "attempt": attempt,
+                          "parent_span": "query"},
             # fault-tolerance plumbing: a private exchange-key
             # namespace per attempt + the spool tag for output pages
             "exchange_ns": tid,
@@ -1081,8 +1213,16 @@ class StageScheduler:
             if faults.ARMED:
                 faults.fire("task.dispatch", url=worker)
             http_post(f"{worker}/v1/task", body)
-        _retry_transient(dispatch, TRANSPORT_RETRIES)
-        self._replay_inputs(rec.fragment, rec.slot, tid, worker)
+        # the launch-pool thread adopts the query's recorder so spool
+        # read-back instants and retry/backoff spans of the input
+        # replay land in the timeline
+        prev_rec = _trace.activate(self._recorder) if traced else None
+        try:
+            _retry_transient(dispatch, TRANSPORT_RETRIES)
+            self._replay_inputs(rec.fragment, rec.slot, tid, worker)
+        finally:
+            if traced:
+                _trace.deactivate(prev_rec)
         METRICS.inc("presto_tpu_tasks_total", status="dispatched",
                     attempt=str(attempt))
         return tid
@@ -1133,6 +1273,10 @@ class StageScheduler:
             rec.stats = st.get("stats")
         running.pop(slot, None)
         self._forget_remote(worker, attempt, rec)
+        self._attempt_span(rec, attempt, "finished", worker)
+        if st.get("trace"):
+            self._task_traces.append((worker, base, attempt,
+                                      st["trace"]))
         METRICS.inc("presto_tpu_tasks_total", status="finished",
                     attempt=str(attempt))
 
@@ -1185,6 +1329,8 @@ class StageScheduler:
             self.report["retried"] += 1
         METRICS.inc("presto_tpu_tasks_total", status="retried",
                     attempt=str(attempt))
+        if _flight.ENABLED:
+            _flight.record("retry", "task", base, error_text[:120])
         pending.append(slot)
 
     def _attempt_failed_before_start(self, rec: _TaskRecord,
@@ -1196,6 +1342,7 @@ class StageScheduler:
         one budget slot and requeue."""
         attempt = rec.attempts
         self._abort_half_launched(rec, worker)
+        self._attempt_span(rec, attempt, "replay_failed", worker)
         self._burn_attempt(rec, attempt, f"{type(e).__name__}: {e}",
                            pending, slot, task_budget)
 
@@ -1215,6 +1362,13 @@ class StageScheduler:
         self.spool.discard(base, attempt)
         running.pop(slot, None)
         self._forget_remote(worker, attempt, rec)
+        # the DEAD attempt stays in the timeline: its coordinator-side
+        # lane closes with state=failed, and whatever spans the worker
+        # buffered before dying ship with the failed status
+        self._attempt_span(rec, attempt, "failed", worker)
+        if st.get("trace"):
+            self._task_traces.append((worker, base, attempt,
+                                      st["trace"]))
         # drop the failed attempt's private exchange state on its
         # worker (best-effort — the worker may be on its way out)
         try:
@@ -1258,6 +1412,12 @@ class StageScheduler:
             rec = recs[slot]
             base = f"{self.query_id}.{rec.fragment}.{rec.slot}"
             self.spool.discard(base, attempt)
+            # the attempt that died WITH its worker: no spans ever
+            # ship (the process is gone) — the coordinator-side lane
+            # is the dead attempt's only trace, which is why it exists
+            self._attempt_span(rec, attempt, "worker_lost", worker)
+            if _flight.ENABLED:
+                _flight.record("retry", "worker_lost", base, worker)
             with self._lock:
                 rec.live_attempt = None
                 self.report["retried"] += 1

@@ -18,10 +18,19 @@ import numpy as np
 
 from presto_tpu.batch import Batch, Column, bucket_capacity
 from presto_tpu.native import codec
+from presto_tpu.telemetry import ledger as _ledger
 from presto_tpu.types import parse_type
 
 
 def batch_to_bytes(batch: Batch, assume_compact: bool = False) -> bytes:
+    # attribution: the whole encode is `serde` wall, except the
+    # device fetch inside it, which is `d2h` (the nested span
+    # subtracts itself from this frame's self time)
+    with _ledger.span("serde"):
+        return _batch_to_bytes(batch, assume_compact)
+
+
+def _batch_to_bytes(batch: Batch, assume_compact: bool) -> bytes:
     import jax
     if assume_compact:
         # caller already packed live rows into a prefix (e.g. the
@@ -31,7 +40,8 @@ def batch_to_bytes(batch: Batch, assume_compact: bool = False) -> bytes:
         # compact: ship live rows only
         n = batch.num_valid()
         b = batch.compact(bucket_capacity(max(n, 1)), known_valid=n)
-    host = jax.device_get(b)
+    with _ledger.span("d2h"):
+        host = jax.device_get(b)
     parts = []
     columns = []
     arrays = []
@@ -61,6 +71,11 @@ def batch_to_bytes(batch: Batch, assume_compact: bool = False) -> bytes:
 
 
 def batch_from_bytes(data: bytes) -> Batch:
+    with _ledger.span("serde"):
+        return _batch_from_bytes(data)
+
+
+def _batch_from_bytes(data: bytes) -> Batch:
     hlen = int.from_bytes(data[:4], "big")
     header = json.loads(data[4:4 + hlen].decode())
     body = codec.decode(data[4 + hlen:])

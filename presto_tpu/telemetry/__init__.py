@@ -16,12 +16,22 @@ TaskStats / QueryStats hierarchy, server/QueryResource, and the
              get_output was running (see operators/driver.py)
   stats    — plain-dict OperatorStats snapshots and the shared
              EXPLAIN ANALYZE / task-status renderer
+  ledger   — the per-query wall-clock attribution ledger: a
+             non-overlapping decomposition of wall into named
+             categories with a machine-checked coverage invariant
+             (Σ categories + unattributed == wall)
+  flight   — the always-on fixed-size flight recorder: lifecycle
+             events (sheds, retries, demotions, membership, compiles)
+             in a per-process ring, snapshotted into error payloads
+             and served on GET /v1/flight
 
 Every hot-path hook is gated on a module-level bool (``trace.ACTIVE``,
 ``kernels.ENABLED``) exactly like execution/faults.ARMED, so disabled
 telemetry costs one attribute load + branch per site."""
 
-from presto_tpu.telemetry import kernels, metrics, trace  # noqa: F401
+from presto_tpu.telemetry import (  # noqa: F401
+    flight, kernels, ledger, metrics, trace,
+)
 from presto_tpu.telemetry.stats import (  # noqa: F401
     build_query_stats, render_operator_stats, snapshot_drivers,
 )

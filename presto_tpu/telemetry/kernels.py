@@ -52,6 +52,8 @@ from typing import Dict, Optional
 
 from presto_tpu import sanitize
 from presto_tpu.telemetry.metrics import METRICS
+from presto_tpu.telemetry import flight as _flight
+from presto_tpu.telemetry import ledger as _ledger
 from presto_tpu.telemetry import trace as _trace
 
 #: master gate for kernel timing. On by default: the per-call cost is
@@ -183,6 +185,13 @@ def record(name: str, dur_ns: int, compiled: bool,
             op.compile_ns += dur_ns
         else:
             op.execute_ns += dur_ns
+    # attribution ledger: compile wall vs async DISPATCH wall (device
+    # completion is measured at drain points as device_wait —
+    # telemetry/ledger.py); flight recorder keeps compile edges
+    _ledger.add_kernel(dur_ns, compiled)
+    if compiled and _flight.ENABLED:
+        _flight.record("compile", name, round(dur_ns / 1e6, 1),
+                       reason or "")
     q = getattr(_TL, "query", None)
     if q is not None:
         q["kernel_calls"] += 1

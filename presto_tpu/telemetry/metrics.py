@@ -23,9 +23,52 @@ class MetricsRegistry:
         self._lock = sanitize.lock("telemetry.metrics")
         self._counters: Dict[_Key, float] = {}
         self._help: Dict[str, str] = {}
+        #: histogram families: name -> bucket upper bounds; series:
+        #: key -> {"buckets": [count per bound], "sum", "count"}
+        self._hist_bounds: Dict[str, Tuple[float, ...]] = {}
+        self._hists: Dict[_Key, Dict[str, object]] = {}
 
     def describe(self, name: str, help_text: str) -> None:
         self._help.setdefault(name, help_text)
+
+    def describe_histogram(self, name: str, help_text: str,
+                           buckets) -> None:
+        """Declare a histogram family (Prometheus TYPE histogram:
+        cumulative _bucket{le=...} + _sum + _count series)."""
+        self._help.setdefault(name, help_text)
+        self._hist_bounds.setdefault(
+            name, tuple(float(b) for b in buckets))
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        bounds = self._hist_bounds[name]  # must be declared
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = {
+                    "buckets": [0] * len(bounds),
+                    "sum": 0.0, "count": 0}
+            for i, b in enumerate(bounds):
+                if value <= b:
+                    h["buckets"][i] += 1
+            h["sum"] += float(value)
+            h["count"] += 1
+
+    def histogram_snapshot(self, name: str) -> Dict[str, object]:
+        """Merged view over every label combination of one histogram
+        family — the bench/test assertion surface."""
+        bounds = self._hist_bounds.get(name, ())
+        out = {"buckets": [0] * len(bounds), "sum": 0.0, "count": 0,
+               "bounds": list(bounds)}
+        with self._lock:
+            for (n, _), h in self._hists.items():
+                if n != name:
+                    continue
+                for i, v in enumerate(h["buckets"]):
+                    out["buckets"][i] += v
+                out["sum"] += h["sum"]
+                out["count"] += h["count"]
+        return out
 
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
         key = (name, tuple(sorted(labels.items())))
@@ -88,6 +131,30 @@ class MetricsRegistry:
             lines.append(f"# TYPE {name} counter")
             for labels, v in series:
                 lines.append(f"{_series(name, labels)} {_num(v)}")
+        with self._lock:
+            hfamilies: Dict[str, list] = {}
+            for (name, labels), h in sorted(self._hists.items()):
+                hfamilies.setdefault(name, []).append(
+                    (labels, list(h["buckets"]), h["sum"],
+                     h["count"]))
+        for name, series in hfamilies.items():
+            bounds = self._hist_bounds[name]
+            lines.append(f"# HELP {name} "
+                         f"{self._help.get(name, name)}")
+            lines.append(f"# TYPE {name} histogram")
+            for labels, buckets, total, count in series:
+                for b, v in zip(bounds, buckets):
+                    le = tuple(sorted(dict(labels,
+                                           le=_num(b)).items()))
+                    lines.append(
+                        f"{_series(name + '_bucket', le)} {v}")
+                inf = tuple(sorted(dict(labels, le="+Inf").items()))
+                lines.append(
+                    f"{_series(name + '_bucket', inf)} {count}")
+                lines.append(
+                    f"{_series(name + '_sum', labels)} {_num(total)}")
+                lines.append(
+                    f"{_series(name + '_count', labels)} {count}")
         for name, typ, help_text, series in (extra or ()):
             lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {typ}")
@@ -100,6 +167,7 @@ class MetricsRegistry:
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._hists.clear()
 
 
 def _series(name: str, labels) -> str:
@@ -186,6 +254,22 @@ METRICS.describe("presto_tpu_spool_bytes_total",
 METRICS.describe("presto_tpu_fleet_memory_sheds_total",
                  "Queries shed by the fleet memory enforcer "
                  "(cluster-wide reservation gate at dispatch)")
+METRICS.describe("presto_tpu_ledger_ns_total",
+                 "Wall-attribution ledger ns by category "
+                 "(telemetry/ledger.py: queued/planning/scan/h2d/"
+                 "compile/dispatch/device_wait/d2h/serde/exchange/"
+                 "spool/retry_backoff/driver), summed over finished "
+                 "queries")
+METRICS.describe("presto_tpu_ledger_unattributed_ns_total",
+                 "Wall ns the attribution ledger could NOT assign to "
+                 "a category (the coverage residual; the histogram "
+                 "presto_tpu_ledger_unattributed_ratio tracks its "
+                 "per-query fraction)")
+METRICS.describe_histogram(
+    "presto_tpu_ledger_unattributed_ratio",
+    "Per-query fraction of wall the attribution ledger left "
+    "unattributed (coverage regressions shift this right)",
+    buckets=(0.01, 0.02, 0.05, 0.10, 0.20, 0.50, 1.0))
 
 
 def render_prometheus() -> str:
@@ -258,13 +342,46 @@ def render_prometheus() -> str:
         monitors = []
     if monitors:
         counts: Dict[str, float] = {}
+        tasks_running = []
+        exec_queued = []
+        reserved = []
         for m in monitors:
             for state, n in m.counts().items():
                 counts[state] = counts.get(state, 0) + n
+            try:
+                rows = m.snapshot()
+            except Exception:  # noqa: BLE001
+                rows = []
+            for w in rows:
+                load = w.get("load") or {}
+                mem = w.get("memory") or {}
+                lbl = {"worker": w["url"]}
+                tasks_running.append(
+                    (lbl, load.get("tasks_running", 0)))
+                exec_queued.append(
+                    (lbl, load.get("executor_queued", 0)))
+                reserved.append(
+                    (lbl, mem.get("reserved_bytes", 0)))
         extra.append((
             "presto_tpu_workers", "gauge",
             "Fleet members by membership state",
             [({"state": s}, n) for s, n in sorted(counts.items())]))
+        # per-worker load feedback (the placement inputs), scraped
+        # from the heartbeat's last successful probe — the Prometheus
+        # face of system.runtime.nodes
+        if tasks_running:
+            extra.append((
+                "presto_tpu_worker_tasks_running", "gauge",
+                "Running fragment tasks per worker (heartbeat "
+                "report)", tasks_running))
+            extra.append((
+                "presto_tpu_worker_executor_queued", "gauge",
+                "Executor queue depth per worker (heartbeat report)",
+                exec_queued))
+            extra.append((
+                "presto_tpu_worker_reserved_bytes", "gauge",
+                "Reserved memory bytes per worker (heartbeat "
+                "report)", reserved))
     try:
         spools = sanitize.tracked("task_spool")
     except Exception:  # noqa: BLE001

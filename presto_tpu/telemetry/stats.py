@@ -116,6 +116,24 @@ def rollup(pipelines: List[List[Dict[str, Any]]]) -> Dict[str, Any]:
     return out
 
 
+def render_ledger(doc: Dict[str, Any]) -> str:
+    """EXPLAIN ANALYZE's wall-attribution section: one line per
+    ledger category + the explicit unattributed residual, with the
+    coverage invariant (Σ == wall) visible in the text itself."""
+    wall = doc.get("wall_ms", 0.0)
+    lines = ["wall attribution (telemetry/ledger.py, "
+             "sum + unattributed == wall):"]
+    for c, ms in doc.get("categories_ms", {}).items():
+        pct = (100.0 * ms / wall) if wall > 0 else 0.0
+        lines.append(f"  {c:<14} {ms:>10.1f}ms  {pct:5.1f}%")
+    unattr = doc.get("unattributed_ms", 0.0)
+    pct = (100.0 * unattr / wall) if wall > 0 else 0.0
+    lines.append(f"  {'unattributed':<14} {unattr:>10.1f}ms  "
+                 f"{pct:5.1f}%")
+    lines.append(f"  {'wall':<14} {wall:>10.1f}ms")
+    return "\n".join(lines)
+
+
 def build_query_stats(wall_ms: float, queued_ms: float = 0.0,
                       kernel: Optional[Dict[str, int]] = None,
                       tasks: Optional[List[Dict[str, Any]]] = None,

@@ -99,6 +99,25 @@ class TraceRecorder:
         with self._lock:
             return list(self._events)
 
+    def drain(self) -> List[Dict[str, Any]]:
+        """Return the buffered events and REMOVE them (the long-task
+        drain contract of GET /v1/task/{id}/trace: terminal status
+        ships only what was never drained)."""
+        with self._lock:
+            out = self._events
+            self._events = []
+            return out
+
+    def extend(self, events: List[Dict[str, Any]]) -> None:
+        """Append pre-built events (merged remote-task spans, lane
+        metadata) verbatim — they already carry pid/tid/ts."""
+        with self._lock:
+            for ev in events:
+                if len(self._events) >= self.MAX_EVENTS:
+                    self.dropped += 1
+                    continue
+                self._events.append(ev)
+
     def chrome_trace(self) -> Dict[str, Any]:
         """The document chrome://tracing / Perfetto loads verbatim."""
         return {
@@ -149,6 +168,103 @@ def attach_failure(recorder: Optional[TraceRecorder], exc,
         exc.trace_events = recorder.events()
     except Exception:  # noqa: BLE001 — slotted exception types etc.
         pass
+
+
+class FleetTraceMerger:
+    """Merge remote tasks' span lists into one coordinator-side
+    recorder as a Perfetto-loadable MULTI-PROCESS timeline: each
+    worker becomes its own trace `pid` (named by url), each (task,
+    attempt) its own lane group within that pid, and every remote
+    timestamp is shifted by the worker's estimated clock offset so
+    spans line up with the coordinator's own lane. A retried task's
+    dead attempt and its replacement land in SEPARATE lanes of the
+    same worker — both visible, which is the whole point."""
+
+    def __init__(self, recorder: TraceRecorder):
+        self.recorder = recorder
+        self._pids: Dict[str, int] = {}
+        #: (pid, task, attempt, remote tid) -> coordinator lane id
+        self._lanes: Dict[tuple, int] = {}
+        #: next free lane per pid (lane 0 is reserved per pid)
+        self._next_lane: Dict[int, int] = {}
+
+    @classmethod
+    def for_recorder(cls, recorder: TraceRecorder
+                     ) -> "FleetTraceMerger":
+        """ONE merger per recorder, stashed on it: a retried query
+        attempt (elastic tier) must reuse the first attempt's
+        pid/lane allocations — a fresh merger would restart pids at 2
+        and lanes at 0, colliding the new attempt's spans into the
+        dead attempt's lanes."""
+        m = getattr(recorder, "_fleet_merger", None)
+        if m is None:
+            m = recorder._fleet_merger = cls(recorder)
+        return m
+
+    def _pid(self, worker: str) -> int:
+        pid = self._pids.get(worker)
+        if pid is None:
+            # pid 1 is the coordinator's own recorder
+            pid = self._pids[worker] = 2 + len(self._pids)
+            self.recorder.extend([{
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"worker {worker}"}}])
+        return pid
+
+    def merge(self, worker: str, task_id: str, attempt,
+              events: List[Dict[str, Any]],
+              offset_ns: Optional[int]) -> int:
+        """Adjust + append one task attempt's spans; returns the
+        number of events merged. `offset_ns` maps the worker's
+        perf_counter epoch onto the coordinator's (None = no estimate;
+        spans merge unshifted and will not line up — still better
+        than dropping them)."""
+        if not events:
+            return 0
+        pid = self._pid(worker)
+        shift_us = (offset_ns or 0) / 1e3
+        out = []
+        for ev in events:
+            ev = dict(ev)
+            lane_key = (pid, task_id, attempt, ev.get("tid", 0))
+            lane = self._lanes.get(lane_key)
+            if lane is None:
+                lane = self._next_lane.get(pid, 0)
+                self._next_lane[pid] = lane + 1
+                self._lanes[lane_key] = lane
+                out.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": lane,
+                    "args": {"name": f"{task_id} attempt {attempt}"}})
+            ev["pid"] = pid
+            ev["tid"] = lane
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            out.append(ev)
+        self.recorder.extend(out)
+        return len(events)
+
+
+def estimate_clock_offset(url: str,
+                          timeout: float = 5.0) -> Optional[int]:
+    """One /v1/info round trip -> (coordinator perf_counter ns at
+    midpoint) - (worker clock_ns): the shift that maps worker span
+    timestamps onto the caller's timeline. Heartbeat probes refine
+    this continuously (smallest RTT wins); this is the cold-start /
+    membership-less fallback."""
+    import json as _json
+    from presto_tpu.server.node import http_get
+    try:
+        t0 = time.perf_counter_ns()
+        info = _json.loads(http_get(f"{url}/v1/info",
+                                    timeout=timeout))
+        t1 = time.perf_counter_ns()
+        remote = info.get("clock_ns")
+        if remote is None:
+            return None
+        return (t0 + t1) // 2 - int(remote)
+    except Exception:  # noqa: BLE001 — offset is best-effort
+        return None
 
 
 @contextlib.contextmanager

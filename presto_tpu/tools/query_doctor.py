@@ -1,0 +1,148 @@
+"""Per-query bottleneck doctor: turn the attribution ledger + flight
+recorder into a VERDICT a human can act on (reference analog: the
+"where did my query's time go" triage the Presto webapp's query detail
+page exists for, automated).
+
+Input is either a live coordinator (``--server URL --query ID`` reads
+``GET /v1/query/{id}``; ``--flight`` dumps ``GET /v1/flight``) or a
+saved stats JSON (``--file``). Output: the category table, the recent
+flight window when present, and one of four verdicts:
+
+    queueing   admission/queue wait dominates — capacity, not code
+    kernel     compile + dispatch + device_wait dominate — the device
+               (or the compile wall) is the bottleneck
+    exchange   exchange transport + serde + spool dominate — the
+               data plane between processes is the bottleneck
+    glue       scan datagen, planning, driver overhead, h2d/d2h, and
+               the unattributed residual dominate — host-side Python
+               is the bottleneck (the caches-off serving story)
+
+Usage:
+    python -m presto_tpu.tools.query_doctor --server http://H:P \\
+        --query 0123abcd
+    python -m presto_tpu.tools.query_doctor --file stats.json
+    python -m presto_tpu.tools.query_doctor --server http://H:P \\
+        --flight
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+#: verdict -> the ledger categories it sums (unlisted categories —
+#: and the unattributed residual — count as glue: host time nobody
+#: attributed finer IS glue by definition)
+VERDICT_GROUPS: Dict[str, Tuple[str, ...]] = {
+    "queueing": ("queued",),
+    "kernel": ("compile", "dispatch", "device_wait"),
+    "exchange": ("exchange", "serde", "spool", "retry_backoff"),
+    "glue": ("planning", "scan", "h2d", "d2h", "driver"),
+}
+
+
+def diagnose(ledger: Dict[str, Any]) -> Dict[str, Any]:
+    """Verdict + per-group shares from one attribution-ledger doc.
+    Pure function — the test surface."""
+    wall = float(ledger.get("wall_ms", 0.0)) or 0.0
+    cats = dict(ledger.get("categories_ms", {}))
+    unattr = max(0.0, float(ledger.get("unattributed_ms", 0.0)))
+    shares: Dict[str, float] = {}
+    for verdict, group in VERDICT_GROUPS.items():
+        shares[verdict] = sum(cats.get(c, 0.0) for c in group)
+    shares["glue"] += unattr
+    total = sum(shares.values()) or 1.0
+    fracs = {k: v / total for k, v in shares.items()}
+    verdict = max(fracs, key=lambda k: fracs[k])
+    return {
+        "verdict": verdict,
+        "shares_ms": {k: round(v, 3) for k, v in shares.items()},
+        "shares_frac": {k: round(v, 4) for k, v in fracs.items()},
+        "wall_ms": wall,
+        "unattributed_ms": round(unattr, 3),
+        "unattributed_frac": ledger.get("unattributed_frac"),
+    }
+
+
+def render(stats: Dict[str, Any],
+           flight: Optional[List[dict]] = None) -> str:
+    lines = []
+    ledger = (stats or {}).get("ledger")
+    if ledger:
+        from presto_tpu.telemetry.stats import render_ledger
+        lines.append(render_ledger(ledger))
+        d = diagnose(ledger)
+        lines.append("")
+        lines.append("verdict: " + d["verdict"].upper())
+        for k in ("queueing", "kernel", "exchange", "glue"):
+            lines.append(f"  {k:<9} {d['shares_ms'][k]:>10.1f}ms  "
+                         f"{100 * d['shares_frac'][k]:5.1f}%")
+    else:
+        lines.append("no attribution ledger in stats "
+                     "(pre-ledger server or non-query statement)")
+    if flight:
+        lines.append("")
+        lines.append(f"flight recorder (last {len(flight)} events):")
+        for ev in flight:
+            lines.append(
+                f"  -{ev.get('age_ms', 0):>9.1f}ms  "
+                f"{ev.get('kind', ''):<10} {ev.get('a', '')} "
+                f"{ev.get('b', '')} {ev.get('c', '')}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Bottleneck verdict from the attribution ledger "
+                    "+ flight recorder")
+    p.add_argument("--server", help="coordinator url")
+    p.add_argument("--query", help="query id (GET /v1/query/{id})")
+    p.add_argument("--file", help="saved stats JSON (a /v1/query/{id}"
+                                  " body or a bare stats dict)")
+    p.add_argument("--flight", action="store_true",
+                   help="dump the node's live flight-recorder ring "
+                        "(GET /v1/flight)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable verdict")
+    args = p.parse_args(argv)
+
+    stats: Dict[str, Any] = {}
+    flight_events: Optional[List[dict]] = None
+    if args.file:
+        with open(args.file) as f:
+            doc = json.load(f)
+        stats = doc.get("stats", doc)
+        flight_events = doc.get("flight")
+    elif args.server and args.query:
+        from presto_tpu.server.node import http_get
+        doc = json.loads(http_get(
+            f"{args.server.rstrip('/')}/v1/query/{args.query}"))
+        stats = doc.get("stats") or {}
+        flight_events = doc.get("flight")
+    elif args.server and args.flight:
+        from presto_tpu.server.node import http_get
+        ring = json.loads(http_get(
+            f"{args.server.rstrip('/')}/v1/flight"))
+        events = ring.get("events", [])
+        if args.json:
+            print(json.dumps(ring, indent=1))
+        else:
+            print(render({}, events[-64:]))
+        return 0
+    else:
+        p.error("need --file, or --server with --query/--flight")
+
+    if args.json:
+        ledger = stats.get("ledger")
+        out = {"verdict": None, "stats": stats}
+        if ledger:
+            out.update(diagnose(ledger))
+        print(json.dumps(out, indent=1))
+    else:
+        print(render(stats, flight_events))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,9 +100,66 @@ def _checksum(rows: List[list]) -> str:
     return h.hexdigest()
 
 
+def _harvest_ledgers(coord, known_ids: set,
+                     names_by_sql: Dict[str, str]) -> Optional[dict]:
+    """Aggregate the attribution-ledger docs of every query this phase
+    FINISHED on `coord` (ids not in `known_ids`): summed categories,
+    the per-query residual distribution (the acceptance bar: every
+    query's unattributed < 10% of wall), and a per-mix-query
+    breakdown — the machine-readable where-the-glue-goes evidence."""
+    per_query: Dict[str, dict] = {}
+    total_cats: Dict[str, float] = {}
+    wall = unattr = 0.0
+    max_frac = 0.0
+    over_10 = 0
+    n = 0
+    for qid, q in list(coord.queries.items()):
+        if qid in known_ids or q.state != "FINISHED":
+            continue
+        led = (q.stats or {}).get("ledger")
+        if not led:
+            continue
+        n += 1
+        name = names_by_sql.get(q.sql, q.sql[:24])
+        frac = max(0.0, float(led.get("unattributed_frac") or 0.0))
+        max_frac = max(max_frac, frac)
+        if frac >= 0.10:
+            over_10 += 1
+        wall += led.get("wall_ms", 0.0)
+        unattr += led.get("unattributed_ms", 0.0)
+        agg = per_query.setdefault(name, {
+            "queries": 0, "wall_ms": 0.0, "unattributed_ms": 0.0,
+            "unattributed_frac_max": 0.0, "categories_ms": {}})
+        agg["queries"] += 1
+        agg["wall_ms"] = round(agg["wall_ms"]
+                               + led.get("wall_ms", 0.0), 3)
+        agg["unattributed_ms"] = round(
+            agg["unattributed_ms"] + led.get("unattributed_ms", 0.0),
+            3)
+        agg["unattributed_frac_max"] = max(
+            agg["unattributed_frac_max"], frac)
+        for c, ms in led.get("categories_ms", {}).items():
+            agg["categories_ms"][c] = round(
+                agg["categories_ms"].get(c, 0.0) + ms, 3)
+            total_cats[c] = round(total_cats.get(c, 0.0) + ms, 3)
+    if n == 0:
+        return None
+    return {
+        "queries": n,
+        "wall_ms": round(wall, 3),
+        "categories_ms": dict(sorted(total_cats.items())),
+        "unattributed_ms": round(unattr, 3),
+        "unattributed_frac_max": round(max_frac, 4),
+        "queries_over_10pct": over_10,
+        "per_query": {k: {**v, "categories_ms": dict(sorted(
+            v["categories_ms"].items()))}
+            for k, v in sorted(per_query.items())},
+    }
+
+
 def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
                tolerant: bool = False, timeout_s: float = 600.0,
-               ) -> Tuple[dict, Dict[str, set]]:
+               coord=None) -> Tuple[dict, Dict[str, set]]:
     """Run each client's (name, sql) list on its own thread through
     the HTTP client protocol. Returns (phase stats, {query name ->
     set of checksums over EVERY SUCCESSFUL execution} — a single
@@ -155,6 +212,9 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
     # DISTINCT COMPILES PER KERNEL FAMILY, the compile-amortization
     # trajectory metric (a phase that re-uses every kernel shows {})
     from presto_tpu.telemetry.metrics import METRICS
+    known_ids = set(coord.queries) if coord is not None else set()
+    names_by_sql = {sql: name
+                    for work in assignments for name, sql in work}
     compile0 = METRICS.total("presto_tpu_kernel_compile_ns_total")
     execute0 = METRICS.total("presto_tpu_kernel_execute_ns_total")
     fam0 = METRICS.by_label("presto_tpu_kernel_compiles_total",
@@ -199,6 +259,11 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
         "fused_fragments": METRICS.delta_by_label(
             "presto_tpu_fused_fragments_total", "status", fuse0),
     }
+    if coord is not None:
+        # wall-attribution ledger rollup of THIS phase's queries —
+        # categories summed, per-query residuals (the coverage bar)
+        stats["ledger"] = _harvest_ledgers(coord, known_ids,
+                                           names_by_sql)
     if tolerant:
         total = n + len(errors)
         stats.update({
@@ -373,7 +438,9 @@ def _spawn_churn_worker(port: int = 0):
 def _run_worker_churn_phase(schema: str, work: List[Tuple[str, str]],
                             clients: int, rounds: int,
                             n_workers: int, kills: int,
-                            period_s: float, host: str) -> dict:
+                            period_s: float, host: str,
+                            timeline_out: Optional[str]
+                            = None) -> dict:
     """Fault-tolerant fleet serving under worker CHURN: a
     multi-worker coordinator (task_retries on, fixed task_partitions
     so results stay byte-identical across membership changes) serves
@@ -395,7 +462,12 @@ def _run_worker_churn_phase(schema: str, work: List[Tuple[str, str]],
         max_concurrent_queries=max(clients, 2),
         properties={"task_retries": 2,
                     "task_partitions": 2 * n_workers,
-                    "query_retries": 2},
+                    "query_retries": 2,
+                    # every churn query is traced: workers ship their
+                    # spans with task status and the scheduler merges
+                    # one fleet timeline per query — the retried-
+                    # attempt evidence the timeline file carries
+                    "query_trace_enabled": True},
         heartbeat_interval_s=0.25)
     stop_churn = threading.Event()
     churn_log = {"kills": 0, "respawns": 0, "errors": []}
@@ -473,6 +545,43 @@ def _run_worker_churn_phase(schema: str, work: List[Tuple[str, str]],
             tolerant=True, timeout_s=300.0)
         stop_churn.set()
         churn_t.join(timeout=60)
+        # merged fleet timeline: pick the traced query whose timeline
+        # shows the MOST task attempts (a worker died under it —
+        # retried lanes + both workers' pids in one Perfetto doc)
+        timeline_doc = None
+        best = (-1, None)
+        for q in list(coord.queries.values()):
+            if not q.trace:
+                continue
+            pids = {e.get("pid") for e in q.trace
+                    if isinstance(e.get("pid"), int)}
+            attempts = len({e["name"] for e in q.trace
+                            if isinstance(e.get("name"), str)
+                            and e["name"].startswith("task ")
+                            and " attempt " in e["name"]})
+            score = attempts * 10 + len(pids)
+            if score > best[0]:
+                best = (score, (q, pids, attempts))
+        if best[1] is not None:
+            q, pids, attempts = best[1]
+            timeline_doc = {
+                "query_id": q.id,
+                "sql": q.sql[:120],
+                "events": len(q.trace),
+                "pids": sorted(p for p in pids
+                               if isinstance(p, int)),
+                "task_attempt_lanes": attempts,
+                "file": timeline_out,
+            }
+            if timeline_out:
+                with open(timeline_out, "w") as f:
+                    json.dump({
+                        "displayTimeUnit": "ms",
+                        "otherData": {"query_id": q.id,
+                                      "sql": q.sql[:200],
+                                      "phase": "worker_churn"},
+                        "traceEvents": q.trace,
+                    }, f)
     finally:
         stop_churn.set()
         coord.stop()
@@ -513,6 +622,7 @@ def _run_worker_churn_phase(schema: str, work: List[Tuple[str, str]],
             "presto_tpu_tasks_total", "status", tasks0),
         "membership_transitions": METRICS.delta_by_label(
             "presto_tpu_membership_transitions_total", "to", trans0),
+        "timeline": timeline_doc,
         "successes_match_baseline": consistent,
     }
     if not consistent:
@@ -534,6 +644,7 @@ def _load_mix(mix: Sequence[str]) -> Dict[str, str]:
 def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       mix: Sequence[str] = DEFAULT_MIX,
                       warm_rounds: int = 3,
+                      flight_ab_rounds: int = 3,
                       verify_off: bool = True,
                       chaos: bool = False,
                       chaos_rounds: int = 2,
@@ -551,6 +662,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       churn_rounds: int = 2,
                       churn_kills: int = 1,
                       churn_period_s: float = 3.0,
+                      timeline_out: Optional[str] = None,
                       host: str = "127.0.0.1") -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
@@ -565,7 +677,9 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
     try:
         return _serving_bench(
             clients=clients, schema=schema, mix=mix,
-            warm_rounds=warm_rounds, verify_off=verify_off,
+            warm_rounds=warm_rounds,
+            flight_ab_rounds=flight_ab_rounds,
+            verify_off=verify_off,
             chaos=chaos, chaos_rounds=chaos_rounds,
             chaos_spec=chaos_spec, restart_warm=restart_warm,
             cache_dir=cache_dir, fusion_report=fusion_report,
@@ -575,7 +689,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             history_phase=history_phase, worker_churn=worker_churn,
             churn_workers=churn_workers, churn_rounds=churn_rounds,
             churn_kills=churn_kills, churn_period_s=churn_period_s,
-            host=host)
+            timeline_out=timeline_out, host=host)
     finally:
         if auto_cache_dir is not None:
             import shutil
@@ -585,7 +699,8 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
 
 
 def _serving_bench(clients: int, schema: str, mix: Sequence[str],
-                   warm_rounds: int, verify_off: bool, chaos: bool,
+                   warm_rounds: int, flight_ab_rounds: int,
+                   verify_off: bool, chaos: bool,
                    chaos_rounds: int, chaos_spec: str,
                    restart_warm: bool, cache_dir: Optional[str],
                    fusion_report: bool, overload: bool,
@@ -594,7 +709,8 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    sanitize_phase: bool, history_phase: bool,
                    worker_churn: bool, churn_workers: int,
                    churn_rounds: int, churn_kills: int,
-                   churn_period_s: float, host: str) -> dict:
+                   churn_period_s: float, timeline_out: Optional[str],
+                   host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
@@ -616,11 +732,50 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
     try:
         # cold: each query exactly once, spread over the clients
         cold_assign = [work[i::clients] for i in range(clients)]
-        cold, cold_checks = _run_phase(coord.url, cold_assign)
+        cold, cold_checks = _run_phase(coord.url, cold_assign,
+                                       coord=coord)
         # warm: every client hammers the full mix
         warm_assign = [list(work) * warm_rounds
                        for _ in range(clients)]
-        warm, warm_checks = _run_phase(coord.url, warm_assign)
+        warm, warm_checks = _run_phase(coord.url, warm_assign,
+                                       coord=coord)
+        # flight-recorder overhead A/B: ALTERNATING warm rounds with
+        # recording on/off, medians compared (single adjacent rounds
+        # on a loaded 1-core box are dominated by run-to-run noise —
+        # alternation + median isolates the recorder's own cost).
+        # Always-on must cost <= ~5% warm QPS, measured not asserted.
+        import statistics
+        from presto_tpu.telemetry import flight as _flight
+        one_round = [list(work) for _ in range(clients)]
+        on_qps: List[float] = []
+        off_qps: List[float] = []
+        flight_checks: Dict[str, set] = {}
+        flight_off_checks: Dict[str, set] = {}
+        try:
+            for _ in range(max(1, flight_ab_rounds)):
+                _flight.ENABLED = True
+                s_on, c_on = _run_phase(coord.url, one_round)
+                on_qps.append(s_on["qps"])
+                for k, v in c_on.items():
+                    flight_checks.setdefault(k, set()).update(v)
+                _flight.ENABLED = False
+                s_off, c_off = _run_phase(coord.url, one_round)
+                off_qps.append(s_off["qps"])
+                for k, v in c_off.items():
+                    flight_off_checks.setdefault(k, set()).update(v)
+        finally:
+            _flight.ENABLED = True
+        med_on = statistics.median(on_qps)
+        med_off = statistics.median(off_qps)
+        flight_doc = {
+            "qps_flight_on": med_on,
+            "qps_flight_off": med_off,
+            "qps_rounds_on": on_qps,
+            "qps_rounds_off": off_qps,
+            "overhead_frac": round(1.0 - med_on / med_off, 4)
+            if med_off else None,
+            "ring": _flight.stats(),
+        }
         if chaos:
             # chaos: the SAME coordinator (warm caches, live resource
             # groups) under seeded periodic faults
@@ -755,7 +910,8 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                 return False
         return True
 
-    identical = _consistent(cold_checks, warm_checks)
+    identical = _consistent(cold_checks, warm_checks, flight_checks,
+                            flight_off_checks)
     off = None
     if verify_off:
         off_coord = Coordinator(
@@ -768,7 +924,8 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         try:
             off, off_checks = _run_phase(
                 off_coord.url, [work[i::clients]
-                                for i in range(clients)])
+                                for i in range(clients)],
+                coord=off_coord)
         finally:
             off_coord.stop()
         identical = identical and _consistent(cold_checks, off_checks)
@@ -793,7 +950,8 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         try:
             rw_assign = [list(work) * warm_rounds
                          for _ in range(clients)]
-            rw, rw_checks = _run_phase(coord2.url, rw_assign)
+            rw, rw_checks = _run_phase(coord2.url, rw_assign,
+                                       coord=coord2)
         finally:
             coord2.stop()
         identical = identical and _consistent(warm_checks, rw_checks)
@@ -859,7 +1017,8 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         # task-retry tier (server/scheduler.py)
         churn_doc = _run_worker_churn_phase(
             schema, work, clients, churn_rounds, churn_workers,
-            churn_kills, churn_period_s, host)
+            churn_kills, churn_period_s, host,
+            timeline_out=timeline_out)
 
     fusion = None
     if fusion_report:
@@ -892,6 +1051,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "warm_rounds": warm_rounds,
         "cold": cold,
         "warm": warm,
+        "flight_overhead": flight_doc,
         "caches_off": off,
         "restart_warm": restart,
         "overload": overload_doc,
@@ -922,6 +1082,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--schema", default="sf0_1")
     p.add_argument("--mix", default=",".join(DEFAULT_MIX))
     p.add_argument("--warm-rounds", type=int, default=3)
+    p.add_argument("--flight-ab-rounds", type=int, default=3,
+                   help="alternating on/off round PAIRS of the "
+                        "flight-recorder overhead A/B (medians "
+                        "compared)")
     p.add_argument("--skip-off", action="store_true",
                    help="skip the caches-disabled equivalence phase")
     p.add_argument("--chaos", action="store_true",
@@ -971,6 +1135,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--churn-kills", type=int, default=1)
     p.add_argument("--churn-period", type=float, default=3.0,
                    help="seconds between churn events")
+    p.add_argument("--timeline-out", default="fleet_timeline.json",
+                   help="file the --worker-churn phase writes the "
+                        "merged Perfetto fleet timeline to")
     p.add_argument("--fusion-report", action="store_true",
                    help="embed the per-query whole-fragment fusion "
                         "coverage (fused chains + fallback reasons, "
@@ -980,7 +1147,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     doc = run_serving_bench(
         clients=args.clients, schema=args.schema,
         mix=[m.strip() for m in args.mix.split(",") if m.strip()],
-        warm_rounds=args.warm_rounds, verify_off=not args.skip_off,
+        warm_rounds=args.warm_rounds,
+        flight_ab_rounds=args.flight_ab_rounds,
+        verify_off=not args.skip_off,
         chaos=args.chaos, chaos_rounds=args.chaos_rounds,
         chaos_spec=args.chaos_spec, restart_warm=args.restart_warm,
         cache_dir=args.cache_dir, fusion_report=args.fusion_report,
@@ -991,7 +1160,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         churn_workers=args.churn_workers,
         churn_rounds=args.churn_rounds,
         churn_kills=args.churn_kills,
-        churn_period_s=args.churn_period)
+        churn_period_s=args.churn_period,
+        timeline_out=args.timeline_out)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

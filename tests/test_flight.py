@@ -1,0 +1,98 @@
+"""Always-on flight recorder (telemetry/flight.py): ring mechanics,
+the failure-payload snapshot riding an injected fault, and the
+/v1/flight + error-payload surfaces on the coordinator."""
+
+import json
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    from presto_tpu.telemetry import flight
+    flight.reset()
+    yield
+    flight.reset()
+
+
+def test_ring_is_bounded_and_ordered():
+    from presto_tpu.telemetry import flight
+    for i in range(flight.RING_SIZE + 50):
+        flight.record("query", "FINISHED", i)
+    st = flight.stats()
+    assert st["size"] == flight.RING_SIZE
+    assert st["total"] == flight.RING_SIZE + 50
+    assert st["dropped"] == 50
+    evs = flight.snapshot(limit=10)
+    assert len(evs) == 10
+    # oldest-first within the window; the first 50 fell off the ring
+    assert [e[3] for e in evs] == list(
+        range(flight.RING_SIZE + 40, flight.RING_SIZE + 50))
+
+
+def test_disabled_gate_is_noop():
+    from presto_tpu.telemetry import flight
+    flight.ENABLED = False
+    try:
+        flight.record("query", "FINISHED")
+        assert flight.stats()["total"] == 0
+    finally:
+        flight.ENABLED = True
+
+
+def test_injected_fault_snapshot_rides_error_payload():
+    """The satellite contract: a query failed by an injected fault
+    carries the recorder's recent window on its exception — the fault
+    event AND the failure edge are in it, no pre-arming needed."""
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny",
+                    {"fault_injection": "operator.add_input:once"})
+    with pytest.raises(Exception) as ei:
+        r.execute("select count(*) from region")
+    evs = getattr(ei.value, "flight_events", None)
+    assert evs, "failure must carry the flight window"
+    kinds = {e["kind"] for e in evs}
+    assert "fault" in kinds
+    assert any(e["kind"] == "query" and e["a"] == "FAILED"
+               for e in evs)
+    # hygiene: disarm the session-property spec for later tests
+    from presto_tpu.execution import faults
+    faults.disarm()
+
+
+def test_coordinator_flight_surfaces():
+    """GET /v1/flight serves the live ring; a FAILED query's flight
+    window rides GET /v1/query/{id} AND the client-protocol error
+    payload itself."""
+    import time
+    from presto_tpu.server.coordinator import Coordinator
+    from presto_tpu.server.node import http_get, http_post
+    coord = Coordinator(
+        [], "tpch", "tiny", single_node=True,
+        properties={"fault_injection": "operator.add_input:once"})
+    coord.start()
+    try:
+        resp = json.loads(http_post(
+            f"{coord.url}/v1/statement",
+            b"select count(*) from nation"))
+        qid = resp["id"]
+        deadline = time.monotonic() + 30
+        state = None
+        while time.monotonic() < deadline:
+            state = json.loads(http_get(resp["nextUri"]))
+            if state["stats"]["state"] in ("FAILED", "FINISHED"):
+                break
+            time.sleep(0.05)
+        assert state["stats"]["state"] == "FAILED", state
+        err = state["error"]
+        assert err.get("flight"), err
+        assert any(e["kind"] == "fault" for e in err["flight"])
+        detail = json.loads(http_get(f"{coord.url}/v1/query/{qid}"))
+        assert detail["flight"]
+        ring = json.loads(http_get(f"{coord.url}/v1/flight"))
+        assert ring["size"] > 0
+        assert any(e["kind"] == "fault" for e in ring["events"])
+    finally:
+        coord.stop()
+        from presto_tpu.execution import faults
+        faults.disarm()

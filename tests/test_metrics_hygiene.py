@@ -1,0 +1,141 @@
+"""Metrics hygiene gate: scrape /v1/metrics on a coordinator AND a
+worker node, parse the Prometheus text exposition, and enforce the
+naming contract against the checked-in allowlist
+(presto_tpu/tools/metrics_allowlist.json) — an accidental metric
+rename or an undeclared new family is a tier-1 failure by design
+(dashboards and alerts key on these names)."""
+
+import json
+import re
+
+import pytest
+
+_ALLOWLIST_PATH = \
+    "/root/repo/presto_tpu/tools/metrics_allowlist.json"
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def _parse(text):
+    """-> (families {name: type}, helps set, samples [name]).
+    Raises on malformed lines — the scrape must be parseable."""
+    families = {}
+    helps = set()
+    samples = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            helps.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(None, 3)
+            assert name not in families, \
+                f"duplicate TYPE declaration for {name}"
+            families[name] = typ
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        samples.append(m.group(1))
+        float(m.group(3))  # value must parse
+    return families, helps, samples
+
+
+def _family_of(sample_name, families):
+    """Histogram samples (_bucket/_sum/_count) belong to their base
+    family."""
+    if sample_name in families:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[:-len(suffix)]
+            if families.get(base) == "histogram":
+                return base
+    return sample_name
+
+
+@pytest.fixture(scope="module")
+def scrapes():
+    """One coordinator + one plain worker NODE in-process, a query
+    through the coordinator (so the interesting families exist), then
+    both /v1/metrics bodies."""
+    from presto_tpu.server.coordinator import (
+        Coordinator, StatementClient,
+    )
+    from presto_tpu.server.node import Node, http_get
+    worker = Node()
+    worker.start()
+    coord = Coordinator([], "tpch", "tiny", single_node=True)
+    coord.start()
+    try:
+        _, rows = StatementClient(coord.url, user="hygiene").execute(
+            "select count(*) from nation")
+        assert rows == [[25]]
+        yield {
+            "coordinator": http_get(
+                f"{coord.url}/v1/metrics").decode(),
+            "worker": http_get(
+                f"{worker.url}/v1/metrics").decode(),
+        }
+    finally:
+        coord.stop()
+        worker.stop()
+
+
+def _allowlist():
+    with open(_ALLOWLIST_PATH) as f:
+        doc = json.load(f)
+    return doc
+
+
+@pytest.mark.parametrize("node", ["coordinator", "worker"])
+def test_exposition_conventions(scrapes, node):
+    families, helps, samples = _parse(scrapes[node])
+    assert families, "scrape served no families"
+    for name, typ in families.items():
+        # HELP on every family
+        assert name in helps, f"{name} has no HELP line"
+        # counters end in _total (units like _ns/_bytes suffix BEFORE
+        # it); gauges/histograms never carry _total
+        if typ == "counter":
+            assert name.endswith("_total"), \
+                f"counter {name} must end with _total"
+        else:
+            assert not name.endswith("_total"), \
+                f"{typ} {name} must not claim _total"
+    # every sample belongs to a declared family
+    for s in samples:
+        fam = _family_of(s, families)
+        assert fam in families, f"sample {s} has no TYPE declaration"
+
+
+@pytest.mark.parametrize("node", ["coordinator", "worker"])
+def test_families_match_checked_in_allowlist(scrapes, node):
+    allow = _allowlist()
+    known = {}
+    for typ_key, typ in (("counters", "counter"),
+                         ("gauges", "gauge"),
+                         ("histograms", "histogram")):
+        for name in allow[typ_key]:
+            known[name] = typ
+    families, _, _ = _parse(scrapes[node])
+    unknown = {n: t for n, t in families.items() if n not in known}
+    assert not unknown, (
+        f"metric families not in the checked-in allowlist "
+        f"(rename/addition needs tools/metrics_allowlist.json "
+        f"updated): {unknown}")
+    mistyped = {n: (t, known[n]) for n, t in families.items()
+                if known[n] != t}
+    assert not mistyped, f"family type drift: {mistyped}"
+
+
+def test_core_families_present_after_traffic(scrapes):
+    families, _, _ = _parse(scrapes["coordinator"])
+    for required in ("presto_tpu_queries_total",
+                     "presto_tpu_kernel_calls_total",
+                     "presto_tpu_ledger_ns_total",
+                     "presto_tpu_ledger_unattributed_ratio"):
+        assert required in families, f"{required} missing after a " \
+            "served query"

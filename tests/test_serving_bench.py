@@ -16,7 +16,8 @@ def test_serving_bench_smoke():
     # which re-plans cached statements once by design — round 2 is
     # the steady serving state whose plan-cache hits this asserts
     doc = run_serving_bench(clients=2, schema="tiny",
-                            mix=("q6", "q1"), warm_rounds=2)
+                            mix=("q6", "q1"), warm_rounds=2,
+                            flight_ab_rounds=1)
     # stable headline schema (CI greps these keys)
     for key in ("metric", "value", "unit", "platform", "clients",
                 "schema", "mix", "warm_rounds", "cold", "warm",
@@ -34,6 +35,18 @@ def test_serving_bench_smoke():
     assert doc["cache"]["plan"]["hits"] > 0
     assert doc["cache"]["fragment"]["hits"] > 0
     assert doc["warm"]["qps"] > 0 and doc["cold"]["qps"] > 0
+    # wall-attribution ledger rides every coordinator-backed phase:
+    # summed categories + per-query residuals, invariant intact
+    for phase in ("cold", "warm", "caches_off"):
+        led = doc[phase]["ledger"]
+        assert led and led["queries"] > 0, phase
+        assert led["categories_ms"], phase
+        assert "unattributed_frac_max" in led
+        assert led["per_query"], phase
+    # flight-recorder overhead A/B is measured, not asserted
+    fo = doc["flight_overhead"]
+    assert fo["qps_flight_on"] > 0 and fo["qps_flight_off"] > 0
+    assert fo["ring"]["total"] > 0
 
 
 def test_serving_bench_chaos_phase():
@@ -45,7 +58,7 @@ def test_serving_bench_chaos_phase():
     from presto_tpu.tools.serving_bench import run_serving_bench
     reset_cache_manager()
     doc = run_serving_bench(
-        clients=2, schema="tiny", mix=("q6", "q1"), warm_rounds=1,
+        flight_ab_rounds=1, clients=2, schema="tiny", mix=("q6", "q1"), warm_rounds=1,
         verify_off=False, chaos=True, chaos_rounds=2,
         chaos_spec="operator.add_input:every:10:7;cache.put:every:2")
     assert not faults.ARMED  # the bench must disarm behind itself
@@ -70,7 +83,7 @@ def test_serving_bench_sanitize_phase():
     from presto_tpu.tools.serving_bench import run_serving_bench
     reset_cache_manager()
     was_armed = sanitize.ARMED
-    doc = run_serving_bench(clients=2, schema="tiny",
+    doc = run_serving_bench(flight_ab_rounds=1, clients=2, schema="tiny",
                             mix=("q6", "q1"), warm_rounds=1,
                             verify_off=False, sanitize_phase=True)
     # the bench restores the PRIOR gate: disarmed suites stay
@@ -95,7 +108,7 @@ def test_serving_bench_restart_warm_phase(tmp_path):
     from presto_tpu.tools.serving_bench import run_serving_bench
     reset_cache_manager()
     doc = run_serving_bench(
-        clients=2, schema="tiny", mix=("q6",), warm_rounds=1,
+        flight_ab_rounds=1, clients=2, schema="tiny", mix=("q6",), warm_rounds=1,
         verify_off=False, restart_warm=True,
         cache_dir=str(tmp_path / "xla_cache"))
     rw = doc["restart_warm"]
@@ -125,7 +138,7 @@ def test_serving_bench_worker_churn_phase():
     from presto_tpu.tools.serving_bench import run_serving_bench
     reset_cache_manager()
     doc = run_serving_bench(
-        clients=2, schema="tiny", mix=("q6",), warm_rounds=1,
+        flight_ab_rounds=1, clients=2, schema="tiny", mix=("q6",), warm_rounds=1,
         verify_off=False, worker_churn=True, churn_workers=2,
         churn_rounds=2, churn_kills=1, churn_period_s=2.0)
     churn = doc["worker_churn"]
@@ -150,7 +163,7 @@ def test_serving_bench_full_capture_shape():
     from presto_tpu.cache import reset_cache_manager
     from presto_tpu.tools.serving_bench import run_serving_bench
     reset_cache_manager()
-    doc = run_serving_bench(clients=4, schema="sf0_01",
+    doc = run_serving_bench(flight_ab_rounds=1, clients=4, schema="sf0_01",
                             warm_rounds=2)
     assert doc["results_identical"] is True
     assert doc["speedup_warm_vs_cold"] >= 5.0
@@ -198,7 +211,7 @@ def test_serving_bench_overload_phase():
     from presto_tpu.cache import reset_cache_manager
     from presto_tpu.tools.serving_bench import run_serving_bench
     reset_cache_manager()
-    doc = run_serving_bench(clients=8, schema="tiny",
+    doc = run_serving_bench(flight_ab_rounds=1, clients=8, schema="tiny",
                             mix=("q6", "q1"), warm_rounds=1,
                             verify_off=False, overload=True,
                             overload_rounds=2,
