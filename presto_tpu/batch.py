@@ -181,13 +181,14 @@ class Column:
     def from_numpy(cls, values: np.ndarray, mask: Optional[np.ndarray],
                    typ: Type, capacity: int,
                    dictionary: Optional[Tuple[str, ...]] = None) -> "Column":
-        n = len(values)
-        assert n <= capacity
-        data = np.zeros(capacity, dtype=typ.np_dtype)
-        data[:n] = values
-        m = np.zeros(capacity, dtype=bool)
-        m[:n] = True if mask is None else mask
-        return cls(jnp.asarray(data), jnp.asarray(m), typ, dictionary)
+        # pad host-side into fresh capacity-bucket buffers, then move
+        # them onto the device via the page layer's dlpack doorway
+        # (zero-copy on the CPU backend; the fresh buffers are ceded)
+        from presto_tpu.native import pages
+        data, m = pages.pad_to_capacity(values, mask, capacity,
+                                        typ.np_dtype)
+        return cls(pages.to_device(data), pages.to_device(m), typ,
+                   dictionary)
 
     @classmethod
     def from_pylist(cls, values: Sequence[Any], typ: Type,
@@ -272,8 +273,13 @@ class Batch:
         return self.columns[name]
 
     def num_valid(self) -> int:
-        """Host-syncing count of live rows (Presto's positionCount)."""
-        return int(jnp.sum(self.row_valid))
+        """Host-syncing count of live rows (Presto's positionCount).
+        The int() blocks on every dispatch the mask depends on, so
+        this wall is a drain point — `device_wait`, not the enclosing
+        frame's self time (the async-dispatch undercount)."""
+        from presto_tpu.telemetry import ledger as _ledger
+        with _ledger.span("device_wait"):
+            return int(jnp.sum(self.row_valid))
 
     # -- construction ------------------------------------------------------
 
@@ -286,9 +292,10 @@ class Batch:
         capacity = capacity or bucket_capacity(n)
         cols = {name: Column.from_pylist(vals, typ, capacity)
                 for name, (vals, typ) in data.items()}
+        from presto_tpu.native import pages
         rv = np.zeros(capacity, dtype=bool)
         rv[:n] = True
-        return cls(cols, jnp.asarray(rv))
+        return cls(cols, pages.to_device(rv))
 
     @classmethod
     def from_numpy(cls, arrays: Dict[str, np.ndarray],
@@ -303,9 +310,10 @@ class Batch:
             mask = masks.get(name) if masks else None
             dic = dictionaries.get(name) if dictionaries else None
             cols[name] = Column.from_numpy(arr, mask, types[name], capacity, dic)
+        from presto_tpu.native import pages
         rv = np.zeros(capacity, dtype=bool)
         rv[:n] = True
-        return cls(cols, jnp.asarray(rv))
+        return cls(cols, pages.to_device(rv))
 
     # -- host-side materialization ----------------------------------------
 
@@ -537,7 +545,8 @@ def end_deferred_compact(batch: "Batch", total) -> "Batch":
     what this just shrank."""
     if total is None:
         return batch
-    n = int(np.asarray(total))
+    from presto_tpu.native.pages import to_host
+    n = int(to_host(total))
     cap = operator_capacity(n, floor=COMPACT_MIN)
     if cap < batch.capacity:
         return batch.compact(cap, known_valid=n)

@@ -267,7 +267,7 @@ class TaskExecutor:
             from presto_tpu.telemetry import ledger as _ledger
             wait_ns = time.perf_counter_ns() - t0_ns
             gap = max(0, wait_ns - scheduled_ns)
-            _ledger.add("driver", gap)
+            _ledger.add("driver.quantum", gap)
             _ledger.absorb(wait_ns - gap)
         if task.failure is not None:
             raise task.failure
@@ -468,12 +468,14 @@ class TaskExecutor:
         try:
             token = task.bind()
             try:
-                # the whole quantum charges to the ledger's `driver`
-                # category by SELF time: kernel/scan/exchange/serde
-                # work inside it subtracts via the nesting discipline,
-                # so `driver` is exactly the drive loop's own overhead
+                # the whole quantum charges to the ledger's
+                # `driver.quantum` category by SELF time: kernel/scan/
+                # exchange/serde work inside it subtracts via the
+                # nesting discipline, and the Driver's own stepping
+                # opens a nested `driver.step` frame — what remains
+                # here is exactly the executor's quantum bookkeeping
                 from presto_tpu.telemetry import ledger as _ledger
-                with _ledger.span("driver"):
+                with _ledger.span("driver.quantum"):
                     from presto_tpu.execution import faults
                     if faults.ARMED:
                         # fault site `executor.quantum`: every

@@ -65,18 +65,33 @@ def checksum(data: bytes) -> int:
     return int(lib.pt_checksum(_ro_buf(data), len(data)))
 
 
+def _count(stage: str, raw: int, framed: int) -> None:
+    """Compression observability: raw (uncompressed payload) vs
+    framed (codec frame incl. 17-byte header) bytes per direction —
+    serving_bench reports the per-phase before/after delta."""
+    from presto_tpu.telemetry.metrics import METRICS
+    METRICS.inc("presto_tpu_serde_bytes_total", raw,
+                stage=stage, kind="raw")
+    METRICS.inc("presto_tpu_serde_bytes_total", framed,
+                stage=stage, kind="framed")
+
+
 def encode(data: bytes) -> bytes:
     lib = load_pageserde()
     csum = checksum(data)
     head = len(data).to_bytes(8, "little") \
         + csum.to_bytes(8, "little")
+    frame = None
     if lib is not None:
         cap = int(lib.pt_compress_bound(len(data)))
         dst = (ctypes.c_uint8 * cap)()
         n = int(lib.pt_compress(_ro_buf(data), len(data), dst, cap))
         if n > 0:
-            return b"P" + head + ctypes.string_at(dst, n)
-    return b"Z" + head + zlib.compress(data, 1)
+            frame = b"P" + head + ctypes.string_at(dst, n)
+    if frame is None:
+        frame = b"Z" + head + zlib.compress(data, 1)
+    _count("encode", len(data), len(frame))
+    return frame
 
 
 def decode(frame: bytes) -> bytes:
@@ -111,4 +126,5 @@ def decode(frame: bytes) -> bytes:
         raise PageCorruption(f"size {len(data)} != header {size}")
     if checksum(data) != csum:
         raise PageCorruption("checksum mismatch")
+    _count("decode", len(data), len(frame))
     return data

@@ -1,0 +1,274 @@
+"""L0 columnar host pages (reference: the Page/Block data model —
+spi/Page.java, spi/block/*Block) and the host->device doorway.
+
+A :class:`HostPage` is the engine's host-side resting representation
+of one batch: named columns of contiguous numpy buffers (data + null
+mask per column, codes + sorted dictionary for varchar) sharing one
+``row_valid`` lane mask. It sits between the three data-plane worlds:
+
+  * **wire**: ``server/serde.py`` frames a page's raw buffers through
+    the LZ4 codec (``native/codec.py``) on every exchange and spool
+    write — the page IS the unit of compression;
+  * **Arrow**: when pyarrow is importable the page exports/imports as
+    a ``pyarrow.RecordBatch`` over the SAME buffers (zero-copy for
+    data lanes; masks fold into Arrow validity bitmaps), the
+    interop surface for external readers/writers;
+  * **device**: :func:`to_device` moves a host buffer into a JAX
+    device array via the **dlpack** protocol — zero-copy on the CPU
+    backend, one staging copy on accelerators — falling back to
+    ``jnp.asarray`` when the buffer's dtype or the backend refuses.
+
+Backend selection happens at import (docs/DATA_PLANE.md fallback
+matrix): ``PRESTO_TPU_PURE_PY_PAGES=1`` forces the pure-Python path
+(no pyarrow, no dlpack) — tests cover both configurations, so a
+container without pyarrow degrades without a behavior change.
+
+Zero-copy discipline: a buffer handed to :func:`to_device` is owned by
+the device array from then on — every caller here constructs fresh
+buffers (pad-to-capacity always copies), so nothing ever mutates a
+donated buffer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: forced pure-Python mode (import-time selection, the test lever)
+PURE_PY = os.environ.get("PRESTO_TPU_PURE_PY_PAGES") == "1"
+
+if PURE_PY:
+    pa = None
+else:
+    try:
+        import pyarrow as pa  # type: ignore
+    except Exception:  # pragma: no cover - container without pyarrow
+        pa = None
+
+#: Arrow interop available?
+HAVE_ARROW = pa is not None
+
+# -- dlpack host->device -----------------------------------------------------
+
+#: per-dtype-kind dlpack capability, probed on first use ('' = probe
+#: the kind on its first array). bool buffers go through dlpack only
+#: where both numpy and jax agree on the bool extension.
+_DLPACK_OK: Dict[str, bool] = {}
+
+
+def _dlpack_probe(kind: str) -> bool:
+    if PURE_PY:
+        return False
+    try:
+        import jax
+        import jax.numpy as jnp
+        sample = np.zeros(8, dtype=np.bool_ if kind == "b"
+                          else np.int32 if kind == "i"
+                          else np.uint32 if kind == "u"
+                          else np.float32)
+        out = jax.dlpack.from_dlpack(sample)
+        if out.shape != (8,) or out.dtype != sample.dtype:
+            return False
+        # dispatch interchangeability: a dlpack array must carry the
+        # same placement commitment as a jnp.asarray one, or mixing
+        # the two paths forks jit cache keys — the zero-new-kernels /
+        # retrace-budget oracles see phantom recompiles (observed as
+        # an extra hashagg_merge specialization when a committed
+        # dlpack-fed state merged with an uncommitted one). Backends
+        # where both paths commit (or neither does) keep zero-copy.
+        ref = jnp.asarray(sample)
+        return bool(getattr(out, "_committed", None)
+                    == getattr(ref, "_committed", None))
+    except Exception:
+        return False
+
+
+def dlpack_available(kind: str = "f") -> bool:
+    """Does the dlpack zero-copy path work for this dtype kind on this
+    backend? Probed once per kind, cached for the process."""
+    ok = _DLPACK_OK.get(kind)
+    if ok is None:
+        ok = _dlpack_probe(kind)
+        _DLPACK_OK[kind] = ok
+    return ok
+
+
+def to_device(arr: np.ndarray):
+    """Host buffer -> JAX device array. dlpack zero-copy when the
+    backend takes it, ``jnp.asarray`` otherwise. The caller cedes
+    ownership of `arr` (see the zero-copy discipline above)."""
+    import jax
+    import jax.numpy as jnp
+    arr = np.ascontiguousarray(arr)
+    if dlpack_available(arr.dtype.kind):
+        try:
+            return jax.dlpack.from_dlpack(arr)
+        except Exception:
+            _DLPACK_OK[arr.dtype.kind] = False
+    return jnp.asarray(arr)
+
+
+def to_host(x) -> np.ndarray:
+    """Device array -> host buffer, the symmetric doorway to
+    ``to_device`` and the engine's ONE sanctioned blocking read.
+
+    ``np.asarray`` on an in-flight jax array silently folds two very
+    different walls into the caller's ledger frame: the wait for the
+    async-dispatched computation to land, then the device->host copy.
+    Before this doorway existed, warm join queries charged ~70% of
+    their wall to `driver.step` when most of it was the device still
+    computing. Splitting the two here keeps query_doctor honest:
+    `device_wait` (kernel group) for the block, `d2h` (glue) for the
+    copy itself. Plain numpy input passes straight through."""
+    if isinstance(x, np.ndarray):
+        return x
+    from presto_tpu.telemetry import ledger as _ledger
+    wait = getattr(x, "block_until_ready", None)
+    if wait is not None:
+        with _ledger.span("device_wait"):
+            wait()
+    with _ledger.span("d2h"):
+        return np.asarray(x)
+
+
+# -- the page ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """One column's host buffers: `data` (numeric lanes or int32
+    dictionary codes), `mask` (True = present), optional sorted
+    dictionary for varchar."""
+
+    data: np.ndarray
+    mask: np.ndarray
+    type_name: str
+    dictionary: Optional[Tuple[str, ...]] = None
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.data.nbytes + self.mask.nbytes)
+
+
+@dataclasses.dataclass
+class HostPage:
+    """Named host columns + the shared row_valid lane mask."""
+
+    columns: Dict[str, HostColumn]
+    row_valid: np.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return int(self.row_valid.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(c.nbytes for c in self.columns.values())
+                   + self.row_valid.nbytes)
+
+    # -- batch <-> page ----------------------------------------------------
+
+    @classmethod
+    def from_host_batch(cls, host) -> "HostPage":
+        """From a device_get'd Batch (numpy leaves): the serde encode
+        side. Buffers are shared, not copied — the caller must treat
+        the page as a frozen view."""
+        cols = {}
+        for name, c in host.columns.items():
+            cols[name] = HostColumn(
+                np.ascontiguousarray(np.asarray(c.data)),
+                np.ascontiguousarray(np.asarray(c.mask)),
+                c.type.display(), c.dictionary)
+        return cls(cols, np.ascontiguousarray(np.asarray(host.row_valid)))
+
+    def to_batch(self):
+        """Move every buffer onto the device (dlpack when available)
+        and assemble the engine Batch. The page's buffers are ceded to
+        the device arrays."""
+        from presto_tpu.batch import Batch, Column
+        from presto_tpu.types import parse_type
+        cols = {}
+        for name, c in self.columns.items():
+            cols[name] = Column(to_device(c.data), to_device(c.mask),
+                                parse_type(c.type_name), c.dictionary)
+        return Batch(cols, to_device(self.row_valid))
+
+    def to_host_batch(self):
+        """Assemble the engine Batch over the page's numpy buffers
+        WITHOUT device placement — the exchange consumer path, where
+        repartition/delivery owns device_put (and its device choice)."""
+        from presto_tpu.batch import Batch, Column
+        from presto_tpu.types import parse_type
+        cols = {}
+        for name, c in self.columns.items():
+            cols[name] = Column(c.data, c.mask,
+                                parse_type(c.type_name), c.dictionary)
+        return Batch(cols, self.row_valid)
+
+    # -- Arrow interop -----------------------------------------------------
+
+    def to_arrow(self):
+        """Export as a ``pyarrow.RecordBatch`` over the same buffers
+        (data lanes are zero-copy; masks/row_valid become Arrow
+        validity + a `__row_valid` column). Requires pyarrow."""
+        if not HAVE_ARROW:
+            raise RuntimeError(
+                "pyarrow unavailable (pure-Python page mode)")
+        arrays, names = [], []
+        for name, c in self.columns.items():
+            if c.dictionary is not None:
+                arr = pa.DictionaryArray.from_arrays(
+                    pa.array(c.data, mask=~c.mask),
+                    pa.array(list(c.dictionary), type=pa.string()))
+            else:
+                arr = pa.array(c.data, mask=~c.mask)
+            arrays.append(arr)
+            names.append(name)
+        arrays.append(pa.array(self.row_valid))
+        names.append("__row_valid")
+        return pa.RecordBatch.from_arrays(arrays, names=names)
+
+    @classmethod
+    def from_arrow(cls, rb, types: Dict[str, str]) -> "HostPage":
+        """Import a RecordBatch produced by :meth:`to_arrow`. `types`
+        maps column name -> engine type display string (Arrow types
+        are lossy against the engine's decimal/varchar encoding)."""
+        if not HAVE_ARROW:
+            raise RuntimeError(
+                "pyarrow unavailable (pure-Python page mode)")
+        cols = {}
+        row_valid = None
+        for name, arr in zip(rb.schema.names, rb.columns):
+            if name == "__row_valid":
+                row_valid = np.asarray(arr, dtype=bool)
+                continue
+            if pa.types.is_dictionary(arr.type):
+                dictionary = tuple(arr.dictionary.to_pylist())
+                data = np.asarray(
+                    arr.indices.fill_null(0), dtype=np.int32)
+            else:
+                dictionary = None
+                zero = False if pa.types.is_boolean(arr.type) else 0
+                data = np.asarray(arr.fill_null(zero))
+            mask = ~np.asarray(arr.is_null(), dtype=bool)
+            cols[name] = HostColumn(data, mask, types[name], dictionary)
+        assert row_valid is not None, "missing __row_valid column"
+        return cls(cols, row_valid)
+
+
+def pad_to_capacity(values: np.ndarray, mask: Optional[np.ndarray],
+                    capacity: int, dtype) -> Tuple[np.ndarray,
+                                                   np.ndarray]:
+    """The one place host lanes are padded to a capacity bucket: fresh
+    buffers (so downstream zero-copy donation is safe), value lanes
+    zero-filled past n, mask False past n."""
+    n = len(values)
+    assert n <= capacity
+    data = np.zeros(capacity, dtype=dtype)
+    data[:n] = values
+    m = np.zeros(capacity, dtype=bool)
+    m[:n] = True if mask is None else mask
+    return data, m

@@ -460,7 +460,8 @@ class AggregationOperator(Operator):
                 # on/off byte-identity oracle caught exactly that.
                 break
             st, cnt = self._pending.pop(0)
-            live = int(np.asarray(cnt))
+            from presto_tpu.native.pages import to_host
+            live = int(to_host(cnt))
             cap = self._state_cap(st)
             tgt = min(cap, self._live_cap(live))
             if tgt < cap:
@@ -593,10 +594,16 @@ class AggregationOperator(Operator):
             # ONE host fetch serves both the overflow check and the
             # live-group count (the count drives output compaction —
             # a stats-overshot state capacity must not ride downstream
-            # as a huge mostly-dead batch)
-            overflow, live = jax.device_get(
-                (self._state.overflow,
-                 jnp.sum(self._state.valid)))
+            # as a huge mostly-dead batch). The fetch blocks on every
+            # async-dispatched agg kernel the state depends on — split
+            # the device's catch-up (device_wait) from the copy (d2h),
+            # same discipline as pages.to_host.
+            from presto_tpu.telemetry import ledger as _ledger
+            pair = (self._state.overflow, jnp.sum(self._state.valid))
+            with _ledger.span("device_wait"):
+                jax.block_until_ready(pair)
+            with _ledger.span("d2h"):
+                overflow, live = jax.device_get(pair)
             if bool(overflow):
                 # groups were dropped — the query must re-run with a
                 # larger table (reference analog: MultiChannelGroupByHash

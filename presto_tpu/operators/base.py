@@ -133,9 +133,13 @@ def run_deferred_checks(dctx: "DriverContext") -> None:
     if not flags:
         return
     import jax
+    from presto_tpu.telemetry import ledger as _ledger
     # device_get, not stack: task flags may live on different devices
-    # of a mesh; one gather call still fetches them together
-    tripped = jax.device_get(flags)
+    # of a mesh; one gather call still fetches them together. The
+    # gather blocks on every dispatch the flags depend on — that wall
+    # is the device finishing, not drive-loop self time.
+    with _ledger.span("device_wait"):
+        tripped = jax.device_get(flags)
     for hit, make_exc in zip(tripped, excs):
         if bool(hit):
             raise make_exc()

@@ -4,17 +4,105 @@ batch current.getOutput() -> next.addInput()).
 
 The host loop only moves device-array handles between operators; jax
 dispatch is async, so the device pipeline stays busy while the host walks
-the operator chain (SURVEY.md hard part #5)."""
+the operator chain (SURVEY.md hard part #5).
+
+Batch pump (docs/DATA_PLANE.md): when the fusion pass has reduced a
+pipeline to `scan -> fused_kernel -> emit/fold`, the generic pair walk
+is pure overhead — every pass re-checks every operator's blocked/
+needs-input/finished state to move the one batch that was always going
+to move. The pump fast path drives such a split in ONE loop with
+double-buffered prefetch: split N+1's scan + host->device transfer
+(the `prefetch` ledger frames) overlaps split N's fused kernel, which
+JAX's async dispatch left running on the device. Semantics are
+identical by construction — the same operator methods run in the same
+per-operator order, the `operator.add_input` fault site still fires on
+every hand-off, and quantum deadlines still checkpoint every split —
+so pump-on and pump-off runs are byte-identical (tests/
+test_batch_pump.py holds that oracle). Profiled or traced runs, and
+any pipeline containing an operator the pump cannot model (exchanges,
+merges, writers), keep the generic loop."""
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List, Optional
 
 from presto_tpu.execution import faults
 from presto_tpu.operators.base import Operator
 from presto_tpu.telemetry import kernels as _tk
+from presto_tpu.telemetry import ledger as _ledger
 from presto_tpu.telemetry import trace as _trace
+
+#: process-wide batch-pump switch (A/B lever: serving_bench's byte-
+#: identity oracle and the pump test battery flip it); the env var is
+#: the subprocess-bench override
+_PUMP_ON = os.environ.get("PRESTO_TPU_PUMP", "1") != "0"
+
+
+def set_pump(on: bool) -> None:
+    global _PUMP_ON
+    _PUMP_ON = bool(on)
+
+
+def pump_enabled() -> bool:
+    return _PUMP_ON
+
+
+def _pump_op_sets():
+    """(sources, streamable) operator classes the pump may drive —
+    resolved lazily to dodge import cycles. Streamable means the pump
+    can preserve the pair loop's semantics from the operator's
+    declared state alone: at most one output batch moves per
+    add_input/get_output round, pending output is advertised through
+    `needs_input` (the pump parks the batch and falls through), and
+    blocking folds simply absorb until the generic loop drains them.
+    Blocking on another driver is fine — the pump re-checks
+    `is_blocked` before every split and parks exactly like the pair
+    loop (a probe waiting on its build bridge pumps once the build
+    publishes). What disqualifies a pipeline is an operator whose
+    output cadence the pump cannot see (exchange sources/sinks, the
+    k-way merge, writers with commit protocols)."""
+    global _PUMP_SOURCES, _PUMP_STREAMABLE
+    try:
+        return _PUMP_SOURCES, _PUMP_STREAMABLE
+    except NameError:
+        pass
+    from presto_tpu.operators.aggregation import (
+        AggregationOperator, StreamingAggregationOperator,
+    )
+    from presto_tpu.operators.cache_ops import (
+        FragmentRecordOperator, FragmentReplayOperator,
+    )
+    from presto_tpu.operators.core import (
+        FilterProjectOperator, LimitOperator, OutputCollectorOperator,
+        SourceOperator,
+    )
+    from presto_tpu.operators.fused_fragment import (
+        FusedDistinctOperator, FusedTopNOperator,
+    )
+    from presto_tpu.operators.join_ops import (
+        HashBuildOperator, LookupJoinOperator, SemiJoinOperator,
+    )
+    from presto_tpu.operators.sort_ops import (
+        DistinctOperator, OrderByOperator, TopNOperator,
+    )
+    _PUMP_SOURCES = (SourceOperator, FragmentReplayOperator)
+    # FilterProjectOperator covers fused chains too (a collapsed
+    # FusedChainOperatorFactory creates one driving the chain kernel),
+    # and LimitOperator covers FusedLimitOperator. The blocking folds
+    # (agg, sort, topn, distinct, hash build) absorb input and emit
+    # nothing until the generic loop drains them; the join probes
+    # pipeline a bounded pending queue behind `needs_input`.
+    _PUMP_STREAMABLE = (
+        FilterProjectOperator, LimitOperator, FusedTopNOperator,
+        FusedDistinctOperator, AggregationOperator,
+        StreamingAggregationOperator, FragmentRecordOperator,
+        OutputCollectorOperator, HashBuildOperator,
+        LookupJoinOperator, SemiJoinOperator, OrderByOperator,
+        TopNOperator, DistinctOperator,
+    )
+    return _PUMP_SOURCES, _PUMP_STREAMABLE
 
 
 class Driver:
@@ -33,6 +121,15 @@ class Driver:
         assert operators, "driver needs at least one operator"
         self.operators = operators
         self._closed = False
+        #: batch-pump state: None = eligibility undecided, False =
+        #: ineligible pipeline shape, True = pumpable. `_prefetched`
+        #: holds split N+1 pulled while split N's kernel runs;
+        #: `_pump_drained` flips once the source is exhausted and the
+        #: generic loop owns finish propagation + the fold drain.
+        self._pump: Optional[bool] = None
+        self._prefetched = None
+        self._pump_drained = False
+        self._pump_splits = 0
 
     def is_finished(self) -> bool:
         return self._closed or self.operators[-1].is_finished()
@@ -63,19 +160,164 @@ class Driver:
         wall time, exactly what the serial loop measured."""
         deadline = time.perf_counter() + quantum_s
         progressed = False
+        if self._pump_ok():
+            with _ledger.span("driver.step"):
+                status, progressed = self._pump_quantum(deadline)
+            if status is not None:
+                return status, progressed
+            # status None: the source drained (or the chain backed
+            # up) mid-quantum — the generic loop below finishes the
+            # job; splits already pumped still count as progress
+        with _ledger.span("driver.step"):
+            while True:
+                if self.is_finished():
+                    return self.FINISHED, progressed
+                moved = self._process_once()
+                progressed = progressed or moved
+                if self.is_finished():
+                    return self.FINISHED, progressed
+                if not moved:
+                    if self.blocked_reason() is not None:
+                        return self.BLOCKED, progressed
+                    return self.IDLE, progressed
+                if time.perf_counter() >= deadline:
+                    return self.PROGRESS, progressed
+
+    # -- batch pump --------------------------------------------------------
+
+    def _pump_ok(self) -> bool:
+        """Pump this quantum? Cheap after the first call: eligibility
+        is a cached shape property; the per-quantum part is only the
+        global switch, the drained flag, and the trace gate."""
+        if not _PUMP_ON or self._pump_drained or self._pump is False:
+            return False
+        if self._pump is None:
+            self._pump = self._pump_eligible()
+            if not self._pump:
+                return False
+        # traced runs want per-hand-off spans; profiled runs want
+        # device-inclusive per-operator timing — both keep the pair
+        # loop (profile is static per driver context, checked once)
+        if _trace.ACTIVE and _trace.current() is not None:
+            return False
+        return True
+
+    def _pump_eligible(self) -> bool:
+        from presto_tpu.telemetry.metrics import METRICS
+        ops = self.operators
+        sources, streamable = _pump_op_sets()
+        ok = (len(ops) >= 2
+              and not ops[0].ctx.driver_context.profile
+              and isinstance(ops[0], sources)
+              and all(isinstance(op, streamable) for op in ops[1:]))
+        METRICS.inc("presto_tpu_pump_drivers_total",
+                    status="pump" if ok else "step")
+        return ok
+
+    def _pump_quantum(self, deadline: float):
+        """Drive `scan -> fused_kernel -> emit/fold` splits until the
+        quantum expires, an operator blocks, or the source drains.
+        Returns (status, progressed); status None means fall through
+        to the generic pair loop (drain/finish propagation, or a
+        backed-up stage the pump won't model)."""
+        ops = self.operators
+        src = ops[0]
+        progressed = False
         while True:
             if self.is_finished():
                 return self.FINISHED, progressed
-            moved = self._process_once()
-            progressed = progressed or moved
-            if self.is_finished():
-                return self.FINISHED, progressed
-            if not moved:
-                if self.blocked_reason() is not None:
+            for op in ops:
+                if op.is_blocked():
                     return self.BLOCKED, progressed
-                return self.IDLE, progressed
+                if op is not src and op.is_finished():
+                    # early termination (LIMIT hit mid-chain): the
+                    # generic loop owns finish propagation
+                    return None, progressed
+            buf = self._prefetched
+            self._prefetched = None
+            if buf is None:
+                buf = self._pump_pull()      # prime the double buffer
+                if buf is None:
+                    if not src.is_finished():
+                        return self.IDLE, progressed
+                    self._pump_drained = True
+                    return None, progressed
+            if not all(op.needs_input() for op in ops[1:]):
+                # a backed-up stage (e.g. a deferred-compact window at
+                # depth): park the batch back in the buffer and let the
+                # generic loop drain — the buffer is re-consumed first
+                # thing next quantum, so no batch is lost or reordered
+                self._prefetched = buf
+                return None, progressed
+            # split N: one add_input dispatches the whole fused chain
+            # asynchronously — the host is back here while the device
+            # still works ...
+            self._pump_feed(buf)
+            progressed = True
+            self._pump_splits += 1
+            # ... which is exactly when split N+1's scan + h2d runs
+            # (the double buffer: device computes N, host readies N+1)
+            if not src.is_finished():
+                self._prefetched = self._pump_pull()
+            if self._prefetched is None and src.is_finished():
+                self._pump_drained = True
+                return None, progressed
             if time.perf_counter() >= deadline:
                 return self.PROGRESS, progressed
+
+    def _pump_pull(self):
+        """One source pull under the ledger's `prefetch` frame: the
+        nested scan/h2d spans charge themselves, so `prefetch` is the
+        overlap machinery's own self time."""
+        src = self.operators[0]
+        timing = _tk.ENABLED
+        if timing:
+            _tk.set_current_op(src.ctx.stats)
+        t0 = time.perf_counter()
+        try:
+            with _ledger.span("prefetch"):
+                batch = src.get_output()
+        finally:
+            src.ctx.stats.busy_seconds += time.perf_counter() - t0
+            if timing:
+                _tk.set_current_op(None)
+        return batch
+
+    def _pump_feed(self, batch) -> None:
+        """Move one prefetched batch through ops[1:], preserving the
+        pair loop's per-hand-off contract: the `operator.add_input`
+        fault site fires, kernel time binds to the consuming
+        operator's stats, and busy_seconds accumulate."""
+        ops = self.operators
+        timing = _tk.ENABLED
+        armed = faults.ARMED
+        x = batch
+        for i in range(1, len(ops)):
+            op = ops[i]
+            if armed:
+                faults.fire("operator.add_input", op=op,
+                            name=op.ctx.name)
+            if timing:
+                _tk.set_current_op(op.ctx.stats)
+            t0 = time.perf_counter()
+            op.add_input(x)
+            if i < len(ops) - 1:
+                x = op.get_output()
+            op.ctx.stats.busy_seconds += time.perf_counter() - t0
+            if timing:
+                _tk.set_current_op(None)
+            if i < len(ops) - 1 and x is None:
+                # absorbed by a fold (or pipelined inside a deferred-
+                # compact window): nothing to move further downstream
+                return
+        # self-driving tail (sink flush), mirroring the pair loop
+        tail = ops[-1]
+        if not tail.is_finished() and not tail.is_blocked():
+            if timing:
+                _tk.set_current_op(tail.ctx.stats)
+            tail.get_output()
+            if timing:
+                _tk.set_current_op(None)
 
     def process(self, max_iterations: int = 1) -> bool:
         """Run up to `max_iterations` passes over the operator chain
@@ -116,17 +358,30 @@ class Driver:
         # (Driver.processInternal:371)
         for i in range(len(ops) - 1):
             current, nxt = ops[i], ops[i + 1]
+            # a parked pump lookahead means the source is NOT done
+            # yet from the pipeline's point of view, whatever its own
+            # state machine says — the buffered batch must flow first
+            cur_finished = current.is_finished() \
+                and not (i == 0 and self._prefetched is not None)
             if current.is_blocked() or nxt.is_blocked():
                 if profile:
                     self._note_blocked(current, nxt)
                 continue
             if profile:
                 self._note_blocked(current, nxt)  # closes open windows
-            if nxt.needs_input() and not current.is_finished():
+            if nxt.needs_input() and not cur_finished:
                 if timing:
                     _tk.set_current_op(current.ctx.stats)
                 t0 = time.perf_counter()
-                batch = current.get_output()
+                if i == 0 and self._prefetched is not None:
+                    # a batch the pump prefetched but could not feed
+                    # (backed-up stage at a quantum boundary): it MUST
+                    # leave the buffer before the source is pulled
+                    # again, or batches would reorder
+                    batch = self._prefetched
+                    self._prefetched = None
+                else:
+                    batch = current.get_output()
                 if profile and batch is not None:
                     # device-inclusive timing: charge this operator for
                     # the async work its output depends on (profiled
@@ -162,7 +417,7 @@ class Driver:
                 if timing:
                     _tk.set_current_op(None)
             # unwind finished prefix (Driver.java:438-447)
-            if current.is_finished():
+            if cur_finished:
                 nxt.finish()
         # drain the tail operator if it is a sink that self-drives
         tail = self.operators[-1]
@@ -235,6 +490,12 @@ class Driver:
 
     def close(self) -> None:
         if not self._closed:
+            self._prefetched = None  # drop any in-flight lookahead
+            if self._pump_splits:
+                from presto_tpu.telemetry.metrics import METRICS
+                METRICS.inc("presto_tpu_pump_splits_total",
+                            self._pump_splits)
+                self._pump_splits = 0
             now = time.perf_counter()
             for op in self.operators:
                 # close any open blocked window: an operator still

@@ -237,7 +237,8 @@ class HashBuildOperator(Operator):
                     c = merged.columns[key]
                     vals, n, ovf = df.distinct_set(
                         c.data, c.mask & merged.row_valid)
-                    if not bool(ovf):
+                    from presto_tpu.native.pages import to_host
+                    if not bool(to_host(ovf)):
                         dset = (vals, n)
                 reg.publish(df_id, mn, mx, dset)
             else:
@@ -266,7 +267,8 @@ class HashBuildOperator(Operator):
                 self._spill, self.key_dicts)
             return
         # one device->host sync for the whole build side (not per batch)
-        total = int(np.asarray(self._total)) if self._total is not None \
+        from presto_tpu.native.pages import to_host
+        total = int(to_host(self._total)) if self._total is not None \
             else 0
         # shape bucketing: the probe kernel's jit cache keys on the
         # BUILD table shape too — landing build capacities on the

@@ -72,6 +72,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.batch import Batch, Column
+from presto_tpu.native import pages
 from presto_tpu.ops import common
 
 CVal = Tuple[jnp.ndarray, jnp.ndarray]
@@ -265,14 +266,14 @@ def build_for_backend(batch: Batch, key_names: Tuple[str, ...],
     if not common.cpu_backend():
         sh, h2, part_starts, run_len, vc, sbatch, spans = \
             _build_sorted(batch, key_names, k)
-        max_span, max_run = (int(x) for x in np.asarray(spans))
+        max_span, max_run = (int(x) for x in pages.to_host(spans))
         return BuildTable(sh, h2, part_starts, run_len, vc, sbatch,
                           radix_bits=k,
                           search_depth=_bucket_depth(
                               common.search_iters(max_span)),
                           unique_runs=max_run <= 1)
     h, h2 = _build_hash(batch, key_names)
-    hn = np.asarray(h)
+    hn = pages.to_host(h)
     perm = np.argsort(hn, kind="stable")
     sh_np = hn[perm]
     n = sh_np.shape[0]

@@ -763,7 +763,7 @@ class LocalRunner:
             # nested planning/scan/kernel/... spans subtract, and the
             # executor wait is absorbed (run_drivers) so worker-thread
             # quanta never double-book it
-            with _ledger.span("driver"):
+            with _ledger.span("driver.quantum"):
                 result = self._execute_lifecycled(sql)
         except BaseException as e:
             # a FAILED traced query keeps its timeline: events (root
@@ -1403,7 +1403,7 @@ class LocalRunner:
             from presto_tpu.telemetry import (
                 render_operator_stats, snapshot_drivers,
             )
-            with _ledger.span("driver"):
+            with _ledger.span("driver.reassembly"):
                 snap = snapshot_drivers(drivers, pool)
                 self._session_tl.op_stats = snap
                 # the history recording tap: ONLY here — past every
@@ -1491,7 +1491,7 @@ class LocalRunner:
                                  abort_check=abort_check,
                                  max_idle_s=max_idle_s)
         else:
-            with _ledger.span("driver"):
+            with _ledger.span("driver.step"):
                 idle_since: Optional[float] = None
                 while True:
                     check_lifecycle(cancel, deadline)

@@ -903,8 +903,8 @@ th{{background:#222}}
                         cats.get("queued", 0.0) + queued_ms, 3)
                 mat_ms = getattr(q, "materialize_ms", 0.0)
                 if mat_ms > 0:
-                    cats["driver"] = round(
-                        cats.get("driver", 0.0) + mat_ms, 3)
+                    cats["driver.reassembly"] = round(
+                        cats.get("driver.reassembly", 0.0) + mat_ms, 3)
                 total = sum(cats.values())
                 if total > wall_ms > 0:
                     # same normalization contract as QueryLedger.
@@ -1167,7 +1167,7 @@ th{{background:#222}}
             # bookkeeping, task-status collection) is driver overhead;
             # nested planning/exchange/serde spans subtract and the
             # root drive's executor wait is absorbed by run_drivers
-            with _ledger.span("driver"):
+            with _ledger.span("driver.quantum"):
                 result = self._execute_attempt_inner(
                     sql, worker_urls, properties, on_columns, user,
                     lifecycle)

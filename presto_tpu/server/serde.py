@@ -16,10 +16,10 @@ import json
 
 import numpy as np
 
-from presto_tpu.batch import Batch, Column, bucket_capacity
+from presto_tpu.batch import Batch, bucket_capacity
 from presto_tpu.native import codec
+from presto_tpu.native.pages import HostColumn, HostPage
 from presto_tpu.telemetry import ledger as _ledger
-from presto_tpu.types import parse_type
 
 
 def batch_to_bytes(batch: Batch, assume_compact: bool = False) -> bytes:
@@ -42,6 +42,13 @@ def _batch_to_bytes(batch: Batch, assume_compact: bool) -> bytes:
         b = batch.compact(bucket_capacity(max(n, 1)), known_valid=n)
     with _ledger.span("d2h"):
         host = jax.device_get(b)
+    return page_to_bytes(HostPage.from_host_batch(host))
+
+
+def page_to_bytes(page: HostPage) -> bytes:
+    """Frame one host page for the wire: header + ONE codec frame of
+    the concatenated column buffers (data + mask per column, then
+    row_valid)."""
     parts = []
     columns = []
     arrays = []
@@ -49,33 +56,38 @@ def _batch_to_bytes(batch: Batch, assume_compact: bool) -> bytes:
 
     def add(arr: np.ndarray):
         nonlocal offset
-        arr = np.ascontiguousarray(arr)
         raw = arr.tobytes()
         arrays.append({"dtype": arr.dtype.str, "n": int(arr.shape[0]),
                        "off": offset})
         parts.append(raw)
         offset += len(raw)
 
-    for name, c in host.columns.items():
+    for name, c in page.columns.items():
         columns.append({
-            "name": name, "type": c.type.display(),
+            "name": name, "type": c.type_name,
             "dictionary": list(c.dictionary)
             if c.dictionary is not None else None,
         })
-        add(np.asarray(c.data))
-        add(np.asarray(c.mask))
-    add(np.asarray(host.row_valid))
+        add(c.data)
+        add(c.mask)
+    add(page.row_valid)
     header = json.dumps({"columns": columns, "arrays": arrays}).encode()
     frame = codec.encode(b"".join(parts))
     return len(header).to_bytes(4, "big") + header + frame
 
 
 def batch_from_bytes(data: bytes) -> Batch:
+    """Wire frame -> HOST batch (numpy leaves): consumers own device
+    placement (repartition pads to the quantized ladder first, local
+    short-circuits never leave the host). A consumer that wants the
+    decoded page straight on the device uses ``page_from_bytes`` +
+    ``HostPage.to_batch`` (the dlpack doorway) instead."""
     with _ledger.span("serde"):
-        return _batch_from_bytes(data)
+        return page_from_bytes(data).to_host_batch()
 
 
-def _batch_from_bytes(data: bytes) -> Batch:
+def page_from_bytes(data: bytes) -> HostPage:
+    """Decode one wire frame back into a host page (no device I/O)."""
     hlen = int.from_bytes(data[:4], "big")
     header = json.loads(data[4:4 + hlen].decode())
     body = codec.decode(data[4 + hlen:])
@@ -91,6 +103,6 @@ def _batch_from_bytes(data: bytes) -> Batch:
     for i, meta in enumerate(header["columns"]):
         dic = tuple(meta["dictionary"]) \
             if meta["dictionary"] is not None else None
-        cols[meta["name"]] = Column(
-            arr(2 * i), arr(2 * i + 1), parse_type(meta["type"]), dic)
-    return Batch(cols, arr(2 * len(header["columns"])))
+        cols[meta["name"]] = HostColumn(
+            arr(2 * i), arr(2 * i + 1), meta["type"], dic)
+    return HostPage(cols, arr(2 * len(header["columns"])))

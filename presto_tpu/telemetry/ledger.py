@@ -62,9 +62,26 @@ Category taxonomy (docs/OBSERVABILITY.md):
     spool         spool I/O: task-output spool put/read-back, lifespan
                   spool disk pages
     retry_backoff transport-retry backoff sleeps
-    driver        driver/executor overhead: the drive loop's own self
-                  time + executor scheduling gaps (the catch-all that
+    prefetch      the batch pump's lookahead frames: pulling split
+                  N+1's scan + h2d while split N's kernel runs on the
+                  device (operators/driver.py; nested scan/h2d spans
+                  subtract, so this is the overlap machinery's own
+                  self time)
+    driver.step   per-operator stepping: the Driver pair loop / batch
+                  pump's own self time (host Python moving batches)
+    driver.reassembly
+                  batch/result reassembly: stats snapshotting, history
+                  recording, coordinator-side row materialization
+    driver.quantum
+                  executor quantum bookkeeping + scheduling gaps +
+                  statement-level drive framing (the catch-all that
                   keeps the invariant honest)
+
+The legacy monolithic ``driver`` category was split into the three
+``driver.*`` sub-categories above (PR 16) so a drive-loop regression is
+attributable per cause; pre-split documents (and ad-hoc charges) still
+render and still count toward the coverage invariant —
+:meth:`QueryLedger.finish` carries any charged category, listed or not.
 """
 
 from __future__ import annotations
@@ -80,7 +97,14 @@ from presto_tpu import sanitize
 CATEGORIES: Tuple[str, ...] = (
     "queued", "planning", "scan", "h2d", "compile", "dispatch",
     "device_wait", "d2h", "serde", "exchange", "spool",
-    "retry_backoff", "driver",
+    "retry_backoff", "prefetch", "driver.step", "driver.reassembly",
+    "driver.quantum",
+)
+
+#: the drive-loop sub-categories (docs/OBSERVABILITY.md): their sum is
+#: the comparable figure for the pre-split monolithic `driver` number
+DRIVER_CATEGORIES: Tuple[str, ...] = (
+    "driver.step", "driver.reassembly", "driver.quantum",
 )
 
 _TL = threading.local()
@@ -134,11 +158,16 @@ class QueryLedger:
             snap = {c: int(v * scale) for c, v in snap.items()}
             attributed = sum(snap.values())
         unattributed = wall_ns - attributed
+        # every charged category travels, listed or not: an ad-hoc key
+        # (a legacy `driver` charge, a future category) counted toward
+        # `attributed`, so dropping it here would break the invariant
+        order = list(CATEGORIES) \
+            + sorted(k for k in snap if k not in CATEGORIES)
         doc: Dict[str, Any] = {
             "wall_ms": round(wall_ns / 1e6, 3),
             "categories_ms": {
                 c: round(snap.get(c, 0) / 1e6, 3)
-                for c in CATEGORIES if snap.get(c, 0) > 0},
+                for c in order if snap.get(c, 0) > 0},
             "unattributed_ms": round(unattributed / 1e6, 3),
             "unattributed_frac": round(unattributed / wall_ns, 4)
             if wall_ns > 0 else 0.0,

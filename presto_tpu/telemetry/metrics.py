@@ -258,8 +258,21 @@ METRICS.describe("presto_tpu_ledger_ns_total",
                  "Wall-attribution ledger ns by category "
                  "(telemetry/ledger.py: queued/planning/scan/h2d/"
                  "compile/dispatch/device_wait/d2h/serde/exchange/"
-                 "spool/retry_backoff/driver), summed over finished "
-                 "queries")
+                 "spool/retry_backoff/prefetch/driver.*), summed "
+                 "over finished queries")
+METRICS.describe("presto_tpu_serde_bytes_total",
+                 "Page-serde codec bytes by stage (encode/decode) "
+                 "and kind: raw = uncompressed payload, framed = "
+                 "the LZ4/zlib codec frame on the wire "
+                 "(native/codec.py; docs/DATA_PLANE.md)")
+METRICS.describe("presto_tpu_pump_drivers_total",
+                 "Driver pipelines by drive mode: pump = the batch-"
+                 "pump fast path (scan -> fused kernel -> emit/fold "
+                 "with double-buffered prefetch), step = the generic "
+                 "pair loop (operators/driver.py)")
+METRICS.describe("presto_tpu_pump_splits_total",
+                 "Source splits driven through the batch pump "
+                 "(one prefetch + one fused dispatch each)")
 METRICS.describe("presto_tpu_ledger_unattributed_ns_total",
                  "Wall ns the attribution ledger could NOT assign to "
                  "a category (the coverage residual; the histogram "

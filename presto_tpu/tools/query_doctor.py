@@ -38,7 +38,11 @@ VERDICT_GROUPS: Dict[str, Tuple[str, ...]] = {
     "queueing": ("queued",),
     "kernel": ("compile", "dispatch", "device_wait"),
     "exchange": ("exchange", "serde", "spool", "retry_backoff"),
-    "glue": ("planning", "scan", "h2d", "d2h", "driver"),
+    # `driver` is the pre-split legacy key: old saved docs still get
+    # the right verdict; live ledgers emit the driver.* sub-categories
+    # plus the batch pump's `prefetch` frames
+    "glue": ("planning", "scan", "h2d", "d2h", "prefetch", "driver",
+             "driver.step", "driver.reassembly", "driver.quantum"),
 }
 
 

@@ -157,6 +157,48 @@ def _harvest_ledgers(coord, known_ids: set,
     }
 
 
+def _serde_delta(metrics, before: Dict[Tuple[str, str], float]) -> dict:
+    """This phase's exchange/spool serde traffic from the monotonic
+    `presto_tpu_serde_bytes_total` counters: raw vs framed bytes per
+    direction plus the achieved compression ratio (framed/raw; < 1.0
+    means the codec shrank the wire). Phases run sequentially, so the
+    before/after delta is exactly this phase's traffic."""
+    out = {}
+    for s in ("encode", "decode"):
+        raw = int(metrics.get("presto_tpu_serde_bytes_total",
+                              stage=s, kind="raw")
+                  - before[(s, "raw")])
+        framed = int(metrics.get("presto_tpu_serde_bytes_total",
+                                 stage=s, kind="framed")
+                     - before[(s, "framed")])
+        out[s] = {"raw_bytes": raw, "framed_bytes": framed,
+                  "ratio": round(framed / raw, 4) if raw else None}
+    return out
+
+
+def _doctor_verdict(warm_stats: dict,
+                    expected: Optional[str]) -> Optional[dict]:
+    """query_doctor's verdict over the warm (serving-mix) phase's
+    aggregated ledger — where does the steady-state wall go. With
+    `expected` set (--assert-verdict) a mismatched verdict FAILS the
+    bench: the CI gate that keeps the serving mix kernel-dominated."""
+    from presto_tpu.tools.query_doctor import diagnose
+    led = (warm_stats or {}).get("ledger")
+    if not led:
+        if expected:
+            raise RuntimeError(
+                "--assert-verdict: warm phase produced no "
+                "attribution ledger to diagnose")
+        return None
+    d = diagnose(led)
+    if expected and d["verdict"] != expected:
+        raise RuntimeError(
+            f"--assert-verdict {expected}: warm serving-mix verdict "
+            f"is {d['verdict']} (shares: "
+            + json.dumps(d["shares_frac"]) + ")")
+    return d
+
+
 def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
                tolerant: bool = False, timeout_s: float = 600.0,
                coord=None) -> Tuple[dict, Dict[str, set]]:
@@ -215,6 +257,12 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
     known_ids = set(coord.queries) if coord is not None else set()
     names_by_sql = {sql: name
                     for work in assignments for name, sql in work}
+    # per-phase serde/compression attribution: raw (uncompressed
+    # payload) vs framed (LZ4/zlib codec frame) bytes per direction —
+    # the before-vs-after-compression evidence of the exchange plane
+    serde0 = {(s, k): METRICS.get("presto_tpu_serde_bytes_total",
+                                  stage=s, kind=k)
+              for s in ("encode", "decode") for k in ("raw", "framed")}
     compile0 = METRICS.total("presto_tpu_kernel_compile_ns_total")
     execute0 = METRICS.total("presto_tpu_kernel_execute_ns_total")
     fam0 = METRICS.by_label("presto_tpu_kernel_compiles_total",
@@ -258,6 +306,7 @@ def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
         # every query of the phase contributes)
         "fused_fragments": METRICS.delta_by_label(
             "presto_tpu_fused_fragments_total", "status", fuse0),
+        "serde_bytes": _serde_delta(METRICS, serde0),
     }
     if coord is not None:
         # wall-attribution ledger rollup of THIS phase's queries —
@@ -663,6 +712,7 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       churn_kills: int = 1,
                       churn_period_s: float = 3.0,
                       timeline_out: Optional[str] = None,
+                      assert_verdict: Optional[str] = None,
                       host: str = "127.0.0.1") -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
@@ -689,7 +739,8 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             history_phase=history_phase, worker_churn=worker_churn,
             churn_workers=churn_workers, churn_rounds=churn_rounds,
             churn_kills=churn_kills, churn_period_s=churn_period_s,
-            timeline_out=timeline_out, host=host)
+            timeline_out=timeline_out,
+            assert_verdict=assert_verdict, host=host)
     finally:
         if auto_cache_dir is not None:
             import shutil
@@ -710,6 +761,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    worker_churn: bool, churn_workers: int,
                    churn_rounds: int, churn_kills: int,
                    churn_period_s: float, timeline_out: Optional[str],
+                   assert_verdict: Optional[str],
                    host: str) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
@@ -739,6 +791,9 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                        for _ in range(clients)]
         warm, warm_checks = _run_phase(coord.url, warm_assign,
                                        coord=coord)
+        # serving-mix diagnosis (and the --assert-verdict CI gate)
+        # over the warm phase's aggregated attribution ledger
+        doctor = _doctor_verdict(warm, assert_verdict)
         # flight-recorder overhead A/B: ALTERNATING warm rounds with
         # recording on/off, medians compared (single adjacent rounds
         # on a loaded 1-core box are dominated by run-to-run noise —
@@ -1051,6 +1106,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "warm_rounds": warm_rounds,
         "cold": cold,
         "warm": warm,
+        "doctor": doctor,
         "flight_overhead": flight_doc,
         "caches_off": off,
         "restart_warm": restart,
@@ -1142,6 +1198,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="embed the per-query whole-fragment fusion "
                         "coverage (fused chains + fallback reasons, "
                         "tools/fusion_report.py) in the output JSON")
+    p.add_argument("--assert-verdict", default=None,
+                   choices=("queueing", "kernel", "exchange", "glue"),
+                   help="fail the bench unless query_doctor's verdict "
+                        "over the warm serving-mix ledger is this "
+                        "category (the CI gate that keeps serving "
+                        "kernel-dominated)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
     doc = run_serving_bench(
@@ -1161,7 +1223,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         churn_rounds=args.churn_rounds,
         churn_kills=args.churn_kills,
         churn_period_s=args.churn_period,
-        timeline_out=args.timeline_out)
+        timeline_out=args.timeline_out,
+        assert_verdict=args.assert_verdict)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

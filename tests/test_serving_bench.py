@@ -47,6 +47,45 @@ def test_serving_bench_smoke():
     fo = doc["flight_overhead"]
     assert fo["qps_flight_on"] > 0 and fo["qps_flight_off"] > 0
     assert fo["ring"]["total"] > 0
+    # serving-mix diagnosis rides the headline: verdict + shares over
+    # the warm phase's aggregated ledger (the --assert-verdict gate
+    # observes this same doc)
+    from presto_tpu.tools.query_doctor import VERDICT_GROUPS
+    doctor = doc["doctor"]
+    assert doctor and doctor["verdict"] in VERDICT_GROUPS
+    assert abs(sum(doctor["shares_frac"].values()) - 1.0) < 0.01
+    # per-phase serde/compression bytes: raw vs framed per direction
+    # (single-node short-circuits exchange, so zero traffic is legal
+    # here — the SHAPE must be present for every phase)
+    for phase in ("cold", "warm", "caches_off"):
+        sb = doc[phase]["serde_bytes"]
+        for stage in ("encode", "decode"):
+            assert set(sb[stage]) == {"raw_bytes", "framed_bytes",
+                                      "ratio"}, (phase, stage)
+            assert sb[stage]["raw_bytes"] >= 0
+
+
+def test_serving_bench_assert_verdict_gate():
+    """--assert-verdict mechanics on synthetic ledgers (pure, no
+    coordinator): matching category passes and returns the diagnosis;
+    a mismatch fails with the shares in the message; a ledger-less
+    warm phase fails only when an assertion was requested."""
+    import pytest as _pytest
+
+    from presto_tpu.tools.serving_bench import _doctor_verdict
+    kernel_led = {"wall_ms": 100.0, "unattributed_ms": 1.0,
+                  "categories_ms": {"compile": 40.0, "dispatch": 30.0,
+                                    "device_wait": 20.0,
+                                    "driver.step": 5.0}}
+    d = _doctor_verdict({"ledger": kernel_led}, "kernel")
+    assert d["verdict"] == "kernel"
+    with _pytest.raises(RuntimeError, match="warm serving-mix "
+                                            "verdict is kernel"):
+        _doctor_verdict({"ledger": kernel_led}, "exchange")
+    # no ledger: quiet without an assertion, fatal with one
+    assert _doctor_verdict({}, None) is None
+    with _pytest.raises(RuntimeError, match="no"):
+        _doctor_verdict({}, "kernel")
 
 
 def test_serving_bench_chaos_phase():
