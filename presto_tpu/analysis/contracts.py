@@ -50,6 +50,7 @@ CONTRACT_MODULES = (
     "presto_tpu.operators.exchange_ops",
     "presto_tpu.operators.array_agg",
     "presto_tpu.execution.dynamic_filters",
+    "presto_tpu.parallel.shuffle",
 )
 
 #: the default ladder sample: three points of the power-of-four

@@ -122,6 +122,10 @@ _ELEMENTWISE = frozenset({
 _STRUCTURAL = frozenset({
     "reshape", "squeeze", "expand_dims", "transpose", "rev", "slice",
     "dynamic_slice", "concatenate", "pad", "broadcast_in_dim", "tie_in",
+    # all_to_all moves whole lane blocks between shards without mixing
+    # values: PAD stays confined to the lanes that carried it (the
+    # sharded shuffle's collective — parallel/shuffle.py)
+    "all_to_all",
 })
 
 #: cross-lane escapes: a reduction over the lane axis pulls dead-lane
@@ -216,7 +220,11 @@ class _Interp:
             return self._cond(eqn, ins)
         if name in ("pjit", "closed_call", "core_call", "xla_call",
                     "custom_jvp_call", "custom_vjp_call", "remat",
-                    "remat2", "checkpoint", "custom_vjp_call_jaxpr"):
+                    "remat2", "checkpoint", "custom_vjp_call_jaxpr",
+                    # shard_map carries its body as the `jaxpr` param;
+                    # lane alignment holds per shard, so recursing is
+                    # exact (the sharded kernel families' KC001 path)
+                    "shard_map"):
             return self._call(eqn, ins, n_out)
         if name in IMPURE_PRIMITIVES:
             # purity is its own contract; taint-wise the result is

@@ -178,6 +178,17 @@ class MeshExchange:
         self._done = [False] * n_producers
         self._template: Optional[Batch] = None
         self._rr = 0
+        #: fused-fragment chain absorbed into the wave program
+        #: (planner/fusion.fuse_exchange_sinks; parallel/shuffle
+        #: WaveChain) — producers then push raw chain-INPUT batches
+        self._chain = None
+        #: per-exchange wave accounting (EXPLAIN ANALYZE + the mesh
+        #: bench's exchange bytes/row): live rows crossing the
+        #: all_to_all and their wire bytes (batch_row_bytes schema)
+        self.wave_count = 0
+        self.wave_rows = 0
+        self.wave_bytes = 0
+        self._row_bytes: Optional[int] = None
         self._remaps = build_remap_tables(hash_dicts, key_dictionaries)
         # host/disk spool accounting
         self._host_spool_bytes = host_spool_bytes
@@ -424,6 +435,37 @@ class MeshExchange:
                 and not self.queues[consumer]
                 and not any(self._pending))
 
+    # -- fused-fragment absorption -----------------------------------------
+
+    def chain_eligible(self) -> bool:
+        """True when the wave path can absorb a producer-side fragment
+        chain: a collective hash repartition with single-lifespan
+        routing (retry ladders bump lifespans, which replans the
+        fragment WITHOUT the fusion — the unfused path is the
+        fallback, never a wrong answer)."""
+        return (self.scheme == "repartition"
+                and bool(self.partition_keys)
+                and self.lifespans == 1
+                and self._collective)
+
+    def attach_chain(self, stages, chain_key, label: str) -> bool:
+        """Absorb a fused-fragment chain into the wave program so the
+        chain traces INSIDE the shard_map body (one jitted program per
+        shape bucket: chain + bucketize + all_to_all). Idempotent
+        across the W producer tasks planning the same fragment: the
+        first attach wins and later attaches must agree on the key."""
+        if not self.chain_eligible() or chain_key is None:
+            return False
+        from presto_tpu.parallel.shuffle import WaveChain
+        if self._chain is not None:
+            if self._chain.key != chain_key:
+                raise AssertionError(
+                    f"exchange {self.exchange_id}: conflicting fused "
+                    f"chains {self._chain.key!r} vs {chain_key!r}")
+            return True
+        self._chain = WaveChain(tuple(stages), chain_key, label)
+        return True
+
     # -- internals ---------------------------------------------------------
 
     @property
@@ -485,11 +527,38 @@ class MeshExchange:
             for i, p in enumerate(self._pending):
                 wave.append(p.popleft() if p
                             else self._pad_batch(cap, i))
-            outs = wave_repartition(self.mesh, wave,
-                                    self.partition_keys,
-                                    key_remaps=self._remaps)
+            outs, counts = self._run_wave(wave)
             for c, b in enumerate(outs):
                 self._route_lifespan(c, b)
+
+    def _run_wave(self, wave):
+        """One collective wave: the ICI all_to_all (plus any absorbed
+        fragment chain) under its own ledger category, with live-row /
+        wire-byte accounting. The collective belongs to the mesh as a
+        whole, so per-device attribution is cleared for its span."""
+        from presto_tpu.telemetry import ledger as _ledger
+        from presto_tpu.telemetry.metrics import METRICS
+        with _ledger.device_scope(None), \
+                _ledger.span("exchange.all_to_all"), \
+                _ledger.kernel_scope("exchange.all_to_all"):
+            outs, counts = wave_repartition(
+                self.mesh, wave, self.partition_keys,
+                key_remaps=self._remaps, chain=self._chain,
+                return_counts=True)
+        rows = int(np.asarray(counts).sum())
+        if self._row_bytes is None and outs:
+            from presto_tpu.parallel.shuffle import batch_row_bytes
+            self._row_bytes = batch_row_bytes(outs[0])
+        nbytes = rows * (self._row_bytes or 0)
+        self.wave_count += 1
+        self.wave_rows += rows
+        self.wave_bytes += nbytes
+        METRICS.inc("presto_tpu_exchange_all_to_all_waves_total")
+        METRICS.inc("presto_tpu_exchange_all_to_all_rows_total",
+                    value=rows)
+        METRICS.inc("presto_tpu_exchange_all_to_all_bytes_total",
+                    value=nbytes)
+        return outs, counts
 
 
 def _host_pad_quantized(batch: Batch) -> Batch:

@@ -254,6 +254,11 @@ class FusedChainOperatorFactory(OperatorFactory):
     def __init__(self, operator_id: int, name: str,
                  stages: Sequence[ChainStage], chain_key):
         super().__init__(operator_id, name)
+        # retained for the exchange-sink rewrite (planner/fusion
+        # fuse_exchange_sinks absorbs the chain into a repartition
+        # exchange's shard_map wave program)
+        self.stages = tuple(stages)
+        self.chain_key = chain_key
         body = make_chain_body(stages)
         self._kernel = _cached_fragment_kernel(
             ("chain", chain_key) if chain_key is not None else None,

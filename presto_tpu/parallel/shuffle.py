@@ -24,6 +24,8 @@ serde + token-acked HTTP long-poll collapses into one XLA collective.
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -211,26 +213,131 @@ def broadcast_batch(batch: Batch, mesh: Mesh,
 # up sized to its live rows, not to W * producer capacity).
 
 
+def _wave_body(n_parts: int, axis: str, row_valid, key_datas,
+               key_masks, datas, masks):
+    """The per-chip wave pipeline: shuffle core, then pack live rows
+    to the front (per-shard compaction) and count them — shared by
+    the plain and the chained (fused-fragment) wave programs and by
+    their KernelContract trace points."""
+    r_datas, r_masks, valid = _shuffle_core(
+        n_parts, axis, row_valid, key_datas, key_masks, datas, masks)
+    order = jnp.argsort(~valid, stable=True)
+    out_datas = tuple(f[order] for f in r_datas)
+    out_masks = tuple(f[order] for f in r_masks)
+    out_valid = valid[order]
+    count = jnp.sum(valid).reshape(1)
+    return out_datas, out_masks, out_valid, count
+
+
 @functools.lru_cache(maxsize=256)
 def _wave_program(mesh: Mesh, axis: str, w: int, n_keys: int,
                   n_cols: int):
     spec = P(axis)
-
-    def body(row_valid, key_datas, key_masks, datas, masks):
-        r_datas, r_masks, valid = _shuffle_core(
-            w, axis, row_valid, key_datas, key_masks, datas, masks)
-        # pack live rows to the front (per-shard compaction)
-        order = jnp.argsort(~valid, stable=True)
-        out_datas = tuple(f[order] for f in r_datas)
-        out_masks = tuple(f[order] for f in r_masks)
-        out_valid = valid[order]
-        count = jnp.sum(valid).reshape(1)
-        return out_datas, out_masks, out_valid, count
-
-    return jax.jit(_shard_map(
+    body = functools.partial(_wave_body, w, axis)
+    from presto_tpu.telemetry.kernels import instrument_kernel
+    # the lru entry holds the instrumented wrapper, so the warm jit
+    # cache (and with it the zero-new-kernels guarantee for the second
+    # same-bucket wave) travels with the cache hit
+    return instrument_kernel(jax.jit(_shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 5,
-        out_specs=(spec, spec, spec, spec)))
+        out_specs=(spec, spec, spec, spec))), "spmd_shuffle")
+
+
+# -- chained wave: a fused-fragment chain traced INSIDE the wave -------
+#
+# planner/fusion.fuse_exchange_sinks absorbs a distributed fragment's
+# tail chain (filter/project run) into its repartition exchange: the
+# chain then traces inside the shard_map body, per shard, IN THE SAME
+# program as the hash + all_to_all — one dispatch per wave instead of
+# one per chain stage per producer, no per-batch deferred-compact host
+# round (the shuffle's bucketize drops dead lanes before the wire), and
+# the output sharding is the consumer's input spec by construction.
+
+
+@dataclasses.dataclass(frozen=True)
+class WaveChain:
+    """The absorbed chain: `stages` are operators/fused_fragment
+    ChainStages, `key` their chain_fingerprint (hashable, never None —
+    the planner declines uncacheable chains), `label` the EXPLAIN
+    constituent label (fused[...+all_to_all])."""
+    stages: tuple
+    key: object
+    label: str
+
+
+_CHAINED_PROGRAMS: "collections.OrderedDict" = collections.OrderedDict()
+_CHAINED_PROGRAMS_MAX = 128
+
+
+def _chained_wave_program(mesh: Mesh, axis: str, w: int,
+                          chain: WaveChain, template: Batch,
+                          key_names: Tuple[str, ...],
+                          remap_flags: Tuple[bool, ...]):
+    """(instrumented jit, output column meta) for one chained wave
+    shape family. Cached like _wave_program; the key adds the chain
+    fingerprint + input schema so two plans sharing a chain share the
+    compiled program (and its warm retrace state)."""
+    in_sig = tuple((n, str(np.dtype(c.data.dtype)))
+                   for n, c in template.columns.items())
+    cache_key = (mesh, axis, w, chain.key, key_names, remap_flags,
+                 in_sig)
+    cached = _CHAINED_PROGRAMS.get(cache_key)
+    if cached is not None:
+        _CHAINED_PROGRAMS.move_to_end(cache_key)
+        return cached
+
+    from presto_tpu.operators.fused_fragment import make_chain_body
+    chain_fn = make_chain_body(chain.stages)
+    in_meta = tuple((n, c.type, c.dictionary)
+                    for n, c in template.columns.items())
+    # output schema by abstract evaluation — names/types/dictionaries
+    # only, nothing executes (Batch aux data rides jax.eval_shape)
+    out_t = jax.eval_shape(chain_fn, template)
+    out_meta = tuple((n, c.type, c.dictionary)
+                     for n, c in out_t.columns.items())
+    out_names = tuple(n for n, _, _ in out_meta)
+
+    def body(row_valid, datas, masks, remap_tables):
+        cols = {n: Column(d, m, t, dic)
+                for (n, t, dic), d, m in zip(in_meta, datas, masks)}
+        out = chain_fn(Batch(cols, row_valid))
+        key_datas, key_masks, ri = [], [], 0
+        for i, k in enumerate(key_names):
+            c = out.columns[k]
+            d = c.data
+            if remap_flags[i]:
+                # routing only: the hash sees unified-dictionary
+                # codes, the payload keeps the producer's codes —
+                # exactly the eager-remap semantics of the plain wave
+                d = remap_tables[ri][d]
+                ri += 1
+            key_datas.append(d)
+            key_masks.append(c.mask)
+        o_datas = tuple(out.columns[n].data for n in out_names)
+        o_masks = tuple(out.columns[n].mask for n in out_names)
+        return _wave_body(w, axis, out.row_valid, tuple(key_datas),
+                          tuple(key_masks), o_datas, o_masks)
+
+    spec = P(axis)
+    from presto_tpu.telemetry.kernels import instrument_kernel
+    fn = instrument_kernel(jax.jit(_shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec, P()),
+        out_specs=(spec, spec, spec, spec))), "spmd_fragment")
+    entry = (fn, out_meta)
+    _CHAINED_PROGRAMS[cache_key] = entry
+    while len(_CHAINED_PROGRAMS) > _CHAINED_PROGRAMS_MAX:
+        _CHAINED_PROGRAMS.popitem(last=False)
+    return entry
+
+
+def batch_row_bytes(batch: Batch) -> int:
+    """Wire bytes per row of a wave for this schema: column payloads
+    + one mask byte per column + the row_valid byte (the exchange's
+    bytes/row accounting; docs/SHARDING.md)."""
+    return sum(np.dtype(c.data.dtype).itemsize + 1
+               for c in batch.columns.values()) + 1
 
 
 def _as_global(arrays, mesh: Mesh, axis: str, cap: int):
@@ -249,16 +356,27 @@ def _as_global(arrays, mesh: Mesh, axis: str, cap: int):
 
 
 def wave_repartition(mesh: Mesh, batches, key_names,
-                     key_remaps=None, axis: str = worker_axis):
+                     key_remaps=None, axis: str = worker_axis,
+                     chain: Optional[WaveChain] = None,
+                     return_counts: bool = False):
     """Hash-repartition one wave (one Batch per worker) over ICI.
 
     `key_remaps[i]`, when set, is an int32 device array re-encoding that
     string key's dictionary codes onto the unified hash dictionary so
     equal strings hash equally on every producer.
 
+    `chain`, when set, is the fused-fragment chain the planner absorbed
+    into this exchange (fuse_exchange_sinks): it traces inside the
+    shard_map body ahead of the hash, per shard, and the partition keys
+    are read from the CHAIN OUTPUT (key remaps ride the trace as
+    replicated operands). The producers then push raw chain INPUT
+    batches and the whole tail runs as one SPMD program per wave.
+
     Returns the list of per-consumer Batches (consumer i's batch lives
     on mesh device i), each compacted and sliced to the capacity bucket
-    of its live rows.
+    of its live rows — with `return_counts`, `(batches, counts)` where
+    `counts[i]` is consumer i's received live rows (the exchange's
+    rows/bytes accounting reads it off the wave's one host sync).
     """
     w = len(batches)
     assert w == mesh.shape[axis]
@@ -271,19 +389,6 @@ def wave_repartition(mesh: Mesh, batches, key_names,
     names = batches[0].names
     tmpl = batches[0]
 
-    key_datas, key_masks = [], []
-    for i, k in enumerate(key_names):
-        datas, masks = [], []
-        for b in batches:
-            c = b.columns[k]
-            d = c.data
-            if key_remaps is not None and key_remaps[i] is not None:
-                d = key_remaps[i][d]
-            datas.append(d)
-            masks.append(c.mask)
-        key_datas.append(_as_global(datas, mesh, axis, cap))
-        key_masks.append(_as_global(masks, mesh, axis, cap))
-
     g_datas = tuple(
         _as_global([b.columns[n].data for b in batches], mesh, axis,
                    cap) for n in names)
@@ -293,23 +398,50 @@ def wave_repartition(mesh: Mesh, batches, key_names,
     g_valid = _as_global([b.row_valid for b in batches], mesh, axis,
                          cap)
 
-    fn = _wave_program(mesh, axis, w, len(key_names), len(names))
-    out_datas, out_masks, out_valid, counts = fn(
-        g_valid, tuple(key_datas), tuple(key_masks), g_datas, g_masks)
+    if chain is not None:
+        remap_flags = tuple(
+            key_remaps is not None and key_remaps[i] is not None
+            for i in range(len(key_names)))
+        fn, out_meta = _chained_wave_program(
+            mesh, axis, w, chain, tmpl, tuple(key_names), remap_flags)
+        tables = tuple(key_remaps[i]
+                       for i, f in enumerate(remap_flags) if f)
+        out_datas, out_masks, out_valid, counts = fn(
+            g_valid, g_datas, g_masks, tables)
+    else:
+        key_datas, key_masks = [], []
+        for i, k in enumerate(key_names):
+            datas, masks = [], []
+            for b in batches:
+                c = b.columns[k]
+                d = c.data
+                if key_remaps is not None \
+                        and key_remaps[i] is not None:
+                    d = key_remaps[i][d]
+                datas.append(d)
+                masks.append(c.mask)
+            key_datas.append(_as_global(datas, mesh, axis, cap))
+            key_masks.append(_as_global(masks, mesh, axis, cap))
+        fn = _wave_program(mesh, axis, w, len(key_names), len(names))
+        out_datas, out_masks, out_valid, counts = fn(
+            g_valid, tuple(key_datas), tuple(key_masks), g_datas,
+            g_masks)
+        out_meta = tuple((n, tmpl.columns[n].type,
+                          tmpl.columns[n].dictionary) for n in names)
 
-    from presto_tpu.batch import quantized_capacity
     counts = np.asarray(counts)  # ONE host sync per wave
     out = []
     for c in range(w):
         shard_len = _shard(out_valid, c).shape[0]
         cap2 = min(quantized_capacity(int(counts[c])), shard_len)
         cols = {}
-        for n, gd, gm in zip(names, out_datas, out_masks):
-            col = tmpl.columns[n]
+        for (n, typ, dic), gd, gm in zip(out_meta, out_datas,
+                                         out_masks):
             cols[n] = Column(_shard(gd, c)[:cap2],
-                             _shard(gm, c)[:cap2],
-                             col.type, col.dictionary)
+                             _shard(gm, c)[:cap2], typ, dic)
         out.append(Batch(cols, _shard(out_valid, c)[:cap2]))
+    if return_counts:
+        return out, counts
     return out
 
 
@@ -318,3 +450,113 @@ def _shard(garr, index: int):
     shards = sorted(garr.addressable_shards,
                     key=lambda s: s.index[0].start or 0)
     return shards[index].data
+
+
+# -- kernel contracts (tools/kernelcheck.py) ---------------------------
+#
+# The sharded families: KC001/KC002 hold THROUGH shard_map — the taint
+# walk recurses into the shard_map jaxpr (analysis/taint.py) and
+# all_to_all is lane-moving structural, so the same pad-invariance
+# proof covers the collective. Contract meshes use a power-of-two
+# width up to 8 so the ladder buckets (4096/16384/65536) always divide
+# evenly; tier-1 traces at the test suite's full 8-virtual-device
+# width, a bare CLI without the XLA flag degrades to w=1 (all_to_all
+# over a singleton axis — still the same program structure).
+from presto_tpu.analysis.contracts import (
+    KernelContract, TracePoint, abstract_batch, register_contract,
+)
+
+
+def _contract_mesh() -> Mesh:
+    from presto_tpu.parallel.mesh import make_mesh
+    n = len(jax.devices())
+    w = 1
+    while w * 2 <= min(8, n):
+        w *= 2
+    return make_mesh(w)
+
+
+def _spmd_shuffle_point(cap, variant):
+    from presto_tpu.types import BIGINT, DOUBLE
+    mesh = _contract_mesh()
+    w = int(mesh.shape[worker_axis])
+    spec = P(worker_axis)
+
+    def fn(batch):
+        names = list(batch.columns)
+        datas = tuple(batch.columns[n].data for n in names)
+        masks = tuple(batch.columns[n].mask for n in names)
+        body = functools.partial(_wave_body, w, worker_axis)
+        sm = _shard_map(body, mesh=mesh, in_specs=(spec,) * 5,
+                        out_specs=(spec,) * 4)
+        out_datas, out_masks, out_valid, count = sm(
+            batch.row_valid, (datas[0],), (masks[0],), datas, masks)
+        cols = {n: Column(d, m, batch.columns[n].type,
+                          batch.columns[n].dictionary)
+                for n, d, m in zip(names, out_datas, out_masks)}
+        return Batch(cols, out_valid), count
+
+    b, rb = abstract_batch(cap, [("k", BIGINT), ("v", DOUBLE)])
+    return TracePoint(fn, (b,), (rb,))
+
+
+register_contract(KernelContract(
+    family="spmd_shuffle", module=__name__,
+    build=_spmd_shuffle_point,
+    notes="the wave program (_wave_program): hash -> bucketize -> "
+          "all_to_all -> pack + count, per shard"))
+
+
+def _spmd_fragment_point(cap, variant):
+    from presto_tpu.expr import ir
+    from presto_tpu.expr.compile import compile_expression
+    from presto_tpu.operators.fused_fragment import (
+        ChainStage, make_chain_body,
+    )
+    from presto_tpu.schema import ColumnSchema
+    from presto_tpu.types import BIGINT, BOOLEAN, DOUBLE
+    schema = {"x": ColumnSchema("x", BIGINT),
+              "y": ColumnSchema("y", DOUBLE)}
+    filt = compile_expression(
+        ir.call("less_than", BOOLEAN, ir.ref("y", DOUBLE),
+                ir.lit(0.5, DOUBLE)), schema)
+    stages = (ChainStage(
+        filt, (("x", compile_expression(ir.ref("x", BIGINT), schema)),
+               ("y", compile_expression(ir.ref("y", DOUBLE), schema))),
+        None),)
+    chain_fn = make_chain_body(stages)
+    mesh = _contract_mesh()
+    w = int(mesh.shape[worker_axis])
+    spec = P(worker_axis)
+
+    def fn(batch):
+        names = list(batch.columns)
+
+        def body(rv, datas, masks):
+            cols = {n: Column(d, m, batch.columns[n].type,
+                              batch.columns[n].dictionary)
+                    for n, d, m in zip(names, datas, masks)}
+            out = chain_fn(Batch(cols, rv))
+            kd = (out.columns["x"].data,)
+            km = (out.columns["x"].mask,)
+            o_datas = tuple(c.data for c in out.columns.values())
+            o_masks = tuple(c.mask for c in out.columns.values())
+            return _wave_body(w, worker_axis, out.row_valid, kd, km,
+                              o_datas, o_masks)
+
+        sm = _shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                        out_specs=(spec,) * 4)
+        datas = tuple(batch.columns[n].data for n in names)
+        masks = tuple(batch.columns[n].mask for n in names)
+        return sm(batch.row_valid, datas, masks)
+
+    b, rb = abstract_batch(cap, [("x", BIGINT), ("y", DOUBLE)])
+    return TracePoint(fn, (b,), (rb,))
+
+
+register_contract(KernelContract(
+    family="spmd_fragment", module=__name__,
+    build=_spmd_fragment_point,
+    notes="the chained wave (_chained_wave_program): a fused-fragment "
+          "chain traced inside the shard_map body ahead of the "
+          "shuffle (planner/fusion.fuse_exchange_sinks)"))

@@ -30,6 +30,7 @@ silent coverage loss is the failure mode this report exists to catch
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Sequence
 
 from presto_tpu.operators import fused_fragment as ff
@@ -56,6 +57,27 @@ R_SELECTIVE = "selective_chain"
 #: (history-provenance) selectivity let a gated chain fold FULLY into
 #: its terminal with an in-trace compaction sized by the measurement
 R_HISTORY_COMPACT = "history_compact"
+
+#: thread-local fusion gate: a mesh phase plans fragments on worker
+#: threads where the DRIVING session's properties are not reachable
+#: through any ambient state — the runner installs the session's
+#: fragment_fusion_enabled here around each statement so every
+#: planner thread agrees with the session that issued the query
+#: (None = not installed; the planner falls back to its own session)
+_GATE = threading.local()
+
+
+def set_fusion_gate(enabled: Optional[bool]) -> Optional[bool]:
+    """Install (or clear, with None) the thread-local fusion gate;
+    returns the previous value so callers can restore it."""
+    prev = getattr(_GATE, "enabled", None)
+    _GATE.enabled = enabled
+    return prev
+
+
+def fusion_gate() -> Optional[bool]:
+    return getattr(_GATE, "enabled", None)
+
 
 #: fold-terminal gate: when the chain's estimated surviving-row
 #: fraction drops below a quarter, live rows fall at least one
@@ -397,3 +419,87 @@ def _apply(pipe: List, cand: _Candidate, terminal, end: int,
            R_NO_TERMINAL if terminal is None
            else f"barrier:{tname}")
     return end
+
+
+def fuse_exchange_sinks(pipelines: List[List], report: Dict,
+                        node_ops=None) -> int:
+    """Second fusion pass, after fuse_pipelines: absorb a producer
+    pipeline's tail chain into its collective exchange so the chain
+    traces INSIDE the shard_map wave program (chain + bucketize +
+    all_to_all = one jitted XLA program per shape bucket; see
+    parallel/shuffle._chained_wave_program and docs/SHARDING.md).
+
+    Eligible tails look like `[..., <chain factory>, exchange_sink]`
+    where the sink is unstaged and feeds exactly one chain-eligible
+    MeshExchange (collective hash repartition, single lifespan). The
+    chain factory is either the FusedChainOperatorFactory the first
+    pass left behind a `barrier:exchange_sink`, or a lone
+    FilterProject. Selective chains are a WIN here, not a gate: the
+    in-trace bucketizer routes dead lanes to the dropped bucket, so
+    filtered-out rows never cross the wire.
+
+    Mutates pipelines/report/node_ops in place; returns the number of
+    chains absorbed. Attach is idempotent across the W producer tasks
+    planning the same fragment."""
+    from presto_tpu.operators.exchange_ops import (
+        ExchangeSinkOperatorFactory,
+    )
+    from presto_tpu.telemetry.metrics import METRICS
+    id_remap = report.setdefault("id_remap", {})
+    absorbed = 0
+    for pi, pipe in enumerate(pipelines):
+        if len(pipe) < 2:
+            continue
+        sink = pipe[-1]
+        if not isinstance(sink, ExchangeSinkOperatorFactory) \
+                or sink.staged or len(sink.exchanges) != 1:
+            continue
+        ex = sink.exchanges[0]
+        if not getattr(ex, "chain_eligible", None) \
+                or not ex.chain_eligible():
+            continue
+        f = pipe[-2]
+        if isinstance(f, ff.FusedChainOperatorFactory):
+            stages, chain_key = f.stages, f.chain_key
+        elif isinstance(f, FilterProjectOperatorFactory):
+            stages = ff.stages_from_factory(f)
+            chain_key = ff.chain_fingerprint(stages) \
+                if stages is not None else None
+        else:
+            continue
+        if stages is None or chain_key is None:
+            continue
+        inner = f.name[len("fused["):-1] \
+            if f.name.startswith("fused[") else f.name
+        label = f"fused[{inner}+all_to_all]"
+        if not ex.attach_chain(stages, chain_key, label):
+            continue
+        del pipe[-2]
+        id_remap[f.operator_id] = sink.operator_id
+        # EXPLAIN ANALYZE shows the absorbed chain on the sink line
+        sink.name = label
+        report.setdefault("fragments", []).append({
+            "pipeline": pi,
+            "source": pipe[0].name if pipe else "?",
+            "chain": [inner],
+            "terminal": "all_to_all",
+            "fused": label,
+            "reason": None,
+            "selectivity": 1.0,
+            "sel_provenance": "static",
+        })
+        report["fused"] = report.get("fused", 0) + 1
+        METRICS.inc("presto_tpu_fused_fragments_total",
+                    status="fused", reason="")
+        absorbed += 1
+    if node_ops is not None and absorbed:
+        for nid, ids in node_ops.items():
+            seen = set()
+            out = []
+            for op_id in ids:
+                mapped = id_remap.get(op_id, op_id)
+                if mapped not in seen:
+                    seen.add(mapped)
+                    out.append(mapped)
+            node_ops[nid] = out
+    return absorbed

@@ -241,6 +241,14 @@ class LocalExecutionPlanner:
             staged=staged_output))
         self._pipelines.append(pipeline)
         self._fuse()
+        if self.fusion_report is not None:
+            # second pass: absorb tail chains into their collective
+            # exchange so they trace inside the shard_map wave
+            # program (docs/SHARDING.md); ineligible exchanges keep
+            # the barrier:exchange_sink fallback from the first pass
+            from presto_tpu.planner.fusion import fuse_exchange_sinks
+            fuse_exchange_sinks(self._pipelines, self.fusion_report,
+                                self.node_ops)
         return self._pipelines
 
     def _fuse(self) -> None:
@@ -255,8 +263,16 @@ class LocalExecutionPlanner:
         # absorbed nodes onto their terminal's operator
         self.node_ops_prefusion = {k: list(v)
                                    for k, v in self.node_ops.items()}
-        if not bool(get_property(self.session.properties,
-                                 "fragment_fusion_enabled")):
+        # a mesh phase plans on worker threads where THIS planner's
+        # session object is a fragment-local reconstruction — the
+        # runner installs the driving session's gate thread-locally
+        # around each statement, and it wins over the property here
+        from presto_tpu.planner.fusion import fusion_gate
+        gate = fusion_gate()
+        enabled = gate if gate is not None else bool(
+            get_property(self.session.properties,
+                         "fragment_fusion_enabled"))
+        if not enabled:
             return
         from presto_tpu.planner.fusion import fuse_pipelines
         # a join build can only spill (handing the probe a host-

@@ -83,9 +83,13 @@ class MeshRunner(LocalRunner):
         # query-local OOM escalation state: (operator, lifespans at the
         # failure, bytes it asked for) of the previous OOM
         prev_oom = None
+        from presto_tpu.telemetry.metrics import METRICS
         while True:
             try:
-                return self._run_fragments(fplan, session, profile)
+                out = self._run_fragments(fplan, session, profile)
+                METRICS.inc("presto_tpu_mesh_queries_total",
+                            status="ok")
+                return out
             except GroupLimitExceeded as e:
                 if e.suggested > 1 << 26:
                     raise QueryError(
@@ -93,6 +97,8 @@ class MeshRunner(LocalRunner):
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "max_groups": e.suggested})
+                METRICS.inc("presto_tpu_mesh_retries_total",
+                            kind="max_groups")
                 if on_retry is not None:
                     on_retry()
             except JoinCapacityExceeded as e:
@@ -103,6 +109,8 @@ class MeshRunner(LocalRunner):
                     session, properties={
                         **session.properties,
                         "join_expansion_factor": e.suggested})
+                METRICS.inc("presto_tpu_mesh_retries_total",
+                            kind="join_expansion")
                 if on_retry is not None:
                     on_retry()
             except FusedChainCompactOverflow:
@@ -113,6 +121,8 @@ class MeshRunner(LocalRunner):
                     session, properties={
                         **session.properties,
                         "history_driven_fusion": False})
+                METRICS.inc("presto_tpu_mesh_retries_total",
+                            kind="history_fusion")
                 if on_retry is not None:
                     on_retry()
             except MemoryLimitExceeded as e:
@@ -152,6 +162,8 @@ class MeshRunner(LocalRunner):
                 session = dataclasses.replace(
                     session, properties={**session.properties,
                                          "lifespans": new})
+                METRICS.inc("presto_tpu_mesh_retries_total",
+                            kind="lifespans")
                 if on_retry is not None:
                     on_retry()
 
@@ -195,13 +207,21 @@ class MeshRunner(LocalRunner):
         # session actually driving this attempt, like
         # node.execute_fragment and the coordinator root drive do
         from presto_tpu import batch as _batch
+        from presto_tpu.planner import fusion as _fusion
         prev_sb = _batch.set_shape_buckets(
             bool(get_property(session.properties,
                               "kernel_shape_buckets")))
+        # same deal for the fragment-fusion gate: fragment planning
+        # happens per-task below with session objects the retry
+        # ladder may have rebuilt — the statement's session decides
+        prev_fg = _fusion.set_fusion_gate(
+            bool(get_property(session.properties,
+                              "fragment_fusion_enabled")))
         try:
             return self._run_fragments_inner(fplan, session, profile)
         finally:
             _batch.set_shape_buckets(prev_sb)
+            _fusion.set_fusion_gate(prev_fg)
 
     def _run_fragments_inner(self, fplan: FragmentedPlan, session,
                              profile: bool = False
@@ -300,8 +320,13 @@ class MeshRunner(LocalRunner):
                         fragment.root, sink_edges,
                         staged_output=recover
                         and lifespans_of[fid] > 1)
-                created.extend(Driver([f.create(dctx) for f in pipe])
-                               for pipe in pipelines)
+                for pipe in pipelines:
+                    d = Driver([f.create(dctx) for f in pipe])
+                    # per-device wall attribution (ledger.device_scope
+                    # in the phased drive): which mesh slot this
+                    # driver's quanta bill against
+                    d._mesh_device = t if n_tasks > 1 else None
+                    created.append(d)
             return created
 
         # phased execution (reference: PhasedExecutionSchedule):
@@ -473,6 +498,7 @@ class MeshRunner(LocalRunner):
             return True
 
         from presto_tpu.runner.local import check_lifecycle
+        from presto_tpu.telemetry import ledger as _ledger
         rounds = 0
         while True:
             # the same lifecycle checkpoints as the local drive loop:
@@ -493,7 +519,10 @@ class MeshRunner(LocalRunner):
                 # must land within one driver hand-off, not one round
                 check_lifecycle(cancel, deadline)
                 try:
-                    progress = d.process() or progress
+                    with _ledger.device_scope(
+                            getattr(d, "_mesh_device", None)):
+                        with _ledger.span("driver.step"):
+                            progress = d.process() or progress
                 except RetryableTaskError:
                     if not recover_generation(d):
                         raise

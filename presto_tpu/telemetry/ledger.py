@@ -59,6 +59,13 @@ Category taxonomy (docs/OBSERVABILITY.md):
     serde         batch <-> bytes encode/decode for the exchange wire
     exchange      exchange transport (HTTP push wall, net of serde
                   and backoff nested inside it)
+    exchange.all_to_all
+                  mesh shuffle waves: assembling the sharded global
+                  arrays, dispatching the shard_map all_to_all
+                  program, and the one per-wave host sync on the
+                  received-row counts (parallel/shuffle.py — the ICI
+                  tier of the exchange, kept apart from the DCN
+                  `exchange` HTTP wall; docs/SHARDING.md)
     spool         spool I/O: task-output spool put/read-back, lifespan
                   spool disk pages
     retry_backoff transport-retry backoff sleeps
@@ -96,9 +103,9 @@ from presto_tpu import sanitize
 #: the full category set, in rendering order
 CATEGORIES: Tuple[str, ...] = (
     "queued", "planning", "scan", "h2d", "compile", "dispatch",
-    "device_wait", "d2h", "serde", "exchange", "spool",
-    "retry_backoff", "prefetch", "driver.step", "driver.reassembly",
-    "driver.quantum",
+    "device_wait", "d2h", "serde", "exchange", "exchange.all_to_all",
+    "spool", "retry_backoff", "prefetch", "driver.step",
+    "driver.reassembly", "driver.quantum",
 )
 
 #: the drive-loop sub-categories (docs/OBSERVABILITY.md): their sum is
@@ -114,18 +121,26 @@ class QueryLedger:
     """Per-query category accumulator (ns). Thread-safe: executor
     worker threads and the submitting thread charge concurrently."""
 
-    __slots__ = ("_lock", "ns", "finished")
+    __slots__ = ("_lock", "ns", "device_ns", "finished")
 
     def __init__(self):
         self._lock = sanitize.lock("telemetry.ledger")
         self.ns: Dict[str, int] = {c: 0 for c in CATEGORIES}
+        #: device index -> {category -> ns}: the shard-aware second
+        #: axis (mesh drives wrap each task's quantum in device_scope,
+        #: so kernel/driver charges land on the device doing the work)
+        self.device_ns: Dict[int, Dict[str, int]] = {}
         self.finished: Optional[Dict[str, Any]] = None
 
-    def charge(self, category: str, dur_ns: int) -> None:
+    def charge(self, category: str, dur_ns: int,
+               device: Optional[int] = None) -> None:
         if dur_ns <= 0:
             return
         with self._lock:
             self.ns[category] = self.ns.get(category, 0) + dur_ns
+            if device is not None:
+                per = self.device_ns.setdefault(device, {})
+                per[category] = per.get(category, 0) + dur_ns
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
@@ -151,12 +166,17 @@ class QueryLedger:
         keeping the invariant true instead of serving a negative
         residual."""
         snap = self.snapshot()
+        with self._lock:
+            dev_snap = {d: dict(per)
+                        for d, per in self.device_ns.items()}
         attributed = sum(snap.values())
         scale = None
         if attributed > wall_ns > 0:
             scale = wall_ns / attributed
             snap = {c: int(v * scale) for c, v in snap.items()}
             attributed = sum(snap.values())
+            dev_snap = {d: {c: int(v * scale) for c, v in per.items()}
+                        for d, per in dev_snap.items()}
         unattributed = wall_ns - attributed
         # every charged category travels, listed or not: an ad-hoc key
         # (a legacy `driver` charge, a future category) counted toward
@@ -174,6 +194,16 @@ class QueryLedger:
         }
         if scale is not None:
             doc["parallel_scale"] = round(scale, 4)
+        if dev_snap:
+            # the shard-aware breakdown: same categories, one column
+            # per mesh device that charged anything (normalized by the
+            # same parallel_scale, so per-device proportions stay
+            # comparable to the wall-true top-level figures)
+            doc["per_device"] = {
+                str(d): {
+                    c: round(per.get(c, 0) / 1e6, 3)
+                    for c in order if per.get(c, 0) > 0}
+                for d, per in sorted(dev_snap.items())}
         self.finished = doc
         return doc
 
@@ -235,9 +265,27 @@ def span(category: str):
     finally:
         stack.pop()
         dur = time.perf_counter_ns() - frame[1]
-        led.charge(category, max(0, dur - frame[2]))
+        led.charge(category, max(0, dur - frame[2]),
+                   device=getattr(_TL, "device", None))
         if stack:
             stack[-1][2] += dur
+
+
+@contextlib.contextmanager
+def device_scope(device: Optional[int]):
+    """Attribute charges made on this thread inside the scope to mesh
+    device `device` (the ledger's second axis — see
+    QueryLedger.device_ns). The mesh drive loop wraps each task's
+    driver quantum so kernel dispatch/compile and driver self-time
+    land on the device doing the work; `None` runs the scope
+    unattributed (single-task fragments, collective waves that belong
+    to the whole mesh)."""
+    prev = getattr(_TL, "device", None)
+    _TL.device = device
+    try:
+        yield
+    finally:
+        _TL.device = prev
 
 
 def add(category: str, dur_ns: int) -> None:
@@ -248,7 +296,7 @@ def add(category: str, dur_ns: int) -> None:
     led = getattr(_TL, "ledger", None)
     if led is None:
         return
-    led.charge(category, dur_ns)
+    led.charge(category, dur_ns, device=getattr(_TL, "device", None))
     stack = _TL.stack
     if stack:
         stack[-1][2] += dur_ns
@@ -268,9 +316,31 @@ def absorb(dur_ns: int) -> None:
         stack[-1][2] += dur_ns
 
 
+@contextlib.contextmanager
+def kernel_scope(category: str):
+    """Attribute warm kernel DISPATCH wall inside the scope to
+    `category` instead of the generic \"dispatch\" bucket — the
+    exchange wave uses this so the collective all_to_all program's
+    steady-state wall is visible as its own line rather than blending
+    into every other kernel's dispatch. Compile wall stays under
+    \"compile\": one-time tracing cost is not the collective's
+    steady-state."""
+    prev = getattr(_TL, "kernel_category", None)
+    _TL.kernel_category = category
+    try:
+        yield
+    finally:
+        _TL.kernel_category = prev
+
+
 def add_kernel(dur_ns: int, compiled: bool) -> None:
     """The telemetry.kernels hook: a compiling call is COMPILE wall, a
     warm call is host DISPATCH wall (async — device-side completion is
     measured separately as device_wait at drain points; see the
-    async-dispatch undercount note in docs/OBSERVABILITY.md)."""
-    add("compile" if compiled else "dispatch", dur_ns)
+    async-dispatch undercount note in docs/OBSERVABILITY.md). Warm
+    dispatch honors any enclosing `kernel_scope` redirect."""
+    if compiled:
+        add("compile", dur_ns)
+    else:
+        add(getattr(_TL, "kernel_category", None) or "dispatch",
+            dur_ns)

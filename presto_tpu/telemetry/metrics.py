@@ -273,6 +273,26 @@ METRICS.describe("presto_tpu_pump_drivers_total",
 METRICS.describe("presto_tpu_pump_splits_total",
                  "Source splits driven through the batch pump "
                  "(one prefetch + one fused dispatch each)")
+METRICS.describe("presto_tpu_exchange_all_to_all_waves_total",
+                 "Collective exchange waves: one fused bucketize + "
+                 "jax.lax.all_to_all dispatch across the whole mesh "
+                 "(parallel/shuffle.wave_repartition; "
+                 "docs/SHARDING.md)")
+METRICS.describe("presto_tpu_exchange_all_to_all_rows_total",
+                 "Live rows delivered by collective exchange waves "
+                 "(dead lanes are routed to the dropped bucket "
+                 "in-trace and never cross the interconnect)")
+METRICS.describe("presto_tpu_exchange_all_to_all_bytes_total",
+                 "Estimated wire bytes of collective exchange waves: "
+                 "live rows x packed row width (data + validity "
+                 "bytes) of the post-wave schema")
+METRICS.describe("presto_tpu_mesh_queries_total",
+                 "Queries completed by the mesh (distributed) "
+                 "runner, by status")
+METRICS.describe("presto_tpu_mesh_retries_total",
+                 "Mesh query re-executions by escalation kind "
+                 "(max_groups/join_expansion/history_fusion/"
+                 "lifespans — runner/mesh.py retry ladder)")
 METRICS.describe("presto_tpu_ledger_unattributed_ns_total",
                  "Wall ns the attribution ledger could NOT assign to "
                  "a category (the coverage residual; the histogram "

@@ -125,12 +125,22 @@ def render_ledger(doc: Dict[str, Any]) -> str:
              "sum + unattributed == wall):"]
     for c, ms in doc.get("categories_ms", {}).items():
         pct = (100.0 * ms / wall) if wall > 0 else 0.0
-        lines.append(f"  {c:<14} {ms:>10.1f}ms  {pct:5.1f}%")
+        lines.append(f"  {c:<20} {ms:>10.1f}ms  {pct:5.1f}%")
     unattr = doc.get("unattributed_ms", 0.0)
     pct = (100.0 * unattr / wall) if wall > 0 else 0.0
-    lines.append(f"  {'unattributed':<14} {unattr:>10.1f}ms  "
+    lines.append(f"  {'unattributed':<20} {unattr:>10.1f}ms  "
                  f"{pct:5.1f}%")
-    lines.append(f"  {'wall':<14} {wall:>10.1f}ms")
+    lines.append(f"  {'wall':<20} {wall:>10.1f}ms")
+    per_device = doc.get("per_device")
+    if per_device:
+        lines.append("per-device attribution (mesh tasks; "
+                     "docs/SHARDING.md):")
+        for dev, cats in per_device.items():
+            total = sum(cats.values())
+            top = sorted(cats.items(), key=lambda kv: -kv[1])[:4]
+            detail = "  ".join(f"{c}={ms:.1f}ms" for c, ms in top)
+            lines.append(f"  device {dev:<3} {total:>10.1f}ms  "
+                         f"{detail}")
     return "\n".join(lines)
 
 

@@ -681,6 +681,134 @@ def _run_worker_churn_phase(schema: str, work: List[Tuple[str, str]],
     return doc
 
 
+def _rows_match(a, b) -> bool:
+    """Mesh-vs-local result identity: exact for non-floats, suite
+    tolerance for floats (the mesh's partial->final aggregation
+    reassociates float sums)."""
+    import math
+    ra = sorted(a.rows(), key=str)
+    rb = sorted(b.rows(), key=str)
+    if len(ra) != len(rb):
+        return False
+    for x, y in zip(ra, rb):
+        if len(x) != len(y):
+            return False
+        for u, v in zip(x, y):
+            if isinstance(u, float) or isinstance(v, float):
+                if not (u == v or math.isclose(
+                        float(u), float(v),
+                        rel_tol=1e-6, abs_tol=1e-6)):
+                    return False
+            elif u != v:
+                return False
+    return True
+
+
+def _run_mesh_phase(schema: str, sqls: Dict[str, str],
+                    rounds: int = 2) -> dict:
+    """The --mesh phase: the serving mix executed on the sharded
+    MeshRunner (shard_map fragments + all_to_all waves) vs the
+    single-device LocalRunner, in process — this phase measures the
+    ENGINE's mesh scaling, not the HTTP coordinator. Reports warm
+    per-query latency both ways, the geomean ratio, per-device wall
+    attribution summed over the mix, exchange bytes/row, and the
+    fused_fragments counters the sharded planner produced.
+
+    Honesty note (carried into the doc): on the CPU test mesh the
+    "devices" are XLA virtual devices inside ONE process sharing the
+    GIL and the host's cores — the ratio here is a correctness-and-
+    attribution exercise, not an ICI scaling claim."""
+    import math
+    import time as _time
+
+    import jax
+
+    from presto_tpu.runner import MeshRunner
+    from presto_tpu.runner.local import LocalRunner
+    from presto_tpu.telemetry.metrics import METRICS
+
+    ndev = len(jax.devices())
+    w = 1
+    while w * 2 <= min(8, ndev):
+        w *= 2
+    if w < 2:
+        return {"skipped": f"{ndev} device(s) visible — the mesh "
+                           "phase needs >=2 (on CPU set XLA_FLAGS="
+                           "--xla_force_host_platform_device_count"
+                           "=8)"}
+    local = LocalRunner("tpch", schema)
+    mesh = MeshRunner("tpch", schema, n_workers=w)
+    ex_names = ("waves", "rows", "bytes")
+    ex_before = {k: METRICS.total(
+        f"presto_tpu_exchange_all_to_all_{k}_total")
+        for k in ex_names}
+    fused_before = METRICS.by_label(
+        "presto_tpu_fused_fragments_total", "status")
+
+    def warm_best(r, sql):
+        times, res = [], None
+        for _ in range(rounds + 1):  # round 0 compiles
+            t0 = _time.perf_counter()
+            res = r.execute(sql)
+            times.append((_time.perf_counter() - t0) * 1e3)
+        return min(times[1:]), res
+
+    per_query = {}
+    per_device: Dict[str, float] = {}
+    ratios = []
+    identical = True
+    for name, sql in sqls.items():
+        local_ms, lres = warm_best(local, sql)
+        mesh_ms, mres = warm_best(mesh, sql)
+        led = mres.query_stats.get("ledger") or {}
+        for dev, cats in (led.get("per_device") or {}).items():
+            per_device[dev] = per_device.get(dev, 0.0) \
+                + sum(cats.values())
+        ok = _rows_match(lres, mres)
+        identical = identical and ok
+        ratio = (local_ms / mesh_ms) if mesh_ms else None
+        per_query[name] = {
+            "local_warm_ms": round(local_ms, 1),
+            "mesh_warm_ms": round(mesh_ms, 1),
+            "mesh_vs_local": round(ratio, 3) if ratio else None,
+            "identical": ok,
+        }
+        if ratio:
+            ratios.append(ratio)
+    ex = {k: int(METRICS.total(
+        f"presto_tpu_exchange_all_to_all_{k}_total") - ex_before[k])
+        for k in ex_names}
+    doc = {
+        "n_devices": w,
+        "rounds": rounds,
+        "geomean_mesh_vs_local": round(math.exp(
+            sum(math.log(r) for r in ratios) / len(ratios)), 3)
+        if ratios else None,
+        "caveat": "CPU virtual-device mesh in one GIL-bound process "
+                  "— attribution/correctness figure, not an ICI "
+                  "scaling claim",
+        "queries": per_query,
+        "results_identical": identical,
+        "per_device_ms": {d: round(ms, 1) for d, ms in
+                          sorted(per_device.items())},
+        "exchange": {
+            "all_to_all_waves": ex["waves"],
+            "all_to_all_rows": ex["rows"],
+            "all_to_all_bytes": ex["bytes"],
+            "bytes_per_row": round(ex["bytes"] / ex["rows"], 2)
+            if ex["rows"] else None,
+        },
+        "fused_fragments": METRICS.delta_by_label(
+            "presto_tpu_fused_fragments_total", "status",
+            fused_before),
+    }
+    if not identical:
+        raise RuntimeError(
+            "mesh phase diverged from single-device results: "
+            + json.dumps(doc, indent=1))
+    return doc
+
+
 def _load_mix(mix: Sequence[str]) -> Dict[str, str]:
     from presto_tpu.tools.verifier import load_suite
     suite = load_suite("tpch")
@@ -713,7 +841,9 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
                       churn_period_s: float = 3.0,
                       timeline_out: Optional[str] = None,
                       assert_verdict: Optional[str] = None,
-                      host: str = "127.0.0.1") -> dict:
+                      host: str = "127.0.0.1",
+                      mesh_phase: bool = False,
+                      mesh_rounds: int = 2) -> dict:
     """Thin wrapper owning the auto-created compilation-cache dir:
     a --restart-warm run without --cache-dir gets a tmpdir that is
     removed (and unconfigured) when the bench finishes, success or
@@ -740,7 +870,8 @@ def run_serving_bench(clients: int = 4, schema: str = "sf0_1",
             churn_workers=churn_workers, churn_rounds=churn_rounds,
             churn_kills=churn_kills, churn_period_s=churn_period_s,
             timeline_out=timeline_out,
-            assert_verdict=assert_verdict, host=host)
+            assert_verdict=assert_verdict, host=host,
+            mesh_phase=mesh_phase, mesh_rounds=mesh_rounds)
     finally:
         if auto_cache_dir is not None:
             import shutil
@@ -762,7 +893,8 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
                    churn_rounds: int, churn_kills: int,
                    churn_period_s: float, timeline_out: Optional[str],
                    assert_verdict: Optional[str],
-                   host: str) -> dict:
+                   host: str, mesh_phase: bool = False,
+                   mesh_rounds: int = 2) -> dict:
     from presto_tpu.cache import get_cache_manager
     from presto_tpu.execution import compile_cache
     from presto_tpu.server.coordinator import Coordinator
@@ -1088,6 +1220,13 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
             "page_source_cache_enabled": False})
         fusion = build_report(fr_runner, sqls)
 
+    mesh_doc = None
+    if mesh_phase:
+        # the sharded-execution phase: shard_map fragments +
+        # all_to_all waves vs the single-device engine, in process
+        # (docs/SHARDING.md)
+        mesh_doc = _run_mesh_phase(schema, sqls, rounds=mesh_rounds)
+
     cache_stats = {name: level.stats.snapshot() for name, level in
                    (("plan", mgr.plan), ("fragment", mgr.fragment),
                     ("page", mgr.page))}
@@ -1118,6 +1257,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "fusion": fusion,
         "history": history_doc,
         "worker_churn": churn_doc,
+        "mesh": mesh_doc,
     }
     if not identical:
         raise RuntimeError(
@@ -1204,6 +1344,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "over the warm serving-mix ledger is this "
                         "category (the CI gate that keeps serving "
                         "kernel-dominated)")
+    p.add_argument("--mesh", action="store_true",
+                   help="run the sharded-execution phase: the mix on "
+                        "the MeshRunner vs single device, with "
+                        "per-device attribution and exchange "
+                        "bytes/row (docs/SHARDING.md)")
+    p.add_argument("--mesh-rounds", type=int, default=2)
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
     doc = run_serving_bench(
@@ -1224,7 +1370,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         churn_kills=args.churn_kills,
         churn_period_s=args.churn_period,
         timeline_out=args.timeline_out,
-        assert_verdict=args.assert_verdict)
+        assert_verdict=args.assert_verdict,
+        mesh_phase=args.mesh, mesh_rounds=args.mesh_rounds)
     text = json.dumps(doc, indent=1)
     print(text)
     if args.out:

@@ -1,0 +1,173 @@
+"""Mesh-vs-single-device parity oracle plus the sharded-fusion
+regression battery (PR 17):
+
+* byte-identity: the SAME engine runs each query on the 8-virtual-
+  device mesh and on a single device; non-float columns must match
+  EXACTLY (floats get the suite tolerance — the mesh's partial->final
+  aggregation reassociates float sums). The engineered join below
+  keys on nationkey, whose value 0 COLLIDES with the zero fill the
+  wave's pad batches carry (exchange_ops._pad_batch) — a pad lane
+  leaking into the shuffle as a real row shows up here as a wrong
+  count, in all-integer output compared byte-exactly.
+* the fragment-fusion session gate must be honored by the mesh
+  phased loop per statement (planner/fusion.set_fusion_gate installed
+  by MeshRunner._run_fragments), mirroring the kernel_shape_buckets
+  gate test in test_shape_buckets.py.
+* zero-new-kernels oracle: a second wave of the same shape bucket
+  must dispatch the cached spmd programs — no new compiles for the
+  spmd_shuffle/spmd_fragment families.
+
+The fast tier runs the engineered collision query plus the cheap half
+of the serving mix (q1, q6); join-heavy mix members and the full
+TPC-H battery ride the slow tier.
+"""
+
+import pytest
+
+from tpch_queries import QUERIES
+from test_tpch_suite import SCHEMA, normalize
+from test_tpch_suite import oracle, runner  # noqa: F401 (fixtures)
+
+#: all-integer output; nationkey=0 exists in nation, so the wave pad
+#: fill (zeros) collides with a REAL join key when a producer pads.
+#: The suppkey >= 0 filter is trivially true — it exists to leave a
+#: FilterProject tail on the supplier fragment so the chain absorbs
+#: into the exchange wave (fused[filter_project+all_to_all])
+COLLISION_SQL = (
+    "SELECT n.nationkey, count(*) AS c "
+    "FROM supplier s, nation n "
+    "WHERE s.nationkey = n.nationkey AND s.suppkey >= 0 "
+    "GROUP BY n.nationkey ORDER BY n.nationkey")
+
+
+@pytest.fixture(scope="module")
+def mesh_r():
+    from presto_tpu.runner import MeshRunner
+    # broadcast off: every join repartitions through the all_to_all
+    # wave, which is the machinery under test
+    return MeshRunner("tpch", SCHEMA,
+                      {"broadcast_join_threshold_rows": 0},
+                      n_workers=8)
+
+
+def _parity(mesh_res, local_res, qn, exact=False):
+    import math
+    types = [f.type.name for f in mesh_res.fields]
+    got = normalize(mesh_res.rows(), types)
+    exp = normalize(local_res.rows(), types)
+    assert len(got) == len(exp), \
+        f"Q{qn}: mesh {len(got)} rows != local {len(exp)}"
+    got_s = sorted(got, key=str)
+    exp_s = sorted(exp, key=str)
+    for i, (g, e) in enumerate(zip(got_s, exp_s)):
+        for j, (gv, ev) in enumerate(zip(g, e)):
+            if not exact and isinstance(gv, float):
+                assert gv == ev or math.isclose(
+                    gv, float(ev), rel_tol=1e-6, abs_tol=1e-6), \
+                    f"Q{qn} row {i} col {j}: {gv!r} != {ev!r}"
+            else:
+                assert gv == ev, \
+                    f"Q{qn} row {i} col {j}: {gv!r} != {ev!r}"
+
+
+def test_pad_collision_join_byte_exact(mesh_r, runner):  # noqa: F811
+    """The engineered collision case: integer-only output compared
+    BYTE-EXACTLY between mesh and single device."""
+    _parity(mesh_r.execute(COLLISION_SQL),
+            runner.execute(COLLISION_SQL), "collision", exact=True)
+
+
+def test_second_wave_zero_new_kernels(mesh_r, runner):  # noqa: F811
+    """Same query, same shape bucket, second run: the spmd shuffle and
+    fused-fragment wave programs must dispatch from cache (zero new
+    compiles per device), the collective must be attributed in the
+    ledger, and the wave counters must advance."""
+    from presto_tpu.telemetry.metrics import METRICS
+
+    def compiles():
+        return (METRICS.get("presto_tpu_kernel_compiles_total",
+                            kernel="spmd_shuffle")
+                + METRICS.get("presto_tpu_kernel_compiles_total",
+                              kernel="spmd_fragment"))
+
+    mesh_r.execute(COLLISION_SQL)  # warm (usually warm already)
+    before_c, before_w = compiles(), METRICS.total(
+        "presto_tpu_exchange_all_to_all_waves_total")
+    res = mesh_r.execute(COLLISION_SQL)
+    assert compiles() == before_c, \
+        "second same-bucket wave recompiled an spmd program"
+    assert METRICS.total(
+        "presto_tpu_exchange_all_to_all_waves_total") > before_w
+    assert METRICS.total(
+        "presto_tpu_exchange_all_to_all_rows_total") > 0
+    assert METRICS.total(
+        "presto_tpu_exchange_all_to_all_bytes_total") > 0
+    led = res.query_stats["ledger"]
+    assert led["categories_ms"].get("exchange.all_to_all", 0) > 0
+    per_dev = led.get("per_device")
+    assert per_dev, "mesh query produced no per-device attribution"
+    assert len(per_dev) == 8
+    assert all(cats.get("driver.step", 0) >= 0
+               for cats in per_dev.values())
+
+
+def test_fused_exchange_in_explain(mesh_r):
+    """EXPLAIN ANALYZE on a mesh plan must show the absorbed chain on
+    the sink line — the fused[...+all_to_all] acceptance marker."""
+    res = mesh_r.execute("EXPLAIN ANALYZE " + COLLISION_SQL)
+    txt = "\n".join(str(r[0]) for r in res.rows())
+    assert "+all_to_all]" in txt, txt
+    assert "exchange.all_to_all" in txt
+    assert "per-device attribution" in txt
+
+
+def test_mesh_fusion_gate_per_statement(monkeypatch):
+    """fragment_fusion_enabled=False must reach every planner thread
+    of the mesh phased drive through the thread-local gate — no fused
+    factories, no chain absorbed into any exchange, same answer."""
+    from presto_tpu.planner import fusion
+    from presto_tpu.runner.mesh import MeshRunner
+    seen = []
+    inner = MeshRunner._run_fragments_inner
+
+    def spy(self, fplan, session, profile=False):
+        seen.append(fusion.fusion_gate())
+        return inner(self, fplan, session, profile)
+
+    monkeypatch.setattr(MeshRunner, "_run_fragments_inner", spy)
+    r = MeshRunner("tpch", SCHEMA,
+                   {"fragment_fusion_enabled": False,
+                    "broadcast_join_threshold_rows": 0}, n_workers=8)
+    res = r.execute("EXPLAIN ANALYZE " + COLLISION_SQL)
+    txt = "\n".join(str(row[0]) for row in res.rows())
+    assert seen == [False]
+    assert "fused[" not in txt
+    # and the gate is restored + honored per statement: a fresh
+    # runner with the default (True) fuses on the same thread
+    r2 = MeshRunner("tpch", SCHEMA,
+                    {"broadcast_join_threshold_rows": 0}, n_workers=8)
+    res2 = r2.execute("EXPLAIN ANALYZE " + COLLISION_SQL)
+    txt2 = "\n".join(str(row[0]) for row in res2.rows())
+    assert seen[-1] is True
+    assert fusion.fusion_gate() is None  # uninstalled after the drive
+    assert "+all_to_all]" in txt2
+
+
+@pytest.mark.parametrize("qn", [
+    6,
+    pytest.param(1, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow),
+    pytest.param(13, marks=pytest.mark.slow)])
+def test_serving_mix_parity(qn, mesh_r, runner):  # noqa: F811
+    """The serving mix, mesh vs single device (q6 fast — q1's
+    aggregation ladder alone costs ~40s of SPMD compiles on the CPU
+    mesh, so the join-heavy half and q1 ride the slow tier)."""
+    _parity(mesh_r.execute(QUERIES[qn]), runner.execute(QUERIES[qn]),
+            qn)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qn", sorted(QUERIES))
+def test_full_tpch_mesh_vs_local(qn, mesh_r, runner):  # noqa: F811
+    _parity(mesh_r.execute(QUERIES[qn]), runner.execute(QUERIES[qn]),
+            qn)
