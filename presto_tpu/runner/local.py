@@ -742,6 +742,7 @@ class LocalRunner:
         self._session_tl.lifecycle = (cancel, deadline)
         self._session_tl.op_stats = None  # this statement's snapshots
         self._session_tl.fusion_report = None  # planner/fusion.py
+        self._session_tl.query_fp = None  # latency-baseline key
         # kernel shape bucketing rides a thread-local gate (operators
         # have no session access): honored by every drive loop this
         # statement runs on THIS thread — remote tasks use the process
@@ -827,6 +828,20 @@ class LocalRunner:
             if entry is not None:
                 entry["unattributed_ms"] = led_doc["unattributed_ms"]
                 self._session_tl.history_entry = None
+            # perf sentinel feeds: the driver-share/unattributed
+            # window detectors eat the ledger doc, and the query's
+            # wall lands in its structural-fingerprint latency sketch
+            # (plan-shape key when the planner produced one, a
+            # normalized-SQL hash for everything else — SHOW/SET/DDL)
+            from presto_tpu.telemetry import sentinel as _sentinel
+            _sentinel.observe_ledger(led_doc)
+            _fp = getattr(self._session_tl, "query_fp", None)
+            if _fp is None:
+                import hashlib as _hashlib
+                _fp = "sql:" + _hashlib.blake2b(
+                    sql.strip().encode(),
+                    digest_size=8).hexdigest()
+            _sentinel.observe_query(_fp, led_doc["wall_ms"])
             import sys as _sys
             _exc = _sys.exc_info()[1]
             if _exc is not None:
@@ -849,6 +864,16 @@ class LocalRunner:
         result.query_stats["ledger"] = led_doc
         result.trace_events = recorder.events() \
             if recorder is not None else None
+        if result.trace_events:
+            # traced queries additionally carry the blocking chain
+            # that determined their wall, in ledger vocabulary —
+            # GET /v1/query/{id} and query_doctor consume it
+            from presto_tpu.telemetry import critical_path as _cp
+            try:
+                result.query_stats["critical_path"] = \
+                    _cp.extract(result.trace_events)
+            except Exception:  # noqa: BLE001 — stats stay servable
+                pass
         # whole-fragment fusion report (fused chains + fallback
         # reasons) rides the result for tools/fusion_report.py and
         # the bench JSON schemas
@@ -1284,6 +1309,20 @@ class LocalRunner:
         import time as _time
         from presto_tpu.telemetry import ledger as _ledger
         session = self.session
+        # query STRUCTURAL fingerprint (history/fingerprint.py keys)
+        # for the streaming latency baselines: queries with the same
+        # plan shape share one sliding-window sketch, so the sentinel
+        # compares like against like (telemetry/sentinel.py). Memo
+        # scope is this call; the stash is per statement.
+        if getattr(self._session_tl, "query_fp", None) is None:
+            try:
+                from presto_tpu.history.fingerprint import (
+                    node_fingerprint,
+                )
+                fp = node_fingerprint(plan, self.catalogs, {})
+                self._session_tl.query_fp = fp[0] if fp else None
+            except Exception:  # noqa: BLE001 — baseline is advisory
+                self._session_tl.query_fp = None
         while True:
             with _ledger.span("planning"):
                 planner = LocalExecutionPlanner(self.catalogs, session)
@@ -1761,8 +1800,22 @@ class LocalRunner:
             # system.runtime.queries
             entry = self._new_history_entry(sql)
             t0 = _time.perf_counter()
+            # critical-path extraction needs trace spans: the analyze
+            # run gets its OWN recorder (even when the session is not
+            # traced — EXPLAIN ANALYZE is already the heavyweight
+            # profiling path), with a root "query" span covering
+            # exactly the profiled execution
+            from presto_tpu.telemetry import trace as _trace_mod
+            _cp_rec = _trace_mod.TraceRecorder()
+            _cp_prev = _trace_mod.activate(_cp_rec)
+            _cp_t0 = _time.perf_counter_ns()
             try:
-                result = self._run_plan(plan, profile=True)
+                try:
+                    result = self._run_plan(plan, profile=True)
+                finally:
+                    _cp_rec.add("query", "query", _cp_t0,
+                                _time.perf_counter_ns() - _cp_t0)
+                    _trace_mod.deactivate(_cp_prev)
                 # annotated tree: each plan node carries its estimate
                 # (+ provenance — measured history vs derived static)
                 # and the rows/wall/compile/cache of the operators it
@@ -1792,6 +1845,16 @@ class LocalRunner:
                 if led is not None and led_t0 is not None:
                     text += "\n\n" + render_ledger(led.finish(
                         _time.perf_counter_ns() - led_t0))
+                # the blocking chain that DETERMINED the profiled
+                # run's wall (telemetry/critical_path.py) — the
+                # ledger above sums thread-time across categories;
+                # this names what actually gated completion
+                from presto_tpu.telemetry import (
+                    critical_path as _cp,
+                )
+                cp_doc = _cp.extract(_cp_rec.events())
+                if cp_doc is not None:
+                    text += "\n\n" + _cp.render(cp_doc)
                 entry["state"] = "FINISHED"
                 entry["rows"] = result.row_count
             except Exception as e:

@@ -24,13 +24,24 @@ TaskStats / QueryStats hierarchy, server/QueryResource, and the
              events (sheds, retries, demotions, membership, compiles)
              in a per-process ring, snapshotted into error payloads
              and served on GET /v1/flight
+  critical_path — blocking-chain extraction over a query's trace
+             spans: which spans DETERMINED the wall, decomposed into
+             the ledger's categories (EXPLAIN ANALYZE's "critical
+             path" section, GET /v1/query/{id}, query_doctor)
+  sentinel — streaming latency baselines (sliding-window quantile
+             sketches per kernel family / query fingerprint) + the
+             noise-aware regression detectors that compare live
+             windows against tools/perf_baseline.json and the
+             previous window (GET /v1/sentinel,
+             system.runtime.latency, serving_bench
+             --check-regressions)
 
 Every hot-path hook is gated on a module-level bool (``trace.ACTIVE``,
 ``kernels.ENABLED``) exactly like execution/faults.ARMED, so disabled
 telemetry costs one attribute load + branch per site."""
 
 from presto_tpu.telemetry import (  # noqa: F401
-    flight, kernels, ledger, metrics, trace,
+    critical_path, flight, kernels, ledger, metrics, sentinel, trace,
 )
 from presto_tpu.telemetry.stats import (  # noqa: F401
     build_query_stats, render_operator_stats, snapshot_drivers,

@@ -1,0 +1,283 @@
+"""Critical-path extraction over a query's trace spans (reference
+analog: the span-level critical-path analysis of distributed tracers —
+Jaeger's "critical path" view, Chromium's tab_loading breakdowns —
+applied to the engine's own Chrome-``trace_event`` span model from
+telemetry/trace.py).
+
+The attribution ledger (telemetry/ledger.py) answers "where did the
+query's CPU-side wall go", summed across every thread.  That sum can
+mislead a diagnosis: a query can book 70% of its thread-time in
+`dispatch` while the chain of spans that actually DETERMINED the wall
+— the blocking chain from query start to query end — was dominated by
+scan or exchange.  This module computes that chain:
+
+  * input: the query's merged span list (single-node recorder events
+    or the fleet-merged multi-process timeline), Chrome ``"X"``
+    complete events where (ts, dur) containment IS the hierarchy;
+  * the root ``query`` span's interval is walked BACKWARDS from its
+    end: at every position the latest-ending child still running is
+    the span that blocked progress, gaps between children are the
+    parent's own self-time, and the walk recurses into each chosen
+    child — so the emitted segments PARTITION the root interval
+    exactly (sum-to-wall holds by construction; ``verify`` rechecks
+    it against a stated tolerance because merged fleet timelines
+    carry clock-offset-shifted remote spans that the walk clamps);
+  * every segment maps onto one of the ledger's named categories
+    (compile / dispatch / scan / exchange / ...), so the critical
+    path renders in the ledger's vocabulary: ``critical path:
+    scan 40% -> dispatch 35% -> exchange 20%``.
+
+Lanes (distinct ``(pid, tid)``) other than the root's are stitched in
+by attaching each lane-top span to the smallest strictly-longer span
+that overlaps it (clamped to the parent's interval), which tolerates
+the imperfect clock alignment of remote lanes: a worker span shifted
+a few ms past its coordinator-side task span still attributes, just
+clipped to the interval it can have blocked."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+#: segments kept verbatim in the output doc; the category totals are
+#: computed over ALL segments before truncation, so a busy serving
+#: query's doc stays bounded without losing attribution mass
+MAX_SEGMENTS = 256
+
+#: default sum-to-wall tolerance (fraction of wall) for verify():
+#: single-process traces are exact; merged fleet timelines carry
+#: clock-offset-clamped remote spans
+TOLERANCE = 0.05
+
+
+def _category(name: str, cat: str) -> str:
+    """Span -> attribution-ledger category. Spans the recorder tags
+    with a kernel/exchange/retry cat map directly; operator spans
+    split scan-shaped sources from glue; structural spans (query
+    root, task lanes, driver quanta) are executor glue."""
+    if cat == "compile":
+        return "compile"
+    if cat == "execute":
+        # a warm kernel span is the host-side dispatch wall; device
+        # completion is measured at drain points (ledger device_wait)
+        return "dispatch"
+    if cat == "exchange":
+        return "exchange"
+    if cat == "retry":
+        return "retry_backoff"
+    if cat == "spool":
+        return "spool"
+    if cat == "cache":
+        # plan/result/fragment cache probes happen while planning or
+        # reassembling — planning is the closest ledger bucket
+        return "planning"
+    if cat == "operator":
+        # "op:{name}.add_input" / "op:{name}.get_output"
+        if "scan" in name or "source" in name or "datagen" in name:
+            return "scan"
+        return "driver.step"
+    if cat == "task":
+        return "driver.quantum"
+    if cat == "query":
+        return "driver.quantum"
+    return "driver.step"
+
+
+class _Span:
+    __slots__ = ("name", "cat", "start", "end", "pid", "tid",
+                 "children", "parent")
+
+    def __init__(self, ev: Dict[str, Any]):
+        self.name = ev.get("name", "")
+        self.cat = ev.get("cat", "")
+        self.start = float(ev.get("ts", 0.0))
+        self.end = self.start + float(ev.get("dur", 0.0))
+        self.pid = ev.get("pid", 1)
+        self.tid = ev.get("tid", 0)
+        self.children: List["_Span"] = []
+        self.parent: Optional["_Span"] = None
+
+    @property
+    def dur(self) -> float:
+        return self.end - self.start
+
+
+def _build_forest(events: List[Dict[str, Any]]) -> List[_Span]:
+    """Materialize "X" events into per-lane containment trees, then
+    stitch lanes together: each lane-top span attaches to the
+    smallest STRICTLY-longer overlapping span of any lane (strictness
+    makes the attachment acyclic), unattachable spans stay roots."""
+    spans = [_Span(ev) for ev in events
+             if ev.get("ph") == "X" and float(ev.get("dur", 0)) > 0]
+    lanes: Dict[Tuple[Any, Any], List[_Span]] = {}
+    for s in spans:
+        lanes.setdefault((s.pid, s.tid), []).append(s)
+    lane_tops: List[_Span] = []
+    for lane in lanes.values():
+        # (start asc, dur desc): a parent sorts before its children,
+        # so a simple stack sweep recovers the in-lane hierarchy
+        lane.sort(key=lambda s: (s.start, -(s.dur)))
+        stack: List[_Span] = []
+        for s in lane:
+            while stack and stack[-1].end <= s.start:
+                stack.pop()
+            if stack and stack[-1].end >= s.end:
+                s.parent = stack[-1]
+                stack[-1].children.append(s)
+            else:
+                # overlapping-but-not-contained (clock jitter between
+                # the lane's own clock reads) attaches to the closest
+                # enclosing candidate anyway when one exists
+                if stack:
+                    s.parent = stack[-1]
+                    stack[-1].children.append(s)
+                else:
+                    lane_tops.append(s)
+            stack.append(s)
+    # cross-lane stitching, longest lane-tops first
+    all_spans = sorted(spans, key=lambda s: s.dur)
+    for top in sorted([t for t in lane_tops], key=lambda s: -s.dur):
+        best = None
+        for cand in all_spans:
+            if cand is top or cand.dur <= top.dur:
+                continue
+            overlap = min(cand.end, top.end) - max(cand.start,
+                                                   top.start)
+            if overlap <= 0:
+                continue
+            if best is None or cand.dur < best.dur:
+                best = cand
+        if best is not None:
+            top.parent = best
+            best.children.append(top)
+    return [s for s in spans if s.parent is None]
+
+
+def _walk(span: _Span, lo: float, hi: float,
+          out: List[Tuple[_Span, float, float]]) -> None:
+    """Attribute [lo, hi] of `span`'s interval: the latest-ending
+    child under the cursor is the blocking chain, gaps are the span's
+    own self-time. Children are clamped to [lo, hi], so the emitted
+    segments partition it exactly."""
+    cursor = hi
+    eps = 1e-9
+    while cursor - lo > eps:
+        best = None
+        best_end = lo
+        for c in span.children:
+            c_end = min(c.end, cursor)
+            if c.start < cursor - eps and c_end > best_end + eps:
+                best, best_end = c, c_end
+        if best is None:
+            out.append((span, lo, cursor))
+            return
+        if best_end < cursor - eps:
+            out.append((span, best_end, cursor))
+        _walk(best, max(best.start, lo), best_end, out)
+        cursor = max(best.start, lo)
+
+
+def extract(events: List[Dict[str, Any]],
+            root_name: str = "query") -> Optional[Dict[str, Any]]:
+    """Critical-path doc of one trace-span list, or None when no
+    usable root span exists.  Doc shape::
+
+        {"wall_ms", "coverage",
+         "categories_ms": {ledger category: blocking ms},
+         "segments": [{"name","category","start_ms","dur_ms"}...],
+         "segments_dropped": n}
+
+    `coverage` is sum(segments)/wall BEFORE rounding — 1.0 by
+    construction for well-formed traces; verify() enforces the
+    tolerance."""
+    if not events:
+        return None
+    roots = _build_forest(events)
+    if not roots:
+        return None
+    named = [r for r in roots if r.name == root_name]
+    root = max(named or roots, key=lambda s: s.dur)
+    if root.dur <= 0:
+        return None
+    segs: List[Tuple[_Span, float, float]] = []
+    _walk(root, root.start, root.end, segs)
+    # oldest first, and merge back-to-back pieces of the same span
+    segs.sort(key=lambda t: t[1])
+    merged: List[List[Any]] = []
+    for sp, lo, hi in segs:
+        if merged and merged[-1][0] is sp \
+                and abs(merged[-1][2] - lo) < 1e-6:
+            merged[-1][2] = hi
+        else:
+            merged.append([sp, lo, hi])
+    cats: Dict[str, float] = {}
+    total_us = 0.0
+    seg_docs: List[Dict[str, Any]] = []
+    for sp, lo, hi in merged:
+        dur_us = hi - lo
+        total_us += dur_us
+        cat = _category(sp.name, sp.cat)
+        cats[cat] = cats.get(cat, 0.0) + dur_us
+        seg_docs.append({
+            "name": sp.name,
+            "category": cat,
+            "start_ms": round((lo - root.start) / 1e3, 3),
+            "dur_ms": round(dur_us / 1e3, 3),
+        })
+    wall_us = root.dur
+    dropped = 0
+    if len(seg_docs) > MAX_SEGMENTS:
+        # keep the longest blockers; category totals already include
+        # the whole path
+        seg_docs.sort(key=lambda d: -d["dur_ms"])
+        dropped = len(seg_docs) - MAX_SEGMENTS
+        seg_docs = sorted(seg_docs[:MAX_SEGMENTS],
+                          key=lambda d: d["start_ms"])
+    return {
+        "wall_ms": round(wall_us / 1e3, 3),
+        "coverage": round(total_us / wall_us, 4),
+        "categories_ms": {
+            c: round(us / 1e3, 3)
+            for c, us in sorted(cats.items(), key=lambda kv: -kv[1])},
+        "segments": seg_docs,
+        "segments_dropped": dropped,
+    }
+
+
+def verify(doc: Optional[Dict[str, Any]],
+           tolerance: float = TOLERANCE) -> Tuple[bool, str]:
+    """Machine-check of the sum-to-wall invariant: the categorized
+    blocking time must cover the root wall within `tolerance`."""
+    if not doc:
+        return False, "no critical-path doc"
+    wall = float(doc.get("wall_ms") or 0.0)
+    if wall <= 0:
+        return False, "zero-wall critical path"
+    total = sum(doc.get("categories_ms", {}).values())
+    frac = abs(total - wall) / wall
+    if frac > tolerance:
+        return False, (f"critical-path segments sum to {total:.1f}ms "
+                       f"vs wall {wall:.1f}ms "
+                       f"({100 * frac:.1f}% > {100 * tolerance:.0f}%)")
+    return True, f"sum {total:.1f}ms == wall {wall:.1f}ms " \
+                 f"within {100 * tolerance:.0f}%"
+
+
+def render(doc: Optional[Dict[str, Any]], top: int = 6) -> str:
+    """One-line category chain + the longest blocking spans — the
+    EXPLAIN ANALYZE / query_doctor rendering."""
+    if not doc:
+        return "critical path: (no trace spans)"
+    wall = doc.get("wall_ms") or 0.0
+    cats = doc.get("categories_ms", {})
+    chain = " -> ".join(
+        f"{c} {100 * ms / wall:.0f}%"
+        for c, ms in list(cats.items())[:top]) if wall else "(empty)"
+    lines = [f"critical path (sum==wall within "
+             f"{100 * TOLERANCE:.0f}%): {chain}"]
+    segs = sorted(doc.get("segments", []),
+                  key=lambda d: -d["dur_ms"])[:top]
+    for s in segs:
+        pct = 100 * s["dur_ms"] / wall if wall else 0.0
+        lines.append(f"  {s['name']:<32} {s['category']:<16} "
+                     f"{s['dur_ms']:>9.1f}ms  {pct:5.1f}%")
+    return "\n".join(lines)

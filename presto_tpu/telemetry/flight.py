@@ -55,7 +55,31 @@ RING_SIZE = 4096
 _LOCK = sanitize.lock("telemetry.flight")
 _RING: "deque[Tuple[int, str, Any, Any, Any]]" = deque(maxlen=RING_SIZE)
 _DROPPED = 0
+_SAMPLED_OUT = 0
 _TOTAL = 0
+
+#: per-kind sampling lever (the overhead satellite): kind -> keep
+#: 1-in-n. Empty by default — every event kept. Operators facing a
+#: hot event class (a retry storm flooding `retry`, per-compile
+#: events during a cold fleet prewarm) dial it down WITHOUT losing
+#: the class entirely; skipped events are counted
+#: (presto_tpu_flight_dropped_total{reason="sampled"}) so the ring
+#: never silently under-reports
+_SAMPLE_EVERY: Dict[str, int] = {}
+_SAMPLE_SEEN: Dict[str, int] = {}
+
+
+def set_sampling(rates: Optional[Dict[str, int]]) -> Dict[str, int]:
+    """Install per-kind keep-1-in-n rates (None/{} clears; n <= 1
+    entries are dropped — they mean 'keep everything'). Returns the
+    previous rates so benches/tests can restore."""
+    global _SAMPLE_EVERY
+    with _LOCK:
+        prev = dict(_SAMPLE_EVERY)
+        _SAMPLE_EVERY = {k: int(n) for k, n in (rates or {}).items()
+                         if int(n) > 1}
+        _SAMPLE_SEEN.clear()
+    return prev
 
 
 def record(kind: str, a: Any = "", b: Any = "", c: Any = "") -> None:
@@ -64,13 +88,29 @@ def record(kind: str, a: Any = "", b: Any = "", c: Any = "") -> None:
     re-checks so an un-gated site is still correct."""
     if not ENABLED:
         return
-    global _DROPPED, _TOTAL
+    global _DROPPED, _SAMPLED_OUT, _TOTAL
     ev = (time.perf_counter_ns(), kind, a, b, c)
+    dropped = sampled = False
     with _LOCK:
         _TOTAL += 1
-        if len(_RING) == RING_SIZE:
-            _DROPPED += 1
-        _RING.append(ev)
+        n = _SAMPLE_EVERY.get(kind)
+        if n is not None:
+            seen = _SAMPLE_SEEN.get(kind, 0)
+            _SAMPLE_SEEN[kind] = seen + 1
+            if seen % n:
+                _SAMPLED_OUT += 1
+                sampled = True
+        if not sampled:
+            if len(_RING) == RING_SIZE:
+                _DROPPED += 1
+                dropped = True
+            _RING.append(ev)
+    # counter incs OUTSIDE the ring lock (METRICS has its own) and
+    # only on the loss paths — the common keep path pays nothing new
+    if dropped or sampled:
+        from presto_tpu.telemetry.metrics import METRICS
+        METRICS.inc("presto_tpu_flight_dropped_total",
+                    reason="sampled" if sampled else "ring_full")
 
 
 def snapshot(limit: Optional[int] = None
@@ -107,13 +147,18 @@ def attach_failure(exc: BaseException, limit: int = 64) -> None:
 def stats() -> Dict[str, int]:
     with _LOCK:
         return {"size": len(_RING), "capacity": RING_SIZE,
-                "total": _TOTAL, "dropped": _DROPPED}
+                "total": _TOTAL, "dropped": _DROPPED,
+                "sampled_out": _SAMPLED_OUT,
+                "sampling": dict(_SAMPLE_EVERY)}
 
 
 def reset() -> None:
-    """Test hygiene only: empty the ring."""
-    global _DROPPED, _TOTAL
+    """Test hygiene only: empty the ring (sampling rates persist —
+    they are configuration, not state)."""
+    global _DROPPED, _SAMPLED_OUT, _TOTAL
     with _LOCK:
         _RING.clear()
+        _SAMPLE_SEEN.clear()
         _DROPPED = 0
+        _SAMPLED_OUT = 0
         _TOTAL = 0

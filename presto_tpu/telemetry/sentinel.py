@@ -1,0 +1,453 @@
+"""Continuous perf sentinel: streaming latency baselines + noise-aware
+regression detectors over the telemetry stack (reference analog: the
+SLO-burn / latency-regression sentinels every production serving fleet
+grows once tracing lands — continuous profiling's "compare this window
+against last window and the checked-in baseline" loop, in-process).
+
+Three pieces:
+
+  * :class:`WindowSketch` — a bounded sliding-window quantile sketch
+    (p50/p95/p99 + MAD) over the most recent N observations.  Exact
+    over its window (sorting 256 floats is cheaper than maintaining a
+    GK/t-digest and the window IS the noise model: quantiles computed
+    over the same horizon the detectors compare).
+  * :class:`LatencyTracker` — per-KERNEL-FAMILY sketches (fed by
+    telemetry.kernels on every warm call; compiles are excluded so a
+    cold start cannot masquerade as a dispatch regression) and
+    per-QUERY-STRUCTURAL-FINGERPRINT sketches (history/fingerprint.py
+    keys, fed at ledger close).  Key space is LRU-bounded.  Surfaced
+    as ``system.runtime.latency`` rows, ``/v1/latency`` on every
+    node, and the ``presto_tpu_{kernel,query}_latency_ms`` histogram
+    families on /v1/metrics.
+  * :class:`Sentinel` — the detector suite, run periodically by the
+    coordinator's housekeeping loop (and on demand via
+    ``GET /v1/sentinel`` / ``serving_bench --check-regressions``).
+    Every fired alert records a structured flight-recorder event
+    (kind ``sentinel``) and bumps
+    ``presto_tpu_sentinel_alerts_total{detector}``.
+
+Detector catalogue (thresholds live in tools/perf_baseline.json, the
+checked-in baseline; all are NOISE-AWARE — shift thresholds are
+relative multiples plus MAD bands, never raw wall-clock deltas,
+because the benches run on loaded shared hosts):
+
+    retrace_storm       kernel_retrace_total slope: more than
+                        `count` fresh XLA re-traces inside
+                        `window_s` — a shape-bucketing or cache
+                        regression (steady state compiles nothing)
+    driver_share_creep  mean driver.* share of recent query walls
+                        above `driver_share_max` — the PR 16 glue
+                        win eroding
+    unattributed_spike  mean unattributed fraction of recent query
+                        walls above `unattributed_frac_max` — the
+                        ledger's coverage regressing
+    latency_shift       a kernel family's (or query fingerprint's)
+                        window p99 beyond BOTH `mult` x reference
+                        AND reference + `mad_k` x window-MAD, where
+                        the reference is the checked-in baseline p99
+                        when present, else the previous rotated
+                        window (the "N minutes ago" comparison)
+    rtt_inflation       a live heartbeat RTT above `rtt_ms_max` —
+                        the control plane degrading
+
+A fired (detector, subject) pair re-alerts at most once per
+`realert_s` so a sustained regression does not flood the ring."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from presto_tpu import sanitize
+from presto_tpu.telemetry import flight as _flight
+from presto_tpu.telemetry.metrics import METRICS
+
+#: default sliding-window length (observations) per sketch key
+WINDOW = 256
+#: LRU bound on tracked keys per scope (kernel families are ~dozens;
+#: query fingerprints are open-ended — evict the coldest)
+MAX_KEYS = 256
+
+#: checked-in baseline + thresholds; see tools/perf_baseline.json
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), os.pardir,
+                             "tools", "perf_baseline.json")
+
+DEFAULTS: Dict[str, Any] = {
+    "retrace_storm": {"count": 8, "window_s": 60.0},
+    "driver_share_max": 0.30,
+    "unattributed_frac_max": 0.10,
+    "latency_shift": {"mult": 2.0, "mad_k": 6.0, "min_samples": 20},
+    "rtt_ms_max": 250.0,
+    "min_queries": 8,
+    "realert_s": 60.0,
+    "rotate_s": 120.0,
+}
+
+
+class WindowSketch:
+    """Bounded sliding window with exact quantiles + MAD over it."""
+
+    __slots__ = ("_vals",)
+
+    def __init__(self, window: int = WINDOW):
+        self._vals: "deque[float]" = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._vals.append(float(value))
+
+    def __len__(self) -> int:
+        return len(self._vals)
+
+    @staticmethod
+    def _quantile(s: List[float], q: float) -> float:
+        if not s:
+            return 0.0
+        i = min(int(round(q * (len(s) - 1))), len(s) - 1)
+        return s[i]
+
+    def snapshot(self) -> Dict[str, float]:
+        s = sorted(self._vals)
+        p50 = self._quantile(s, 0.50)
+        mad = self._quantile(sorted(abs(v - p50) for v in s), 0.50)
+        return {
+            "count": len(s),
+            "p50_ms": round(p50, 3),
+            "p95_ms": round(self._quantile(s, 0.95), 3),
+            "p99_ms": round(self._quantile(s, 0.99), 3),
+            "mad_ms": round(mad, 3),
+            "window": self._vals.maxlen,
+        }
+
+
+class LatencyTracker:
+    """Per-key WindowSketch store, two scopes: kernel families and
+    query structural fingerprints."""
+
+    def __init__(self):
+        self._lock = sanitize.lock("telemetry.latency_tracker")
+        self._scopes: Dict[str, "OrderedDict[str, WindowSketch]"] = {
+            "kernel": OrderedDict(), "query": OrderedDict()}
+
+    def _observe(self, scope: str, key: str, ms: float) -> None:
+        with self._lock:
+            store = self._scopes[scope]
+            sk = store.get(key)
+            if sk is None:
+                sk = store[key] = WindowSketch()
+                if len(store) > MAX_KEYS:
+                    store.popitem(last=False)
+            else:
+                store.move_to_end(key)
+            sk.observe(ms)
+
+    def observe_kernel(self, family: str, ms: float) -> None:
+        self._observe("kernel", family, ms)
+        METRICS.observe("presto_tpu_kernel_latency_ms", ms,
+                        kernel=family)
+
+    def observe_query(self, fingerprint: str, ms: float) -> None:
+        self._observe("query", fingerprint, ms)
+        METRICS.observe("presto_tpu_query_latency_ms", ms)
+
+    def snapshot_rows(self) -> List[Dict[str, Any]]:
+        """One row per tracked key — the system.runtime.latency /
+        GET /v1/latency body."""
+        with self._lock:
+            items = [(scope, key, sk)
+                     for scope, store in self._scopes.items()
+                     for key, sk in store.items()]
+        return [{"scope": scope, "key": key, **sk.snapshot()}
+                for scope, key, sk in sorted(
+                    items, key=lambda t: (t[0], t[1]))]
+
+    def sketches(self, scope: str) -> List[Tuple[str, WindowSketch]]:
+        with self._lock:
+            return list(self._scopes[scope].items())
+
+    def reset(self) -> None:
+        with self._lock:
+            for store in self._scopes.values():
+                store.clear()
+
+
+class Sentinel:
+    """The detector suite. `check()` is cheap enough for a
+    housekeeping loop: it reads counters, deques, and bounded
+    sketches — no RPC unless an `rtt_supplier` was wired."""
+
+    def __init__(self, tracker: Optional[LatencyTracker] = None,
+                 baseline: Optional[Dict[str, Any]] = None):
+        self._lock = sanitize.lock("telemetry.sentinel")
+        self.tracker = tracker if tracker is not None else TRACKER
+        self.config: Dict[str, Any] = json.loads(
+            json.dumps(DEFAULTS))  # deep copy
+        self.baseline: Dict[str, Any] = {}
+        if baseline is not None:
+            self.install_baseline(baseline)
+        #: (t_monotonic, retrace_total) samples, one per check
+        self._retrace_samples: "deque[Tuple[float, float]]" = \
+            deque(maxlen=64)
+        #: recent per-query ledger observations:
+        #: (t, driver_frac, unattributed_frac)
+        self._ledgers: "deque[Tuple[float, float, float]]" = \
+            deque(maxlen=WINDOW)
+        #: previous rotated window snapshots per (scope, key) — the
+        #: "window N minutes ago" reference when no baseline entry
+        self._prev_windows: Dict[Tuple[str, str],
+                                 Dict[str, float]] = {}
+        self._last_rotate = 0.0
+        #: (detector, subject) -> last fire t (re-alert damping)
+        self._last_fired: Dict[Tuple[str, str], float] = {}
+        self.checks = 0
+        self.alerts_recent: "deque[Dict[str, Any]]" = deque(maxlen=64)
+        #: optional: () -> [(worker_url, rtt_ms)] — wired by the
+        #: coordinator from its heartbeat monitor
+        self.rtt_supplier: Optional[
+            Callable[[], List[Tuple[str, float]]]] = None
+
+    # -- wiring -----------------------------------------------------
+
+    def install_baseline(self, doc: Dict[str, Any]) -> None:
+        """Overlay a perf_baseline.json doc: threshold keys override
+        the defaults, `kernel_families` seeds latency references."""
+        self.baseline = dict(doc or {})
+        for k in ("driver_share_max", "unattributed_frac_max",
+                  "rtt_ms_max", "min_queries", "realert_s",
+                  "rotate_s"):
+            if k in self.baseline:
+                self.config[k] = self.baseline[k]
+        for k in ("retrace_storm", "latency_shift"):
+            if isinstance(self.baseline.get(k), dict):
+                self.config[k] = {**self.config[k],
+                                  **self.baseline[k]}
+
+    def load_baseline_file(self, path: str = BASELINE_PATH) -> bool:
+        try:
+            with open(path) as f:
+                self.install_baseline(json.load(f))
+            return True
+        except Exception:  # noqa: BLE001 — baseline is optional
+            return False
+
+    def observe_ledger(self, led_doc: Dict[str, Any],
+                       now: Optional[float] = None) -> None:
+        """Feed one finished query's attribution-ledger doc (runner
+        ledger close) — the driver-share / unattributed detectors'
+        input stream."""
+        wall = float(led_doc.get("wall_ms") or 0.0)
+        if wall <= 0:
+            return
+        cats = led_doc.get("categories_ms") or {}
+        driver = sum(ms for c, ms in cats.items()
+                     if c == "driver" or c.startswith("driver."))
+        unattr = max(0.0, float(led_doc.get("unattributed_ms")
+                                or 0.0))
+        with self._lock:
+            self._ledgers.append((
+                now if now is not None else time.monotonic(),
+                driver / wall, unattr / wall))
+
+    # -- detectors --------------------------------------------------
+
+    def _fire(self, out: List[Dict[str, Any]], now: float,
+              detector: str, subject: str, value: float,
+              threshold: float, detail: str) -> None:
+        key = (detector, subject)
+        last = self._last_fired.get(key)
+        if last is not None \
+                and now - last < float(self.config["realert_s"]):
+            return
+        self._last_fired[key] = now
+        alert = {"detector": detector, "subject": subject,
+                 "value": round(value, 4),
+                 "threshold": round(threshold, 4), "detail": detail}
+        out.append(alert)
+        self.alerts_recent.append({**alert, "t": now})
+        METRICS.inc("presto_tpu_sentinel_alerts_total",
+                    detector=detector)
+        if _flight.ENABLED:
+            _flight.record("sentinel", detector, subject, detail)
+
+    def _check_retrace_storm(self, out, now) -> None:
+        cfg = self.config["retrace_storm"]
+        total = METRICS.total("presto_tpu_kernel_retrace_total")
+        self._retrace_samples.append((now, total))
+        horizon = now - float(cfg["window_s"])
+        base = None
+        for t, v in self._retrace_samples:
+            if t >= horizon:
+                base = v
+                break
+        if base is None:
+            return
+        delta = total - base
+        if delta >= cfg["count"]:
+            self._fire(out, now, "retrace_storm", "kernel_cache",
+                       delta, cfg["count"],
+                       f"{delta:.0f} XLA re-traces in the last "
+                       f"{cfg['window_s']:.0f}s (budget "
+                       f"{cfg['count']})")
+
+    def _check_ledger_windows(self, out, now) -> None:
+        with self._lock:
+            obs = list(self._ledgers)
+        if len(obs) < int(self.config["min_queries"]):
+            return
+        driver = sum(o[1] for o in obs) / len(obs)
+        unattr = sum(o[2] for o in obs) / len(obs)
+        dmax = float(self.config["driver_share_max"])
+        if driver > dmax:
+            self._fire(out, now, "driver_share_creep", "driver",
+                       driver, dmax,
+                       f"mean driver share {100 * driver:.1f}% over "
+                       f"last {len(obs)} queries (max "
+                       f"{100 * dmax:.0f}%)")
+        umax = float(self.config["unattributed_frac_max"])
+        if unattr > umax:
+            self._fire(out, now, "unattributed_spike", "ledger",
+                       unattr, umax,
+                       f"mean unattributed {100 * unattr:.1f}% over "
+                       f"last {len(obs)} queries (max "
+                       f"{100 * umax:.0f}%)")
+
+    def _latency_reference(self, scope: str,
+                           key: str) -> Optional[float]:
+        """Baseline p99 for (scope, key): the checked-in baseline
+        wins, else the rotated previous window."""
+        if scope == "kernel":
+            fam = (self.baseline.get("kernel_families") or {})
+            ent = fam.get(key)
+            if isinstance(ent, dict) and ent.get("p99_ms"):
+                return float(ent["p99_ms"])
+        prev = self._prev_windows.get((scope, key))
+        if prev and prev.get("count", 0) >= \
+                self.config["latency_shift"]["min_samples"]:
+            return float(prev["p99_ms"])
+        return None
+
+    def _check_latency_shift(self, out, now) -> None:
+        cfg = self.config["latency_shift"]
+        for scope in ("kernel", "query"):
+            for key, sk in self.tracker.sketches(scope):
+                if len(sk) < int(cfg["min_samples"]):
+                    continue
+                snap = sk.snapshot()
+                ref = self._latency_reference(scope, key)
+                if ref is None or ref <= 0:
+                    continue
+                bar = max(ref * float(cfg["mult"]),
+                          ref + float(cfg["mad_k"])
+                          * snap["mad_ms"])
+                if snap["p99_ms"] > bar:
+                    self._fire(
+                        out, now, "latency_shift",
+                        f"{scope}:{key}", snap["p99_ms"], bar,
+                        f"{scope} {key} p99 {snap['p99_ms']:.1f}ms "
+                        f"vs reference {ref:.1f}ms (bar "
+                        f"{bar:.1f}ms = max({cfg['mult']}x, "
+                        f"+{cfg['mad_k']}xMAD))")
+
+    def _check_rtt(self, out, now) -> None:
+        if self.rtt_supplier is None:
+            return
+        try:
+            probes = self.rtt_supplier() or []
+        except Exception:  # noqa: BLE001 — advisory
+            return
+        rmax = float(self.config["rtt_ms_max"])
+        for worker, rtt_ms in probes:
+            if rtt_ms is not None and rtt_ms > rmax:
+                self._fire(out, now, "rtt_inflation", str(worker),
+                           float(rtt_ms), rmax,
+                           f"heartbeat RTT {rtt_ms:.1f}ms to "
+                           f"{worker} (max {rmax:.0f}ms)")
+
+    def _rotate_windows(self, now: float) -> None:
+        """Snapshot every sketch as the next check's "window N
+        minutes ago" reference (used only when the checked-in
+        baseline has no entry for the key)."""
+        if now - self._last_rotate < float(self.config["rotate_s"]):
+            return
+        self._last_rotate = now
+        for scope in ("kernel", "query"):
+            for key, sk in self.tracker.sketches(scope):
+                if len(sk):
+                    self._prev_windows[(scope, key)] = sk.snapshot()
+
+    # -- entry points -----------------------------------------------
+
+    def check(self, now: Optional[float] = None
+              ) -> List[Dict[str, Any]]:
+        """Run every detector once; returns the alerts fired by THIS
+        call (damped ones are omitted)."""
+        now = time.monotonic() if now is None else now
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            self.checks += 1
+        self._check_retrace_storm(out, now)
+        self._check_ledger_windows(out, now)
+        self._check_latency_shift(out, now)
+        self._check_rtt(out, now)
+        self._rotate_windows(now)
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        return {
+            "checks": self.checks,
+            "baseline_loaded": bool(self.baseline),
+            "config": self.config,
+            "alerts_recent": [
+                {**{k: v for k, v in a.items() if k != "t"},
+                 "age_s": round(now - a["t"], 1)}
+                for a in list(self.alerts_recent)],
+            "alerts_total": METRICS.by_label(
+                "presto_tpu_sentinel_alerts_total", "detector"),
+        }
+
+    def reset(self) -> None:
+        """Test hygiene: forget windows, damping, and alert history
+        (the process-wide counters are monotonic by design)."""
+        with self._lock:
+            self._ledgers.clear()
+        self._retrace_samples.clear()
+        self._prev_windows.clear()
+        self._last_fired.clear()
+        self.alerts_recent.clear()
+        self.checks = 0
+
+
+#: process-wide instances (the faults.ARMED-style module singletons):
+#: kernels.py feeds TRACKER on every warm call, the runner feeds
+#: query observations + ledgers, servers expose both
+TRACKER = LatencyTracker()
+SENTINEL = Sentinel(TRACKER)
+SENTINEL.load_baseline_file()
+
+
+def observe_kernel(family: str, ms: float) -> None:
+    TRACKER.observe_kernel(family, ms)
+
+
+def observe_query(fingerprint: str, ms: float) -> None:
+    TRACKER.observe_query(fingerprint, ms)
+
+
+def observe_ledger(led_doc: Dict[str, Any]) -> None:
+    SENTINEL.observe_ledger(led_doc)
+
+
+def check() -> List[Dict[str, Any]]:
+    return SENTINEL.check()
+
+
+def snapshot_rows() -> List[Dict[str, Any]]:
+    return TRACKER.snapshot_rows()
+
+
+def reset() -> None:
+    TRACKER.reset()
+    SENTINEL.reset()

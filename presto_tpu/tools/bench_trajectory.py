@@ -1,0 +1,178 @@
+"""Cross-round bench trajectory: fold every checked-in
+``BENCH_SERVING_r*.json`` / ``BENCH_LOCAL_r*.json`` capture into one
+``BENCH_TRAJECTORY.json`` time series, so "did round N regress round
+N-1" is one file diff instead of archaeology over a dozen captures.
+
+Every row carries the environment caveats AS FIELDS — these captures
+were taken on a 1-core (occasionally 2-core) shared container across
+weeks of rounds, so absolute wall-clock across rounds is NOT an
+apples-to-apples series; the structural columns (driver share,
+unattributed fraction, fresh compiles, byte-identity) are. The
+perf-sentinel's tools/perf_diff.py gates on exactly those columns for
+the same reason.
+
+Usage:
+    python -m presto_tpu.tools.bench_trajectory [--repo DIR] [--json]
+        [--out BENCH_TRAJECTORY.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+#: absolute numbers across rounds were captured under different
+#: background load and (for early rounds) different container shapes
+#: — recorded on every row so no reader mistakes the series for a
+#: controlled benchmark
+ENV_CAVEAT = ("shared 1-core CPU container; cross-round wall-clock "
+              "is load-confounded — compare structural columns, not "
+              "absolute qps")
+
+
+def _round_no(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def _driver_share(capture: Dict[str, Any]) -> Optional[float]:
+    from presto_tpu.tools.perf_diff import driver_share
+    return driver_share(capture)
+
+
+def serving_row(path: str, doc: Dict[str, Any]) -> Dict[str, Any]:
+    warm = doc.get("warm") or {}
+    cold = doc.get("cold") or {}
+    fl = doc.get("flight_overhead") or {}
+    share = _driver_share(doc)
+    led = warm.get("ledger") or {}
+    return {
+        "round": _round_no(path),
+        "file": os.path.basename(path),
+        "kind": "serving",
+        "warm_qps": warm.get("qps"),
+        "warm_p99_ms": warm.get("p99_ms"),
+        "cold_wall_s": cold.get("wall_s"),
+        "cold_fresh_compiles": cold.get("fresh_compiles"),
+        "warm_fresh_compiles": warm.get("fresh_compiles"),
+        "driver_share": round(share, 4) if share is not None else None,
+        "unattributed_frac_max": led.get("unattributed_frac_max"),
+        "flight_overhead_frac": fl.get("overhead_frac")
+        if isinstance(fl, dict) else None,
+        "doctor_verdict": (doc.get("doctor") or {}).get("verdict"),
+        "results_identical": doc.get("results_identical"),
+        "mix": doc.get("mix"),
+        "clients": doc.get("clients"),
+        "env_caveat": ENV_CAVEAT,
+    }
+
+
+def local_row(path: str, doc: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "round": _round_no(path),
+        "file": os.path.basename(path),
+        "kind": "local",
+        "metric": doc.get("metric"),
+        "value": doc.get("value"),
+        "unit": doc.get("unit"),
+        "geomean_vs_baseline": doc.get("geomean_vs_baseline"),
+        "baseline": doc.get("baseline"),
+        "note": doc.get("note"),
+        "env_caveat": ENV_CAVEAT,
+    }
+
+
+def build(repo: str) -> Dict[str, Any]:
+    serving: List[Dict[str, Any]] = []
+    local: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(
+            os.path.join(repo, "BENCH_SERVING_r*.json")),
+            key=_round_no):
+        try:
+            with open(path) as f:
+                serving.append(serving_row(path, json.load(f)))
+        except Exception as e:  # noqa: BLE001 — one rotten capture
+            serving.append({"round": _round_no(path),
+                            "file": os.path.basename(path),
+                            "error": f"{type(e).__name__}: {e}"})
+    for path in sorted(glob.glob(
+            os.path.join(repo, "BENCH_LOCAL_r*.json")),
+            key=_round_no):
+        try:
+            with open(path) as f:
+                local.append(local_row(path, json.load(f)))
+        except Exception as e:  # noqa: BLE001
+            local.append({"round": _round_no(path),
+                          "file": os.path.basename(path),
+                          "error": f"{type(e).__name__}: {e}"})
+
+    qps = [r["warm_qps"] for r in serving
+           if r.get("warm_qps")]
+    geo = None
+    if qps:
+        prod = 1.0
+        for v in qps:
+            prod *= float(v)
+        geo = round(prod ** (1.0 / len(qps)), 3)
+    latest = next((r for r in reversed(serving)
+                   if r.get("warm_qps") is not None), None)
+    return {
+        "serving_rounds": serving,
+        "local_rounds": local,
+        "summary": {
+            "serving_rounds": len(serving),
+            "local_rounds": len(local),
+            "warm_qps_geomean_all_rounds": geo,
+            "latest_round": latest.get("round") if latest else None,
+            "latest_warm_qps": latest.get("warm_qps")
+            if latest else None,
+            "latest_driver_share": latest.get("driver_share")
+            if latest else None,
+        },
+        "env_caveat": ENV_CAVEAT,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Fold BENCH_SERVING_r*/BENCH_LOCAL_r* captures "
+                    "into one BENCH_TRAJECTORY.json series")
+    p.add_argument("--repo", default=".",
+                   help="directory holding the capture files")
+    p.add_argument("--out", default=None,
+                   help="output path (default REPO/BENCH_TRAJECTORY"
+                        ".json; '-' = stdout only)")
+    p.add_argument("--json", action="store_true",
+                   help="print the document to stdout too")
+    args = p.parse_args(argv)
+
+    doc = build(args.repo)
+    out = args.out or os.path.join(args.repo, "BENCH_TRAJECTORY.json")
+    if out != "-":
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    if args.json or out == "-":
+        print(json.dumps(doc, indent=1))
+    else:
+        s = doc["summary"]
+        print(f"{s['serving_rounds']} serving rounds, "
+              f"{s['local_rounds']} local rounds -> {out}")
+        for r in doc["serving_rounds"]:
+            if r.get("error"):
+                print(f"  r{r['round']:>2}: ERROR {r['error']}")
+                continue
+            print(f"  r{r['round']:>2}: warm {r['warm_qps']} qps  "
+                  f"p99 {r['warm_p99_ms']}ms  cold "
+                  f"{r['cold_wall_s']}s  driver "
+                  f"{r['driver_share']}  verdict "
+                  f"{r['doctor_verdict']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,261 @@
+"""Regression diff between two serving-bench captures (or a live
+server and the checked-in baseline): the CI face of the perf sentinel.
+
+The default gates are STRUCTURAL — metrics that survive a loaded,
+shared host (these benches run on a 1-core container where absolute
+wall-clock varies ~30% run to run with background load):
+
+    driver share        host-side driver/reassembly/quantum fraction
+                        of the warm ledger — creep means new Python
+                        glue on the hot path, load doesn't move it
+    unattributed frac   the attribution ledger's coverage residual —
+                        a spike means new UNTRACKED code on the path
+    warm fresh compiles a warm mix that recompiles is a retrace
+                        regression regardless of wall-clock
+    results_identical   byte-identity across the phases must not rot
+    chaos availability  fault-tolerance yield (when both ran chaos)
+    flight overhead     the always-on recorder's measured warm-QPS
+                        cost must stay within budget
+
+Absolute throughput/latency deltas are reported as WARNINGS by
+default and only gate under ``--strict`` (for same-host back-to-back
+A/B runs where wall-clock IS comparable).
+
+Usage:
+    python -m presto_tpu.tools.perf_diff A.json B.json   # A=reference
+    python -m presto_tpu.tools.perf_diff A.json B.json --strict
+    python -m presto_tpu.tools.perf_diff --server http://H:P
+    (exit 0 = no regression, 1 = regression, 2 = bad input)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                             "perf_baseline.json")
+
+#: driver-share creep gate: candidate share may exceed the reference
+#: by the LARGER of +5 points absolute or 2x relative (small shares
+#: jitter relatively; big shares jitter absolutely) before it fails.
+#: The 2x comes from the checked-in rounds themselves: r16 -> r17
+#: moved 0.162 -> 0.105 (1.55x) on identical code purely from host
+#: load, so anything tighter false-positives on healthy rounds; the
+#: absolute driver_share_max budget stays the hard line
+DRIVER_ABS_SLACK = 0.05
+DRIVER_REL_SLACK = 2.0
+#: chaos availability may drop this much before it gates (one extra
+#: lost query in a 20-query chaos mix)
+CHAOS_SLACK = 0.05
+#: --strict wall-clock tolerance (same-host A/B runs only)
+STRICT_TOL = 0.15
+
+
+def _load_baseline(path: Optional[str]) -> Dict[str, Any]:
+    try:
+        with open(path or BASELINE_PATH) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — defaults stand alone
+        return {}
+
+
+def driver_share(capture: Dict[str, Any],
+                 phase: str = "warm") -> Optional[float]:
+    """Host-driver fraction of the phase ledger: the categories the
+    doctor calls glue-by-construction (driver.*, legacy driver)."""
+    led = (capture.get(phase) or {}).get("ledger") or {}
+    wall = float(led.get("wall_ms", 0.0)) or 0.0
+    if wall <= 0:
+        return None
+    cats = led.get("categories_ms") or {}
+    drv = sum(v for c, v in cats.items()
+              if c == "driver" or c.startswith("driver."))
+    return drv / wall
+
+
+def diff_captures(ref: Dict[str, Any], cand: Dict[str, Any],
+                  baseline: Dict[str, Any],
+                  strict: bool = False) -> Dict[str, Any]:
+    """Pure diff: returns {regressions: [..], warnings: [..],
+    metrics: {..}} — the test surface; main() just renders it."""
+    regressions: List[str] = []
+    warnings: List[str] = []
+    metrics: Dict[str, Any] = {}
+
+    share_max = float(baseline.get("driver_share_max", 0.30))
+    unattr_max = float(baseline.get("unattributed_frac_max", 0.10))
+    flight_max = float(baseline.get("flight_overhead_max", 0.08))
+
+    # driver-share creep (warm phase = the serving steady state)
+    s_ref = driver_share(ref)
+    s_cand = driver_share(cand)
+    metrics["driver_share"] = {"ref": s_ref, "cand": s_cand}
+    if s_cand is not None:
+        if s_cand > share_max:
+            regressions.append(
+                f"warm driver share {s_cand:.3f} exceeds the absolute "
+                f"budget {share_max:.2f}")
+        elif s_ref is not None and s_cand > max(
+                s_ref + DRIVER_ABS_SLACK, s_ref * DRIVER_REL_SLACK):
+            regressions.append(
+                f"warm driver share crept {s_ref:.3f} -> {s_cand:.3f} "
+                f"(allowed max({s_ref:.3f}+{DRIVER_ABS_SLACK}, "
+                f"{DRIVER_REL_SLACK}x))")
+
+    # unattributed-ratio spike
+    for phase in ("warm", "cold"):
+        led = (cand.get(phase) or {}).get("ledger") or {}
+        frac = led.get("unattributed_frac_max")
+        if frac is None:
+            continue
+        metrics[f"unattributed_frac_max.{phase}"] = frac
+        if float(frac) > unattr_max:
+            regressions.append(
+                f"{phase} unattributed_frac_max {frac} exceeds "
+                f"{unattr_max} — new untracked code on the path")
+
+    # retrace regression: a warm mix must not recompile more than the
+    # reference did (counts are load-invariant — XLA retraces on
+    # structure, not on wall-clock)
+    fc_ref = (ref.get("warm") or {}).get("fresh_compiles")
+    fc_cand = (cand.get("warm") or {}).get("fresh_compiles")
+    metrics["warm_fresh_compiles"] = {"ref": fc_ref, "cand": fc_cand}
+    if fc_ref is not None and fc_cand is not None \
+            and int(fc_cand) > int(fc_ref):
+        regressions.append(
+            f"warm fresh compiles grew {fc_ref} -> {fc_cand} "
+            f"(retrace regression)")
+
+    # byte-identity must not rot
+    if ref.get("results_identical") is True \
+            and cand.get("results_identical") is False:
+        regressions.append("results_identical went True -> False")
+    metrics["results_identical"] = cand.get("results_identical")
+
+    # chaos availability (both sides must have run the phase)
+    av_ref = (ref.get("chaos") or {}).get("availability") \
+        if isinstance(ref.get("chaos"), dict) else None
+    av_cand = (cand.get("chaos") or {}).get("availability") \
+        if isinstance(cand.get("chaos"), dict) else None
+    if av_ref is not None and av_cand is not None:
+        metrics["chaos_availability"] = {"ref": av_ref,
+                                         "cand": av_cand}
+        if float(av_cand) < float(av_ref) - CHAOS_SLACK:
+            regressions.append(
+                f"chaos availability dropped {av_ref} -> {av_cand}")
+
+    # flight-recorder overhead budget (measured A/B in the capture)
+    ov = (cand.get("flight_overhead") or {}).get("overhead_frac") \
+        if isinstance(cand.get("flight_overhead"), dict) else None
+    if ov is not None:
+        metrics["flight_overhead_frac"] = ov
+        if float(ov) > flight_max:
+            regressions.append(
+                f"flight recorder overhead {ov} exceeds the "
+                f"{flight_max} budget")
+
+    # wall-clock deltas: warnings by default, gates under --strict
+    for label, path_, higher_is_worse in (
+            ("warm qps", ("warm", "qps"), False),
+            ("warm p99_ms", ("warm", "p99_ms"), True),
+            ("cold wall_s", ("cold", "wall_s"), True)):
+        r = (ref.get(path_[0]) or {}).get(path_[1])
+        c = (cand.get(path_[0]) or {}).get(path_[1])
+        if r is None or c is None or float(r) == 0:
+            continue
+        delta = (float(c) - float(r)) / float(r)
+        metrics[label.replace(" ", "_")] = {
+            "ref": r, "cand": c, "delta_frac": round(delta, 4)}
+        worse = delta > STRICT_TOL if higher_is_worse \
+            else delta < -STRICT_TOL
+        if worse:
+            msg = (f"{label} moved {r} -> {c} "
+                   f"({100 * delta:+.1f}%)")
+            if strict:
+                regressions.append(msg)
+            else:
+                warnings.append(
+                    msg + " [warn-only: shared-host wall-clock; "
+                          "use --strict for same-host A/B]")
+
+    return {"regressions": regressions, "warnings": warnings,
+            "metrics": metrics}
+
+
+def diff_live(server: str,
+              baseline: Dict[str, Any]) -> Dict[str, Any]:
+    """Live mode: ask the coordinator's sentinel (which already
+    compares its streaming windows against this same baseline) for
+    alerts; any recent alert is a regression."""
+    from presto_tpu.server.node import http_get
+    doc = json.loads(http_get(
+        f"{server.rstrip('/')}/v1/sentinel", timeout=10))
+    regs = [f"sentinel alert: {a.get('detector')} "
+            f"[{a.get('subject')}] {a.get('detail')}"
+            for a in (doc.get("alerts_recent") or [])]
+    return {"regressions": regs, "warnings": [],
+            "metrics": {"sentinel": {
+                "checks": doc.get("checks"),
+                "baseline_loaded": doc.get("baseline_loaded"),
+                "latency_rows": len(doc.get("latency") or [])}}}
+
+
+def _render(out: Dict[str, Any]) -> str:
+    lines = []
+    for k, v in sorted(out["metrics"].items()):
+        lines.append(f"  {k:<28} {v}")
+    for w in out["warnings"]:
+        lines.append(f"  WARN: {w}")
+    for r in out["regressions"]:
+        lines.append(f"  REGRESSION: {r}")
+    lines.append("verdict: " + (
+        "REGRESSION" if out["regressions"] else "OK"))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Noise-aware perf regression diff between two "
+                    "serving captures, or a live sentinel check")
+    p.add_argument("captures", nargs="*",
+                   help="reference.json candidate.json")
+    p.add_argument("--server", help="live mode: coordinator url "
+                                    "(GET /v1/sentinel)")
+    p.add_argument("--baseline", help="threshold file "
+                                      "(default tools/perf_baseline"
+                                      ".json)")
+    p.add_argument("--strict", action="store_true",
+                   help="gate on absolute wall-clock deltas too "
+                        "(same-host back-to-back runs only)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    baseline = _load_baseline(args.baseline)
+    if args.server:
+        out = diff_live(args.server, baseline)
+    elif len(args.captures) == 2:
+        try:
+            with open(args.captures[0]) as f:
+                ref = json.load(f)
+            with open(args.captures[1]) as f:
+                cand = json.load(f)
+        except Exception as e:  # noqa: BLE001
+            print(f"error: {e}")
+            return 2
+        out = diff_captures(ref, cand, baseline, strict=args.strict)
+    else:
+        p.error("need two capture files, or --server URL")
+        return 2  # unreachable; argparse exits
+
+    if args.json:
+        print(json.dumps(out, indent=1))
+    else:
+        print(_render(out))
+    return 1 if out["regressions"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,42 +46,103 @@ VERDICT_GROUPS: Dict[str, Tuple[str, ...]] = {
 }
 
 
-def diagnose(ledger: Dict[str, Any]) -> Dict[str, Any]:
+def _group_shares(cats: Dict[str, float],
+                  residual: float) -> Dict[str, float]:
+    shares: Dict[str, float] = {}
+    claimed = set()
+    for verdict, group in VERDICT_GROUPS.items():
+        shares[verdict] = sum(cats.get(c, 0.0) for c in group)
+        claimed.update(group)
+    # categories no group claims (new ledger keys, critical-path
+    # extras like exchange.all_to_all) fold into the group whose
+    # prefix they extend, else glue — same contract as unattributed
+    for c, v in cats.items():
+        if c in claimed:
+            continue
+        for verdict, group in VERDICT_GROUPS.items():
+            if any(c.startswith(g + ".") for g in group):
+                shares[verdict] += v
+                break
+        else:
+            shares["glue"] += v
+    shares["glue"] += max(0.0, residual)
+    return shares
+
+
+def diagnose(ledger: Dict[str, Any],
+             critical_path: Optional[Dict[str, Any]] = None
+             ) -> Dict[str, Any]:
     """Verdict + per-group shares from one attribution-ledger doc.
-    Pure function — the test surface."""
+    Pure function — the test surface.
+
+    When a critical-path doc rides along, the VERDICT comes from the
+    blocking chain's categories, not the ledger's totals: 70% of wall
+    spent in dispatch OFF the critical path (concurrent lanes the
+    query never waited on) must not drive the diagnosis. The ledger's
+    own verdict survives as ``ledger_verdict`` and the coverage gap
+    between the chain and wall counts as glue (time the blocking
+    analysis could not pin is host residue by definition)."""
     wall = float(ledger.get("wall_ms", 0.0)) or 0.0
     cats = dict(ledger.get("categories_ms", {}))
     unattr = max(0.0, float(ledger.get("unattributed_ms", 0.0)))
-    shares: Dict[str, float] = {}
-    for verdict, group in VERDICT_GROUPS.items():
-        shares[verdict] = sum(cats.get(c, 0.0) for c in group)
-    shares["glue"] += unattr
+    shares = _group_shares(cats, unattr)
     total = sum(shares.values()) or 1.0
     fracs = {k: v / total for k, v in shares.items()}
     verdict = max(fracs, key=lambda k: fracs[k])
-    return {
+    out = {
         "verdict": verdict,
+        "verdict_source": "ledger",
         "shares_ms": {k: round(v, 3) for k, v in shares.items()},
         "shares_frac": {k: round(v, 4) for k, v in fracs.items()},
         "wall_ms": wall,
         "unattributed_ms": round(unattr, 3),
         "unattributed_frac": ledger.get("unattributed_frac"),
     }
+    cp_cats = dict((critical_path or {}).get("categories_ms", {}))
+    if cp_cats:
+        cp_wall = float(critical_path.get("wall_ms", 0.0)) or 0.0
+        gap = max(0.0, cp_wall - sum(cp_cats.values()))
+        cp_shares = _group_shares(cp_cats, gap)
+        cp_total = sum(cp_shares.values()) or 1.0
+        cp_fracs = {k: v / cp_total for k, v in cp_shares.items()}
+        out["ledger_verdict"] = verdict
+        out["verdict"] = max(cp_fracs, key=lambda k: cp_fracs[k])
+        out["verdict_source"] = "critical_path"
+        out["critical_path_shares_ms"] = {
+            k: round(v, 3) for k, v in cp_shares.items()}
+        out["critical_path_shares_frac"] = {
+            k: round(v, 4) for k, v in cp_fracs.items()}
+    return out
 
 
 def render(stats: Dict[str, Any],
            flight: Optional[List[dict]] = None) -> str:
     lines = []
     ledger = (stats or {}).get("ledger")
+    cp = (stats or {}).get("critical_path")
     if ledger:
         from presto_tpu.telemetry.stats import render_ledger
         lines.append(render_ledger(ledger))
-        d = diagnose(ledger)
+        d = diagnose(ledger, critical_path=cp)
+        if cp:
+            from presto_tpu.telemetry import critical_path as _cpm
+            lines.append("")
+            lines.append(_cpm.render(cp))
         lines.append("")
-        lines.append("verdict: " + d["verdict"].upper())
+        lines.append(f"verdict: {d['verdict'].upper()}  "
+                     f"(from {d['verdict_source']})")
+        shares_key = ("critical_path_shares_ms"
+                      if d["verdict_source"] == "critical_path"
+                      else "shares_ms")
+        fracs_key = shares_key.replace("_ms", "_frac")
         for k in ("queueing", "kernel", "exchange", "glue"):
-            lines.append(f"  {k:<9} {d['shares_ms'][k]:>10.1f}ms  "
-                         f"{100 * d['shares_frac'][k]:5.1f}%")
+            lines.append(f"  {k:<9} {d[shares_key][k]:>10.1f}ms  "
+                         f"{100 * d[fracs_key][k]:5.1f}%")
+        if d.get("ledger_verdict") and \
+                d["ledger_verdict"] != d["verdict"]:
+            lines.append(f"  (ledger totals alone would say "
+                         f"{d['ledger_verdict'].upper()} — that time "
+                         f"ran off the blocking chain)")
     else:
         lines.append("no attribution ledger in stats "
                      "(pre-ledger server or non-query statement)")
@@ -141,7 +202,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         ledger = stats.get("ledger")
         out = {"verdict": None, "stats": stats}
         if ledger:
-            out.update(diagnose(ledger))
+            out.update(diagnose(
+                ledger, critical_path=stats.get("critical_path")))
         print(json.dumps(out, indent=1))
     else:
         print(render(stats, flight_events))

@@ -65,6 +65,7 @@ from __future__ import annotations
 import argparse
 import hashlib
 import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -197,6 +198,62 @@ def _doctor_verdict(warm_stats: dict,
             f"is {d['verdict']} (shares: "
             + json.dumps(d["shares_frac"]) + ")")
     return d
+
+
+def _run_critical_path_phase(coord, work: List[Tuple[str, str]],
+                             tolerance: float = 0.05) -> dict:
+    """Each mix query once, traced, through the live coordinator: the
+    blocking-chain extraction must produce a critical path whose
+    segments sum to wall within `tolerance` for EVERY query (the
+    machine-checked contract of telemetry/critical_path.py), and the
+    per-query category decomposition rides the capture so a round's
+    "where did warm latency go" is answerable from the JSON alone."""
+    from presto_tpu.server.coordinator import StatementClient
+    from presto_tpu.telemetry import critical_path as _cp
+    runner = coord._runner()
+    prev = runner.session.properties.get("query_trace_enabled")
+    runner.session.properties["query_trace_enabled"] = True
+    per_query: Dict[str, dict] = {}
+    failures: List[str] = []
+    try:
+        c = StatementClient(coord.url, user="bench-cp",
+                            source="serving_bench")
+        for name, sql in work:
+            known = set(coord.queries)
+            c.execute(sql, timeout=600.0)
+            qid = next((i for i in coord.queries
+                        if i not in known), None)
+            doc = ((coord.queries[qid].stats or {})
+                   .get("critical_path")) if qid else None
+            if not doc:
+                failures.append(f"{name}: traced query produced no "
+                                f"critical-path doc")
+                continue
+            ok, detail = _cp.verify(doc, tolerance)
+            if not ok:
+                failures.append(f"{name}: {detail}")
+            cats = doc.get("categories_ms") or {}
+            per_query[name] = {
+                "wall_ms": doc.get("wall_ms"),
+                "coverage": doc.get("coverage"),
+                "verified": ok,
+                "categories_ms": dict(list(cats.items())[:6]),
+                "summary": _cp.render(doc).splitlines()[0],
+            }
+    finally:
+        if prev is None:
+            runner.session.properties.pop("query_trace_enabled",
+                                          None)
+        else:
+            runner.session.properties["query_trace_enabled"] = prev
+    out = {"tolerance": tolerance, "queries": per_query,
+           "failures": failures, "verified_all": not failures}
+    if failures:
+        # the sum-to-wall invariant is the whole point of the
+        # extraction — a query it fails on is a bench failure
+        raise RuntimeError("critical-path phase failed: "
+                           + json.dumps(out, indent=1))
+    return out
 
 
 def _run_phase(url: str, assignments: List[List[Tuple[str, str]]],
@@ -926,6 +983,9 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         # serving-mix diagnosis (and the --assert-verdict CI gate)
         # over the warm phase's aggregated attribution ledger
         doctor = _doctor_verdict(warm, assert_verdict)
+        # critical-path phase: each mix query once, traced, with the
+        # blocking-chain sum-to-wall invariant machine-checked
+        critical = _run_critical_path_phase(coord, work)
         # flight-recorder overhead A/B: ALTERNATING warm rounds with
         # recording on/off, medians compared (single adjacent rounds
         # on a loaded 1-core box are dominated by run-to-run noise —
@@ -1246,6 +1306,7 @@ def _serving_bench(clients: int, schema: str, mix: Sequence[str],
         "cold": cold,
         "warm": warm,
         "doctor": doctor,
+        "critical_path": critical,
         "flight_overhead": flight_doc,
         "caches_off": off,
         "restart_warm": restart,
@@ -1350,6 +1411,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "per-device attribution and exchange "
                         "bytes/row (docs/SHARDING.md)")
     p.add_argument("--mesh-rounds", type=int, default=2)
+    p.add_argument("--check-regressions", action="store_true",
+                   help="after the run, diff this capture against the "
+                        "newest checked-in BENCH_SERVING_r*.json with "
+                        "tools/perf_diff.py's structural gates; a "
+                        "regression makes the bench exit nonzero")
+    p.add_argument("--regression-ref", default=None,
+                   help="explicit reference capture for "
+                        "--check-regressions (default: the newest "
+                        "BENCH_SERVING_r*.json in the cwd)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
     doc = run_serving_bench(
@@ -1377,6 +1447,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    if args.check_regressions:
+        # the sentinel's CI gate: structural (load-invariant) diff of
+        # this capture against the previous round's
+        import glob as _glob
+        import re as _re
+        from presto_tpu.tools.perf_diff import (
+            _load_baseline, _render, diff_captures,
+        )
+        ref_path = args.regression_ref
+        if ref_path is None:
+            # newest checked-in round that is NOT this run's output —
+            # a fresh capture must diff against its predecessor
+            own = os.path.abspath(args.out) if args.out else None
+            rounds = sorted(
+                (p_ for p_ in _glob.glob("BENCH_SERVING_r*.json")
+                 if os.path.abspath(p_) != own),
+                key=lambda p_: int(
+                    (_re.search(r"_r(\d+)", p_) or [0, 0])[1]))
+            ref_path = rounds[-1] if rounds else None
+        if ref_path is None:
+            print("check-regressions: no reference capture found")
+        else:
+            with open(ref_path) as f:
+                ref_doc = json.load(f)
+            out = diff_captures(ref_doc, doc, _load_baseline(None))
+            print(f"check-regressions vs {ref_path}:")
+            print(_render(out))
+            if out["regressions"]:
+                return 1
     return 0
 
 

@@ -1,0 +1,105 @@
+"""Tier-1 wall-clock budget watchdog: parse pytest ``--durations``
+output and report/gate the slowest tests, so the suite's 870s CI cap
+is defended by a tool instead of by noticing the timeout fire.
+
+pytest's slowest-durations block looks like::
+
+    ============= slowest 50 durations =============
+    12.34s call     tests/test_serving.py::test_warm_mix
+    0.05s setup    tests/test_serving.py::test_warm_mix
+    (142 durations < 0.005s hidden.  Use -vv to show these durations.)
+
+Only ``call`` phases count against the ceiling — setup/teardown
+share fixtures across tests and would double-charge them.
+
+Usage:
+    pytest tests/ -q --durations=50 | \\
+        python -m presto_tpu.tools.test_budget --ceiling 30
+    python -m presto_tpu.tools.test_budget --file durations.txt
+    (exit 0 = within budget, 1 = a test broke the ceiling)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: ``12.34s call     tests/test_x.py::test_y[param]``
+_LINE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S.*?)\s*$")
+
+
+def parse_durations(text: str) -> List[Tuple[float, str, str]]:
+    """All ``(seconds, phase, test_id)`` rows in pytest output, any
+    phase, sorted slowest first. Pure function — the test surface."""
+    rows = []
+    for line in text.splitlines():
+        m = _LINE.match(line)
+        if m:
+            rows.append((float(m.group(1)), m.group(2), m.group(3)))
+    rows.sort(key=lambda r: -r[0])
+    return rows
+
+
+def over_ceiling(rows: List[Tuple[float, str, str]],
+                 ceiling_s: float) -> List[Tuple[float, str, str]]:
+    """The ``call``-phase rows that individually exceed the ceiling."""
+    return [r for r in rows if r[1] == "call" and r[0] > ceiling_s]
+
+
+def report(rows: List[Tuple[float, str, str]],
+           top: int = 20) -> str:
+    calls = [r for r in rows if r[1] == "call"]
+    lines = [f"top {min(top, len(calls))} slowest tests "
+             f"(call phase; {sum(r[0] for r in calls):.1f}s total "
+             f"across {len(calls)} measured):"]
+    for secs, _, test in calls[:top]:
+        lines.append(f"  {secs:>8.2f}s  {test}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Parse pytest --durations output; report the "
+                    "slowest tests and gate on a per-test ceiling")
+    p.add_argument("--file", help="saved pytest output "
+                                  "(default: stdin)")
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--ceiling", type=float, default=None,
+                   help="fail (exit 1) if any single test's call "
+                        "phase exceeds this many seconds")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.file:
+        with open(args.file) as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    rows = parse_durations(text)
+    breaches = over_ceiling(rows, args.ceiling) \
+        if args.ceiling is not None else []
+
+    if args.json:
+        doc: Dict = {
+            "tests_measured": sum(1 for r in rows if r[1] == "call"),
+            "top": [{"seconds": s, "phase": ph, "test": t}
+                    for s, ph, t in rows[:args.top]],
+            "ceiling_s": args.ceiling,
+            "breaches": [{"seconds": s, "test": t}
+                         for s, _, t in breaches],
+        }
+        print(json.dumps(doc, indent=1))
+    else:
+        print(report(rows, args.top))
+        for secs, _, test in breaches:
+            print(f"  CEILING BREACH: {test} took {secs:.2f}s "
+                  f"(> {args.ceiling}s)")
+    return 1 if breaches else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,242 @@
+"""Critical-path extraction (telemetry/critical_path.py): hand-built
+span trees with a machine-checked sum-to-wall invariant, the
+query_doctor's on-path verdict, and the live single-node surfaces
+(traced queries, EXPLAIN ANALYZE). The 2-worker fleet pin lives in
+test_fleet_trace.py, which already owns a subprocess fleet."""
+
+import pytest
+
+from presto_tpu.telemetry import critical_path as cp
+
+
+def ev(name, cat, start_ms, dur_ms, pid=1, tid=0):
+    """Chrome "X" event with ms inputs (trace stores µs)."""
+    return {"name": name, "cat": cat, "ph": "X",
+            "ts": start_ms * 1e3, "dur": dur_ms * 1e3,
+            "pid": pid, "tid": tid}
+
+
+def cats_sum(doc):
+    return sum(doc["categories_ms"].values())
+
+
+def seg_sum(doc):
+    return sum(s["dur_ms"] for s in doc["segments"])
+
+
+def test_nested_tree_partitions_wall():
+    events = [
+        ev("query", "query", 0, 100),
+        ev("kernel:agg_step", "compile", 10, 30),
+        ev("op:scan:lineitem.get_output", "operator", 50, 20),
+    ]
+    doc = cp.extract(events)
+    assert doc["wall_ms"] == pytest.approx(100.0)
+    assert doc["coverage"] == pytest.approx(1.0)
+    assert cats_sum(doc) == pytest.approx(100.0, rel=1e-6)
+    assert seg_sum(doc) == pytest.approx(100.0, rel=1e-6)
+    assert doc["categories_ms"]["compile"] == pytest.approx(30.0)
+    assert doc["categories_ms"]["scan"] == pytest.approx(20.0)
+    # root self-time (the gaps between children) is executor glue
+    assert doc["categories_ms"]["driver.quantum"] == \
+        pytest.approx(50.0)
+    ok, detail = cp.verify(doc)
+    assert ok, detail
+
+
+def test_deep_nesting_attributes_innermost_blocker():
+    # query > task > kernel: the blocking chain must bottom out in
+    # the kernel span, not stop at the task lane
+    events = [
+        ev("query", "query", 0, 100),
+        ev("task", "task", 10, 80),
+        ev("kernel:join_probe", "execute", 20, 60),
+    ]
+    doc = cp.extract(events)
+    assert cats_sum(doc) == pytest.approx(100.0, rel=1e-6)
+    assert doc["categories_ms"]["dispatch"] == pytest.approx(60.0)
+    # task self-time: [10,20] + [80,90]; root: [0,10] + [90,100]
+    assert doc["categories_ms"]["driver.quantum"] == \
+        pytest.approx(40.0)
+
+
+def test_parallel_lanes_latest_ending_blocks():
+    # two overlapping kernels on parallel lanes: only the portions
+    # that actually gated completion land on the path — the ledger
+    # would book 50 + 60 = 110ms of thread-time against a 100ms wall,
+    # the critical path must book exactly 100
+    events = [
+        ev("query", "query", 0, 100),
+        ev("kernel:a", "execute", 10, 50, tid=1),   # [10, 60]
+        ev("kernel:b", "execute", 20, 60, tid=2),   # [20, 80]
+    ]
+    doc = cp.extract(events)
+    assert cats_sum(doc) == pytest.approx(100.0, rel=1e-6)
+    # the stitcher nests a (50ms) under its smallest strictly-longer
+    # overlap b (60ms); the walk credits a while both ran ([20,60])
+    # and b for its solo tail ([60,80]) — NEVER 50+60=110ms of
+    # thread-time against the 100ms wall like the ledger would
+    assert doc["categories_ms"]["dispatch"] == pytest.approx(60.0)
+    assert doc["categories_ms"]["driver.quantum"] == \
+        pytest.approx(40.0)
+    by_name = {}
+    for s in doc["segments"]:
+        by_name[s["name"]] = by_name.get(s["name"], 0.0) + s["dur_ms"]
+    assert by_name["kernel:a"] == pytest.approx(40.0)
+    assert by_name["kernel:b"] == pytest.approx(20.0)
+
+
+def test_multi_worker_clock_offset_clamped():
+    # a remote lane (worker pid=2) whose clock-offset-shifted span
+    # pokes past its coordinator-side task span: the walk clamps it
+    # to the interval it can have blocked and the invariant holds
+    events = [
+        ev("query", "query", 0, 100, pid=1),
+        ev("task", "task", 10, 80, pid=1),          # [10, 90]
+        ev("kernel:join", "execute", 15, 78, pid=2, tid=5),  # [15,93]
+    ]
+    doc = cp.extract(events)
+    assert cats_sum(doc) == pytest.approx(100.0, rel=1e-6)
+    ok, detail = cp.verify(doc, tolerance=0.05)
+    assert ok, detail
+    # the remote span is clipped at the task's end (90), so dispatch
+    # gets [15,90] = 75ms, never the off-clock tail
+    assert doc["categories_ms"]["dispatch"] == pytest.approx(75.0)
+
+
+def test_two_worker_lanes_merge_onto_one_path():
+    # fleet-merged shape: two worker pids, each with its own task
+    # lane under the coordinator root — sum-to-wall across processes
+    events = [
+        ev("query", "query", 0, 200, pid=1),
+        ev("task", "task", 10, 90, pid=2, tid=1),    # [10, 100]
+        ev("kernel:scan_w1", "execute", 20, 70, pid=2, tid=2),
+        ev("task", "task", 50, 140, pid=3, tid=1),   # [50, 190]
+        ev("kernel:scan_w2", "execute", 60, 120, pid=3, tid=2),
+    ]
+    doc = cp.extract(events)
+    assert doc["wall_ms"] == pytest.approx(200.0)
+    assert cats_sum(doc) == pytest.approx(200.0, rel=1e-6)
+    ok, detail = cp.verify(doc)
+    assert ok, detail
+
+
+def test_verify_rejects_uncovered_doc():
+    ok, detail = cp.verify({"wall_ms": 100.0,
+                            "categories_ms": {"scan": 50.0}})
+    assert not ok
+    assert "50.0ms" in detail
+    assert cp.verify(None)[0] is False
+    assert cp.verify({"wall_ms": 0.0, "categories_ms": {}})[0] is False
+
+
+def test_extract_degenerate_inputs():
+    assert cp.extract([]) is None
+    # zero-duration spans are not a usable timeline
+    assert cp.extract([ev("query", "query", 0, 0)]) is None
+    # no span named "query": fall back to the longest root
+    doc = cp.extract([ev("task", "task", 0, 50),
+                      ev("kernel:x", "execute", 10, 20)])
+    assert doc is not None and doc["wall_ms"] == pytest.approx(50.0)
+
+
+def test_render_chain_and_top_blockers():
+    doc = cp.extract([
+        ev("query", "query", 0, 100),
+        ev("kernel:agg", "compile", 0, 90),
+    ])
+    text = cp.render(doc)
+    assert text.startswith("critical path")
+    assert "compile 90%" in text
+    assert "kernel:agg" in text
+    assert cp.render(None) == "critical path: (no trace spans)"
+
+
+def test_segment_cap_keeps_category_mass():
+    # far more spans than MAX_SEGMENTS: the segment list truncates,
+    # the category totals still cover the wall
+    events = [ev("query", "query", 0, 4000.0)]
+    for i in range(400):
+        events.append(ev(f"kernel:k{i}", "execute", i * 10.0, 9.0))
+    doc = cp.extract(events)
+    assert doc["segments_dropped"] > 0
+    assert len(doc["segments"]) <= cp.MAX_SEGMENTS
+    assert cats_sum(doc) == pytest.approx(4000.0, rel=1e-4)
+
+
+def test_doctor_verdict_follows_the_path_not_the_totals():
+    # the ISSUE's motivating case: 70% of thread-time in dispatch OFF
+    # the critical path must not drive the diagnosis
+    from presto_tpu.tools.query_doctor import diagnose
+    ledger = {"wall_ms": 1000.0,
+              "categories_ms": {"dispatch": 700.0, "scan": 100.0},
+              "unattributed_ms": 0.0}
+    path = {"wall_ms": 1000.0,
+            "categories_ms": {"scan": 800.0, "dispatch": 100.0}}
+    d = diagnose(ledger)
+    assert d["verdict"] == "kernel"
+    assert d["verdict_source"] == "ledger"
+    d = diagnose(ledger, critical_path=path)
+    assert d["verdict"] == "glue"  # scan-side: host datagen
+    assert d["verdict_source"] == "critical_path"
+    assert d["ledger_verdict"] == "kernel"
+    # the coverage gap (100ms the chain couldn't pin) counts as glue
+    assert d["critical_path_shares_ms"]["glue"] == \
+        pytest.approx(900.0)
+
+
+def test_doctor_render_shows_path_section():
+    from presto_tpu.tools.query_doctor import render
+    stats = {
+        "ledger": {"wall_ms": 100.0,
+                   "categories_ms": {"dispatch": 90.0},
+                   "unattributed_ms": 0.0},
+        "critical_path": {
+            "wall_ms": 100.0,
+            "categories_ms": {"scan": 95.0},
+            "segments": [{"name": "op:scan:l.get_output",
+                          "category": "scan", "start_ms": 0.0,
+                          "dur_ms": 95.0}]},
+    }
+    text = render(stats)
+    assert "critical path" in text
+    assert "(from critical_path)" in text
+    assert "ledger totals alone would say KERNEL" in text
+
+
+# -- live single-node surfaces -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_runner():
+    from presto_tpu.runner import LocalRunner
+    return LocalRunner("tpch", "tiny",
+                       {"query_trace_enabled": True})
+
+
+def test_traced_query_carries_verified_path(traced_runner):
+    res = traced_runner.execute(
+        "select returnflag, count(*) from lineitem "
+        "group by returnflag")
+    doc = (res.query_stats or {}).get("critical_path")
+    assert doc is not None
+    ok, detail = cp.verify(doc, tolerance=0.05)
+    assert ok, detail
+    assert doc["segments"]
+    # the blocking chain speaks the ledger's vocabulary
+    led_cats = set((res.query_stats.get("ledger") or {})
+                   .get("categories_ms", {}))
+    assert led_cats  # the ledger closed
+    known = {"queued", "planning", "scan", "h2d", "compile",
+             "dispatch", "device_wait", "d2h", "serde", "exchange",
+             "exchange.all_to_all", "spool", "retry_backoff",
+             "prefetch", "driver.step", "driver.reassembly",
+             "driver.quantum"}
+    assert set(doc["categories_ms"]) <= known
+
+
+def test_explain_analyze_renders_critical_path(traced_runner):
+    res = traced_runner.execute(
+        "explain analyze select count(*) from region")
+    text = "\n".join(r[0] for r in res.rows())
+    assert "critical path (sum==wall within" in text

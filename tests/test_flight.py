@@ -60,6 +60,56 @@ def test_injected_fault_snapshot_rides_error_payload():
     faults.disarm()
 
 
+def test_sampling_lever_keeps_one_in_n_and_counts_losses():
+    from presto_tpu.telemetry import flight
+    from presto_tpu.telemetry.metrics import METRICS
+    before = METRICS.by_label("presto_tpu_flight_dropped_total",
+                              "reason").get("sampled", 0)
+    prev = flight.set_sampling({"retry": 4})
+    try:
+        for i in range(12):
+            flight.record("retry", "task", i)
+        for i in range(5):
+            flight.record("query", "FINISHED", i)  # unsampled kind
+        st = flight.stats()
+        # 12 retry events at 1-in-4 -> 3 kept, 9 sampled out; the
+        # query class is untouched
+        assert st["sampled_out"] == 9
+        assert st["total"] == 17
+        assert st["size"] == 8
+        assert st["sampling"] == {"retry": 4}
+        kept = [e for e in flight.snapshot() if e[1] == "retry"]
+        assert [e[3] for e in kept] == [0, 4, 8]
+        assert sum(1 for e in flight.snapshot()
+                   if e[1] == "query") == 5
+        after = METRICS.by_label("presto_tpu_flight_dropped_total",
+                                 "reason")["sampled"]
+        assert after == before + 9
+        # rates survive a ring reset (configuration, not state) and
+        # set_sampling returns the previous rates for restore
+        flight.reset()
+        assert flight.stats()["sampling"] == {"retry": 4}
+        assert flight.set_sampling(prev) == {"retry": 4}
+    finally:
+        flight.set_sampling(prev)
+
+
+def test_ring_full_loss_reason_is_counted():
+    from presto_tpu.telemetry import flight
+    from presto_tpu.telemetry.metrics import METRICS
+    before = METRICS.by_label("presto_tpu_flight_dropped_total",
+                              "reason").get("ring_full", 0)
+    for i in range(flight.RING_SIZE + 7):
+        flight.record("query", "FINISHED", i)
+    after = METRICS.by_label("presto_tpu_flight_dropped_total",
+                             "reason")["ring_full"]
+    assert after == before + 7
+    # n <= 1 sampling entries mean "keep everything" and are dropped
+    prev = flight.set_sampling({"query": 1, "task": 0})
+    assert flight.stats()["sampling"] == {}
+    flight.set_sampling(prev)
+
+
 def test_coordinator_flight_surfaces():
     """GET /v1/flight serves the live ring; a FAILED query's flight
     window rides GET /v1/query/{id} AND the client-protocol error

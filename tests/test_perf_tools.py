@@ -1,0 +1,184 @@
+"""The perf sentinel's CI-facing tools: perf_diff (capture regression
+gate), test_budget (tier-1 wall-clock watchdog), bench_trajectory
+(cross-round series). Pure-function surfaces plus the real checked-in
+captures as fixtures."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from presto_tpu.tools import bench_trajectory, perf_diff, test_budget
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    with open(os.path.join(REPO, name)) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def r16():
+    return _load("BENCH_SERVING_r16.json")
+
+
+@pytest.fixture(scope="module")
+def r17():
+    return _load("BENCH_SERVING_r17.json")
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return perf_diff._load_baseline(None)
+
+
+# -- perf_diff ---------------------------------------------------------
+
+
+def test_diff_real_rounds_is_clean(r16, r17, baseline):
+    """The acceptance pin: r16 -> r17 was a healthy round whose
+    wall-clock moved with background load — the structural gates must
+    pass (warnings allowed, regressions not)."""
+    out = perf_diff.diff_captures(r16, r17, baseline)
+    assert out["regressions"] == []
+    assert out["metrics"]["driver_share"]["cand"] is not None
+
+
+def test_diff_flags_driver_share_creep(r16, r17, baseline):
+    doctored = copy.deepcopy(r17)
+    led = doctored["warm"]["ledger"]
+    led["categories_ms"]["driver.step"] = \
+        0.9 * float(led["wall_ms"])
+    out = perf_diff.diff_captures(r16, doctored, baseline)
+    assert any("driver share" in r for r in out["regressions"])
+
+
+def test_diff_flags_unattributed_spike(r16, r17, baseline):
+    doctored = copy.deepcopy(r17)
+    doctored["warm"]["ledger"]["unattributed_frac_max"] = 0.5
+    out = perf_diff.diff_captures(r16, doctored, baseline)
+    assert any("unattributed" in r for r in out["regressions"])
+
+
+def test_diff_flags_retrace_and_identity_rot(r16, r17, baseline):
+    doctored = copy.deepcopy(r17)
+    doctored["warm"]["fresh_compiles"] = \
+        int(r16["warm"]["fresh_compiles"]) + 5
+    doctored["results_identical"] = False
+    out = perf_diff.diff_captures(r16, doctored, baseline)
+    assert any("fresh compiles grew" in r for r in out["regressions"])
+    assert any("results_identical" in r for r in out["regressions"])
+
+
+def test_diff_flags_flight_overhead_budget(r16, r17, baseline):
+    doctored = copy.deepcopy(r17)
+    doctored["flight_overhead"] = {"overhead_frac": 0.5}
+    out = perf_diff.diff_captures(r16, doctored, baseline)
+    assert any("flight recorder overhead" in r
+               for r in out["regressions"])
+
+
+def test_diff_strict_promotes_wallclock_to_gate(r16, baseline):
+    doctored = copy.deepcopy(r16)
+    doctored["warm"]["qps"] = float(r16["warm"]["qps"]) * 0.5
+    relaxed = perf_diff.diff_captures(r16, doctored, baseline)
+    assert relaxed["regressions"] == []
+    assert any("warm qps" in w for w in relaxed["warnings"])
+    strict = perf_diff.diff_captures(r16, doctored, baseline,
+                                     strict=True)
+    assert any("warm qps" in r for r in strict["regressions"])
+
+
+def test_diff_cli_exit_codes(tmp_path, r16, r17):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(r16))
+    b.write_text(json.dumps(r17))
+    assert perf_diff.main([str(a), str(b)]) == 0
+    doctored = copy.deepcopy(r17)
+    doctored["results_identical"] = False
+    b.write_text(json.dumps(doctored))
+    assert perf_diff.main([str(a), str(b)]) == 1
+    assert perf_diff.main([str(a), str(tmp_path / "nope.json")]) == 2
+
+
+# -- test_budget -------------------------------------------------------
+
+DURATIONS = """\
+============= slowest 50 durations =============
+12.34s call     tests/test_serving.py::test_warm_mix
+3.21s call     tests/test_fleet.py::test_churn[2]
+0.45s setup    tests/test_serving.py::test_warm_mix
+0.10s teardown tests/test_serving.py::test_warm_mix
+(142 durations < 0.005s hidden.  Use -vv to show these durations.)
+= 900 passed in 700.00s =
+"""
+
+
+def test_budget_parses_and_sorts():
+    rows = test_budget.parse_durations(DURATIONS)
+    assert rows[0] == (12.34, "call", "tests/test_serving.py::"
+                                      "test_warm_mix")
+    assert [r[1] for r in rows] == ["call", "call", "setup",
+                                    "teardown"]
+
+
+def test_budget_ceiling_counts_call_phase_only():
+    rows = test_budget.parse_durations(DURATIONS)
+    # the 0.45s setup shares a fixture — never double-charged
+    assert test_budget.over_ceiling(rows, 10.0) == \
+        [(12.34, "call", "tests/test_serving.py::test_warm_mix")]
+    assert test_budget.over_ceiling(rows, 20.0) == []
+    text = test_budget.report(rows)
+    assert "test_warm_mix" in text and "15.6s total" in text
+
+
+def test_budget_cli(tmp_path, capsys):
+    f = tmp_path / "durations.txt"
+    f.write_text(DURATIONS)
+    assert test_budget.main(["--file", str(f), "--ceiling",
+                             "20"]) == 0
+    capsys.readouterr()  # drain the plain-text report
+    assert test_budget.main(["--file", str(f), "--ceiling", "5",
+                             "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["tests_measured"] == 2
+    assert [b["test"] for b in doc["breaches"]] == \
+        ["tests/test_serving.py::test_warm_mix"]
+
+
+# -- bench_trajectory --------------------------------------------------
+
+
+def test_trajectory_builds_from_checked_in_captures():
+    doc = bench_trajectory.build(REPO)
+    rounds = [r["round"] for r in doc["serving_rounds"]
+              if "error" not in r]
+    assert 16 in rounds and 17 in rounds
+    assert doc["summary"]["serving_rounds"] >= len(rounds)
+    assert doc["summary"]["warm_qps_geomean_all_rounds"] > 0
+    # every row carries the environment caveat AS A FIELD
+    for r in doc["serving_rounds"]:
+        if "error" not in r:
+            assert r["env_caveat"] == bench_trajectory.ENV_CAVEAT
+    r17_row = next(r for r in doc["serving_rounds"]
+                   if r["round"] == 17)
+    assert r17_row["driver_share"] is not None
+    assert r17_row["results_identical"] is True
+
+
+def test_trajectory_tolerates_rotten_capture(tmp_path):
+    (tmp_path / "BENCH_SERVING_r01.json").write_text("{not json")
+    (tmp_path / "BENCH_SERVING_r02.json").write_text(json.dumps(
+        {"warm": {"qps": 2.0, "p99_ms": 10.0,
+                  "ledger": {"wall_ms": 100.0,
+                             "categories_ms": {"driver.step": 10.0}}},
+         "cold": {"wall_s": 5.0}, "mix": ["q1"], "clients": 1}))
+    doc = bench_trajectory.build(str(tmp_path))
+    assert doc["serving_rounds"][0]["error"]
+    row = doc["serving_rounds"][1]
+    assert row["warm_qps"] == 2.0
+    assert row["driver_share"] == pytest.approx(0.1)
+    assert doc["summary"]["latest_round"] == 2

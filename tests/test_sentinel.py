@@ -1,0 +1,291 @@
+"""Perf sentinel (telemetry/sentinel.py): sketch math, the detector
+catalogue driven with explicit clocks, and the injected-regression
+oracle — a deliberately de-optimized kernel family must trip exactly
+latency_shift while byte-identity holds, and an unperturbed warm run
+must trip nothing."""
+
+import pytest
+
+from presto_tpu.telemetry import flight as _flight
+from presto_tpu.telemetry import sentinel
+from presto_tpu.telemetry.metrics import METRICS
+from presto_tpu.telemetry.sentinel import (LatencyTracker, Sentinel,
+                                           WindowSketch)
+
+
+# -- WindowSketch ------------------------------------------------------
+
+
+def test_sketch_quantiles_and_mad():
+    sk = WindowSketch(window=128)
+    for v in range(1, 101):          # 1..100
+        sk.observe(float(v))
+    snap = sk.snapshot()
+    assert snap["count"] == 100
+    assert snap["p50_ms"] == pytest.approx(50.0, abs=2.0)
+    assert snap["p95_ms"] == pytest.approx(95.0, abs=2.0)
+    assert snap["p99_ms"] == pytest.approx(99.0, abs=2.0)
+    # MAD of a uniform ramp is ~quarter of the range
+    assert snap["mad_ms"] == pytest.approx(25.0, abs=2.0)
+    assert snap["window"] == 128
+
+
+def test_sketch_window_bounds_memory_and_forgets():
+    sk = WindowSketch(window=16)
+    for _ in range(100):
+        sk.observe(1000.0)           # ancient slow regime
+    for _ in range(16):
+        sk.observe(1.0)              # new fast regime fills window
+    snap = sk.snapshot()
+    assert snap["count"] == 16
+    assert snap["p99_ms"] == pytest.approx(1.0)
+
+
+def test_sketch_empty():
+    snap = WindowSketch().snapshot()
+    assert snap["count"] == 0 and snap["p99_ms"] == 0.0
+
+
+# -- LatencyTracker ----------------------------------------------------
+
+
+def test_tracker_lru_bounds_key_space():
+    tr = LatencyTracker()
+    for i in range(sentinel.MAX_KEYS + 50):
+        tr._observe("query", f"fp{i}", 1.0)
+    keys = [k for k, _ in tr.sketches("query")]
+    assert len(keys) == sentinel.MAX_KEYS
+    assert "fp0" not in keys         # coldest got evicted
+    assert f"fp{sentinel.MAX_KEYS + 49}" in keys
+
+
+def test_tracker_rows_sorted_and_shaped():
+    tr = LatencyTracker()
+    tr._observe("kernel", "b_fam", 2.0)
+    tr._observe("kernel", "a_fam", 1.0)
+    tr._observe("query", "fp1", 5.0)
+    rows = tr.snapshot_rows()
+    assert [(r["scope"], r["key"]) for r in rows] == \
+        [("kernel", "a_fam"), ("kernel", "b_fam"), ("query", "fp1")]
+    for r in rows:
+        for col in ("count", "p50_ms", "p95_ms", "p99_ms", "mad_ms",
+                    "window"):
+            assert col in r
+
+
+# -- detectors (private Sentinel instances, explicit clocks) -----------
+
+
+def _mk(min_queries=3, **cfg):
+    s = Sentinel(tracker=LatencyTracker())
+    s.config["min_queries"] = min_queries
+    s.config.update(cfg)
+    return s
+
+
+def _led(wall_ms, driver_ms=0.0, unattr_ms=0.0):
+    return {"wall_ms": wall_ms,
+            "categories_ms": {"driver.step": driver_ms,
+                              "dispatch": wall_ms - driver_ms},
+            "unattributed_ms": unattr_ms}
+
+
+def test_driver_share_creep_fires_and_damps():
+    s = _mk()
+    for _ in range(4):
+        s.observe_ledger(_led(100.0, driver_ms=50.0), now=0.0)
+    fired = s.check(now=1.0)
+    assert [a["detector"] for a in fired] == ["driver_share_creep"]
+    assert fired[0]["value"] == pytest.approx(0.5)
+    # damped inside realert_s...
+    assert s.check(now=10.0) == []
+    # ...and re-alerts after it elapses
+    fired = s.check(now=1.0 + s.config["realert_s"] + 1)
+    assert [a["detector"] for a in fired] == ["driver_share_creep"]
+
+
+def test_unattributed_spike_fires():
+    s = _mk()
+    for _ in range(4):
+        s.observe_ledger(_led(100.0, unattr_ms=30.0), now=0.0)
+    fired = s.check(now=1.0)
+    assert [a["detector"] for a in fired] == ["unattributed_spike"]
+
+
+def test_ledger_detectors_wait_for_min_queries():
+    s = _mk(min_queries=8)
+    for _ in range(4):
+        s.observe_ledger(_led(100.0, driver_ms=90.0), now=0.0)
+    assert s.check(now=1.0) == []
+
+
+def test_retrace_storm_counts_fresh_traces_in_window():
+    s = _mk()
+    s.check(now=0.0)                 # establishes the base sample
+    METRICS.inc("presto_tpu_kernel_retrace_total", 10,
+                kernel="sentinel_test_fam", reason="test")
+    fired = s.check(now=10.0)
+    assert [a["detector"] for a in fired] == ["retrace_storm"]
+    assert fired[0]["value"] >= s.config["retrace_storm"]["count"]
+
+
+def test_rtt_inflation_flags_only_slow_workers():
+    s = _mk()
+    s.rtt_supplier = lambda: [("http://w1:8080", 500.0),
+                              ("http://w2:8080", 10.0)]
+    fired = s.check(now=1.0)
+    assert [(a["detector"], a["subject"]) for a in fired] == \
+        [("rtt_inflation", "http://w1:8080")]
+
+
+def test_latency_shift_against_checked_in_baseline():
+    s = _mk()
+    s.install_baseline({
+        "kernel_families": {"agg_step": {"p99_ms": 10.0}},
+        "latency_shift": {"mult": 2.0, "mad_k": 6.0,
+                         "min_samples": 5}})
+    for _ in range(20):
+        s.tracker.observe_kernel("agg_step", 10.0)
+    for _ in range(3):               # tail regression: p99 catches it
+        s.tracker.observe_kernel("agg_step", 100.0)
+    fired = s.check(now=1.0)
+    assert [(a["detector"], a["subject"]) for a in fired] == \
+        [("latency_shift", "kernel:agg_step")]
+
+
+def test_latency_shift_against_rotated_window():
+    # no baseline entry: the reference is the window rotated one
+    # rotate_s ago — the "vs N minutes ago" comparison
+    s = _mk()
+    s.config["latency_shift"] = {"mult": 2.0, "mad_k": 6.0,
+                                 "min_samples": 5}
+    for _ in range(25):
+        s.tracker.observe_kernel("join_probe", 10.0)
+    # first check: no reference yet (nothing rotated) -> silent, and
+    # the rotation at the end snapshots the healthy window
+    assert s.check(now=130.0) == []
+    for _ in range(3):
+        s.tracker.observe_kernel("join_probe", 200.0)
+    fired = s.check(now=140.0)
+    assert [(a["detector"], a["subject"]) for a in fired] == \
+        [("latency_shift", "kernel:join_probe")]
+
+
+def test_alert_ships_flight_event_and_counter(monkeypatch):
+    monkeypatch.setattr(_flight, "ENABLED", True)
+    before = METRICS.by_label("presto_tpu_sentinel_alerts_total",
+                              "detector").get("driver_share_creep", 0)
+    s = _mk()
+    for _ in range(4):
+        s.observe_ledger(_led(100.0, driver_ms=80.0), now=0.0)
+    fired = s.check(now=1.0)
+    assert fired
+    after = METRICS.by_label("presto_tpu_sentinel_alerts_total",
+                             "detector")["driver_share_creep"]
+    assert after == before + 1
+    kinds = [e["kind"] for e in _flight.snapshot_dicts(64)]
+    assert "sentinel" in kinds
+    snap = s.snapshot()
+    assert snap["checks"] == 1
+    assert snap["alerts_recent"][-1]["detector"] == \
+        "driver_share_creep"
+    assert "age_s" in snap["alerts_recent"][-1]
+
+
+def test_baseline_file_loads_and_overrides():
+    s = Sentinel(tracker=LatencyTracker())
+    assert s.load_baseline_file()    # the checked-in baseline parses
+    assert s.config["driver_share_max"] == \
+        s.baseline["driver_share_max"]
+    # a bogus path is survivable (baseline is optional)
+    assert s.load_baseline_file("/nonexistent.json") is False
+
+
+# -- injected-regression oracle ----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def warm_runner():
+    from presto_tpu.runner import LocalRunner
+    r = LocalRunner("tpch", "tiny")
+    # two passes: the first compiles (excluded from sketches), the
+    # second runs warm and seeds the latency baselines
+    for _ in range(2):
+        r.execute("select returnflag, count(*), sum(extendedprice) "
+                  "from lineitem group by returnflag "
+                  "order by returnflag")
+    return r
+
+
+def _warm_rows(runner):
+    return runner.execute(
+        "select returnflag, count(*), sum(extendedprice) "
+        "from lineitem group by returnflag order by returnflag"
+    ).rows()
+
+
+def test_injected_regression_oracle(warm_runner):
+    """Deliberately de-optimize ONE kernel family (a 30ms stall inside
+    its timed window); the sentinel must fire latency_shift for that
+    family — and nothing else — while results stay byte-identical.
+    Before the stall, an unperturbed warm run must fire nothing."""
+    from presto_tpu.telemetry import kernels
+
+    # find the families this query's warm path actually exercises —
+    # via the call counters, NOT sketch lengths: under the full suite
+    # the 256-deep windows are already saturated and len() can't grow
+    before = METRICS.by_label("presto_tpu_kernel_calls_total",
+                              "kernel")
+    clean_rows = _warm_rows(warm_runner)
+    after = METRICS.by_label("presto_tpu_kernel_calls_total",
+                             "kernel")
+    tracked = {k for k, _ in sentinel.TRACKER.sketches("kernel")}
+    grown = {k: after[k] - before.get(k, 0) for k in after
+             if after[k] > before.get(k, 0) and k in tracked}
+    assert grown, "warm run must feed the kernel sketches"
+    family = max(grown, key=lambda k: grown[k])
+
+    # deepen the healthy window: the rotated reference is only used
+    # once it holds min_samples, and a deeper window of clean runs
+    # makes its p99 absorb ambient load noise
+    for _ in range(4):
+        _warm_rows(warm_runner)
+
+    s = Sentinel(tracker=sentinel.TRACKER)
+    # mult 8x: the injected stall is a >100x shift on a warm sub-ms
+    # kernel, while ambient scheduler noise on a loaded shared host
+    # stays well under 8x the window's own max
+    s.config["latency_shift"] = {"mult": 8.0, "mad_k": 8.0,
+                                 "min_samples": 3}
+    # first check: rotates the healthy windows in as references
+    s.check(now=130.0)
+    assert s._latency_reference("kernel", family) is not None
+    # unperturbed warm runs: NO false positives
+    _warm_rows(warm_runner)
+    assert s.check(now=140.0) == [], "false positive on healthy run"
+
+    alerts_before = METRICS.by_label(
+        "presto_tpu_sentinel_alerts_total",
+        "detector").get("latency_shift", 0)
+    # the stall must dominate the window's p99: with a saturated
+    # 256-deep window the p99 index sits ~3 from the top, so inject
+    # enough slow samples to own that tail
+    kernels.set_handicap(family, 30.0)
+    try:
+        slow_rows = _warm_rows(warm_runner)
+        for _ in range(7):
+            _warm_rows(warm_runner)
+    finally:
+        kernels.set_handicap(None)
+
+    # the regression is performance-only: bytes identical
+    assert slow_rows == clean_rows
+
+    fired = s.check(now=150.0)
+    assert fired, "sentinel missed the injected regression"
+    assert {a["detector"] for a in fired} == {"latency_shift"}
+    subjects = {a["subject"] for a in fired}
+    assert f"kernel:{family}" in subjects
+    alerts_after = METRICS.by_label(
+        "presto_tpu_sentinel_alerts_total", "detector")["latency_shift"]
+    assert alerts_after > alerts_before
